@@ -1,0 +1,24 @@
+"""partitionedarrays_jl_tpu — a TPU-native framework for partitioned
+(distributed) vectors and sparse matrices.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of
+`fredrikekre/PartitionedArrays.jl` (the reference; see SURVEY.md): data
+algebra written once against an abstract "value per part" type and executed
+by interchangeable backends — a sequential host backend (the debugging /
+determinism oracle) and a TPU backend where each part is one device of a
+`jax.sharding.Mesh`, halo exchange lowers to `ppermute` over ICI, and whole
+solver loops compile to single XLA programs.
+
+Import convention::
+
+    import partitionedarrays_jl_tpu as pa
+"""
+
+from .parallel import *  # noqa: F401,F403
+from .parallel import __all__ as _parallel_all
+from .utils import *  # noqa: F401,F403
+from .utils import __all__ as _utils_all
+
+__version__ = "0.1.0"
+
+__all__ = list(_parallel_all) + list(_utils_all)

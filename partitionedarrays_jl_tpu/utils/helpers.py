@@ -1,0 +1,52 @@
+"""Contract-enforcement helpers.
+
+TPU-native analog of the reference's error macros (reference:
+src/Helpers.jl:6-61 — `@abstractmethod`, `@notimplemented`, `@check`).
+Python has no compile-time boundscheck elision, so `check` is gated by an
+environment flag instead: set ``PA_TPU_CHECKS=0`` to strip contract checks in
+production runs (mirrors Julia's ``--boundscheck=no``).
+"""
+from __future__ import annotations
+
+import os
+
+_CHECKS_ENABLED = os.environ.get("PA_TPU_CHECKS", "1") != "0"
+
+
+class AbstractMethodError(NotImplementedError):
+    pass
+
+
+def abstractmethod(obj=None, name: str = "") -> None:
+    """Raise: a subtype forgot to implement part of its interface contract."""
+    raise AbstractMethodError(
+        f"abstract method {name or ''} called on {type(obj).__name__}: "
+        "this method is part of an interface definition and concrete "
+        "implementations must override it"
+    )
+
+
+def notimplemented(msg: str = "this case is not yet implemented") -> None:
+    raise NotImplementedError(msg)
+
+
+def notimplementedif(condition: bool, msg: str = "this case is not yet implemented") -> None:
+    if condition:
+        notimplemented(msg)
+
+
+def unreachable(msg: str = "this line of code cannot be reached") -> None:
+    raise AssertionError(msg)
+
+
+def checks_enabled() -> bool:
+    return _CHECKS_ENABLED
+
+
+def check(condition, msg: str = "check failed") -> None:
+    """Cheap contract assertion, strippable via PA_TPU_CHECKS=0.
+
+    Reference: src/Helpers.jl:50-61 (`@check`).
+    """
+    if _CHECKS_ENABLED and not condition:
+        raise AssertionError(msg)

@@ -1,0 +1,19 @@
+"""Test harness configuration.
+
+Mirrors the reference's CI story (SURVEY.md §4): the whole tree runs on one
+machine. The TPU-backend tests run on a virtual 8-device CPU mesh via
+``xla_force_host_platform_device_count`` (the `mpiexec -n 8` analog), and
+float64 is enabled so correctness checks match the sequential oracle.
+
+This file must set the environment before anything imports jax.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

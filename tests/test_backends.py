@@ -1,0 +1,108 @@
+"""L1 tests: backend protocol, prun, map_parts on the sequential backend.
+
+Behavior mirrors reference src/Interfaces.jl:12-124 and
+src/SequentialBackend.jl, 0-based.
+"""
+import numpy as np
+import pytest
+
+from partitionedarrays_jl_tpu import (
+    MAIN,
+    SequentialData,
+    get_backend,
+    get_main_part,
+    get_part,
+    get_part_ids,
+    i_am_main,
+    map_main,
+    map_parts,
+    num_parts,
+    prun,
+    prun_debug,
+    sequential,
+    unzip,
+)
+
+
+def test_prun_linear():
+    out = {}
+
+    def driver(parts):
+        out["n"] = num_parts(parts)
+        out["vals"] = list(parts)
+        return "done"
+
+    assert prun(driver, sequential, 4) == "done"
+    assert out["n"] == 4
+    assert out["vals"] == [0, 1, 2, 3]
+
+
+def test_prun_cartesian_grid():
+    def driver(parts):
+        assert parts.shape == (2, 2)
+        assert list(parts) == [0, 1, 2, 3]
+        return True
+
+    assert prun(driver, sequential, (2, 2))
+    assert prun_debug(driver, sequential, (2, 2))
+
+
+def test_map_parts_and_broadcast():
+    parts = sequential.get_part_ids(3)
+    squares = map_parts(lambda p: p * p, parts)
+    assert list(squares) == [0, 1, 4]
+    shifted = map_parts(lambda p, s: p + s, parts, 10)  # non-PData broadcast
+    assert list(shifted) == [10, 11, 12]
+    both = map_parts(lambda a, b: a + b, squares, shifted)
+    assert list(both) == [10, 12, 16]
+
+
+def test_map_parts_mismatched_counts():
+    a = sequential.get_part_ids(3)
+    b = sequential.get_part_ids(4)
+    with pytest.raises(AssertionError):
+        map_parts(lambda x, y: x + y, a, b)
+
+
+def test_get_part_and_main():
+    parts = sequential.get_part_ids(4)
+    vals = map_parts(lambda p: p * 100, parts)
+    assert get_part(vals, 2) == 200
+    assert get_main_part(vals) == 0
+    with pytest.raises(AssertionError):
+        get_part(vals)  # no local part in a 4-part sequential run
+    single = sequential.get_part_ids(1)
+    assert get_part(single) == 0
+
+
+def test_i_am_main_and_map_main():
+    parts = sequential.get_part_ids(3)
+    assert i_am_main(parts)
+    r = map_main(lambda p: p + 42, parts)
+    assert list(r) == [42, None, None]
+
+
+def test_get_part_ids_from_pdata_and_backend():
+    parts = sequential.get_part_ids((2, 3))
+    again = get_part_ids(parts)
+    assert again.shape == (2, 3)
+    assert list(again) == list(range(6))
+    direct = get_part_ids(sequential, 2)
+    assert list(direct) == [0, 1]
+    assert get_backend(parts) is sequential
+    assert MAIN == 0
+
+
+def test_unzip():
+    parts = sequential.get_part_ids(3)
+    pairs = map_parts(lambda p: (p, p * 2), parts)
+    a, b = unzip(pairs, 2)
+    assert list(a) == [0, 1, 2]
+    assert list(b) == [0, 2, 4]
+
+
+def test_map_parts_with_numpy_chunks():
+    parts = sequential.get_part_ids(2)
+    chunks = map_parts(lambda p: np.arange(3) + 10 * p, parts)
+    doubled = map_parts(lambda c: c * 2, chunks)
+    assert list(doubled.get_part(1)) == [20, 22, 24]

@@ -1,0 +1,76 @@
+"""L0 unit tests: ragged Table storage + ptr arithmetic.
+
+Mirrors the reference's Helpers coverage (reference: src/Helpers.jl:63-156),
+0-based.
+"""
+import numpy as np
+import pytest
+
+from partitionedarrays_jl_tpu import (
+    Table,
+    counts_to_ptrs,
+    empty_table,
+    generate_data_and_ptrs,
+    get_data,
+    get_ptrs,
+    length_to_ptrs,
+    ptrs_to_counts,
+    rewind_ptrs,
+)
+
+
+def test_length_to_ptrs_roundtrip():
+    counts = np.array([3, 0, 2, 4])
+    ptrs = length_to_ptrs(counts)
+    assert list(ptrs) == [0, 3, 3, 5, 9]
+    assert list(ptrs_to_counts(ptrs)) == [3, 0, 2, 4]
+    assert counts_to_ptrs is length_to_ptrs
+
+
+def test_rewind_ptrs():
+    ptrs = np.array([3, 5, 9, 9], dtype=np.int32)
+    rewind_ptrs(ptrs)
+    assert list(ptrs) == [0, 3, 5, 9]
+
+
+def test_generate_data_and_ptrs():
+    rows = [np.array([1, 2]), np.array([], dtype=np.int64), np.array([3, 4, 5])]
+    data, ptrs = generate_data_and_ptrs(rows)
+    assert list(data) == [1, 2, 3, 4, 5]
+    assert list(ptrs) == [0, 2, 2, 5]
+
+
+def test_table_rows_and_views():
+    t = Table.from_rows([[1.0, 2.0], [], [3.0]])
+    assert len(t) == 3
+    assert list(t[0]) == [1.0, 2.0]
+    assert list(t[1]) == []
+    assert list(t[2]) == [3.0]
+    assert t.row_length(1) == 0
+    assert list(t.counts()) == [2, 0, 1]
+    # rows are views: writing through them mutates the flat data
+    t[0][:] = [7.0, 8.0]
+    assert list(get_data(t)[:2]) == [7.0, 8.0]
+    assert list(get_ptrs(t)) == [0, 2, 2, 3]
+
+
+def test_table_equality_and_empty():
+    a = Table.from_rows([[1, 2], [3]])
+    b = Table.from_rows([[1, 2], [3]])
+    c = Table.from_rows([[1], [2, 3]])
+    assert a == b
+    assert a != c
+    e = empty_table(np.int32)
+    assert len(e) == 1 - 1
+    assert list(e.counts()) == []
+
+
+def test_table_from_all_empty_rows():
+    t = Table.from_rows([[], [], []])
+    assert len(t) == 3
+    assert all(t.row_length(i) == 0 for i in range(3))
+
+
+def test_table_bad_ptrs_rejected():
+    with pytest.raises(AssertionError):
+        Table(np.zeros(2), np.array([1, 2], dtype=np.int32))
