@@ -225,11 +225,16 @@ def async_exchange(
             counts_rcv,
         )
     else:
-        def _alloc(pr, ds):
-            ds = np.asarray(ds)
-            return np.zeros(len(np.asarray(pr)), dtype=ds.dtype if ds.size else np.float64)
-
-        data_rcv = map_parts(_alloc, parts_rcv, data_snd)
+        # The payload dtype is a global property of the exchange: a part with
+        # an empty snd list may still receive, so resolve the dtype across
+        # all parts (host metadata in both backends).
+        dtypes = [
+            np.asarray(d).dtype for d in data_snd.part_values() if np.asarray(d).size
+        ]
+        dtype = np.result_type(*dtypes) if dtypes else np.float64
+        data_rcv = map_parts(
+            lambda pr: np.zeros(len(np.asarray(pr)), dtype=dtype), parts_rcv
+        )
     t = async_exchange_into(data_rcv, data_snd, parts_rcv, parts_snd)
     return data_rcv, t
 

@@ -1,0 +1,494 @@
+"""PRange: the distributed index space 0..ngids-1 (L4).
+
+TPU-native analog of reference src/Interfaces.jl:964-1574. A PRange is the
+`axes` object of PVector/PSparseMatrix: a global size plus a per-part
+partition (PData of index sets), a lazily built Exchanger, and an optional
+global gid->owner map. The constructor catalog below is the framework's
+partitioning-strategy menu (reference table at SURVEY.md §2/L4):
+
+* 1-D balanced block (`uniform_partition`)
+* variable block sizes (`variable_partition`), with or without explicit
+  ghosts
+* N-D Cartesian blocks, plain / with a 1-cell halo / periodic per dimension
+  (`cartesian_partition`) — the FD/FV stencil layout; on TPU the halo graph
+  maps 1:1 onto ICI torus neighbors
+* fully general partitions from explicit `IndexSet`s
+
+All construction is host-side NumPy planning; nothing here touches a
+device. Lid numbering is **owned-first** throughout (a from-scratch design
+choice: device code gets owned data as a plain array prefix).
+
+C-order (row-major) linearization everywhere: parts and gids.
+"""
+from __future__ import annotations
+
+import copy as _copy
+import math
+import operator
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.helpers import check, notimplementedif
+from ..utils.table import INDEX_DTYPE
+from .backends import AbstractPData, get_part_ids, map_parts
+from .collectives import preduce, xscan_all
+from .exchanger import Exchanger
+from .index_sets import (
+    GID_DTYPE,
+    AbstractIndexSet,
+    CartesianGidToPart,
+    IndexRange,
+    IndexSet,
+    LinearGidToPart,
+)
+
+
+class WithGhost:
+    """Tag: build the 1-cell halo (reference: src/Interfaces.jl:1160-1164)."""
+
+    def __repr__(self):
+        return "with_ghost"
+
+
+class NoGhost:
+    def __repr__(self):
+        return "no_ghost"
+
+
+with_ghost = WithGhost()
+no_ghost = NoGhost()
+
+
+class PRange:
+    """Reference: src/Interfaces.jl:964-1006. Mutable so ghosts can be
+    added after construction (which invalidates the cached Exchanger,
+    mirroring the reference's rebuild at :1510)."""
+
+    def __init__(
+        self,
+        ngids: int,
+        partition: AbstractPData,
+        gid_to_part=None,
+        ghost: bool = True,
+        exchanger: Optional[Exchanger] = None,
+        neighbors: Optional[AbstractPData] = None,
+        reuse_parts_rcv: bool = False,
+    ):
+        self.ngids = int(ngids)
+        self.partition = partition
+        self.gid_to_part = gid_to_part
+        self.ghost = ghost
+        self._exchanger = exchanger
+        self._neighbors = neighbors
+        self._reuse_parts_rcv = reuse_parts_rcv
+
+    # --- range protocol ------------------------------------------------
+    def __len__(self) -> int:
+        return self.ngids
+
+    @property
+    def num_parts(self) -> int:
+        return self.partition.num_parts
+
+    @property
+    def exchanger(self) -> Exchanger:
+        if self._exchanger is None:
+            if self.ghost:
+                self._exchanger = Exchanger.from_partition(
+                    self.partition,
+                    neighbors=self._neighbors,
+                    reuse_parts_rcv=self._reuse_parts_rcv,
+                )
+            else:
+                self._exchanger = Exchanger.empty(get_part_ids(self.partition))
+        return self._exchanger
+
+    def invalidate_exchanger(self):
+        self._exchanger = None
+
+    # --- per-part size queries ----------------------------------------
+    def num_lids(self) -> AbstractPData:
+        return map_parts(lambda i: i.num_lids, self.partition)
+
+    def num_oids(self) -> AbstractPData:
+        return map_parts(lambda i: i.num_oids, self.partition)
+
+    def num_hids(self) -> AbstractPData:
+        return map_parts(lambda i: i.num_hids, self.partition)
+
+    def copy(self) -> "PRange":
+        return PRange(
+            self.ngids,
+            map_parts(_copy.deepcopy, self.partition),
+            gid_to_part=self.gid_to_part,
+            ghost=self.ghost,
+            neighbors=self._neighbors,
+            reuse_parts_rcv=self._reuse_parts_rcv,
+        )
+
+    def __repr__(self):
+        return f"PRange(ngids={self.ngids}, nparts={self.num_parts}, ghost={self.ghost})"
+
+
+# ---------------------------------------------------------------------------
+# balanced 1-D blocks
+# ---------------------------------------------------------------------------
+
+
+def _block_sizes(n: int, k: int) -> np.ndarray:
+    """Balanced block sizes; the remainder is spread over the trailing
+    blocks (reference `_oid_to_gid`: src/Interfaces.jl:1307-1319)."""
+    base, rem = divmod(n, k)
+    sizes = np.full(k, base, dtype=GID_DTYPE)
+    if rem:
+        sizes[k - rem :] += 1
+    return sizes
+
+
+def _block_firsts(n: int, k: int) -> np.ndarray:
+    firsts = np.zeros(k, dtype=GID_DTYPE)
+    np.cumsum(_block_sizes(n, k)[:-1], out=firsts[1:])
+    return firsts
+
+
+def uniform_partition(parts: AbstractPData, ngids: int) -> PRange:
+    """1-D balanced block partition, no ghosts
+    (reference: src/Interfaces.jl:1014-1030)."""
+    nparts = parts.num_parts
+    sizes = _block_sizes(ngids, nparts)
+    firsts = _block_firsts(ngids, nparts)
+    partition = map_parts(
+        lambda p: IndexRange(p, int(sizes[p]), int(firsts[p])), parts
+    )
+    g2p = LinearGidToPart(ngids, firsts)
+    return PRange(ngids, partition, gid_to_part=g2p, ghost=False)
+
+
+def variable_partition(
+    parts: AbstractPData,
+    noids: AbstractPData,
+    ngids: Optional[int] = None,
+    part_to_firstgid: Optional[np.ndarray] = None,
+    hid_to_gid: Optional[AbstractPData] = None,
+    hid_to_part: Optional[AbstractPData] = None,
+    neighbors: Optional[AbstractPData] = None,
+) -> PRange:
+    """Variable block sizes; `ngids` by reduction and firstgid by exclusive
+    scan when not given (reference: src/Interfaces.jl:1038-1112). With
+    `hid_to_gid`/`hid_to_part`, builds IndexRanges **with explicit ghosts**
+    and a (lazy) Exchanger."""
+    if part_to_firstgid is None:
+        firstgid, total = xscan_all(operator.add, noids, init=0, with_total=True)
+        if ngids is None:
+            ngids = int(total)
+        firsts_main = np.asarray(firstgid.get_part(0), dtype=GID_DTYPE)
+    else:
+        firsts_main = np.asarray(part_to_firstgid, dtype=GID_DTYPE)
+        check(ngids is not None, "ngids required with explicit part_to_firstgid")
+
+    def _mk(p, n, *ghosts):
+        if ghosts:
+            hg, hp = ghosts
+            return IndexRange(p, int(n), int(firsts_main[p]), hg, hp)
+        return IndexRange(p, int(n), int(firsts_main[p]))
+
+    parts_ids = get_part_ids(parts)
+    if hid_to_gid is not None:
+        partition = map_parts(_mk, parts_ids, noids, hid_to_gid, hid_to_part)
+        ghost = True
+    else:
+        partition = map_parts(_mk, parts_ids, noids)
+        ghost = False
+    g2p = LinearGidToPart(ngids, firsts_main)
+    return PRange(
+        ngids, partition, gid_to_part=g2p, ghost=ghost, neighbors=neighbors
+    )
+
+
+# ---------------------------------------------------------------------------
+# N-D Cartesian blocks
+# ---------------------------------------------------------------------------
+
+
+def _part_coords(p: int, pshape: Tuple[int, ...]) -> Tuple[int, ...]:
+    return tuple(int(c) for c in np.unravel_index(p, pshape))
+
+
+def _cartesian_box(
+    coord: Tuple[int, ...], ngids: Tuple[int, ...], pshape: Tuple[int, ...]
+):
+    """Owned cell range [lo, hi) per dimension for a part coordinate."""
+    lo, hi = [], []
+    for d, (n, k, c) in enumerate(zip(ngids, pshape, coord)):
+        firsts = _block_firsts(n, k)
+        sizes = _block_sizes(n, k)
+        lo.append(int(firsts[c]))
+        hi.append(int(firsts[c] + sizes[c]))
+    return lo, hi
+
+
+def _extended_dim(
+    lo: int, hi: int, n: int, k: int, periodic: bool
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Extended (1-cell halo) coordinates for one dimension.
+
+    Returns (ext_cells, wrapped_cells): `ext_cells` are the *logical* cell
+    positions (may be -1 or n under periodic wrap), `wrapped_cells` the
+    actual global cell ids. Non-periodic halos are clamped at the domain
+    boundary; a dimension with a single part gets no extension (it already
+    owns every cell). Reference: the per-dimension 1-cell-halo maps of
+    src/Interfaces.jl:1307-1499 (`_oid_to_gid`/`_lid_to_gid` ± periodic).
+    """
+    if k == 1:
+        cells = np.arange(lo, hi, dtype=GID_DTYPE)
+        return cells, cells
+    ext = np.arange(lo - 1, hi + 1, dtype=GID_DTYPE)
+    if periodic:
+        return ext, np.mod(ext, n)
+    keep = (ext >= 0) & (ext < n)
+    return ext[keep], ext[keep]
+
+
+def cartesian_partition(
+    parts: AbstractPData,
+    ngids: Sequence[int],
+    ghost=no_ghost,
+    periodic: Optional[Sequence[bool]] = None,
+) -> PRange:
+    """N-D Cartesian block partition (reference:
+    src/Interfaces.jl:1114-1231): plain (`no_ghost`), with a 1-cell halo in
+    every direction (`with_ghost` — the FD stencil layout, diagonal
+    neighbors included), optionally with periodic wrap per dimension.
+
+    The halo neighbor graph is symmetric, so the Exchanger reuses
+    `parts_rcv` as `parts_snd` (reference: src/Interfaces.jl:1191).
+    """
+    ngids = tuple(int(n) for n in ngids)
+    pshape = parts.shape
+    check(
+        len(pshape) == len(ngids),
+        f"part grid rank {len(pshape)} != index-space rank {len(ngids)}",
+    )
+    nglobal = math.prod(ngids)
+    if periodic is None:
+        periodic = tuple(False for _ in ngids)
+    periodic = tuple(bool(b) for b in periodic)
+    for d, (k, per) in enumerate(zip(pshape, periodic)):
+        notimplementedif(
+            per and k == 1,
+            f"periodic dimension {d} with a single part is not supported",
+        )
+    dim_firsts = tuple(_block_firsts(n, k) for n, k in zip(ngids, pshape))
+    g2p = CartesianGidToPart(ngids, dim_firsts)
+    halo = isinstance(ghost, WithGhost)
+
+    def _mk(p):
+        coord = _part_coords(p, pshape)
+        lo, hi = _cartesian_box(coord, ngids, pshape)
+        own_ranges = [np.arange(l, h, dtype=GID_DTYPE) for l, h in zip(lo, hi)]
+        own_grid = np.meshgrid(*own_ranges, indexing="ij")
+        own_gids = np.ravel_multi_index(own_grid, ngids).ravel()
+        if not halo:
+            noids = len(own_gids)
+            return IndexSet(
+                p,
+                own_gids,
+                np.full(noids, p, dtype=INDEX_DTYPE),
+                oid_to_lid=np.arange(noids, dtype=INDEX_DTYPE),
+                hid_to_lid=np.empty(0, dtype=INDEX_DTYPE),
+            )
+        ext = [
+            _extended_dim(l, h, n, k, per)
+            for l, h, n, k, per in zip(lo, hi, ngids, pshape, periodic)
+        ]
+        ext_logical = [e[0] for e in ext]
+        ext_wrapped = [e[1] for e in ext]
+        log_grid = np.meshgrid(*ext_logical, indexing="ij")
+        wrap_grid = np.meshgrid(*ext_wrapped, indexing="ij")
+        owned_mask = np.ones(log_grid[0].shape, dtype=bool)
+        for d, (l, h) in enumerate(zip(lo, hi)):
+            owned_mask &= (log_grid[d] >= l) & (log_grid[d] < h)
+        ghost_mask = ~owned_mask
+        ghost_coords = [g[ghost_mask] for g in wrap_grid]
+        ghost_gids = np.ravel_multi_index(ghost_coords, ngids)
+        ghost_owner = g2p(ghost_gids)
+        lid_to_gid = np.concatenate([own_gids, ghost_gids])
+        lid_to_part = np.concatenate(
+            [np.full(len(own_gids), p, dtype=INDEX_DTYPE), ghost_owner]
+        )
+        noids = len(own_gids)
+        return IndexSet(
+            p,
+            lid_to_gid,
+            lid_to_part,
+            oid_to_lid=np.arange(noids, dtype=INDEX_DTYPE),
+            hid_to_lid=np.arange(noids, noids + len(ghost_gids), dtype=INDEX_DTYPE),
+        )
+
+    parts_ids = get_part_ids(parts)
+    partition = map_parts(_mk, parts_ids)
+    return PRange(
+        nglobal,
+        partition,
+        gid_to_part=g2p,
+        ghost=halo,
+        reuse_parts_rcv=halo,
+    )
+
+
+class CartesianLocalIndices:
+    """One part's block of global Cartesian indices (owned or haloed):
+    per-dimension global coordinate arrays. Reference `PCartesianIndices`
+    (src/Interfaces.jl:1146-1158, :1233-1305); periodic variants hold the
+    wrapped coordinates."""
+
+    __slots__ = ("ranges",)
+
+    def __init__(self, ranges: Tuple[np.ndarray, ...]):
+        self.ranges = tuple(np.asarray(r, dtype=GID_DTYPE) for r in ranges)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(len(r) for r in self.ranges)
+
+    def grid(self):
+        """Meshgrid (ij) of global coordinates of every local cell."""
+        return np.meshgrid(*self.ranges, indexing="ij")
+
+    def gids(self, ngids: Tuple[int, ...]) -> np.ndarray:
+        return np.ravel_multi_index(self.grid(), ngids).ravel()
+
+    def __repr__(self):
+        return f"CartesianLocalIndices(shape={self.shape})"
+
+
+def p_cartesian_indices(
+    parts: AbstractPData,
+    ngids: Sequence[int],
+    ghost=no_ghost,
+    periodic: Optional[Sequence[bool]] = None,
+) -> AbstractPData:
+    """Per-part global CartesianIndices blocks (PData of
+    CartesianLocalIndices). Reference: src/Interfaces.jl:1233-1305."""
+    ngids = tuple(int(n) for n in ngids)
+    pshape = parts.shape
+    if periodic is None:
+        periodic = tuple(False for _ in ngids)
+    halo = isinstance(ghost, WithGhost)
+
+    def _mk(p):
+        coord = _part_coords(p, pshape)
+        lo, hi = _cartesian_box(coord, ngids, pshape)
+        if not halo:
+            return CartesianLocalIndices(
+                tuple(np.arange(l, h, dtype=GID_DTYPE) for l, h in zip(lo, hi))
+            )
+        ranges = []
+        for l, h, n, k, per in zip(lo, hi, ngids, pshape, periodic):
+            _, wrapped = _extended_dim(l, h, n, k, per)
+            ranges.append(wrapped)
+        return CartesianLocalIndices(tuple(ranges))
+
+    return map_parts(_mk, get_part_ids(parts))
+
+
+# ---------------------------------------------------------------------------
+# mutation: post-hoc ghost addition, renumbering
+# ---------------------------------------------------------------------------
+
+
+def add_gids_inplace(
+    r: PRange, gids: AbstractPData, owners: Optional[AbstractPData] = None
+) -> PRange:
+    """Extend each part's partition with ghost entries for `gids` it does
+    not yet hold, and invalidate the Exchanger
+    (reference add_gids!: src/Interfaces.jl:1501-1533)."""
+    if owners is None:
+        check(
+            r.gid_to_part is not None,
+            "add_gids: PRange has no global gid->part map; pass owners explicitly",
+        )
+        owners = map_parts(lambda g: r.gid_to_part(np.asarray(g)), gids)
+
+    map_parts(
+        lambda iset, g, o: iset.add_gids(np.asarray(g), np.asarray(o)),
+        r.partition,
+        gids,
+        owners,
+    )
+    r.ghost = True
+    r.invalidate_exchanger()
+    return r
+
+
+def add_gids(r: PRange, gids: AbstractPData, owners=None) -> PRange:
+    """Copy-then-mutate variant (reference: src/Interfaces.jl:1535-1539)."""
+    r2 = r.copy()
+    add_gids_inplace(r2, gids, owners)
+    return r2
+
+
+def to_lids(r: PRange, ids: AbstractPData) -> AbstractPData:
+    """Bulk in-place gid->lid renumbering of per-part id arrays
+    (reference: src/Interfaces.jl:1541-1544)."""
+    return map_parts(lambda iset, a: iset.to_lids(np.asarray(a)), r.partition, ids)
+
+
+def to_gids(r: PRange, ids: AbstractPData) -> AbstractPData:
+    """Reference: src/Interfaces.jl:1546-1547."""
+    return map_parts(lambda iset, a: iset.to_gids(np.asarray(a)), r.partition, ids)
+
+
+# ---------------------------------------------------------------------------
+# distributed equality checks (reference: src/Interfaces.jl:1549-1574)
+# ---------------------------------------------------------------------------
+
+
+def _all_parts(flags: AbstractPData) -> bool:
+    return bool(preduce(operator.and_, flags, True))
+
+
+def oids_are_equal(a: PRange, b: PRange) -> bool:
+    return _all_parts(map_parts(lambda x, y: x.oids_eq(y), a.partition, b.partition))
+
+
+def hids_are_equal(a: PRange, b: PRange) -> bool:
+    return _all_parts(map_parts(lambda x, y: x.hids_eq(y), a.partition, b.partition))
+
+
+def lids_are_equal(a: PRange, b: PRange) -> bool:
+    return _all_parts(map_parts(lambda x, y: x.lids_eq(y), a.partition, b.partition))
+
+
+def prange_eq(a: PRange, b: PRange) -> bool:
+    return a.ngids == b.ngids and lids_are_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# the `PRange(...)` overload dispatcher (reference constructor catalog)
+# ---------------------------------------------------------------------------
+
+
+def prange(parts: AbstractPData, *args, **kwargs) -> PRange:
+    """Convenience dispatcher mirroring the reference's constructor
+    overloads (reference table: src/Interfaces.jl:998-1231):
+
+    - ``prange(parts, ngids)`` — 1-D balanced block
+    - ``prange(parts, noids_pdata)`` — variable blocks
+    - ``prange(parts, (n1,..,nd))`` — Cartesian, no ghost
+    - ``prange(parts, (n1,..,nd), with_ghost[, periodic])`` — halo'd
+    """
+    if (
+        len(args) == 1
+        and isinstance(args[0], (int, np.integer))
+        and not isinstance(args[0], bool)
+    ):
+        return uniform_partition(parts, int(args[0]))
+    if len(args) == 1 and isinstance(args[0], AbstractPData):
+        return variable_partition(parts, args[0], **kwargs)
+    if len(args) >= 1 and isinstance(args[0], (tuple, list)):
+        ghost = args[1] if len(args) > 1 else kwargs.pop("ghost", no_ghost)
+        periodic = args[2] if len(args) > 2 else kwargs.pop("periodic", None)
+        return cartesian_partition(parts, args[0], ghost, periodic)
+    raise TypeError(f"no prange constructor matches arguments {args!r}")

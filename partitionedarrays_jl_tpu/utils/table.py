@@ -92,14 +92,7 @@ class Table:
 
     @classmethod
     def from_rows(cls, rows: Iterable[Sequence]) -> "Table":
-        rows = [np.asarray(r) for r in rows]
-        counts = np.fromiter((len(r) for r in rows), dtype=INDEX_DTYPE, count=len(rows))
-        ptrs = length_to_ptrs(counts)
-        if int(ptrs[-1]) == 0:
-            dtype = rows[0].dtype if rows else np.float64
-            data = np.empty(0, dtype=dtype)
-        else:
-            data = np.concatenate([r for r in rows if len(r)])
+        data, ptrs = generate_data_and_ptrs(list(rows))
         return cls(data, ptrs)
 
     @classmethod

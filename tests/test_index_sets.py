@@ -1,0 +1,120 @@
+"""L4 unit tests: index sets (pure, no parallelism).
+
+Mirrors the reference's IndexSetsTests coverage (reference:
+test/IndexSetsTests.jl:1-94) re-derived 0-based: IndexRange invariants
+including mutation, lazy lookup behavior, and the explicit IndexSet.
+"""
+import numpy as np
+import pytest
+
+from partitionedarrays_jl_tpu import (
+    CartesianGidToPart,
+    ExtendedIndexRange,
+    IndexRange,
+    IndexSet,
+    LinearGidToPart,
+)
+
+
+def test_index_range_basic():
+    # part 1 owns gids [4, 9) with ghosts 2 (owner 0) and 11 (owner 2)
+    i = IndexRange(1, 5, 4, hid_to_gid=[2, 11], hid_to_part=[0, 2])
+    assert i.num_oids == 5
+    assert i.num_hids == 2
+    assert i.num_lids == 7
+    assert list(i.lid_to_gid) == [4, 5, 6, 7, 8, 2, 11]
+    assert list(i.lid_to_part) == [1, 1, 1, 1, 1, 0, 2]
+    assert list(i.oid_to_lid) == [0, 1, 2, 3, 4]
+    assert list(i.hid_to_lid) == [5, 6]
+    assert list(i.lid_to_ohid) == [0, 1, 2, 3, 4, -1, -2]
+    assert list(i.oid_to_gid) == [4, 5, 6, 7, 8]
+    assert list(i.hid_to_gid) == [2, 11]
+    assert list(i.hid_to_part) == [0, 2]
+
+
+def test_index_range_lookup_and_renumber():
+    i = IndexRange(1, 5, 4, hid_to_gid=[11, 2], hid_to_part=[2, 0])
+    # vectorized gid->lid: arithmetic on owned range, search over ghosts
+    assert list(i.gids_to_lids([4, 8, 11, 2, 99])) == [0, 4, 5, 6, -1]
+    assert list(i.has_gids([4, 99])) == [True, False]
+    ids = np.array([2, 4, 11])
+    i.to_lids(ids)
+    assert list(ids) == [6, 0, 5]
+    i.to_gids(ids)
+    assert list(ids) == [2, 4, 11]
+    with pytest.raises(AssertionError):
+        i.to_lids(np.array([57]))
+
+
+def test_index_range_mutation():
+    i = IndexRange(0, 3, 0)
+    assert i.num_hids == 0
+    lids = i.add_gids(np.array([5, 3, 5, 1]), np.array([1, 1, 1, 0]))
+    # gid 1 is owned; 5 and 3 appended in first-touch order
+    assert list(lids) == [3, 4, 3, 1]
+    assert list(i.lid_to_gid) == [0, 1, 2, 5, 3]
+    assert list(i.hid_to_part) == [1, 1]
+    lid = i.add_gid(7, 2)
+    assert lid == 5
+    assert i.num_lids == 6
+    with pytest.raises(AssertionError):
+        i.add_gids(np.array([99]), np.array([0]))  # own part as ghost owner
+
+
+def test_index_set_explicit():
+    s = IndexSet(2, lid_to_gid=[7, 3, 9, 0], lid_to_part=[2, 1, 2, 0])
+    # owned/ghost derived from lid_to_part
+    assert list(s.oid_to_lid) == [0, 2]
+    assert list(s.hid_to_lid) == [1, 3]
+    assert list(s.lid_to_ohid) == [0, -1, 1, -2]
+    assert list(s.oid_to_gid) == [7, 9]
+    assert list(s.hid_to_gid) == [3, 0]
+    assert list(s.gids_to_lids([9, 3, 4])) == [2, 1, -1]
+
+
+def test_index_set_touched_hids():
+    s = IndexSet(2, lid_to_gid=[7, 3, 9, 0], lid_to_part=[2, 1, 2, 0])
+    # gids touch ghost 0 (hid 1) then ghost 3 (hid 0); dedup first-touch
+    assert list(s.touched_hids([0, 9, 0, 3, 42])) == [1, 0]
+
+
+def test_find_lid_map():
+    a = IndexSet(0, lid_to_gid=[4, 2, 7], lid_to_part=[0, 0, 1])
+    b = IndexSet(0, lid_to_gid=[7, 4, 2, 9], lid_to_part=[1, 0, 0, 0])
+    assert list(a.find_lid_map(b)) == [1, 2, 0]
+
+
+def test_extended_index_range():
+    e = ExtendedIndexRange(
+        0, noids=3, firstgid=0, lid_to_gid=[0, 1, 2, 8], lid_to_part=[0, 0, 0, 1]
+    )
+    assert e.num_oids == 3
+    assert list(e.gids_to_lids([8, 1])) == [3, 1]
+    assert e.noids_range == (0, 3)
+
+
+def test_linear_gid_to_part():
+    g2p = LinearGidToPart(10, np.array([0, 2, 4, 7]))
+    assert list(g2p(np.arange(10))) == [0, 0, 1, 1, 2, 2, 2, 3, 3, 3]
+
+
+def test_cartesian_gid_to_part():
+    # 4x4 cells, 2x2 parts, balanced: each part owns a 2x2 box (C-order)
+    g2p = CartesianGidToPart((4, 4), (np.array([0, 2]), np.array([0, 2])))
+    expected = np.array(
+        [
+            [0, 0, 1, 1],
+            [0, 0, 1, 1],
+            [2, 2, 3, 3],
+            [2, 2, 3, 3],
+        ]
+    ).ravel()
+    assert list(g2p(np.arange(16))) == list(expected)
+
+
+def test_index_set_equality_helpers():
+    a = IndexSet(0, [0, 1, 5], [0, 0, 1])
+    b = IndexSet(0, [0, 1, 5], [0, 0, 1])
+    c = IndexSet(0, [0, 1, 6], [0, 0, 1])
+    assert a.oids_eq(b) and a.hids_eq(b) and a.lids_eq(b)
+    assert a.oids_eq(c) and not a.hids_eq(c) and not a.lids_eq(c)
