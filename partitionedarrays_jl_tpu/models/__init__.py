@@ -1,0 +1,23 @@
+from .poisson_fdm import assemble_poisson, manufactured_solution, poisson_fdm_driver
+from .solvers import (
+    PLU,
+    cg,
+    direct_solve,
+    gather_psparse,
+    gather_pvector,
+    lu,
+    scatter_pvector_values,
+)
+
+__all__ = [
+    "assemble_poisson",
+    "manufactured_solution",
+    "poisson_fdm_driver",
+    "PLU",
+    "cg",
+    "direct_solve",
+    "gather_psparse",
+    "gather_pvector",
+    "lu",
+    "scatter_pvector_values",
+]

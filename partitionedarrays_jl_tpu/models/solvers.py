@@ -1,0 +1,162 @@
+"""Distributed solvers over the PData algebra.
+
+The reference delegates Krylov solves to the *unmodified*
+IterativeSolvers.jl CG, which works because PVector/PSparseMatrix provide
+`mul!`, `dot`, `norm`, `similar`, broadcast (reference shim:
+src/Interfaces.jl:2752-2757). This framework ships its own CG written
+against the same primitive set, so the whole loop runs distributed on any
+backend — and compiles to a single XLA program on the TPU backend.
+
+Also here: the gather-to-main direct-solve debug path
+(reference: src/Interfaces.jl:2626-2748 — `\\`, `lu`/`ldiv!`, `gather`,
+`scatter!`).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..ops.sparse import CSRMatrix, compresscoo
+from ..utils.helpers import check
+from ..parallel.backends import map_parts
+from ..parallel.prange import PRange
+from ..parallel.psparse import PSparseMatrix, psparse_global_triplets
+from ..parallel.pvector import PVector, _owned, _write_owned
+
+
+def cg(
+    A: PSparseMatrix,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """Conjugate gradients for SPD `A`. The start vector lives on
+    ``A.cols`` — the PRange carrying the column ghost layer — mirroring the
+    reference's `zerox` axes shim (src/Interfaces.jl:2752-2757), so every
+    SpMV can halo-update it in place.
+
+    Deterministic: all reductions are fixed-order part folds; the residual
+    history is reproducible bit-for-bit for a given backend and matches the
+    sequential oracle on the TPU backend (the BASELINE.md gate).
+    """
+    x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+
+    r = b.copy()  # rows-range residual
+    q = A @ x
+    _owned_update(r, lambda rv, qv: rv - qv, q)
+    p = PVector.full(0.0, A.cols, dtype=b.dtype)
+    _owned_assign(p, r)
+    rs = r.dot(r)
+    rs0 = rs
+    history = [np.sqrt(rs)]
+    it = 0
+    while np.sqrt(rs) > tol * max(1.0, np.sqrt(rs0)) and it < maxiter:
+        q = A @ p
+        pq = p.dot(q)  # owned dot across owned-compatible PRanges
+        check(pq != 0.0, "cg: breakdown, p'Ap == 0")
+        alpha = rs / pq
+        _owned_update(x, lambda xv, pv: xv + alpha * pv, p)
+        _owned_update(r, lambda rv, qv: rv - alpha * qv, q)
+        rs_new = r.dot(r)
+        beta = rs_new / rs
+        _owned_update(p, lambda pv, rv: rv + beta * pv, r)
+        rs = rs_new
+        history.append(np.sqrt(rs))
+        it += 1
+        if verbose:
+            print(f"cg it={it} residual={np.sqrt(rs):.3e}")
+    return x, {"iterations": it, "residuals": np.array(history), "converged": np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0))}
+
+
+def _owned_update(dest: PVector, f, src: PVector):
+    """dest.owned = f(dest.owned, src.owned), in place; dest and src may
+    live on different (owned-compatible) PRanges."""
+    map_parts(
+        lambda di, dv, si, sv: _write_owned(di, dv, f(_owned(di, dv), _owned(si, sv))),
+        dest.rows.partition,
+        dest.values,
+        src.rows.partition,
+        src.values,
+    )
+
+
+def _owned_assign(dest: PVector, src: PVector):
+    _owned_update(dest, lambda _d, s: s, src)
+
+
+# ---------------------------------------------------------------------------
+# gather-to-main direct solve (debug path)
+# ---------------------------------------------------------------------------
+
+
+def gather_psparse(A: PSparseMatrix) -> Optional[CSRMatrix]:
+    """Collect the owned-row triplets of every part and compress the global
+    matrix on MAIN; other parts get None
+    (reference gather(A): src/Interfaces.jl:2664-2704). Ghost rows are
+    ignored: run `A.assemble()` first for unassembled matrices."""
+    trip = psparse_global_triplets(A)
+    gi_all, gj_all, v_all = [], [], []
+    for (gi, gj, v), iset in zip(trip.part_values(), A.rows.partition.part_values()):
+        owned = iset.lid_to_ohid[iset.gids_to_lids(gi)] >= 0
+        gi_all.append(gi[owned])
+        gj_all.append(gj[owned])
+        v_all.append(v[owned])
+    m, n = A.rows.ngids, A.cols.ngids
+    return compresscoo(
+        np.concatenate(gi_all), np.concatenate(gj_all), np.concatenate(v_all), m, n
+    )
+
+
+def gather_pvector(b: PVector) -> np.ndarray:
+    """Owned values of every part placed at their gids (on MAIN)
+    (reference gather(b): src/Interfaces.jl:2706-2732)."""
+    out = np.zeros(b.rows.ngids, dtype=b.dtype)
+    for iset, vals in zip(b.rows.partition.part_values(), b.values.part_values()):
+        out[iset.oid_to_gid] = _owned(iset, np.asarray(vals))
+    return out
+
+
+def scatter_pvector_values(c_main: np.ndarray, rows: PRange) -> PVector:
+    """Distribute a MAIN-resident global vector back over a PRange
+    (reference scatter!: src/Interfaces.jl:2734-2748). Ghost entries are
+    filled too (the data is available on main)."""
+    vals = map_parts(lambda i: np.asarray(c_main)[i.lid_to_gid], rows.partition)
+    return PVector(vals, rows)
+
+
+class PLU:
+    """Centralize-on-main LU factorization, reusable across solves
+    (reference PLU/lu/ldiv!: src/Interfaces.jl:2641-2662)."""
+
+    def __init__(self, A: PSparseMatrix):
+        from scipy.linalg import lu_factor
+
+        self.cols = A.cols
+        self._factors = lu_factor(gather_psparse(A).toarray())
+
+    def refactorize(self, A: PSparseMatrix) -> "PLU":
+        from scipy.linalg import lu_factor
+
+        self._factors = lu_factor(gather_psparse(A).toarray())
+        return self
+
+    def solve(self, b: PVector) -> PVector:
+        from scipy.linalg import lu_solve
+
+        x_main = lu_solve(self._factors, gather_pvector(b))
+        return scatter_pvector_values(x_main, self.cols)
+
+
+def lu(A: PSparseMatrix) -> PLU:
+    return PLU(A)
+
+
+def direct_solve(A: PSparseMatrix, b: PVector) -> PVector:
+    """The `\\` analog: gather A and b to MAIN, dense solve, scatter back
+    (reference: src/Interfaces.jl:2626-2638). Debug-scale only."""
+    x_main = np.linalg.solve(gather_psparse(A).toarray(), gather_pvector(b))
+    return scatter_pvector_values(x_main, A.cols)

@@ -1,0 +1,23 @@
+from .sparse import (
+    CSRMatrix,
+    ELLMatrix,
+    compresscoo,
+    csr_block,
+    csr_spmv,
+    indextype,
+    nz_triplets,
+    nzindex,
+    nziterator,
+)
+
+__all__ = [
+    "CSRMatrix",
+    "ELLMatrix",
+    "compresscoo",
+    "csr_block",
+    "csr_spmv",
+    "indextype",
+    "nz_triplets",
+    "nzindex",
+    "nziterator",
+]

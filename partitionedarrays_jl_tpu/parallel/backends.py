@@ -49,21 +49,21 @@ class AbstractBackend:
     def get_part_ids(self, nparts: PartShape) -> "AbstractPData":
         abstractmethod(self, "get_part_ids")
 
-    def prun(self, driver: Callable, nparts: PartShape, *args):
+    def prun(self, driver: Callable, nparts: PartShape, *args, **kwargs):
         parts = self.get_part_ids(nparts)
-        return driver(parts, *args)
+        return driver(parts, *args, **kwargs)
 
-    def prun_debug(self, driver: Callable, nparts: PartShape, *args):
-        return self.prun(driver, nparts, *args)
+    def prun_debug(self, driver: Callable, nparts: PartShape, *args, **kwargs):
+        return self.prun(driver, nparts, *args, **kwargs)
 
 
-def prun(driver: Callable, backend: AbstractBackend, nparts: PartShape, *args):
+def prun(driver: Callable, backend: AbstractBackend, nparts: PartShape, *args, **kwargs):
     """THE program entry point (reference: src/Interfaces.jl:33-36)."""
-    return backend.prun(driver, nparts, *args)
+    return backend.prun(driver, nparts, *args, **kwargs)
 
 
-def prun_debug(driver: Callable, backend: AbstractBackend, nparts: PartShape, *args):
-    return backend.prun_debug(driver, nparts, *args)
+def prun_debug(driver: Callable, backend: AbstractBackend, nparts: PartShape, *args, **kwargs):
+    return backend.prun_debug(driver, nparts, *args, **kwargs)
 
 
 class AbstractPData:

@@ -89,6 +89,15 @@ class AbstractIndexSet:
     def hid_to_part(self) -> np.ndarray:
         return self.lid_to_part[self.hid_to_lid]
 
+    @property
+    def owned_first(self) -> bool:
+        """True when lids are numbered owned block first (oid == lid for
+        owned entries): the layout every built-in constructor produces, and
+        the fast path the TPU backend exploits (owned data = array prefix)."""
+        o = self.oid_to_lid
+        return len(o) == 0 or (o[0] == 0 and o[-1] == len(o) - 1 and
+                               np.array_equal(o, np.arange(len(o), dtype=o.dtype)))
+
     # --- vectorized lookup --------------------------------------------
     def gids_to_lids(self, gids, missing_to: int = -1) -> np.ndarray:
         """Vectorized gid -> lid; absent gids map to `missing_to`."""

@@ -1,0 +1,467 @@
+"""PSparseMatrix: the row-partitioned distributed sparse matrix (L5).
+
+TPU-native analog of reference src/Interfaces.jl:2108-2757. Per part: a
+local CSR over (row lids x col lids) keyed by `rows`/`cols` PRanges; rows
+may carry ghost rows (pre-assembly), cols carry the column ghost layer SpMV
+needs. Owned-first lid layout makes the four (owned|ghost)x(owned|ghost)
+blocks plain row/column threshold splits, materialized as CSR blocks (and
+ELL for the device kernel) instead of the reference's lazy filtered views
+(src/Interfaces.jl:2142-2183, src/SparseUtils.jl:5-29).
+
+The SpMV preserves the reference's defining performance property
+(src/Interfaces.jl:2246-2275): start the halo update of b, compute
+``c_o = beta c_o + alpha A_oo b_o`` while the wire is busy, wait, then add
+``alpha A_oh b_h``. On the TPU backend the same structure is realized by
+XLA async collectives inside one compiled program.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..ops.sparse import CSRMatrix, ELLMatrix, compresscoo, csr_block, nzindex
+from ..utils.helpers import check
+from ..utils.table import INDEX_DTYPE, Table
+from .backends import AbstractPData, Token, map_parts
+from .collectives import exchange
+from .exchanger import Exchanger, async_exchange_values
+from .index_sets import AbstractIndexSet, GID_DTYPE
+from .prange import PRange, add_gids_inplace, oids_are_equal, lids_are_equal, to_lids, uniform_partition
+from .pvector import PVector, _owned, _ghost
+
+
+class PSparseMatrix:
+    __slots__ = ("values", "rows", "cols", "_exchanger", "_blocks")
+
+    def __init__(
+        self,
+        values: AbstractPData,
+        rows: PRange,
+        cols: PRange,
+        exchanger: Optional[Exchanger] = None,
+    ):
+        self.values = values
+        self.rows = rows
+        self.cols = cols
+        self._exchanger = exchanger
+        self._blocks = None
+
+    # ------------------------------------------------------------------
+    # constructors (reference: src/Interfaces.jl:2194-2244)
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_coo(
+        cls,
+        I: AbstractPData,
+        J: AbstractPData,
+        V: AbstractPData,
+        rows,
+        cols,
+        ids: str = "global",
+        assemble_rows: bool = False,
+    ) -> "PSparseMatrix":
+        """Build from per-part COO triplets. ``ids='global'`` renumbers I, J
+        to lids in place. Integer `rows`/`cols` build uniform PRanges and
+        add the touched off-part gids as ghosts (reference:
+        src/Interfaces.jl:2220-2244). With `assemble_rows=True` the raw
+        triplets are first migrated to their row owners
+        (`assemble_coo`, reference: src/Interfaces.jl:2406-2492)."""
+        check(ids in ("global", "local"), "ids must be 'global' or 'local'")
+        if isinstance(rows, (int, np.integer)):
+            check(ids == "global", "building rows from n requires global ids")
+            from .backends import get_part_ids
+
+            parts = get_part_ids(I)
+            rows = uniform_partition(parts, int(rows))
+            add_gids_inplace(rows, I)
+        if isinstance(cols, (int, np.integer)):
+            check(ids == "global", "building cols from n requires global ids")
+            from .backends import get_part_ids
+
+            parts = get_part_ids(J)
+            cols = uniform_partition(parts, int(cols))
+            add_gids_inplace(cols, J)
+        if assemble_rows:
+            check(ids == "global", "assemble_rows operates on global ids")
+            I, J, V = assemble_coo(I, J, V, rows)
+        if ids == "global":
+            to_lids(rows, I)
+            to_lids(cols, J)
+
+        def _compress(ri, ci, i, j, v):
+            return compresscoo(i, j, v, ri.num_lids, ci.num_lids)
+
+        values = map_parts(_compress, rows.partition, cols.partition, I, J, V)
+        return cls(values, rows, cols)
+
+    # ------------------------------------------------------------------
+    # block views (reference: src/Interfaces.jl:2142-2183)
+    # ------------------------------------------------------------------
+
+    def _block_cache(self):
+        if self._blocks is None:
+            def _split(ri: AbstractIndexSet, ci: AbstractIndexSet, A: CSRMatrix):
+                check(
+                    ri.owned_first and ci.owned_first,
+                    "PSparseMatrix blocks require owned-first lid layouts",
+                )
+                no_r, no_c = ri.num_oids, ci.num_oids
+                o_rows = np.arange(no_r, dtype=INDEX_DTYPE)
+                h_rows = np.arange(no_r, A.shape[0], dtype=INDEX_DTYPE)
+                return {
+                    "oo": csr_block(A, o_rows, no_c, want_upper=False),
+                    "oh": csr_block(A, o_rows, no_c, want_upper=True, col_offset=no_c),
+                    "ho": csr_block(A, h_rows, no_c, want_upper=False),
+                    "hh": csr_block(A, h_rows, no_c, want_upper=True, col_offset=no_c),
+                }
+
+            self._blocks = map_parts(
+                _split, self.rows.partition, self.cols.partition, self.values
+            )
+        return self._blocks
+
+    def invalidate_blocks(self):
+        self._blocks = None
+
+    @property
+    def owned_owned_values(self) -> AbstractPData:
+        return map_parts(lambda b: b["oo"], self._block_cache())
+
+    @property
+    def owned_ghost_values(self) -> AbstractPData:
+        return map_parts(lambda b: b["oh"], self._block_cache())
+
+    @property
+    def ghost_owned_values(self) -> AbstractPData:
+        return map_parts(lambda b: b["ho"], self._block_cache())
+
+    @property
+    def ghost_ghost_values(self) -> AbstractPData:
+        return map_parts(lambda b: b["hh"], self._block_cache())
+
+    @property
+    def dtype(self):
+        return self.values.part_values()[0].dtype
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.rows.ngids, self.cols.ngids)
+
+    def __repr__(self):
+        return (
+            f"PSparseMatrix(shape={self.shape}, nparts={self.rows.num_parts}, "
+            f"dtype={self.dtype})"
+        )
+
+    # ------------------------------------------------------------------
+    # SpMV (reference: src/Interfaces.jl:2246-2275)
+    # ------------------------------------------------------------------
+
+    def mul_into(
+        self, c: PVector, b: PVector, alpha: float = 1.0, beta: float = 0.0
+    ) -> PVector:
+        """c = beta*c + alpha*A@b with communication/compute overlap.
+        Ghost rows of c are not touched. Axis contract: c.rows ~ A.rows on
+        owned ids; A.cols ~ b.rows on owned AND ghost ids (b must carry A's
+        column ghost layer)."""
+        check(oids_are_equal(c.rows, self.rows), "mul: c.rows incompatible with A.rows")
+        check(
+            lids_are_equal(self.cols, b.rows),
+            "mul: b.rows must match A.cols incl. the ghost layer",
+        )
+        t = b.async_exchange()  # start halo update of b (non-blocking)
+        blocks = self._block_cache()
+
+        def _phase1(ri, cv, bi, bv, blk):
+            # in-place owned update needs the slice view, not a fancy copy
+            check(ri.owned_first, "mul: c.rows must use the owned-first lid layout")
+            co = _owned(ri, cv)
+            bo = _owned(bi, bv)
+            if beta == 0.0:
+                co[...] = 0.0
+            elif beta != 1.0:
+                co *= beta
+            co += alpha * (blk["oo"] @ bo)
+            return None
+
+        map_parts(_phase1, self.rows.partition, c.values, b.rows.partition, b.values, blocks)
+        t.wait()  # ghosts of b are now current
+
+        def _phase2(ri, cv, bi, bv, blk):
+            if blk["oh"].nnz:
+                check(ri.owned_first, "mul: c.rows must use the owned-first lid layout")
+                co = _owned(ri, cv)
+                bh = _ghost(bi, bv)
+                co += alpha * (blk["oh"] @ bh)
+            return None
+
+        map_parts(_phase2, self.rows.partition, c.values, b.rows.partition, b.values, blocks)
+        return c
+
+    def __matmul__(self, b: PVector) -> PVector:
+        c = PVector.full(0.0, self.rows, dtype=np.result_type(self.dtype, b.dtype))
+        return self.mul_into(c, b)
+
+    def __mul__(self, a):
+        check(np.isscalar(a), "PSparseMatrix * non-scalar (use @ for SpMV)")
+        vals = map_parts(
+            lambda A: CSRMatrix(A.indptr, A.indices, A.data * a, A.shape), self.values
+        )
+        return PSparseMatrix(vals, self.rows, self.cols, self._exchanger)
+
+    __rmul__ = __mul__
+
+    def __neg__(self):
+        return self * (-1.0)
+
+    # ------------------------------------------------------------------
+    # nonzero exchanger + matrix halo/assembly
+    # (reference: src/Interfaces.jl:2300-2404)
+    # ------------------------------------------------------------------
+
+    @property
+    def exchanger(self) -> Exchanger:
+        if self._exchanger is None:
+            self._exchanger = matrix_exchanger(self.values, self.rows, self.cols)
+        return self._exchanger
+
+    def _nz_data(self) -> AbstractPData:
+        return map_parts(lambda A: A.data, self.values)
+
+    def async_exchange(self) -> Token:
+        """Owner -> ghost copy of nonzero values (matrix halo update)."""
+        nz = self._nz_data()
+        inner = async_exchange_values(nz, nz, self.exchanger)
+
+        def _finish():
+            inner.wait()
+            self.invalidate_blocks()
+            return self.values
+
+        return Token(wait_fn=_finish)
+
+    def exchange(self) -> "PSparseMatrix":
+        self.async_exchange().wait()
+        return self
+
+    def async_assemble(self, combine_op=np.add) -> Token:
+        """Ghost-row nonzeros sent to owners, combined (default +), then the
+        local ghost-row entries zeroed (reference: src/Interfaces.jl:2383-2404)."""
+        nz = self._nz_data()
+        inner = async_exchange_values(nz, nz, self.exchanger.reverse(), combine_op)
+
+        def _finish():
+            inner.wait()
+
+            def _zero_ghost_rows(ri: AbstractIndexSet, A: CSRMatrix):
+                A.data[ri.lid_to_ohid[A.row_of_nz()] < 0] = 0
+                return A
+
+            map_parts(_zero_ghost_rows, self.rows.partition, self.values)
+            self.invalidate_blocks()
+            return self.values
+
+        return Token(wait_fn=_finish)
+
+    def assemble(self, combine_op=np.add) -> "PSparseMatrix":
+        self.async_assemble(combine_op).wait()
+        return self
+
+
+def matrix_exchanger(values: AbstractPData, rows: PRange, cols: PRange) -> Exchanger:
+    """Build the nonzero-value exchanger for ghost-row halo/assembly
+    (reference: src/Interfaces.jl:2300-2372): for each stored entry in a
+    ghost row, record its nz index and (gi, gj); ship the (gi, gj) pairs to
+    the row owner along the row-halo graph; the owner looks up its own nz
+    index via `nzindex` (consistent sparsity pattern required — checked)."""
+    rex = rows.exchanger  # row-halo neighbor graph
+
+    def _collect(ri: AbstractIndexSet, ci: AbstractIndexSet, A: CSRMatrix, prcv):
+        rows_of_nz = A.row_of_nz()
+        ohid = ri.lid_to_ohid[rows_of_nz]
+        mask = ohid < 0
+        k = np.nonzero(mask)[0].astype(INDEX_DTYPE)
+        gi = ri.lid_to_gid[rows_of_nz[mask]]
+        gj = ci.lid_to_gid[A.indices[mask]]
+        owner = ri.lid_to_part[rows_of_nz[mask]]
+        prcv = np.asarray(prcv)
+        rows_k, rows_gi, rows_gj = [], [], []
+        for q in prcv:
+            sel = owner == q
+            rows_k.append(k[sel])
+            rows_gi.append(gi[sel])
+            rows_gj.append(gj[sel])
+        return (
+            Table.from_rows(rows_k) if rows_k else Table.empty(INDEX_DTYPE),
+            Table.from_rows(rows_gi) if rows_gi else Table.empty(GID_DTYPE),
+            Table.from_rows(rows_gj) if rows_gj else Table.empty(GID_DTYPE),
+        )
+
+    col = map_parts(_collect, rows.partition, cols.partition, values, rex.parts_rcv)
+    k_rcv = map_parts(lambda c: c[0], col)
+    gi_rcv = map_parts(lambda c: c[1], col)
+    gj_rcv = map_parts(lambda c: c[2], col)
+
+    # ship wanted (gi, gj) to the owners along the reversed halo graph
+    gi_snd = exchange(gi_rcv, rex.parts_snd, rex.parts_rcv)
+    gj_snd = exchange(gj_rcv, rex.parts_snd, rex.parts_rcv)
+
+    def _lookup(ri, ci, A, git, gjt):
+        li = ri.gids_to_lids(git.data)
+        lj = ci.gids_to_lids(gjt.data)
+        check((li >= 0).all() and (lj >= 0).all(), "matrix_exchanger: unknown gid on owner")
+        k = nzindex(A, li, lj)
+        check(
+            (k >= 0).all(),
+            "matrix_exchanger: ghost entry absent from owner sparsity pattern",
+        )
+        return Table(k.astype(INDEX_DTYPE), git.ptrs)
+
+    k_snd = map_parts(
+        _lookup, rows.partition, cols.partition, values, gi_snd, gj_snd
+    )
+    return Exchanger(rex.parts_rcv, rex.parts_snd, k_rcv, k_snd)
+
+
+# ---------------------------------------------------------------------------
+# COO-level assembly / replication (reference: src/Interfaces.jl:2406-2592)
+# ---------------------------------------------------------------------------
+
+
+def assemble_coo(
+    I: AbstractPData, J: AbstractPData, V: AbstractPData, rows: PRange
+) -> Tuple[AbstractPData, AbstractPData, AbstractPData]:
+    """Migrate raw COO triplets (global ids) to their row owners *before*
+    compression (reference async_assemble!(I,J,V,rows):
+    src/Interfaces.jl:2406-2492). Triplets whose row this part owns stay;
+    the rest are shipped along the row-halo graph and appended on the
+    owner, with the shipped local copies zeroed. Returns new (I, J, V)
+    PDatas, I in global numbering."""
+    rex = rows.exchanger
+
+    def _split(ri: AbstractIndexSet, prcv, i, j, v):
+        i = np.asarray(i, dtype=GID_DTYPE)
+        j = np.asarray(j, dtype=GID_DTYPE)
+        v = np.asarray(v)
+        lids = ri.gids_to_lids(i)
+        check((lids >= 0).all(), "assemble_coo: triplet row is not a local row")
+        owner = ri.lid_to_part[lids]
+        keep = owner == ri.part
+        rows_i, rows_j, rows_v = [], [], []
+        for q in np.asarray(prcv):
+            sel = owner == q
+            rows_i.append(i[sel])
+            rows_j.append(j[sel])
+            rows_v.append(v[sel])
+        # zero the shipped local copies (keep arrays append-only)
+        v_out = np.where(keep, v, 0)
+        return (
+            Table.from_rows(rows_i) if rows_i else Table.empty(GID_DTYPE),
+            Table.from_rows(rows_j) if rows_j else Table.empty(GID_DTYPE),
+            Table.from_rows(rows_v) if rows_v else Table.empty(v.dtype),
+            i,
+            j,
+            v_out,
+        )
+
+    parts_stay = map_parts(_split, rows.partition, rex.parts_rcv, I, J, V)
+    ti = map_parts(lambda s: s[0], parts_stay)
+    tj = map_parts(lambda s: s[1], parts_stay)
+    tv = map_parts(lambda s: s[2], parts_stay)
+
+    ri_rcv = exchange(ti, rex.parts_snd, rex.parts_rcv)
+    rj_rcv = exchange(tj, rex.parts_snd, rex.parts_rcv)
+    rv_rcv = exchange(tv, rex.parts_snd, rex.parts_rcv)
+
+    def _append(s, rit, rjt, rvt):
+        i, j, v = s[3], s[4], s[5]
+        n = int(rit.ptrs[-1])
+        return (
+            np.concatenate([i, rit.data[:n]]),
+            np.concatenate([j, rjt.data[:n]]),
+            np.concatenate([v, rvt.data[:n]]),
+        )
+
+    out = map_parts(_append, parts_stay, ri_rcv, rj_rcv, rv_rcv)
+    return (
+        map_parts(lambda o: o[0], out),
+        map_parts(lambda o: o[1], out),
+        map_parts(lambda o: o[2], out),
+    )
+
+
+def exchange_coo(
+    I: AbstractPData, J: AbstractPData, V: AbstractPData, rows: PRange
+) -> Tuple[AbstractPData, AbstractPData, AbstractPData]:
+    """Inverse direction (reference async_exchange!(I,J,V,rows):
+    src/Interfaces.jl:2494-2592): owners *replicate* the triplets of rows
+    that other parts hold as ghosts, appending to those parts' COO lists —
+    used to set up overlapping/ghosted matrices."""
+    rex = rows.exchanger
+
+    def _select(ri: AbstractIndexSet, lids_snd: Table, i, j, v):
+        i = np.asarray(i, dtype=GID_DTYPE)
+        j = np.asarray(j, dtype=GID_DTYPE)
+        v = np.asarray(v)
+        lids = ri.gids_to_lids(i)
+        rows_i, rows_j, rows_v = [], [], []
+        for nb in range(len(lids_snd)):
+            wanted = lids_snd[nb]
+            sel = np.isin(lids, wanted)
+            rows_i.append(i[sel])
+            rows_j.append(j[sel])
+            rows_v.append(v[sel])
+        return (
+            Table.from_rows(rows_i) if rows_i else Table.empty(GID_DTYPE),
+            Table.from_rows(rows_j) if rows_j else Table.empty(GID_DTYPE),
+            Table.from_rows(rows_v) if rows_v else Table.empty(v.dtype),
+        )
+
+    sel = map_parts(_select, rows.partition, rex.lids_snd, I, J, V)
+    ti = map_parts(lambda s: s[0], sel)
+    tj = map_parts(lambda s: s[1], sel)
+    tv = map_parts(lambda s: s[2], sel)
+
+    # owners send to the parts ghosting their rows: the forward halo graph
+    ri_rcv = exchange(ti, rex.parts_rcv, rex.parts_snd)
+    rj_rcv = exchange(tj, rex.parts_rcv, rex.parts_snd)
+    rv_rcv = exchange(tv, rex.parts_rcv, rex.parts_snd)
+
+    def _append(i, j, v, rit, rjt, rvt):
+        n = int(rit.ptrs[-1])
+        return (
+            np.concatenate([np.asarray(i, dtype=GID_DTYPE), rit.data[:n]]),
+            np.concatenate([np.asarray(j, dtype=GID_DTYPE), rjt.data[:n]]),
+            np.concatenate([np.asarray(v), rvt.data[:n]]),
+        )
+
+    out = map_parts(_append, I, J, V, ri_rcv, rj_rcv, rv_rcv)
+    return (
+        map_parts(lambda o: o[0], out),
+        map_parts(lambda o: o[1], out),
+        map_parts(lambda o: o[2], out),
+    )
+
+
+# ---------------------------------------------------------------------------
+# views (reference: src/Interfaces.jl:2277-2298)
+# ---------------------------------------------------------------------------
+
+
+def psparse_local_values(A: PSparseMatrix) -> AbstractPData:
+    """The raw per-part local CSR matrices (lid x lid)."""
+    return A.values
+
+
+def psparse_global_triplets(A: PSparseMatrix) -> AbstractPData:
+    """Per-part (gi, gj, v) of all stored entries, in global numbering —
+    the building block of the gather/global_view debug paths."""
+
+    def _mk(ri, ci, M: CSRMatrix):
+        gi = ri.lid_to_gid[M.row_of_nz()]
+        gj = ci.lid_to_gid[M.indices]
+        return gi, gj, M.data.copy()
+
+    return map_parts(_mk, A.rows.partition, A.cols.partition, A.values)

@@ -1,0 +1,206 @@
+"""L5 tests: PSparseMatrix build, block views, SpMV, assembly, solvers.
+
+Mirrors the reference conformance coverage
+(reference: test/test_interfaces.jl:645-734), re-derived 0-based.
+"""
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.models import cg, direct_solve, gather_psparse, gather_pvector, lu
+
+
+def parts4():
+    return pa.sequential.get_part_ids(4)
+
+
+def laplacian_1d(n=12):
+    """1-D Laplacian with Dirichlet identity end rows over 4 parts."""
+    parts = parts4()
+    rows = pa.uniform_partition(parts, n)
+
+    def _coo(iset):
+        gi = iset.oid_to_gid
+        interior = (gi > 0) & (gi < n - 1)
+        I = [gi[~interior], gi[interior], gi[interior], gi[interior]]
+        J = [gi[~interior], gi[interior], gi[interior] - 1, gi[interior] + 1]
+        V = [
+            np.ones(int((~interior).sum())),
+            np.full(int(interior.sum()), 2.0),
+            np.full(int(interior.sum()), -1.0),
+            np.full(int(interior.sum()), -1.0),
+        ]
+        return np.concatenate(I), np.concatenate(J), np.concatenate(V)
+
+    coo = pa.map_parts(_coo, rows.partition)
+    I = pa.map_parts(lambda c: c[0], coo)
+    J = pa.map_parts(lambda c: c[1], coo)
+    V = pa.map_parts(lambda c: c[2], coo)
+    cols = pa.add_gids(rows, J)
+    A = pa.PSparseMatrix.from_coo(I, J, V, rows, cols, ids="global")
+    return A, rows, cols
+
+
+def test_from_coo_and_gather():
+    A, rows, cols = laplacian_1d()
+    assert A.shape == (12, 12)
+    G = gather_psparse(A).toarray()
+    expected = np.zeros((12, 12))
+    expected[0, 0] = expected[11, 11] = 1.0
+    for i in range(1, 11):
+        expected[i, i] = 2.0
+        expected[i, i - 1] = -1.0
+        expected[i, i + 1] = -1.0
+    assert np.array_equal(G, expected)
+
+
+def test_block_views():
+    A, rows, cols = laplacian_1d()
+
+    def _check(ri, ci, blk_oo, blk_oh, full):
+        no_r, no_c = ri.num_oids, ci.num_oids
+        d = full.toarray()
+        assert np.array_equal(blk_oo.toarray(), d[:no_r, :no_c])
+        assert np.array_equal(blk_oh.toarray(), d[:no_r, no_c:])
+
+    pa.map_parts(
+        _check,
+        A.rows.partition,
+        A.cols.partition,
+        A.owned_owned_values,
+        A.owned_ghost_values,
+        A.values,
+    )
+
+
+def test_spmv_matches_gathered():
+    A, rows, cols = laplacian_1d()
+    x = pa.PVector(
+        pa.map_parts(lambda i: np.sin(i.lid_to_gid.astype(float)), cols.partition),
+        cols,
+    )
+    y = A @ x
+    assert np.allclose(gather_pvector(y), gather_psparse(A).toarray() @ gather_pvector(x))
+    # alpha/beta accumulation form
+    c = pa.PVector.full(1.0, rows)
+    A.mul_into(c, x, alpha=2.0, beta=0.5)
+    assert np.allclose(
+        gather_pvector(c), 0.5 + 2.0 * (gather_psparse(A).toarray() @ gather_pvector(x))
+    )
+
+
+def test_spmv_axis_contract():
+    A, rows, cols = laplacian_1d()
+    bad = pa.PVector.full(1.0, rows)  # missing the column ghost layer
+    with pytest.raises(AssertionError):
+        A @ bad
+
+
+def test_scalar_ops():
+    A, rows, cols = laplacian_1d()
+    B = 2.0 * A
+    assert np.array_equal(gather_psparse(B).toarray(), 2.0 * gather_psparse(A).toarray())
+    C = -A
+    assert np.array_equal(gather_psparse(C).toarray(), -gather_psparse(A).toarray())
+
+
+def test_assemble_coo_migration():
+    # triplets written on the "wrong" part migrate to row owners
+    parts = parts4()
+    rows0 = pa.uniform_partition(parts, 8)
+    ghosts = pa.map_parts(lambda p: np.array([(2 * p + 2) % 8]), parts)
+    rows = pa.add_gids(rows0, ghosts)
+    # each part writes 1.0 into (g, g) for its ghost row g (owned by p+1)
+    I = pa.map_parts(lambda p: np.array([(2 * p + 2) % 8]), parts)
+    J = pa.map_parts(lambda p: np.array([(2 * p + 2) % 8]), parts)
+    V = pa.map_parts(lambda p: np.array([1.0]), parts)
+    I2, J2, V2 = pa.assemble_coo(I, J, V, rows)
+    # every shipped triplet landed on its owner with the local copy zeroed
+    for p, (i2, v2) in enumerate(zip(I2.part_values(), V2.part_values())):
+        own_gid = 2 * p
+        assert (np.asarray(v2) != 0).sum() == 1
+        nz = np.asarray(i2)[np.asarray(v2) != 0]
+        assert list(nz) == [own_gid]
+    A = pa.PSparseMatrix.from_coo(I2, J2, V2, rows, rows.copy(), ids="global")
+    G = gather_psparse(A).toarray()
+    assert np.array_equal(np.diag(G), [1.0, 0, 1.0, 0, 1.0, 0, 1.0, 0])
+
+
+def test_matrix_exchanger_halo_and_assembly():
+    # matrix with ghost rows: parts hold copies of remote rows
+    parts = parts4()
+    rows0 = pa.uniform_partition(parts, 8)
+    ghosts = pa.map_parts(lambda p: np.array([(2 * p + 2) % 8]), parts)
+    rows = pa.add_gids(rows0, ghosts)
+    cols = rows.copy()
+    # each part stores (g,g)=5 for its ghost row g and (o,o)=p+1 for first owned o
+    I = pa.map_parts(lambda p: np.array([(2 * p + 2) % 8, 2 * p]), parts)
+    J = pa.map_parts(lambda p: np.array([(2 * p + 2) % 8, 2 * p]), parts)
+    V = pa.map_parts(lambda p: np.array([5.0, float(p + 1)]), parts)
+    A = pa.PSparseMatrix.from_coo(I, J, V, rows, cols, ids="global")
+    # assembly: ghost-row values add into the owner entry, ghosts zeroed
+    A.assemble()
+    for ri, M in zip(A.rows.partition, A.values.part_values()):
+        own_lid = 0
+        k = M.indptr[own_lid]
+        assert M.data[k] == pytest.approx(5.0 + (ri.part + 1))
+        for h in ri.hid_to_lid:
+            assert np.all(M.data[M.indptr[h] : M.indptr[h + 1]] == 0.0)
+    # halo update: owners push their values back out to ghost copies
+    A.exchange()
+    for ri, M in zip(A.rows.partition, A.values.part_values()):
+        for h in ri.hid_to_lid:
+            assert np.all(M.data[M.indptr[h] : M.indptr[h + 1]] != 0.0)
+
+
+def test_exchange_coo_replication():
+    parts = parts4()
+    rows0 = pa.uniform_partition(parts, 8)
+    ghosts = pa.map_parts(lambda p: np.array([(2 * p + 2) % 8]), parts)
+    rows = pa.add_gids(rows0, ghosts)
+    # owners hold (g, g, g+1.0) for each owned gid
+    I = pa.map_parts(lambda i: i.oid_to_gid.copy(), rows.partition)
+    J = pa.map_parts(lambda i: i.oid_to_gid.copy(), rows.partition)
+    V = pa.map_parts(lambda i: i.oid_to_gid.astype(float) + 1.0, rows.partition)
+    I2, J2, V2 = pa.exchange_coo(I, J, V, rows)
+    # every part now also holds the triplet of its ghost row
+    for iset, i2, v2 in zip(rows.partition, I2.part_values(), V2.part_values()):
+        g = int(iset.hid_to_gid[0])
+        hit = np.asarray(i2) == g
+        assert hit.sum() == 1
+        assert np.asarray(v2)[hit][0] == g + 1.0
+
+
+def test_cg_and_direct_solve():
+    A, rows, cols = laplacian_1d()
+    x_exact = pa.PVector(
+        pa.map_parts(
+            lambda i: np.cos(i.lid_to_gid.astype(float)), cols.partition
+        ),
+        cols,
+    )
+    b = A @ x_exact
+    # Dirichlet rows are identity: the start vector must carry the exact
+    # boundary values so CG's residual stays in the SPD interior subspace
+    # (same device as the reference driver, test/test_fdm.jl:98-110).
+    x0 = pa.PVector(
+        pa.map_parts(
+            lambda i: np.where(
+                (i.lid_to_gid == 0) | (i.lid_to_gid == 11),
+                np.cos(i.lid_to_gid.astype(float)),
+                0.0,
+            ),
+            cols.partition,
+        ),
+        cols,
+    )
+    x, info = cg(A, b, x0=x0, tol=1e-12)
+    assert info["converged"]
+    assert (x - x_exact).norm() < 1e-9
+    xd = direct_solve(A, b)
+    assert (xd - x_exact).norm() < 1e-9
+    f = lu(A)
+    xl = f.solve(b)
+    assert (xl - x_exact).norm() < 1e-9
+    # residual check mirroring the reference's norm(A*x-y) < 1e-9
+    assert (A @ xl - b).norm() < 1e-9

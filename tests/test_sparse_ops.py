@@ -1,0 +1,142 @@
+"""L6 unit tests: local sparse kernels (pure, no parallelism).
+
+Mirrors the reference's SparseUtilsTests coverage
+(reference: test/SparseUtilsTests.jl:1-65): compresscoo / nzindex /
+nziterator / block extraction / SpMV, over the CSR host format and the ELL
+device format.
+"""
+import numpy as np
+import pytest
+
+from partitionedarrays_jl_tpu import (
+    CSRMatrix,
+    ELLMatrix,
+    compresscoo,
+    csr_block,
+    csr_spmv,
+    indextype,
+    nz_triplets,
+    nzindex,
+    nziterator,
+)
+
+
+def _example():
+    # 4x5 with a duplicate entry at (1, 2): 3 + 4 = 7
+    I = [0, 1, 1, 1, 3, 2, 0]
+    J = [0, 2, 4, 2, 1, 3, 4]
+    V = [1.0, 3.0, 5.0, 4.0, 6.0, 2.0, 9.0]
+    return compresscoo(I, J, V, 4, 5)
+
+
+def test_compresscoo_dedup_and_sort():
+    A = _example()
+    assert A.shape == (4, 5)
+    assert A.nnz == 6
+    dense = A.toarray()
+    expected = np.zeros((4, 5))
+    expected[0, 0] = 1.0
+    expected[0, 4] = 9.0
+    expected[1, 2] = 7.0  # accumulated duplicate
+    expected[1, 4] = 5.0
+    expected[3, 1] = 6.0
+    expected[2, 3] = 2.0
+    assert np.array_equal(dense, expected)
+    # columns sorted within each row
+    for r in range(4):
+        row = A.indices[A.indptr[r] : A.indptr[r + 1]]
+        assert np.all(np.diff(row) > 0)
+
+
+def test_compresscoo_custom_combine():
+    A = compresscoo([0, 0], [1, 1], [3.0, 4.0], 1, 2, combine=lambda a, b: max(a, b))
+    assert A.toarray()[0, 1] == 4.0
+
+
+def test_compresscoo_bounds_check():
+    with pytest.raises(AssertionError):
+        compresscoo([5], [0], [1.0], 4, 5)
+
+
+def test_nzindex():
+    A = _example()
+    k = nzindex(A, [1, 0, 3, 2], [2, 0, 1, 0])
+    assert k[0] >= 0 and A.data[k[0]] == 7.0
+    assert k[1] >= 0 and A.data[k[1]] == 1.0
+    assert k[2] >= 0 and A.data[k[2]] == 6.0
+    assert k[3] == -1  # not stored
+    assert indextype(A) == np.int32
+
+
+def test_nziterator_and_triplets():
+    A = _example()
+    trip = sorted(nziterator(A))
+    assert trip[0] == (0, 0, 1.0)
+    I, J, V = nz_triplets(A)
+    assert len(I) == A.nnz
+    B = compresscoo(I, J, V, *A.shape)
+    assert np.array_equal(B.toarray(), A.toarray())
+
+
+def test_csr_spmv_matches_dense():
+    rng = np.random.default_rng(0)
+    I = rng.integers(0, 30, 200)
+    J = rng.integers(0, 20, 200)
+    V = rng.standard_normal(200)
+    A = compresscoo(I, J, V, 30, 20)
+    x = rng.standard_normal(20)
+    assert np.allclose(csr_spmv(A, x), A.toarray() @ x)
+    y = np.ones(30)
+    out = csr_spmv(A, x, y=y, alpha=2.0, beta=0.5)
+    assert np.allclose(out, 0.5 * np.ones(30) + 2.0 * (A.toarray() @ x))
+    assert out is y
+
+
+def test_spmv_with_empty_rows():
+    A = compresscoo([2], [1], [5.0], 4, 3)
+    x = np.array([1.0, 2.0, 3.0])
+    assert np.array_equal(csr_spmv(A, x), [0.0, 0.0, 10.0, 0.0])
+
+
+def test_ell_from_csr_and_spmv():
+    rng = np.random.default_rng(1)
+    I = rng.integers(0, 17, 120)
+    J = rng.integers(0, 11, 120)
+    V = rng.standard_normal(120)
+    A = compresscoo(I, J, V, 17, 11)
+    E = ELLMatrix.from_csr(A)
+    assert E.row_width == int(np.max(np.diff(A.indptr)))
+    x = rng.standard_normal(11)
+    assert np.allclose(E.spmv(x), A.toarray() @ x)
+    # padded wider
+    E2 = ELLMatrix.from_csr(A, row_width=E.row_width + 3)
+    assert np.allclose(E2.spmv(x), A.toarray() @ x)
+    with pytest.raises(AssertionError):
+        ELLMatrix.from_csr(A, row_width=E.row_width - 1)
+
+
+def test_csr_block_split():
+    # 4x6, split cols at 4: lower has cols 0..3, upper cols 4..5 remapped
+    I = [0, 0, 1, 2, 3, 3]
+    J = [1, 4, 3, 5, 0, 4]
+    V = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+    A = compresscoo(I, J, V, 4, 6)
+    rows = np.arange(4)
+    lo = csr_block(A, rows, 4, want_upper=False)
+    hi = csr_block(A, rows, 4, want_upper=True, col_offset=4)
+    assert lo.shape == (4, 4) and hi.shape == (4, 2)
+    d = np.zeros((4, 6))
+    d[:, :4] = lo.toarray()
+    d[:, 4:] = hi.toarray()
+    assert np.array_equal(d, A.toarray())
+    # row subset
+    sub = csr_block(A, np.array([3, 0]), 6, want_upper=False)
+    assert np.array_equal(sub.toarray(), A.toarray()[[3, 0], :])
+
+
+def test_empty_matrix():
+    A = compresscoo([], [], [], 3, 3)
+    assert A.nnz == 0
+    assert np.array_equal(csr_spmv(A, np.ones(3)), np.zeros(3))
+    E = ELLMatrix.from_csr(A)
+    assert E.row_width == 0
