@@ -1,0 +1,115 @@
+"""Benchmark harness: PSparseMatrix SpMV GFLOPS/chip (3-D Poisson FDM).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric (BASELINE.json): the compiled ELL SpMV throughput of the 7-point
+3-D Poisson operator on one chip. The reference publishes no absolute
+numbers (BASELINE.md: "published": {}), so `vs_baseline` reports the
+speedup over this repo's own sequential (NumPy CSR) oracle on the same
+problem — the honest stand-in for the reference's CPU execution model.
+
+Run with the default environment (real TPU via the axon platform); do NOT
+set the virtual-CPU test flags here.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu.models import assemble_poisson
+    from partitionedarrays_jl_tpu.ops.sparse import csr_spmv
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        DeviceVector,
+        TPUBackend,
+        device_matrix,
+        make_spmv_fn,
+    )
+
+    n = int(os.environ.get("PA_BENCH_N", "192"))  # n^3 cells, 7-pt stencil
+    reps = int(os.environ.get("PA_BENCH_REPS", "50"))
+    dtype = np.float32
+
+    backend = TPUBackend(devices=jax.devices()[:1])
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (n, n, n))
+        A.values = pa.map_parts(
+            lambda M: pa.CSRMatrix(M.indptr, M.indices, M.data.astype(dtype), M.shape),
+            A.values,
+        )
+        A.invalidate_blocks()
+        x_exact.values = pa.map_parts(
+            lambda v: np.asarray(v, dtype=dtype), x_exact.values
+        )
+        return A, x_exact
+
+    A, x = pa.prun(driver, backend, (1, 1, 1))
+    dA = device_matrix(A, backend)
+    dx = DeviceVector.from_pvector(x, backend, dA.col_layout)
+    spmv = make_spmv_fn(dA)
+    flops = dA.flops_per_spmv
+
+    # Device timing by *marginal* chain cost: the axon relay acks
+    # block_until_ready before true completion, so we chain K dependent
+    # SpMVs, force completion with a host scalar fetch, and difference two
+    # chain lengths to cancel the fixed RTT overhead.
+    from functools import partial
+
+    assert dx.data.shape == spmv(dx.data).shape, "square chain layout expected"
+
+    @partial(jax.jit, static_argnums=1)
+    def chain(x, k):
+        # K dependent SpMVs in ONE compiled program: per-dispatch relay
+        # overhead (tens of ms through the axon tunnel) stays out of the
+        # marginal per-op cost; the host scalar fetch forces completion.
+        return jax.lax.fori_loop(0, k, lambda i, y: spmv(y), x).sum()
+
+    def chain_time(k: int) -> float:
+        float(chain(dx.data, k))  # warm compile for this k
+        t0 = time.perf_counter()
+        float(chain(dx.data, k))
+        return time.perf_counter() - t0
+
+    k1, k2 = max(5, reps // 4), reps
+    t1 = min(chain_time(k1) for _ in range(3))
+    t2 = min(chain_time(k2) for _ in range(3))
+    dt = max((t2 - t1) / (k2 - k1), 1e-9)
+    gflops = flops / dt / 1e9
+
+    # sequential-oracle timing on the same local problem (NumPy CSR)
+    M = A.values.part_values()[0]
+    xv = np.asarray(x.values.part_values()[0], dtype=dtype)
+    host_reps = max(1, min(5, reps // 10))
+    csr_spmv(M, xv)
+    t0 = time.perf_counter()
+    for _ in range(host_reps):
+        csr_spmv(M, xv)
+    host_dt = (time.perf_counter() - t0) / host_reps
+    host_gflops = flops / host_dt / 1e9
+
+    print(
+        json.dumps(
+            {
+                "metric": f"spmv_gflops_per_chip_poisson3d_{n}cube_f32",
+                "value": round(gflops, 3),
+                "unit": "GFLOP/s",
+                "vs_baseline": round(gflops / host_gflops, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,3 +1,4 @@
+from .fem_q1 import assemble_fem_q1, fem_q1_driver
 from .poisson_fdm import assemble_poisson, manufactured_solution, poisson_fdm_driver
 from .solvers import (
     PLU,
@@ -10,6 +11,8 @@ from .solvers import (
 )
 
 __all__ = [
+    "assemble_fem_q1",
+    "fem_q1_driver",
     "assemble_poisson",
     "manufactured_solution",
     "poisson_fdm_driver",

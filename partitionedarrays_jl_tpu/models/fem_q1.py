@@ -1,0 +1,184 @@
+"""2-D Q1 finite-element assembly driver: the remote-row assembly workload.
+
+The analog of the reference's FEM test driver (reference:
+test/test_fem_sa.jl): a structured grid of Q1 (bilinear quad) elements,
+each assembled by the part owning its lower-left node, so element
+contributions touch nodes (rows AND cols) owned by *other* parts. This
+exercises the machinery the FDM driver does not:
+
+* row-ghosted PRanges (`add_gids` on rows),
+* `assemble_coo` migration of off-owner triplets before compression
+  (reference: test/test_fem_sa.jl:76-104, src/Interfaces.jl:2406-2492),
+* `global_view` writes into the rhs + PVector `assemble`
+  (reference: test/test_fem_sa.jl:86-101),
+* CG on the assembled operator with the 1e-5 gate
+  (reference: test/test_fem_sa.jl:137).
+
+The hardcoded 4x4 Q1 Laplace element stiffness matches the reference's
+fixture (test/test_fem_sa.jl:17-22); it is the standard textbook matrix
+(1/6)*[[4,-1,-2,-1],[-1,4,-1,-2],[-2,-1,4,-1],[-1,-2,-1,4]].
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from ..parallel.backends import AbstractPData, map_parts
+from ..utils.helpers import check
+from ..parallel.prange import add_gids, cartesian_partition, no_ghost, p_cartesian_indices
+from ..parallel.psparse import PSparseMatrix, assemble_coo
+from ..parallel.pvector import PVector, global_view
+from .solvers import cg
+
+#: Q1 Laplace element stiffness, nodes ordered (0,0),(1,0),(0,1),(1,1)
+KE = (
+    np.array(
+        [
+            [4.0, -1.0, -2.0, -1.0],
+            [-1.0, 4.0, -1.0, -2.0],
+            [-2.0, -1.0, 4.0, -1.0],
+            [-1.0, -2.0, -1.0, 4.0],
+        ]
+    )
+    / 6.0
+)
+
+
+def _boundary_mask(gids, ns):
+    """Dirichlet predicate: node on any face of the (n0 x n1) node grid."""
+    c0, c1 = np.unravel_index(np.asarray(gids), ns)
+    return (c0 == 0) | (c0 == ns[0] - 1) | (c1 == 0) | (c1 == ns[1] - 1)
+
+
+def assemble_fem_q1(parts: AbstractPData, nodes_per_dim: Sequence[int]):
+    """Assemble the Q1 Laplace stiffness over an (n0 x n1) node grid with
+    Dirichlet identity rows on the boundary; returns (A, b, x_exact, x0)
+    with b manufactured as A @ x̂."""
+    ns = tuple(int(n) for n in nodes_per_dim)
+    check(len(ns) == 2, "the Q1 driver is 2-D")
+    rows0 = cartesian_partition(parts, ns, no_ghost)
+    cis = p_cartesian_indices(parts, ns, no_ghost)
+
+    def _local_coo(ci):
+        # elements whose lower-left node this part owns and which fit the grid
+        x0s = ci.ranges[0]
+        x1s = ci.ranges[1]
+        ex = x0s[x0s < ns[0] - 1]
+        ey = x1s[x1s < ns[1] - 1]
+        EX, EY = np.meshgrid(ex, ey, indexing="ij")
+        EX, EY = EX.ravel(), EY.ravel()
+        # the element's 4 node gids, reference node order
+        corner = [(0, 0), (1, 0), (0, 1), (1, 1)]
+        gids = [
+            np.ravel_multi_index((EX + dx, EY + dy), ns) for dx, dy in corner
+        ]
+        I_list, J_list, V_list = [], [], []
+        # interior-node test functions only: boundary rows become identity
+        for a in range(4):
+            ga = gids[a]
+            keep = ~_boundary_mask(ga, ns)
+            for bidx in range(4):
+                gb = gids[bidx]
+                I_list.append(ga[keep])
+                J_list.append(gb[keep])
+                V_list.append(np.full(int(keep.sum()), KE[a, bidx]))
+        return (
+            np.concatenate(I_list) if I_list else np.empty(0, dtype=np.int64),
+            np.concatenate(J_list) if J_list else np.empty(0, dtype=np.int64),
+            np.concatenate(V_list) if V_list else np.empty(0),
+        )
+
+    coo = map_parts(_local_coo, cis)
+    I = map_parts(lambda c: c[0], coo)
+    J = map_parts(lambda c: c[1], coo)
+    V = map_parts(lambda c: c[2], coo)
+
+    # identity rows for boundary nodes, contributed by their owners
+    def _boundary_coo(iset):
+        g = iset.oid_to_gid
+        gb = g[_boundary_mask(g, ns)]
+        return gb, gb, np.ones(len(gb))
+
+    bcoo = map_parts(_boundary_coo, rows0.partition)
+    I = map_parts(lambda a, b: np.concatenate([a, b[0]]), I, bcoo)
+    J = map_parts(lambda a, b: np.concatenate([a, b[1]]), J, bcoo)
+    V = map_parts(lambda a, b: np.concatenate([a, b[2]]), V, bcoo)
+
+    # rows ghosted by the off-owner rows each part touches -> migrate
+    rows = add_gids(rows0, I)
+    I2, J2, V2 = assemble_coo(I, J, V, rows)
+    # migration keeps the shipped triplets locally with value 0 (append-only
+    # semantics); drop everything not on an owned row, then compress over
+    # the ghost-free rows0 and a column map discovered from the kept J
+    def _keep_owned(iset, i, j, v):
+        own = iset.gids_to_lids(np.asarray(i)) >= 0
+        return np.asarray(i)[own], np.asarray(j)[own], np.asarray(v)[own]
+
+    kept = map_parts(_keep_owned, rows0.partition, I2, J2, V2)
+    I2 = map_parts(lambda k: k[0], kept)
+    J2 = map_parts(lambda k: k[1], kept)
+    V2 = map_parts(lambda k: k[2], kept)
+    cols = add_gids(rows0, J2)
+    A = PSparseMatrix.from_coo(I2, J2, V2, rows0, cols, ids="global")
+
+    def _exact(iset):
+        c0, c1 = np.unravel_index(iset.lid_to_gid, ns)
+        return np.sin(0.4 + c0 / (ns[0] + 1.0)) + np.cos(0.3 + 2.0 * c1 / (ns[1] + 1.0))
+
+    x_exact = PVector(map_parts(_exact, cols.partition), cols)
+    b = A @ x_exact
+
+    def _x0(iset):
+        return np.where(_boundary_mask(iset.lid_to_gid, ns), _exact(iset), 0.0)
+
+    x0 = PVector(map_parts(_x0, cols.partition), cols)
+    return A, b, x_exact, x0
+
+
+def fem_q1_driver(
+    parts: AbstractPData,
+    nodes_per_dim: Sequence[int] = (8, 8),
+    tol: float = 1e-10,
+    maxiter: int = 2000,
+    verbose: bool = False,
+) -> Tuple[float, dict]:
+    """End-to-end FEM: assemble with remote-row migration, CG-solve, return
+    (error vs x̂, info). Gate: error < 1e-5 (reference: test/test_fem_sa.jl:137)."""
+    A, b, x_exact, x0 = assemble_fem_q1(parts, nodes_per_dim)
+    x, info = cg(A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose)
+    err = (x - x_exact).norm()
+    return float(err), info
+
+
+def fem_q1_rhs_via_global_view(parts: AbstractPData, nodes_per_dim=(8, 8)):
+    """Demonstrates the reference's rhs-assembly flow (test_fem_sa.jl:86-101):
+    per-element contributions written through a global_view into a
+    row-ghosted PVector, then `assemble()`d to the owners. Returns the
+    assembled rhs as a plain gathered array (for testing)."""
+    ns = tuple(int(n) for n in nodes_per_dim)
+    rows0 = cartesian_partition(parts, ns, no_ghost)
+    cis = p_cartesian_indices(parts, ns, no_ghost)
+
+    def _touched(ci):
+        x0s, x1s = ci.ranges
+        ex = x0s[x0s < ns[0] - 1]
+        ey = x1s[x1s < ns[1] - 1]
+        EX, EY = np.meshgrid(ex, ey, indexing="ij")
+        gs = [
+            np.ravel_multi_index((EX.ravel() + dx, EY.ravel() + dy), ns)
+            for dx, dy in [(0, 0), (1, 0), (0, 1), (1, 1)]
+        ]
+        return np.concatenate(gs) if gs else np.empty(0, dtype=np.int64)
+
+    touched = map_parts(_touched, cis)
+    rows = add_gids(rows0, touched)
+    bvec = PVector.full(0.0, rows)
+    gv = global_view(bvec)
+
+    def _scatter(view, t):
+        view.add_at(t, np.ones(len(t)))
+
+    map_parts(_scatter, gv, touched)
+    bvec.assemble()
+    return bvec

@@ -42,6 +42,12 @@ def cg(
     history is reproducible bit-for-bit for a given backend and matches the
     sequential oracle on the TPU backend (the BASELINE.md gate).
     """
+    from ..parallel.tpu import TPUBackend, tpu_cg
+
+    if isinstance(b.values.backend, TPUBackend):
+        # Device path: the whole loop is one compiled shard_map program.
+        return tpu_cg(A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose)
+
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
 

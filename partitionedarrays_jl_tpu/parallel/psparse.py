@@ -32,7 +32,7 @@ from .pvector import PVector, _owned, _ghost
 
 
 class PSparseMatrix:
-    __slots__ = ("values", "rows", "cols", "_exchanger", "_blocks")
+    __slots__ = ("values", "rows", "cols", "_exchanger", "_blocks", "_device")
 
     def __init__(
         self,
@@ -46,6 +46,7 @@ class PSparseMatrix:
         self.cols = cols
         self._exchanger = exchanger
         self._blocks = None
+        self._device = {}  # backend id -> lowered DeviceMatrix (tpu.py)
 
     # ------------------------------------------------------------------
     # constructors (reference: src/Interfaces.jl:2194-2244)

@@ -1,0 +1,141 @@
+"""PTimer: execution-model-aware named-section wall timing (L7).
+
+TPU-native analog of reference src/PTimers.jl. Semantics preserved:
+
+* `tic(barrier=True)` synchronizes all parts first so a section measures
+  the slowest part honestly (the reference inserts `MPI.Barrier`,
+  src/PTimers.jl:69-74). Under the TPU backend the barrier drains the
+  dispatch queue (`jax.effects_barrier` + blocking on pending arrays is the
+  device analog of a rank barrier in a single-controller runtime).
+* `toc(name)` stores one Δt per part (PData), optionally printing on MAIN
+  (src/PTimers.jl:76-87).
+* `.data` gathers every section to MAIN and reduces to (min, max, avg)
+  (src/PTimers.jl:40-59).
+* `print_timer()` renders a max-sorted table on MAIN (src/PTimers.jl:93-148).
+
+In this single-controller design all parts share one host clock, so
+per-part times are equal unless the user times per-part work explicitly —
+the PData-of-times structure is kept for API parity and for the
+distributed-future where parts live on separate hosts.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .backends import AbstractPData, get_part_ids, i_am_main, map_parts
+from .collectives import gather
+from ..utils.helpers import check
+
+
+def _device_barrier(backend) -> None:
+    from .tpu import TPUBackend
+
+    if isinstance(backend, TPUBackend):
+        import jax
+        import numpy as _np
+
+        jax.effects_barrier()  # drains effectful computations
+        # Pure computations are NOT covered by effects_barrier: flush each
+        # device's FIFO by queueing a tiny jitted op behind the pending
+        # work and blocking on it — the single-controller analog of
+        # MPI.Barrier (reference: src/PTimers.jl:69-74).
+        for d in backend.devices():
+            x = jax.device_put(_np.zeros(()), d)
+            jax.block_until_ready(jax.jit(lambda a: a + 1)(x))
+
+
+class PTimer:
+    def __init__(self, parts: AbstractPData, verbose: bool = False):
+        self.parts = get_part_ids(parts)
+        self.verbose = verbose
+        self.timings = {}  # name -> PData of seconds
+        self._t0: Optional[float] = None
+        self._current: Optional[str] = None
+
+    # -- reference API: tic!/toc! ---------------------------------------
+    def tic(self, barrier: bool = True) -> "PTimer":
+        if barrier:
+            _device_barrier(self.parts.backend)
+        self._t0 = time.perf_counter()
+        return self
+
+    def toc(self, name: str) -> "PTimer":
+        check(self._t0 is not None, "toc without tic")
+        _device_barrier(self.parts.backend)
+        dt = time.perf_counter() - self._t0
+        self.timings[name] = map_parts(lambda _p: dt, self.parts)
+        self._t0 = None
+        if self.verbose and i_am_main(self.parts):
+            print(f"[ptimer] {name}: {dt:.6f} s")
+        return self
+
+    def section(self, name: str):
+        """Context-manager sugar: `with t.section("assembly"): ...`"""
+        timer = self
+
+        class _Section:
+            def __enter__(self):
+                timer.tic()
+                return timer
+
+            def __exit__(self, exc_type, exc, tb):
+                if exc_type is None:
+                    timer.toc(name)
+                return False
+
+        return _Section()
+
+    # -- reference API: t.data ------------------------------------------
+    @property
+    def data(self):
+        """(min, max, avg) per section, on MAIN (reference: src/PTimers.jl:40-59)."""
+        out = {}
+        for name, times in self.timings.items():
+            g = gather(times)
+
+            def _stats(ts):
+                ts = list(ts)
+                if not ts:
+                    return None
+                return {
+                    "min": min(ts),
+                    "max": max(ts),
+                    "avg": sum(ts) / len(ts),
+                }
+
+            stats = map_parts(lambda t: _stats(t) if len(t) else None, g)
+            out[name] = stats.get_part(0)
+        return out
+
+    def print_timer(self) -> None:
+        """Max-sorted section table, printed on MAIN only."""
+        if not i_am_main(self.parts):
+            return
+        data = self.data
+        rows = sorted(data.items(), key=lambda kv: -kv[1]["max"])
+        namew = max([len("section")] + [len(k) for k in data])
+        print(f"{'section'.ljust(namew)}  {'max':>12}  {'min':>12}  {'avg':>12}")
+        print("-" * (namew + 44))
+        for name, st in rows:
+            print(
+                f"{name.ljust(namew)}  {st['max']:>12.6f}  {st['min']:>12.6f}  "
+                f"{st['avg']:>12.6f}"
+            )
+
+    def __repr__(self):
+        return f"PTimer(sections={list(self.timings)})"
+
+
+def tic(t: PTimer, barrier: bool = True) -> PTimer:
+    """Reference export parity (src/PTimers.jl:69-74)."""
+    return t.tic(barrier)
+
+
+def toc(t: PTimer, name: str) -> PTimer:
+    """Reference export parity (src/PTimers.jl:76-87)."""
+    return t.toc(name)
+
+
+def print_timer(t: PTimer) -> None:
+    return t.print_timer()

@@ -452,6 +452,50 @@ def global_view(v: PVector, rows: Optional[PRange] = None) -> AbstractPData:
     )
 
 
+# ---------------------------------------------------------------------------
+# distance metrics (reference L8: Distances.jl metrics on PVector via
+# owned-only partial evaluation + cross-part reduce, src/Interfaces.jl:1776-1825)
+# ---------------------------------------------------------------------------
+
+
+def _metric_reduce(a: PVector, b: PVector, local, across, post, init):
+    partials = map_parts(
+        lambda ai, av, bi, bv: local(_owned(ai, av), _owned(bi, bv)),
+        a.rows.partition,
+        a.values,
+        b.rows.partition,
+        b.values,
+    )
+    return post(preduce(across, partials, init))
+
+
+def sqeuclidean(a: PVector, b: PVector):
+    return _metric_reduce(
+        a, b, lambda x, y: float(np.sum((x - y) ** 2)), operator.add, lambda s: s, 0.0
+    )
+
+
+def euclidean(a: PVector, b: PVector):
+    return float(np.sqrt(sqeuclidean(a, b)))
+
+
+def cityblock(a: PVector, b: PVector):
+    return _metric_reduce(
+        a, b, lambda x, y: float(np.sum(np.abs(x - y))), operator.add, lambda s: s, 0.0
+    )
+
+
+def chebyshev(a: PVector, b: PVector):
+    return _metric_reduce(
+        a,
+        b,
+        lambda x, y: float(np.max(np.abs(x - y))) if len(x) else 0.0,
+        max,
+        lambda s: s,
+        0.0,
+    )
+
+
 # free-function parity helpers
 def assemble(v: PVector, combine_op=np.add) -> PVector:
     return v.assemble(combine_op)

@@ -64,6 +64,11 @@ class SequentialData(AbstractPData):
     def shape(self) -> Tuple[int, ...]:
         return self._shape
 
+    def _like(self, parts: list) -> "SequentialData":
+        """Same-type, same-grid PData over new values (subclass hook so
+        derived backends keep their identity through map_parts/collectives)."""
+        return SequentialData(parts, self._shape)
+
     def map_parts(self, task: Callable, *args) -> "SequentialData":
         n = self.num_parts
         cols = []
@@ -74,7 +79,7 @@ class SequentialData(AbstractPData):
             else:
                 cols.append([a] * n)
         out = [task(*vals) for vals in zip(*cols)]
-        return SequentialData(out, self._shape)
+        return self._like(out)
 
     def get_part(self, part: int = None):
         if part is None:
@@ -114,7 +119,7 @@ class SequentialData(AbstractPData):
             out = [_copy_payload(full) for _ in range(n)]
         else:
             out = [full if p == MAIN else _copy_payload(empty) for p in range(n)]
-        return SequentialData(out, self._shape)
+        return self._like(out)
 
     def _scatter(self) -> "SequentialData":
         n = self.num_parts
@@ -126,12 +131,12 @@ class SequentialData(AbstractPData):
             src = np.asarray(src)
             check(len(src) == n, "scatter: MAIN must hold one entry per part")
             out = [src[p] for p in range(n)]
-        return SequentialData(out, self._shape)
+        return self._like(out)
 
     def _emit(self) -> "SequentialData":
         n = self.num_parts
         src = self.parts[MAIN]
-        return SequentialData([_copy_payload(src) for _ in range(n)], self._shape)
+        return self._like([_copy_payload(src) for _ in range(n)])
 
     def _async_exchange(
         self,
@@ -166,7 +171,7 @@ class SequentialData(AbstractPData):
                     drow[:] = row
                 else:
                     dst[i] = payload[j]
-        return SequentialData([Token() for _ in range(n)], self._shape)
+        return self._like([Token() for _ in range(n)])
 
 
 def _is_vector_payload(vals) -> bool:

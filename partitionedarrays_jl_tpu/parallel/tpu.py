@@ -1,0 +1,699 @@
+"""TPU backend: parts are devices of a `jax.sharding.Mesh` (L3').
+
+The TPU-native execution model (BASELINE.md north star; SURVEY.md §7):
+
+* **Planning on host.** `TPUData` extends the sequential PData, so every
+  planning-phase algorithm (PRange construction, Exchanger build, COO
+  assembly, neighbor discovery) runs unchanged — metadata is host NumPy in
+  both backends, mirroring the reference's plan/execute split.
+* **Execution compiled.** A lowering layer ("graft" of the host objects
+  onto the mesh) turns a PRange+Exchanger into static pack/`ppermute`/
+  unpack index programs, a PSparseMatrix into stacked padded-ELL blocks in
+  HBM, and a PVector into one (P, W) array sharded over the mesh's
+  ``'parts'`` axis. Halo exchange is a fixed sequence of `ppermute` rounds
+  over ICI (host-side greedy edge coloring of the neighbor graph);
+  reductions are deterministic `all_gather` + fixed-order folds so results
+  match the sequential oracle; the whole CG loop is ONE `shard_map`-ped
+  jitted program (`lax.while_loop`), with the A_oo partial SpMV issued
+  before the halo unpack so XLA's latency-hiding scheduler overlaps compute
+  with the collectives — the compiled analog of the reference's task-graph
+  overlap (reference: src/Interfaces.jl:2246-2275).
+
+Layout of a device vector row (one part), width ``W = no_max + nh_max + 1``:
+
+    [ owned values (padded to no_max) | ghosts (padded to nh_max) | trash ]
+
+Padding stays zero by construction; the final "trash" slot absorbs masked
+scatter lanes so no dynamic shapes or bound checks reach the compiled code.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from ..utils.helpers import check
+from ..utils.table import INDEX_DTYPE
+from .backends import AbstractBackend, PartShape, _as_shape
+from .exchanger import Exchanger
+from .prange import PRange
+from .sequential import SequentialData
+from .pvector import PVector, _owned
+from .psparse import PSparseMatrix
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+class TPUBackend(AbstractBackend):
+    """Each part is one device of a 1-D mesh over axis ``'parts'``.
+
+    Works identically on real TPU chips and on virtual CPU devices
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=N`` — the CI story,
+    SURVEY.md §4)."""
+
+    def __init__(self, devices=None):
+        self._devices = devices
+        self._meshes = {}
+
+    def devices(self):
+        return self._devices if self._devices is not None else _jax().devices()
+
+    def mesh(self, nparts: int):
+        if nparts not in self._meshes:
+            jax = _jax()
+            devs = self.devices()
+            check(
+                nparts <= len(devs),
+                f"TPUBackend: {nparts} parts requested but only {len(devs)} devices",
+            )
+            self._meshes[nparts] = jax.sharding.Mesh(
+                np.array(devs[:nparts]), ("parts",)
+            )
+        return self._meshes[nparts]
+
+    def parts_spec(self):
+        jax = _jax()
+        return jax.sharding.PartitionSpec("parts")
+
+    def sharding(self, nparts: int):
+        jax = _jax()
+        return jax.sharding.NamedSharding(self.mesh(nparts), self.parts_spec())
+
+    def get_part_ids(self, nparts: PartShape) -> "TPUData":
+        shape = _as_shape(nparts)
+        n = math.prod(shape)
+        self.mesh(n)  # validate device count early
+        return TPUData(list(range(n)), shape, self)
+
+    def prun(self, driver, nparts, *args, **kwargs):
+        """Fail-fast entry point: any driver exception is logged with its
+        traceback before propagating, so a failure kills the whole job
+        instead of wedging devices mid-collective — the single-controller
+        analog of the reference's catch + `MPI.Abort`
+        (reference: src/MPIBackend.jl:21-36)."""
+        parts = self.get_part_ids(nparts)
+        try:
+            return driver(parts, *args, **kwargs)
+        except Exception:
+            import traceback
+
+            print("[partitionedarrays_jl_tpu] driver failed; aborting job:")
+            traceback.print_exc()
+            raise
+
+    def __repr__(self):
+        return f"TPUBackend(ndevices={len(self.devices())})"
+
+
+#: Default-singleton, the analog of `sequential` (uses all visible devices).
+tpu = TPUBackend()
+
+
+class TPUData(SequentialData):
+    """Host-side per-part metadata under the TPU backend: planning values
+    live on host exactly as in the sequential backend; only the lowered
+    hot-path arrays live in HBM. Collective semantics are inherited — the
+    device collectives appear in the *compiled* programs, not here."""
+
+    __slots__ = ("_backend",)
+
+    def __init__(self, parts, shape=None, backend: TPUBackend = None):
+        super().__init__(parts, shape)
+        self._backend = backend if backend is not None else tpu
+
+    @property
+    def backend(self) -> TPUBackend:
+        return self._backend
+
+    def _like(self, parts: list) -> "TPUData":
+        return TPUData(parts, self._shape, self._backend)
+
+
+# ---------------------------------------------------------------------------
+# lowering: host plan -> static device programs
+# ---------------------------------------------------------------------------
+
+
+class DeviceLayout:
+    """Slot layout shared by every device object over one PRange."""
+
+    __slots__ = ("P", "W", "no_max", "nh_max", "noids", "nhids", "lid_slots")
+
+    def __init__(self, rows: PRange):
+        isets = rows.partition.part_values()
+        self.P = len(isets)
+        self.noids = np.array([i.num_oids for i in isets], dtype=np.int64)
+        self.nhids = np.array([i.num_hids for i in isets], dtype=np.int64)
+        self.no_max = int(self.noids.max())
+        self.nh_max = int(self.nhids.max()) if self.P else 0
+        self.W = self.no_max + self.nh_max + 1
+        # lid -> slot per part (owned-first contract)
+        self.lid_slots = []
+        for i in isets:
+            check(i.owned_first, "device lowering requires owned-first lid layout")
+            slots = np.concatenate(
+                [
+                    np.arange(i.num_oids, dtype=INDEX_DTYPE),
+                    self.no_max + np.arange(i.num_hids, dtype=INDEX_DTYPE),
+                ]
+            )
+            self.lid_slots.append(slots)
+
+    @property
+    def trash(self) -> int:
+        return self.W - 1
+
+
+def _color_edges(edges):
+    """Greedy edge coloring of the directed neighbor graph into rounds
+    where each part sends to at most one part and receives from at most one
+    — each round is one partial permutation, i.e. one `ppermute` over ICI.
+    Cartesian halo graphs color into (#offsets) rounds, matching the torus
+    neighbor structure."""
+    edges = sorted(edges, key=lambda e: -len(e[2]))  # big payloads first
+    rounds = []
+    for src, dst, snd, rcv in edges:
+        placed = False
+        for r in rounds:
+            if all(s != src for s, _, _, _ in r) and all(d != dst for _, d, _, _ in r):
+                r.append((src, dst, snd, rcv))
+                placed = True
+                break
+        if not placed:
+            rounds.append([(src, dst, snd, rcv)])
+    return rounds
+
+
+class DeviceExchangePlan:
+    """Static halo-exchange program: R `ppermute` rounds with pack/unpack
+    index matrices (the compiled form of an Exchanger)."""
+
+    __slots__ = ("layout", "perms", "snd_idx", "snd_mask", "rcv_idx", "R", "L")
+
+    def __init__(self, exchanger: Exchanger, layout: DeviceLayout):
+        P, W = layout.P, layout.W
+        edges = []
+        parts_snd = exchanger.parts_snd.part_values()
+        parts_rcv = exchanger.parts_rcv.part_values()
+        lids_snd = exchanger.lids_snd.part_values()
+        lids_rcv = exchanger.lids_rcv.part_values()
+        for p in range(P):
+            for j, q in enumerate(np.asarray(parts_snd[p])):
+                q = int(q)
+                hits = np.nonzero(np.asarray(parts_rcv[q]) == p)[0]
+                check(len(hits) == 1, "device plan: inconsistent neighbor graphs")
+                i = int(hits[0])
+                snd_slots = layout.lid_slots[p][lids_snd[p][j]]
+                rcv_slots = layout.lid_slots[q][lids_rcv[q][i]]
+                check(len(snd_slots) == len(rcv_slots), "device plan: edge size mismatch")
+                edges.append((p, q, snd_slots, rcv_slots))
+        rounds = _color_edges(edges)
+        self.layout = layout
+        self.R = len(rounds)
+        self.L = max((len(e[2]) for e in edges), default=0)
+        R, L = max(self.R, 1), max(self.L, 1)
+        self.snd_idx = np.zeros((P, R, L), dtype=INDEX_DTYPE)
+        self.snd_mask = np.zeros((P, R, L), dtype=bool)
+        self.rcv_idx = np.full((P, R, L), layout.trash, dtype=INDEX_DTYPE)
+        self.perms = []
+        for r, edges_r in enumerate(rounds):
+            perm = []
+            for src, dst, snd, rcv in edges_r:
+                k = len(snd)
+                self.snd_idx[src, r, :k] = snd
+                self.snd_mask[src, r, :k] = True
+                self.rcv_idx[dst, r, :k] = rcv
+                perm.append((src, dst))
+            self.perms.append(tuple(perm))
+        self.perms = tuple(self.perms)
+
+
+def _shard_exchange(plan: DeviceExchangePlan, combine: str):
+    """Per-shard halo exchange body (used inside shard_map): R static
+    `ppermute` rounds. `combine='set'` for owner->ghost halo updates,
+    `'add'` for ghost->owner assembly scatter-accumulation (which, like the
+    host `assemble`, zeroes the ghost region afterwards —
+    reference: src/Interfaces.jl:2078-2106)."""
+    import jax
+    import jax.numpy as jnp
+
+    R = plan.R
+    perms = plan.perms
+    no_max = plan.layout.no_max
+
+    def body(xv, si, sm, ri):
+        for r in range(R):
+            buf = jnp.where(sm[r], xv[si[r]], 0)
+            buf = jax.lax.ppermute(buf, "parts", perm=perms[r])
+            if combine == "add":
+                xv = xv.at[ri[r]].add(buf)
+            else:
+                xv = xv.at[ri[r]].set(buf)
+            # keep the trash slot clean so padding invariants hold
+            xv = xv.at[plan.layout.trash].set(0)
+        if combine == "add":
+            xv = xv.at[no_max:].set(0)  # ghost contributions now live on owners
+        return xv
+
+    return body
+
+
+class DeviceVector:
+    """A PVector lowered to one (P, W) array sharded over the mesh."""
+
+    __slots__ = ("data", "rows", "layout", "backend")
+
+    def __init__(self, data, rows: PRange, layout: DeviceLayout, backend: TPUBackend):
+        self.data = data
+        self.rows = rows
+        self.layout = layout
+        self.backend = backend
+
+    @classmethod
+    def from_pvector(cls, v: PVector, backend: TPUBackend, layout=None) -> "DeviceVector":
+        layout = layout or device_layout(v.rows)
+        stacked = np.zeros((layout.P, layout.W), dtype=v.dtype)
+        for p, (iset, vals) in enumerate(
+            zip(v.rows.partition.part_values(), v.values.part_values())
+        ):
+            vals = np.asarray(vals)
+            stacked[p, : iset.num_oids] = vals[: iset.num_oids]
+            stacked[p, layout.no_max : layout.no_max + iset.num_hids] = vals[
+                iset.num_oids :
+            ]
+        jax = _jax()
+        data = jax.device_put(stacked, backend.sharding(layout.P))
+        return cls(data, v.rows, layout, backend)
+
+    def to_pvector(self) -> PVector:
+        host = np.asarray(self.data)
+        vals = []
+        for p, iset in enumerate(self.rows.partition.part_values()):
+            vals.append(
+                np.concatenate(
+                    [
+                        host[p, : iset.num_oids],
+                        host[p, self.layout.no_max : self.layout.no_max + iset.num_hids],
+                    ]
+                )
+            )
+        parts = self.rows.partition
+        return PVector(parts._like(vals), self.rows)
+
+
+def device_layout(rows: PRange) -> DeviceLayout:
+    if not hasattr(rows, "_device_layout"):
+        rows._device_layout = DeviceLayout(rows)
+    return rows._device_layout
+
+
+def device_exchange_plan(rows: PRange) -> DeviceExchangePlan:
+    if not hasattr(rows, "_device_plan"):
+        rows._device_plan = DeviceExchangePlan(rows.exchanger, device_layout(rows))
+    return rows._device_plan
+
+
+class DeviceMatrix:
+    """A PSparseMatrix lowered to stacked padded-ELL blocks in HBM:
+    A_oo and A_oh as (P, no_max, L) val/col arrays, cols indexing the
+    (P, W) vector slots. The owned/ghost split keeps the overlap structure
+    of the reference SpMV (src/Interfaces.jl:2246-2275) visible to XLA."""
+
+    __slots__ = (
+        "oo_vals", "oo_cols", "oh_vals", "oh_cols",
+        "dia_offsets", "dia_vals",
+        "rows", "cols", "row_layout", "col_layout", "col_plan", "backend",
+        "flops_per_spmv", "_cg_cache",
+    )
+
+    #: Use the diagonal (DIA) fast path when the union of A_oo band offsets
+    #: across parts is at most this. TPUs have no fast random-gather unit —
+    #: a generic ELL gather runs element-at-a-time — but a banded SpMV is a
+    #: sum of rolled slices, pure VPU streaming at HBM bandwidth. Stencil
+    #: operators (FDM/FVM) are exactly this shape.
+    DIA_MAX_OFFSETS = 64
+
+    def __init__(self, A: PSparseMatrix, backend: TPUBackend):
+        from ..ops.sparse import ELLMatrix
+
+        jax = _jax()
+        row_layout = device_layout(A.rows)
+        col_layout = device_layout(A.cols)
+        self.rows, self.cols = A.rows, A.cols
+        self.row_layout, self.col_layout = row_layout, col_layout
+        self.col_plan = device_exchange_plan(A.cols)
+        self.backend = backend
+        P = row_layout.P
+        oo = A.owned_owned_values.part_values()
+        oh = A.owned_ghost_values.part_values()
+        L_oo = max((int(m.row_lengths().max()) if m.nnz else 0 for m in oo), default=0)
+        L_oh = max((int(m.row_lengths().max()) if m.nnz else 0 for m in oh), default=0)
+        L_oo, L_oh = max(L_oo, 1), max(L_oh, 1)
+        no_max = row_layout.no_max
+        Wc = col_layout.W
+        oo_vals = np.zeros((P, no_max, L_oo))
+        oo_cols = np.full((P, no_max, L_oo), col_layout.trash, dtype=INDEX_DTYPE)
+        oh_vals = np.zeros((P, no_max, L_oh))
+        oh_cols = np.full((P, no_max, L_oh), col_layout.trash, dtype=INDEX_DTYPE)
+        nnz = 0
+        for p in range(P):
+            Eoo = ELLMatrix.from_csr(oo[p], row_width=L_oo)
+            m = Eoo.vals.shape[0]
+            oo_vals[p, :m] = Eoo.vals
+            # ELL pad cols are 0 with val 0 — safe: slot 0 is a real owned slot
+            oo_cols[p, :m] = Eoo.cols  # owned cols: slot == col lid
+            Eoh = ELLMatrix.from_csr(oh[p], row_width=L_oh)
+            oh_vals[p, :m] = Eoh.vals
+            oh_cols[p, :m] = col_layout.no_max + Eoh.cols  # ghost region slots
+            nnz += oo[p].nnz + oh[p].nnz
+        self.flops_per_spmv = 2 * nnz
+        self._cg_cache = {}
+        sh = backend.sharding(P)
+        dt = A.dtype
+        self.oo_vals = jax.device_put(oo_vals.astype(dt), sh)
+        self.oo_cols = jax.device_put(oo_cols, sh)
+        self.oh_vals = jax.device_put(oh_vals.astype(dt), sh)
+        self.oh_cols = jax.device_put(oh_cols, sh)
+
+        # DIA fast path for the owned-owned block (cols' owned lids number
+        # identically to rows' in square operators): entry (r, r+o) goes to
+        # diagonal o. Offsets sorted ascending = ascending column order per
+        # row, so the accumulation order (and the bits) match the ELL/CSR
+        # kernels; absent diagonals contribute exact +0 terms.
+        offs = set()
+        square = all(
+            np.array_equal(ri.oid_to_gid, ci.oid_to_gid)
+            for ri, ci in zip(
+                A.rows.partition.part_values(), A.cols.partition.part_values()
+            )
+        )
+        if square:
+            for p in range(P):
+                M = oo[p]
+                if M.nnz:
+                    offs.update(
+                        np.unique(M.indices.astype(np.int64) - M.row_of_nz()).tolist()
+                    )
+        if square and 0 < len(offs) <= self.DIA_MAX_OFFSETS:
+            offsets = tuple(sorted(offs))
+            D = len(offsets)
+            dia = np.zeros((P, D, no_max))
+            off_arr = np.array(offsets)
+            for p in range(P):
+                M = oo[p]
+                if M.nnz:
+                    r = M.row_of_nz()
+                    d = np.searchsorted(off_arr, M.indices.astype(np.int64) - r)
+                    dia[p, d, r] = M.data
+            self.dia_offsets = offsets
+            self.dia_vals = jax.device_put(dia.astype(dt), sh)
+        else:
+            self.dia_offsets = None
+            self.dia_vals = self.oo_vals  # placeholder with a valid sharding
+
+
+def device_matrix(A: PSparseMatrix, backend: TPUBackend) -> DeviceMatrix:
+    # cached ON the matrix object so the lowering's lifetime is tied to A
+    # (an external id()-keyed dict would go stale when ids are recycled)
+    key = id(backend)
+    if key not in A._device:
+        A._device[key] = DeviceMatrix(A, backend)
+    return A._device[key]
+
+
+# ---------------------------------------------------------------------------
+# compiled programs
+# ---------------------------------------------------------------------------
+
+
+def _pdot_factory(no_max: int):
+    """Deterministic across-parts dot: per-shard partial (owned region;
+    padding is zero by invariant), `all_gather`, fold in part order — the
+    compiled form of the sequential `preduce` left-fold, so the reduction
+    order (and hence bits) matches the oracle."""
+    import jax
+    import jax.numpy as jnp
+
+    def pdot(a, b):
+        partial_ = jnp.sum(a[:no_max] * b[:no_max])
+        allp = jax.lax.all_gather(partial_, "parts")
+        return jnp.sum(allp)
+
+    return pdot
+
+
+def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") -> Callable:
+    """Compiled halo update: (P, W) sharded array -> same with ghosts
+    current (combine='set') or owners accumulated (combine='add', reverse
+    plan) — the device form of exchange!/assemble!."""
+    import jax
+    from jax import shard_map
+
+    plan = device_exchange_plan(rows)
+    if combine == "add":
+        rev = plan.layout  # reverse plan: swap pack/unpack roles
+        rplan = DeviceExchangePlan(rows.exchanger.reverse(), rev)
+        plan = rplan
+    mesh = backend.mesh(plan.layout.P)
+    spec = backend.parts_spec()
+    body = _shard_exchange(plan, combine)
+
+    @jax.jit
+    def fn(x, si, sm, ri):
+        def shard_fn(xs, sis, sms, ris):
+            return body(xs[0], sis[0], sms[0], ris[0])[None]
+
+        return shard_map(
+            shard_fn, mesh=mesh, in_specs=(spec, spec, spec, spec), out_specs=spec
+        )(x, si, sm, ri)
+
+    sh = backend.sharding(plan.layout.P)
+    si = _jax().device_put(plan.snd_idx, sh)
+    sm = _jax().device_put(plan.snd_mask, sh)
+    ri = _jax().device_put(plan.rcv_idx, sh)
+    return lambda x: fn(x, si, sm, ri)
+
+
+def _spmv_body(dA: DeviceMatrix):
+    """Per-shard overlapped SpMV: pack+permute the halo, compute the A_oo
+    partial on pre-exchange owned values (independent of the collective —
+    XLA overlaps them), then unpack and add the A_oh ghost contribution."""
+    import jax.numpy as jnp
+
+    plan = dA.col_plan
+    exch = _shard_exchange(plan, "set")
+    no_max = dA.row_layout.no_max
+
+    def _ell_rowsum(vals, cols, xv):
+        # strict left-to-right fold over the (static, small) row width, the
+        # same accumulation order as the host CSR kernel's reduceat — keeps
+        # the device result bit-comparable with the sequential oracle
+        L = vals.shape[-1]
+        acc = vals[:, 0] * xv[cols[:, 0]]
+        for l in range(1, L):
+            acc = acc + vals[:, l] * xv[cols[:, l]]
+        return acc
+
+    offsets = dA.dia_offsets
+
+    def _dia_rowsum(vals, xv):
+        # banded fast path: no gather — each diagonal is a rolled slice of
+        # x streamed through the VPU. Ascending-offset order == ascending-
+        # column order per row, so bits match the ELL fold (absent
+        # diagonals add exact zeros).
+        acc = vals[0] * jnp.roll(xv, -offsets[0])[:no_max]
+        for d in range(1, len(offsets)):
+            acc = acc + vals[d] * jnp.roll(xv, -offsets[d])[:no_max]
+        return acc
+
+    def body(xv, oo_v, oo_c, oh_v, oh_c, si, sm, ri):
+        if offsets is not None:
+            partial_ = _dia_rowsum(oo_v, xv)  # owned block, overlaps the wire
+        else:
+            partial_ = _ell_rowsum(oo_v, oo_c, xv)
+        xv = exch(xv, si, sm, ri)
+        y_o = partial_ + _ell_rowsum(oh_v, oh_c, xv)
+        y = jnp.zeros_like(xv).at[:no_max].set(y_o)
+        return y, xv
+
+    return body
+
+
+def _oo_operand(dA: "DeviceMatrix"):
+    """The A_oo operand fed to compiled programs: DIA bands when the fast
+    path applies, the padded-ELL values otherwise."""
+    return dA.dia_vals if dA.dia_offsets is not None else dA.oo_vals
+
+
+def make_spmv_fn(dA: DeviceMatrix) -> Callable:
+    """Compiled y = A @ x over the mesh: returns a function mapping the
+    (P, Wc) column-range vector to the (P, Wr) row-range product (ghost
+    slots of y zero, like the host mul)."""
+    import jax
+    from jax import shard_map
+
+    mesh = dA.backend.mesh(dA.row_layout.P)
+    spec = dA.backend.parts_spec()
+    body = _spmv_body(dA)
+    plan = dA.col_plan
+    sh = dA.backend.sharding(plan.layout.P)
+    si = jax.device_put(plan.snd_idx, sh)
+    sm = jax.device_put(plan.snd_mask, sh)
+    ri = jax.device_put(plan.rcv_idx, sh)
+
+    @jax.jit
+    def fn(x, oo_v, oo_c, oh_v, oh_c, si, sm, ri):
+        def shard_fn(xs, a, b, c, d, e, f, g):
+            y, _ = body(xs[0], a[0], b[0], c[0], d[0], e[0], f[0], g[0])
+            return y[None]
+
+        return shard_map(
+            shard_fn, mesh=mesh, in_specs=(spec,) * 8, out_specs=spec
+        )(x, oo_v, oo_c, oh_v, oh_c, si, sm, ri)
+
+    return lambda x: fn(x, _oo_operand(dA), dA.oo_cols, dA.oh_vals, dA.oh_cols, si, sm, ri)
+
+
+def make_cg_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
+    """The whole CG solve as ONE compiled shard_map program:
+    `lax.while_loop` whose body does the overlapped SpMV, deterministic
+    all-gather dots, and owned-region axpys. Returns
+    (x_stacked, iterations, final_residual)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+
+    mesh = dA.backend.mesh(dA.row_layout.P)
+    spec = dA.backend.parts_spec()
+    none_spec = jax.sharding.PartitionSpec()
+    body_spmv = _spmv_body(dA)
+    no_max = dA.row_layout.no_max
+    pdot = _pdot_factory(no_max)
+    plan = dA.col_plan
+    sh = dA.backend.sharding(plan.layout.P)
+    si_d = jax.device_put(plan.snd_idx, sh)
+    sm_d = jax.device_put(plan.snd_mask, sh)
+    ri_d = jax.device_put(plan.rcv_idx, sh)
+
+    # per-iteration residual history, fixed-shape for the while_loop carry
+    # (capped: a convergence curve beyond this many entries is truncated)
+    H = int(min(maxiter + 1, 4096))
+
+    @jax.jit
+    def fn(b, x0, oo_v, oo_c, oh_v, oh_c, si, sm, ri):
+        def shard_fn(bs, x0s, a, c, d, e, f, g, h):
+            bv, xv = bs[0], x0s[0]
+            mats = (a[0], c[0], d[0], e[0], f[0], g[0], h[0])
+
+            def spmv(z):
+                y, _ = body_spmv(z, *mats)
+                return y
+
+            q = spmv(xv)
+            r = (bv - q).at[no_max:].set(0.0)  # rows-range residual, owned only
+            p = jnp.zeros_like(xv).at[:no_max].set(r[:no_max])
+            rs0 = pdot(r, r)
+            hist = jnp.full(H, jnp.nan, dtype=bv.dtype).at[0].set(jnp.sqrt(rs0))
+
+            def cond(state):
+                _x, _r, _p, rs, it, _h = state
+                return jnp.logical_and(
+                    jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0)),
+                    it < maxiter,
+                )
+
+            def step(state):
+                x, r, p, rs, it, hist = state
+                q = spmv(p)
+                pq = pdot(p, q)
+                alpha = rs / pq
+                x = x.at[:no_max].add(alpha * p[:no_max])
+                r = r.at[:no_max].add(-alpha * q[:no_max])
+                rs_new = pdot(r, r)
+                beta = rs_new / rs
+                p = p.at[:no_max].set(r[:no_max] + beta * p[:no_max])
+                hist = hist.at[jnp.minimum(it + 1, H - 1)].set(jnp.sqrt(rs_new))
+                return (x, r, p, rs_new, it + 1, hist)
+
+            x, r, p, rs, it, hist = jax.lax.while_loop(
+                cond, step, (xv, r, p, rs0, jnp.int32(0), hist)
+            )
+            return x[None], rs, rs0, it, hist
+
+        return shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec,) * 9,
+            out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
+            check_vma=False,
+        )(b, x0, oo_v, oo_c, oh_v, oh_c, si, sm, ri)
+
+    return lambda b, x0: fn(
+        b, x0, _oo_operand(dA), dA.oo_cols, dA.oh_vals, dA.oh_cols, si_d, sm_d, ri_d
+    )
+
+
+# ---------------------------------------------------------------------------
+# high-level entry points (used by solvers.cg dispatch and PVector methods)
+# ---------------------------------------------------------------------------
+
+
+def tpu_cg(
+    A: PSparseMatrix,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """Device CG: lower (cached), run the single compiled program, lift the
+    result back to a host PVector over A.cols. The info dict matches the
+    host solver's contract: `residuals` has iterations+1 entries (capped at
+    the compiled history length)."""
+    backend = b.values.backend
+    check(isinstance(backend, TPUBackend), "tpu_cg needs a TPU-backend PVector")
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    dA = device_matrix(A, backend)
+    x0 = x0 if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
+    db = _b_on_cols_layout(b, dA)
+    dx0 = DeviceVector.from_pvector(x0, backend, dA.col_layout)
+    solve = _cg_fn_for(dA, tol, maxiter)
+    x_data, rs, rs0, it, hist = solve(db.data, dx0.data)
+    x = DeviceVector(x_data, A.cols, dA.col_layout, backend).to_pvector()
+    rs, rs0, it = float(rs), float(rs0), int(it)
+    residuals = np.asarray(hist)[: min(it + 1, len(np.asarray(hist)))]
+    if verbose:
+        for i, r in enumerate(residuals[1:], start=1):
+            print(f"cg it={i} residual={r:.3e}")
+    return x, {
+        "iterations": it,
+        "residuals": residuals,
+        "converged": bool(np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0))),
+    }
+
+
+def _cg_fn_for(dA: DeviceMatrix, tol: float, maxiter: int):
+    key = (float(tol), int(maxiter))
+    if key not in dA._cg_cache:
+        dA._cg_cache[key] = make_cg_fn(dA, tol, maxiter)
+    return dA._cg_cache[key]
+
+
+def _b_on_cols_layout(b: PVector, dA: DeviceMatrix) -> DeviceVector:
+    """b lives on A.rows (no ghosts); the compiled CG keeps every vector in
+    the cols layout (same owned gids). Restack b's owned values there."""
+    layout = dA.col_layout
+    stacked = np.zeros((layout.P, layout.W), dtype=b.dtype)
+    for p, (iset, vals) in enumerate(
+        zip(b.rows.partition.part_values(), b.values.part_values())
+    ):
+        stacked[p, : iset.num_oids] = _owned(iset, np.asarray(vals))
+    jax = _jax()
+    data = jax.device_put(stacked, dA.backend.sharding(layout.P))
+    return DeviceVector(data, dA.cols, layout, dA.backend)

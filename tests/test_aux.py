@@ -1,0 +1,81 @@
+"""Aux-subsystem tests: PTimer, fail-fast prun, distance metrics.
+
+Mirrors the reference coverage of test/test_p_timers.jl and
+test/test_exception.jl (SURVEY.md §5.1, §5.3).
+"""
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+
+
+def test_ptimer_sections_and_stats(capsys):
+    def driver(parts):
+        t = pa.PTimer(parts, verbose=True)
+        t.tic()
+        sum(range(1000))
+        t.toc("phase-a")
+        with t.section("phase-b"):
+            sum(range(10))
+        data = t.data
+        assert set(data) == {"phase-a", "phase-b"}
+        for st in data.values():
+            assert st["min"] <= st["avg"] <= st["max"]
+            assert st["max"] >= 0
+        t.print_timer()
+        return True
+
+    assert pa.prun(driver, pa.sequential, 4)
+    out = capsys.readouterr().out
+    assert "phase-a" in out and "phase-b" in out and "max" in out
+
+
+def test_ptimer_toc_without_tic():
+    def driver(parts):
+        t = pa.PTimer(parts)
+        with pytest.raises(AssertionError):
+            t.toc("nope")
+
+    pa.prun(driver, pa.sequential, 2)
+
+
+def test_exception_fail_fast(capsys):
+    """A driver raising on one part must take the whole job down cleanly
+    with the error surfaced (reference: test/test_exception.jl,
+    src/MPIBackend.jl:21-36)."""
+
+    class Boom(RuntimeError):
+        pass
+
+    def driver(parts):
+        def _raise(p):
+            if p == 2:
+                raise Boom("part 2 exploded")
+            return p
+
+        return pa.map_parts(_raise, parts)
+
+    with pytest.raises(Boom):
+        pa.prun(driver, pa.tpu, 4)
+    assert "aborting job" in capsys.readouterr().out
+    # sequential backend propagates too
+    with pytest.raises(Boom):
+        pa.prun(driver, pa.sequential, 4)
+
+
+def test_distance_metrics():
+    def driver(parts):
+        rows = pa.uniform_partition(parts, 12)
+        a = pa.PVector(
+            pa.map_parts(lambda i: i.lid_to_gid.astype(float), rows.partition), rows
+        )
+        b = pa.PVector.full(1.0, rows)
+        ref_a = np.arange(12.0)
+        ref_b = np.ones(12)
+        assert pa.sqeuclidean(a, b) == pytest.approx(np.sum((ref_a - ref_b) ** 2))
+        assert pa.euclidean(a, b) == pytest.approx(np.linalg.norm(ref_a - ref_b))
+        assert pa.cityblock(a, b) == pytest.approx(np.sum(np.abs(ref_a - ref_b)))
+        assert pa.chebyshev(a, b) == pytest.approx(np.max(np.abs(ref_a - ref_b)))
+        return True
+
+    assert pa.prun(driver, pa.sequential, 4)

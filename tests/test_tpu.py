@@ -1,0 +1,173 @@
+"""TPU backend tests on a virtual 8-device CPU mesh.
+
+The `mpiexec -n 8` analog of the reference's MPI suite (SURVEY.md §4): the
+same driver bodies run under the TPU backend, and the results are compared
+against the sequential oracle — the determinism gate of BASELINE.md.
+"""
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.models import assemble_poisson, cg, gather_pvector, poisson_fdm_driver
+from partitionedarrays_jl_tpu.parallel.tpu import (
+    DeviceVector,
+    device_matrix,
+    make_exchange_fn,
+    make_spmv_fn,
+)
+
+
+def test_backend_protocol():
+    import jax
+
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual CPU devices"
+    parts = pa.tpu.get_part_ids((2, 2))
+    assert parts.shape == (2, 2) and list(parts) == [0, 1, 2, 3]
+    assert parts.backend is pa.tpu
+    # map_parts preserves the backend identity through planning code
+    doubled = pa.map_parts(lambda p: p * 2, parts)
+    assert doubled.backend is pa.tpu
+    g = pa.gather(doubled)
+    assert g.backend is pa.tpu
+    assert pa.i_am_main(parts)
+
+
+def test_too_many_parts_rejected():
+    with pytest.raises(AssertionError):
+        pa.tpu.get_part_ids(64)
+
+
+def test_device_vector_roundtrip():
+    def driver(parts):
+        r = pa.prange(parts, (6, 6), pa.with_ghost)
+        v = pa.PVector(
+            pa.map_parts(lambda i: i.lid_to_gid.astype(np.float64), r.partition), r
+        )
+        dv = DeviceVector.from_pvector(v, parts.backend)
+        v2 = dv.to_pvector()
+        for a, b in zip(v.values, v2.values):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        return True
+
+    assert pa.prun(driver, pa.tpu, (2, 2))
+
+
+def test_compiled_exchange_matches_host():
+    def driver(parts):
+        r = pa.prange(parts, (6, 6), pa.with_ghost)
+        mk = lambda: pa.PVector(
+            pa.map_parts(
+                lambda i: np.where(
+                    i.lid_to_part == i.part, i.lid_to_gid.astype(np.float64), -1.0
+                ),
+                r.partition,
+            ),
+            r,
+        )
+        # host path
+        vh = mk()
+        pa.exchange_values(vh.values, vh.values, r.exchanger)
+        # device path
+        vd = mk()
+        dv = DeviceVector.from_pvector(vd, parts.backend)
+        dv.data = make_exchange_fn(r, parts.backend)(dv.data)
+        v2 = dv.to_pvector()
+        for a, b in zip(vh.values, v2.values):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        return True
+
+    assert pa.prun(driver, pa.tpu, (2, 2))
+
+
+def test_compiled_exchange_periodic_3d():
+    def driver(parts):
+        r = pa.prange(parts, (4, 4, 4), pa.with_ghost, (True, True, True))
+        v = pa.PVector(
+            pa.map_parts(
+                lambda i: np.where(
+                    i.lid_to_part == i.part, i.lid_to_gid.astype(np.float64), -1.0
+                ),
+                r.partition,
+            ),
+            r,
+        )
+        dv = DeviceVector.from_pvector(v, parts.backend)
+        dv.data = make_exchange_fn(r, parts.backend)(dv.data)
+        v2 = dv.to_pvector()
+        for i, vals in zip(r.partition, v2.values):
+            assert np.array_equal(np.asarray(vals), i.lid_to_gid.astype(np.float64))
+        return True
+
+    assert pa.prun(driver, pa.tpu, (2, 2, 2))
+
+
+def test_compiled_assembly_matches_host():
+    def driver(parts):
+        r = pa.prange(parts, (6, 6), pa.with_ghost)
+        vh = pa.PVector.full(1.0, r)
+        vh.assemble()
+        vd = pa.PVector.full(1.0, r)
+        dv = DeviceVector.from_pvector(vd, parts.backend)
+        dv.data = make_exchange_fn(r, parts.backend, combine="add")(dv.data)
+        v2 = dv.to_pvector()
+        # device add-combine accumulates into owners; host then zeroes
+        # ghosts — compare owned regions only
+        for i, a, b in zip(r.partition, vh.values, v2.values):
+            assert np.array_equal(
+                np.asarray(a)[: i.num_oids], np.asarray(b)[: i.num_oids]
+            )
+        return True
+
+    assert pa.prun(driver, pa.tpu, (2, 2))
+
+
+def test_compiled_spmv_matches_host():
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        dA = device_matrix(A, parts.backend)
+        dx = DeviceVector.from_pvector(x_exact, parts.backend, dA.col_layout)
+        y = make_spmv_fn(dA)(dx.data)
+        host = gather_pvector(b)
+        dev = np.asarray(y)
+        got = np.zeros_like(host)
+        for p, iset in enumerate(A.rows.partition.part_values()):
+            got[iset.oid_to_gid] = dev[p, : iset.num_oids]
+        # XLA emits fused multiply-adds in the ELL row fold; NumPy cannot,
+        # so individual entries may differ by the FMA rounding (<= ~2 ulp)
+        # even though the accumulation order is identical.
+        np.testing.assert_allclose(got, host, rtol=1e-14, atol=1e-14)
+        return True
+
+    assert pa.prun(driver, pa.tpu, (2, 2))
+
+
+def test_fdm_on_tpu_backend_matches_sequential():
+    """The BASELINE.md determinism gate: the same driver, same grid, on the
+    sequential oracle and the TPU backend. Iteration counts must be equal
+    and the solutions equal to machine precision."""
+    err_s, info_s = pa.prun(poisson_fdm_driver, pa.sequential, (2, 2, 2), (10, 10, 10))
+    err_t, info_t = pa.prun(poisson_fdm_driver, pa.tpu, (2, 2, 2), (10, 10, 10))
+    assert err_s < 1e-5 and err_t < 1e-5
+    assert info_t["converged"]
+    assert info_s["iterations"] == info_t["iterations"]
+    assert abs(err_s - err_t) < 1e-12
+
+
+def test_fdm_on_tpu_single_part():
+    err, info = pa.prun(poisson_fdm_driver, pa.tpu, (1, 1), (8, 8))
+    assert err < 1e-5 and info["converged"]
+
+
+def test_cg_dispatches_to_device():
+    """pa.models.cg on TPU-backend data must route to the compiled path and
+    agree with the host solve."""
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        x, info = cg(A, b, x0=x0, tol=1e-12)
+        return float((x - x_exact).norm()), info["iterations"]
+
+    err_t, it_t = pa.prun(driver, pa.tpu, (2, 2))
+    err_s, it_s = pa.prun(driver, pa.sequential, (2, 2))
+    assert err_t < 1e-9
+    assert it_t == it_s
