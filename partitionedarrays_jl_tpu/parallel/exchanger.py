@@ -128,6 +128,46 @@ class Exchanger:
     def npartners_rcv(self) -> AbstractPData:
         return map_parts(len, self.parts_rcv)
 
+    def table_exchanger(
+        self, values: AbstractPData, values_snd: Optional[AbstractPData] = None
+    ) -> "Exchanger":
+        """Derive the plan for ragged per-lid payloads: translate the lid
+        lists into flat-data index lists through the Table values' ptrs,
+        so the exchange moves `values[lid][:]` blocks
+        (reference: src/Interfaces.jl:891-961). Row widths must agree
+        between the sender's and receiver's copy of each exchanged lid."""
+
+        def _flatten(lids: Table, t: Table) -> Table:
+            ptrs = np.asarray(t.ptrs)
+            nn = len(lids.ptrs) - 1
+            new_ptrs = np.zeros(nn + 1, dtype=INDEX_DTYPE)
+            chunks = []
+            for k in range(nn):
+                row_lids = lids.data[lids.ptrs[k] : lids.ptrs[k + 1]]
+                flat = (
+                    np.concatenate(
+                        [np.arange(ptrs[l], ptrs[l + 1]) for l in row_lids]
+                    ).astype(INDEX_DTYPE)
+                    if len(row_lids)
+                    else np.empty(0, dtype=INDEX_DTYPE)
+                )
+                chunks.append(flat)
+                new_ptrs[k + 1] = new_ptrs[k] + len(flat)
+            data = (
+                np.concatenate(chunks).astype(INDEX_DTYPE)
+                if chunks
+                else np.empty(0, dtype=INDEX_DTYPE)
+            )
+            return Table(data, new_ptrs)
+
+        values_snd = values_snd if values_snd is not None else values
+        return Exchanger(
+            self.parts_rcv,
+            self.parts_snd,
+            map_parts(_flatten, self.lids_rcv, values),
+            map_parts(_flatten, self.lids_snd, values_snd),
+        )
+
     def __repr__(self):
         return "Exchanger(...)"
 
@@ -155,7 +195,17 @@ def async_exchange_values(
 
     `combine_op` must be a NumPy ufunc (e.g. ``np.add``) so ghost->owner
     assembly accumulates duplicates correctly via ``ufunc.at``.
+
+    Table-valued payloads (ragged per-lid data) are routed through the
+    derived table exchanger: the flat `.data` arrays are exchanged with
+    lid lists translated through the Tables' ptrs
+    (reference: src/Interfaces.jl:891-961).
     """
+    if isinstance(values_rcv.part_values()[0], Table):
+        derived = exchanger.table_exchanger(values_rcv, values_snd)
+        flat_rcv = map_parts(lambda t: t.data, values_rcv)
+        flat_snd = map_parts(lambda t: t.data, values_snd)
+        return async_exchange_values(flat_rcv, flat_snd, derived, combine_op)
     # pack
     def _pack(vals, t: Table):
         return Table(np.asarray(vals)[t.data], t.ptrs)
@@ -185,9 +235,23 @@ def async_exchange_values(
 
 
 def exchange_values(
-    values_rcv, values_snd, exchanger: Exchanger, combine_op: Optional[Callable] = None
+    values_rcv,
+    values_snd=None,
+    exchanger: Exchanger = None,
+    combine_op: Optional[Callable] = None,
+    combine: Optional[Callable] = None,
 ):
-    """Blocking wrapper."""
+    """Blocking wrapper. The two-argument form ``exchange_values(values,
+    exchanger)`` uses the same array as source and destination — the
+    in-place halo-update shape of the reference's `exchange!(values,
+    exchanger)` (src/Interfaces.jl:818-835)."""
+    if exchanger is None and isinstance(values_snd, Exchanger):
+        exchanger, values_snd = values_snd, values_rcv
+    if values_snd is None:
+        check(exchanger is not None, "exchange_values: no exchanger given")
+        values_snd = values_rcv  # exchange_values(values, exchanger=ex) form
+    if combine is not None:
+        combine_op = combine
     t = async_exchange_values(values_rcv, values_snd, exchanger, combine_op)
     schedule_and_wait(t)
     return values_rcv

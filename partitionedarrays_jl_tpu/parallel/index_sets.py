@@ -431,18 +431,6 @@ class CartesianGidToPart:
 # ---------------------------------------------------------------------------
 
 
-def num_lids(i: AbstractIndexSet) -> int:
-    return i.num_lids
-
-
-def num_oids(i: AbstractIndexSet) -> int:
-    return i.num_oids
-
-
-def num_hids(i: AbstractIndexSet) -> int:
-    return i.num_hids
-
-
 def get_lid_to_gid(i: AbstractIndexSet) -> np.ndarray:
     return i.lid_to_gid
 
@@ -468,9 +456,52 @@ def get_gid_to_lid(i: AbstractIndexSet):
     return i.gids_to_lids
 
 
-def touched_hids(i: AbstractIndexSet, gids) -> np.ndarray:
+def touched_hids(i, gids):
+    """Which ghost ids appear in `gids` (dedup, first-touch order).
+    Accepts a single IndexSet, a PData of IndexSets, or a PRange paired
+    with a PData of gid arrays (reference: src/Interfaces.jl:670-696)."""
+    from .backends import AbstractPData, map_parts
+
+    if isinstance(gids, AbstractPData):
+        partition = i.partition if hasattr(i, "partition") else i
+        return map_parts(lambda s, g: s.touched_hids(g), partition, gids)
     return i.touched_hids(gids)
 
 
 def add_gid(i: AbstractIndexSet, gid: int, owner: int) -> int:
     return i.add_gid(gid, owner)
+
+
+def _per_part_count(i, attr: str):
+    """Shared body of the num_* free functions: accepts one IndexSet, a
+    PData of IndexSets, or a PRange (reference exports num_gids/num_lids/
+    num_oids/num_hids, src/PartitionedArrays.jl:63-66)."""
+    from .backends import AbstractPData, map_parts
+
+    if hasattr(i, "partition"):  # PRange
+        i = i.partition
+    if isinstance(i, AbstractPData):
+        return map_parts(lambda s: getattr(s, attr), i)
+    return getattr(i, attr)
+
+
+def num_gids(i):
+    """Total global ids of a PRange (`ngids`). Index sets do not record
+    the global count, so only a PRange (or anything carrying `ngids`) is
+    accepted — same as the reference, whose num_gids overloads all read
+    an ngids field."""
+    if hasattr(i, "ngids"):
+        return i.ngids
+    raise TypeError("num_gids needs a PRange (index sets don't store ngids)")
+
+
+def num_lids(i):
+    return _per_part_count(i, "num_lids")
+
+
+def num_oids(i):
+    return _per_part_count(i, "num_oids")
+
+
+def num_hids(i):
+    return _per_part_count(i, "num_hids")

@@ -451,6 +451,122 @@ def exchange_coo(
 # ---------------------------------------------------------------------------
 
 
+class LocalMatrixViewPart:
+    """One part of `local_view(A, rows, cols)`: A's local matrix re-indexed
+    by another (rows, cols) pair's lids. Reads of entries absent from the
+    sparsity pattern return 0; writes to them raise
+    (reference LocalView semantics: src/Interfaces.jl:1994-2035)."""
+
+    __slots__ = ("values", "row_map", "col_map")
+
+    def __init__(self, values: CSRMatrix, row_map: np.ndarray, col_map: np.ndarray):
+        self.values = values
+        self.row_map = np.asarray(row_map)
+        self.col_map = np.asarray(col_map)
+
+    @property
+    def shape(self):
+        return (len(self.row_map), len(self.col_map))
+
+    def _nz(self, i, j):
+        li = self.row_map[np.asarray(i)]
+        lj = self.col_map[np.asarray(j)]
+        check(
+            bool((li >= 0).all()) and bool((lj >= 0).all()),
+            "local_view: index not present in the parent matrix's lids",
+        )
+        return nzindex(self.values, li, lj)
+
+    def __getitem__(self, ij):
+        i, j = ij
+        k = self._nz(i, j)
+        out = np.where(k >= 0, self.values.data[np.maximum(k, 0)], 0.0)
+        if np.isscalar(i) and np.isscalar(j):
+            return out.reshape(-1)[0]
+        return out
+
+    def __setitem__(self, ij, v):
+        i, j = ij
+        k = self._nz(i, j)
+        check(bool((np.asarray(k) >= 0).all()),
+              "local_view write to an entry not stored in parent")
+        self.values.data[k] = v
+
+    def add(self, i, j, v):
+        """Scatter-accumulate (the FEM assembly primitive)."""
+        k = self._nz(i, j)
+        check(bool((np.asarray(k) >= 0).all()),
+              "local_view add to an entry not stored in parent")
+        np.add.at(self.values.data, np.asarray(k), np.asarray(v))
+
+
+class GlobalMatrixViewPart:
+    """One part of `global_view(A)`: entries addressed by (gi, gj) global
+    ids (reference GlobalView: src/Interfaces.jl:2037-2069)."""
+
+    __slots__ = ("values", "rows_iset", "cols_iset", "shape")
+
+    def __init__(self, values: CSRMatrix, rows_iset, cols_iset, shape):
+        self.values = values
+        self.rows_iset = rows_iset
+        self.cols_iset = cols_iset
+        self.shape = shape
+
+    def _nz(self, gi, gj):
+        li = self.rows_iset.gids_to_lids(np.asarray(gi))
+        lj = self.cols_iset.gids_to_lids(np.asarray(gj))
+        check(
+            bool((li >= 0).all()) and bool((lj >= 0).all()),
+            "global_view: gid not local on this part",
+        )
+        return nzindex(self.values, li, lj)
+
+    def __getitem__(self, ij):
+        i, j = ij
+        k = self._nz(i, j)
+        out = np.where(k >= 0, self.values.data[np.maximum(k, 0)], 0.0)
+        if np.isscalar(i) and np.isscalar(j):
+            return out.reshape(-1)[0]
+        return out
+
+    def __setitem__(self, ij, v):
+        k = self._nz(*ij)
+        check(bool((np.asarray(k) >= 0).all()),
+              "global_view write to an entry not stored in parent")
+        self.values.data[k] = v
+
+    def add(self, gi, gj, v):
+        k = self._nz(gi, gj)
+        check(bool((np.asarray(k) >= 0).all()),
+              "global_view add to an entry not stored in parent")
+        np.add.at(self.values.data, np.asarray(k), np.asarray(v))
+
+
+def psparse_local_view(A: PSparseMatrix, rows: PRange = None, cols: PRange = None):
+    rows = rows if rows is not None else A.rows
+    cols = cols if cols is not None else A.cols
+
+    def _mk(vri, vci, ri, ci, M):
+        rm = ri.gids_to_lids(vri.lid_to_gid)
+        cm = ci.gids_to_lids(vci.lid_to_gid)
+        return LocalMatrixViewPart(M, rm, cm)
+
+    return map_parts(
+        _mk, rows.partition, cols.partition,
+        A.rows.partition, A.cols.partition, A.values,
+    )
+
+
+def psparse_global_view(A: PSparseMatrix, rows: PRange = None, cols: PRange = None):
+    rows = rows if rows is not None else A.rows
+    cols = cols if cols is not None else A.cols
+    shape = (rows.ngids, cols.ngids)
+    return map_parts(
+        lambda ri, ci, M: GlobalMatrixViewPart(M, ri, ci, shape),
+        rows.partition, cols.partition, A.values,
+    )
+
+
 def psparse_local_values(A: PSparseMatrix) -> AbstractPData:
     """The raw per-part local CSR matrices (lid x lid)."""
     return A.values

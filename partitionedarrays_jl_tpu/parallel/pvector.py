@@ -211,6 +211,13 @@ class PVector:
 
     __rmul__ = __mul__
 
+    def scale(self, a) -> "PVector":
+        """In-place scalar scaling (the `rmul!` analog)."""
+        check(np.isscalar(a), "PVector.scale needs a scalar")
+        for v in self.values.part_values():
+            np.multiply(v, a, out=v)
+        return self
+
     def __truediv__(self, a):
         check(np.isscalar(a), "PVector / non-scalar")
         return self.map_values(lambda v: v / a)
@@ -435,8 +442,15 @@ class GlobalViewPart:
         np.add.at(self.parent_values, lids, np.asarray(v))
 
 
-def local_view(v: PVector, rows: PRange) -> AbstractPData:
-    """PData of per-part LocalViewPart re-indexing v by `rows`' lids."""
+def local_view(v, rows: Optional[PRange] = None, cols: Optional[PRange] = None) -> AbstractPData:
+    """PData of per-part LocalViewPart re-indexing v by `rows`' lids.
+    For a PSparseMatrix, `local_view(A[, rows, cols])` re-indexes by both
+    axes (reference: src/Interfaces.jl:2277-2287)."""
+    if not isinstance(v, PVector):
+        from .psparse import psparse_local_view
+
+        return psparse_local_view(v, rows, cols)
+    rows = rows if rows is not None else v.rows
 
     def _mk(view_iset, parent_iset, vals):
         m = parent_iset.gids_to_lids(view_iset.lid_to_gid)
@@ -445,7 +459,11 @@ def local_view(v: PVector, rows: PRange) -> AbstractPData:
     return map_parts(_mk, rows.partition, v.rows.partition, v.values)
 
 
-def global_view(v: PVector, rows: Optional[PRange] = None) -> AbstractPData:
+def global_view(v, rows: Optional[PRange] = None, cols: Optional[PRange] = None) -> AbstractPData:
+    if not isinstance(v, PVector):
+        from .psparse import psparse_global_view
+
+        return psparse_global_view(v, rows, cols)
     rows = rows or v.rows
     return map_parts(
         lambda i, vals: GlobalViewPart(vals, i), rows.partition, v.values

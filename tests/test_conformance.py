@@ -1,0 +1,450 @@
+"""Golden-fixture conformance suite.
+
+The reference's full-surface integration test carries hand-built 4-part
+fixtures with exact expected values (reference: test/test_interfaces.jl).
+SURVEY.md §4 calls these "golden data worth porting verbatim" — this file
+is that port, translated once to 0-based ids (parts 1..4 -> 0..3,
+gids 1..10 -> 0..9).  Where the reference checks Cartesian gid tables it
+assumes Julia's column-major numbering; this framework numbers C-order, so
+those fixtures live (re-derived) in test_prange.py instead.
+"""
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+
+
+@pytest.fixture
+def parts():
+    return pa.sequential.get_part_ids(4)
+
+
+# ---------------------------------------------------------------------------
+# the asymmetric 4-part neighbor graph (reference: test_interfaces.jl:19-63)
+# ---------------------------------------------------------------------------
+
+PARTS_RCV = [[1, 2], [3], [0, 1], [0, 2]]
+PARTS_SND = [[2, 3], [0, 2], [0, 3], [1]]
+# data_snd = 10*(part+1) per neighbor -> each receiver sees its senders' tags
+EXPECTED_RCV = [[20, 30], [40], [10, 20], [10, 30]]
+
+
+def _graph(parts):
+    rcv = pa.map_parts(lambda p: np.array(PARTS_RCV[p]), parts)
+    snd = pa.map_parts(lambda p: np.array(PARTS_SND[p]), parts)
+    return rcv, snd
+
+
+def test_exchange_fixed_size_golden(parts):
+    rcv, snd = _graph(parts)
+    data_snd = pa.map_parts(lambda s, p: np.full(len(s), 10 * (p + 1)), snd, parts)
+    data_rcv = pa.exchange(data_snd, rcv, snd)
+    for p, got in enumerate(data_rcv.part_values()):
+        assert list(got) == EXPECTED_RCV[p]
+
+
+def test_async_exchange_golden(parts):
+    rcv, snd = _graph(parts)
+    data_snd = pa.map_parts(lambda s, p: np.full(len(s), 10 * (p + 1)), snd, parts)
+    data_rcv, t = pa.async_exchange(data_snd, rcv, snd)
+    pa.schedule_and_wait(t)
+    for p, got in enumerate(data_rcv.part_values()):
+        assert list(got) == EXPECTED_RCV[p]
+
+
+def test_discover_parts_snd_golden(parts):
+    rcv, _ = _graph(parts)
+    snd2 = pa.discover_parts_snd(rcv)
+    for p, got in enumerate(snd2.part_values()):
+        assert sorted(got) == PARTS_SND[p]
+
+
+# ---------------------------------------------------------------------------
+# reductions and scans (reference: test_interfaces.jl:65-124)
+# ---------------------------------------------------------------------------
+
+
+def test_reduce_golden(parts):
+    one_based = pa.map_parts(lambda p: p + 1, parts)
+    a = pa.reduce_main(lambda x, y: x + y, one_based, 0)
+    assert pa.get_main_part(a) == 1 + 2 + 3 + 4
+    b = pa.reduce_all(lambda x, y: x + y, one_based, 0)
+    assert all(v == 10 for v in b.part_values())
+    assert pa.preduce(lambda x, y: x + y, one_based, 0) == 10
+    assert pa.sum_parts(one_based) == 10
+
+
+SCAN_IN = [4, 2, 6, 3]
+
+
+def test_iscan_golden(parts):
+    a = pa.map_parts(lambda p: SCAN_IN[p], parts)
+    b = pa.iscan(lambda x, y: x + y, a, 0)
+    assert list(b.part_values()) == [4, 6, 12, 15]
+    b, n = pa.iscan(lambda x, y: x + y, a, 0, with_total=True)
+    assert n == 15
+    b, n = pa.iscan_all(lambda x, y: x + y, a, 0, with_total=True)
+    assert n == 15
+    for v in b.part_values():
+        assert list(v) == [4, 6, 12, 15]
+
+
+def test_xscan_golden(parts):
+    a = pa.map_parts(lambda p: SCAN_IN[p], parts)
+    b = pa.xscan(lambda x, y: x + y, a, 1)
+    assert list(b.part_values()) == [1, 5, 7, 13]
+    b, n = pa.xscan(lambda x, y: x + y, a, 1, with_total=True)
+    assert n == 16
+    b, n = pa.xscan_all(lambda x, y: x + y, a, 1, with_total=True)
+    assert n == 16
+    for v in b.part_values():
+        assert list(v) == [1, 5, 7, 13]
+
+
+# ---------------------------------------------------------------------------
+# the 10-gid 4-part IndexSet partition + Exchanger plan
+# (reference: test_interfaces.jl:177-207) — layout-independent golden data
+# ---------------------------------------------------------------------------
+
+LID_TO_GID = [
+    [0, 1, 2, 4, 6, 7],
+    [1, 3, 4, 9],
+    [5, 6, 7, 4, 3, 9],
+    [0, 2, 6, 8, 9],
+]
+LID_TO_PART = [
+    [0, 0, 0, 1, 2, 2],
+    [0, 1, 1, 3],
+    [2, 2, 2, 1, 1, 3],
+    [0, 0, 2, 3, 3],
+]
+# exact expected plan (0-based translation of :191-207)
+EXP_PARTS_SND = [[1, 3], [0, 2], [0, 3], [1, 2]]
+EXP_LIDS_SND = [
+    [[1], [0, 2]],
+    [[2], [2, 1]],
+    [[1, 2], [1]],
+    [[4], [4]],
+]
+NGIDS = 10
+
+
+@pytest.fixture
+def partition(parts):
+    return pa.map_parts(
+        lambda p: pa.IndexSet(p, LID_TO_GID[p], LID_TO_PART[p]), parts
+    )
+
+
+def test_exchanger_plan_golden(parts, partition):
+    ex = pa.Exchanger.from_partition(partition)
+    for p in range(4):
+        snd = list(ex.parts_snd.part_values()[p])
+        lids = [list(t) for t in ex.lids_snd.part_values()[p]]
+        got = dict(zip(snd, lids))
+        want = dict(zip(EXP_PARTS_SND[p], EXP_LIDS_SND[p]))
+        assert got == want
+
+
+def test_exchanger_halo_update_golden(parts, partition):
+    ex = pa.Exchanger.from_partition(partition)
+
+    def mk(p, iset):
+        v = np.zeros(iset.num_lids)
+        owners = np.asarray(iset.lid_to_part)
+        v[owners == p] = 10.0 * (p + 1)
+        return v
+
+    values = pa.map_parts(mk, parts, partition)
+    pa.exchange_values(values, ex)
+    for p, (v, iset) in enumerate(zip(values.part_values(), partition.part_values())):
+        owners = np.asarray(iset.lid_to_part)
+        assert np.array_equal(v, 10.0 * (owners + 1))
+
+
+def test_exchanger_explicit_buffers_golden(parts, partition):
+    # reference :229-251 — rcv-side untouched at owned lids, overwritten at
+    # ghosts; snd buffer never mutated
+    ex = pa.Exchanger.from_partition(partition)
+    values_rcv = pa.map_parts(lambda i: np.full(i.num_lids, 10.0), partition)
+    values_snd = pa.map_parts(lambda i: np.full(i.num_lids, 20.0), partition)
+    pa.exchange_values(values_rcv, values_snd, ex)
+    for p, (v, iset) in enumerate(zip(values_rcv.part_values(), partition.part_values())):
+        owners = np.asarray(iset.lid_to_part)
+        assert np.all(v[owners == p] == 10.0)
+        assert np.all(v[owners != p] == 20.0)
+    for v in values_snd.part_values():
+        assert np.all(v == 20.0)
+
+
+def test_exchanger_table_payload_golden(parts, partition):
+    # ragged per-lid payloads (reference :253-274): 3 values per lid,
+    # 100*(part+1) + 10*(gid+1) + (i+1), stamped by owners only
+    ex = pa.Exchanger.from_partition(partition)
+
+    def mk(p, iset):
+        rows = []
+        owners = np.asarray(iset.lid_to_part)
+        gids = np.asarray(iset.lid_to_gid)
+        for lid in range(iset.num_lids):
+            if owners[lid] == p:
+                rows.append(
+                    [100 * (p + 1) + 10 * (int(gids[lid]) + 1) + i for i in (1, 2, 3)]
+                )
+            else:
+                rows.append([0, 0, 0])
+        return pa.Table.from_rows(rows)
+
+    values = pa.map_parts(mk, parts, partition)
+    pa.exchange_values(values, ex)
+    for p, (t, iset) in enumerate(zip(values.part_values(), partition.part_values())):
+        owners = np.asarray(iset.lid_to_part)
+        gids = np.asarray(iset.lid_to_gid)
+        for lid in range(iset.num_lids):
+            want = [
+                100 * (int(owners[lid]) + 1) + 10 * (int(gids[lid]) + 1) + i
+                for i in (1, 2, 3)
+            ]
+            assert list(t[lid]) == want
+
+
+def test_exchanger_reverse_assembly_golden(parts, partition):
+    # reference :276-287: stamp 10*(part+1) on EVERY lid, push ghost copies
+    # to owners with +, then forward-exchange. Owner value of gid g ends as
+    # 10 * sum over holders of g of (holder+1); ghosts mirror owners.
+    ex_rcv = pa.Exchanger.from_partition(partition)
+    ex_snd = ex_rcv.reverse()
+    values = pa.map_parts(lambda p, i: np.full(i.num_lids, 10.0 * (p + 1)), parts, partition)
+    pa.exchange_values(values, ex_snd, combine=np.add)
+    pa.exchange_values(values, ex_rcv)
+
+    holders = {g: [] for g in range(NGIDS)}
+    for p in range(4):
+        for g in LID_TO_GID[p]:
+            holders[g].append(p)
+    for p, (v, iset) in enumerate(zip(values.part_values(), partition.part_values())):
+        gids = np.asarray(iset.lid_to_gid)
+        for lid, g in enumerate(gids):
+            assert v[lid] == 10.0 * sum(q + 1 for q in holders[int(g)])
+
+
+# ---------------------------------------------------------------------------
+# PRange over the explicit partition (reference :289-372)
+# ---------------------------------------------------------------------------
+
+
+def test_prange_from_explicit_partition(parts, partition):
+    ids = pa.PRange(NGIDS, partition)
+    assert ids.num_parts == 4
+    assert len(ids) == NGIDS
+    ids2 = ids.copy()
+    assert ids2 is not ids and ids2.partition is not ids.partition
+    assert pa.prange_eq(ids, ids2)
+    for iset in ids.partition.part_values():
+        np.testing.assert_array_equal(
+            pa.get_lid_to_gid(iset), np.asarray(iset.lid_to_gid)
+        )
+        np.testing.assert_array_equal(
+            pa.get_lid_to_part(iset), np.asarray(iset.lid_to_part)
+        )
+        np.testing.assert_array_equal(
+            pa.get_oid_to_lid(iset), np.asarray(iset.oid_to_lid)
+        )
+        np.testing.assert_array_equal(
+            pa.get_hid_to_lid(iset), np.asarray(iset.hid_to_lid)
+        )
+
+
+GIDS_GHOSTS = [[0, 3, 5], [2, 0, 1, 7], [0, 8, 5], [2, 1, 7, 9]]
+TOUCHED = [[3, 5], [0, 1], [0, 8], [2]]
+
+
+def test_add_gids_and_touched_hids_golden(parts):
+    ids2 = pa.uniform_partition(parts, NGIDS)
+    assert not ids2.ghost
+    gids = pa.map_parts(lambda p: np.array(GIDS_GHOSTS[p]), parts)
+    owners = pa.map_parts(lambda g: ids2.gid_to_part(g), gids)
+    ids3 = pa.add_gids(ids2, gids, owners)
+    assert ids3.ghost
+    ids3b = pa.add_gids(ids2, gids)  # owner lookup derived from gid_to_part
+    assert pa.prange_eq(ids3, ids3b)
+
+    gids2 = pa.map_parts(lambda p: np.array(TOUCHED[p]), parts)
+    hids = pa.touched_hids(ids3, gids2)
+    for h, g2, iset in zip(
+        hids.part_values(), gids2.part_values(), ids3.partition.part_values()
+    ):
+        lids = np.asarray(iset.hid_to_lid)[np.asarray(h)]
+        np.testing.assert_array_equal(np.asarray(iset.lid_to_gid)[lids], g2)
+
+    # round-trip renumbering (reference :346-347)
+    pa.to_lids(ids3, gids)
+    pa.to_gids(ids3, gids)
+    for g, want in zip(gids.part_values(), GIDS_GHOSTS):
+        assert list(g) == want
+
+
+def test_variable_partition_golden(parts):
+    a = pa.map_parts(lambda p: SCAN_IN[p], parts)
+    ids5 = pa.variable_partition(parts, a)
+    want_gids = [[0, 1, 2, 3], [4, 5], [6, 7, 8, 9, 10, 11], [12, 13, 14]]
+    for p, iset in enumerate(ids5.partition.part_values()):
+        assert list(iset.lid_to_gid) == want_gids[p]
+    want_owner = [0, 0, 0, 0, 1, 1, 2, 2, 2, 2, 2, 2, 3, 3, 3]
+    np.testing.assert_array_equal(ids5.gid_to_part(np.arange(15)), want_owner)
+
+
+# ---------------------------------------------------------------------------
+# PVector over the golden partition (reference :501-643 highlights)
+# ---------------------------------------------------------------------------
+
+
+def test_pvector_coo_over_ghosted_range(parts):
+    ids2 = pa.uniform_partition(parts, NGIDS)
+    gids = pa.map_parts(lambda p: np.array(GIDS_GHOSTS[p]), parts)
+    ids3 = pa.add_gids(ids2, gids)
+    v = pa.pvector(
+        pa.map_parts(np.copy, gids),
+        pa.map_parts(lambda g: g.astype(float), gids),
+        ids3,
+        ids="global",
+    )
+    u = 2.0 * v
+    for uv, vv in zip(u.values.part_values(), v.values.part_values()):
+        np.testing.assert_array_equal(uv, 2 * vv)
+    u = v + u
+    for uv, vv in zip(u.values.part_values(), v.values.part_values()):
+        np.testing.assert_array_equal(uv, 3 * vv)
+
+    # reductions over OWNED entries (reference :513-520): each owned gid
+    # appears with value == its gid where touched, else 0
+    assert v.any(lambda i: i > 4)
+    assert not v.any(lambda i: i > 10)
+    assert v.all(lambda i: i < 10)
+    assert not v.all(lambda i: i < 4)
+    assert v.maximum() == 9  # gid 9 accumulated once
+    assert v.minimum() == 0
+    assert v.maximum(lambda i: i - 1) == 8
+    assert v.minimum(lambda i: i - 1) == -1
+
+    w = v.copy()
+    w.scale(-1.0)
+    assert (v + w).all(lambda i: i == 0)
+    assert w == w
+    assert w != v
+    assert pa.sqeuclidean(w, v) == pytest.approx((w - v).norm() ** 2)
+    assert pa.euclidean(w, v) == pytest.approx((w - v).norm())
+
+
+def test_axis_compat_predicates(parts):
+    ids2 = pa.uniform_partition(parts, NGIDS)
+    gids = pa.map_parts(lambda p: np.array(GIDS_GHOSTS[p]), parts)
+    ids3 = pa.add_gids(ids2, gids)
+    u = pa.pvector(1.0, ids2)
+    w = pa.pvector(3.0, ids3)
+    assert pa.oids_are_equal(u.rows, u.rows)
+    assert pa.hids_are_equal(u.rows, u.rows)
+    assert pa.lids_are_equal(u.rows, u.rows)
+    assert pa.oids_are_equal(u.rows, w.rows)
+    assert not pa.hids_are_equal(u.rows, w.rows)
+    assert not pa.lids_are_equal(u.rows, w.rows)
+
+
+# ---------------------------------------------------------------------------
+# the COO PSparseMatrix fixture (reference :686-733), 0-based
+# ---------------------------------------------------------------------------
+
+COO_I = [[0, 1, 0, 1], [2, 2, 3], [4, 4, 5, 6], [8, 8, 7, 9]]
+COO_J = [[1, 5, 0, 1], [2, 7, 3], [4, 5, 5, 6], [8, 1, 7, 9]]
+COO_V = [
+    [1.0, 2.0, 30.0, 10.0],
+    [10.0, 2.0, 30.0],
+    [10.0, 2.0, 30.0, 1.0],
+    [10.0, 2.0, 30.0, 50.0],
+]
+
+
+def _golden_matrix(parts):
+    I = pa.map_parts(lambda p: np.array(COO_I[p]), parts)
+    J = pa.map_parts(lambda p: np.array(COO_J[p]), parts)
+    V = pa.map_parts(lambda p: np.array(COO_V[p]), parts)
+    return pa.PSparseMatrix.from_coo(I, J, V, NGIDS, NGIDS, ids="global")
+
+
+def _dense_golden():
+    M = np.zeros((NGIDS, NGIDS))
+    for I, J, V in zip(COO_I, COO_J, COO_V):
+        for i, j, v in zip(I, J, V):
+            M[i, j] += v
+    return M
+
+
+def test_golden_matrix_spmv(parts):
+    A = _golden_matrix(parts)
+    pa.local_view(A)
+    pa.global_view(A)
+    x = pa.pvector(1.0, A.cols)
+    y = A @ x
+    want = _dense_golden() @ np.ones(NGIDS)
+    got = pa.gather_pvector(y)
+    np.testing.assert_allclose(got, want)
+    dy = y - y
+    assert dy.norm() == 0.0
+
+
+def test_matrix_views_read_write(parts):
+    A = _golden_matrix(parts)
+    dense = _dense_golden()
+    gv = pa.global_view(A)
+    # part 0 owns global rows 0-2 (uniform 10 over 4 parts: sizes 2,2,3,3)
+    g0 = gv.part_values()[0]
+    assert g0[0, 1] == dense[0, 1]
+    assert g0[0, 5] == 0.0  # local (ghost col) but not stored -> 0 read
+    g0[0, 1] = 7.0
+    g0.add(0, 1, 1.0)
+    assert g0[0, 1] == 8.0
+    g0[0, 1] = dense[0, 1]
+    with pytest.raises(Exception):
+        g0[0, 5] = 1.0  # write-guard on unstored entry
+    with pytest.raises(Exception):
+        g0[0, 3]  # gid not local on this part
+
+    lv = pa.local_view(A, A.rows, A.cols)
+    l0 = lv.part_values()[0]
+    r0 = A.rows.partition.part_values()[0]
+    c0 = A.cols.partition.part_values()[0]
+    gi, gj = np.asarray(r0.lid_to_gid), np.asarray(c0.lid_to_gid)
+    for li in range(min(2, r0.num_lids)):
+        for lj in range(c0.num_lids):
+            assert l0[li, lj] == dense[gi[li], gj[lj]]
+
+
+def test_num_free_functions(parts, partition):
+    ids = pa.PRange(NGIDS, partition)
+    assert pa.num_gids(ids) == NGIDS
+    assert list(pa.num_lids(ids)) == [6, 4, 6, 5]
+    assert list(pa.num_oids(ids)) == [3, 2, 3, 2]
+    assert list(pa.num_hids(ids)) == [3, 2, 3, 3]
+    iset = partition.part_values()[0]
+    assert pa.num_lids(iset) == 6 and pa.num_oids(iset) == 3
+
+
+def test_golden_matrix_solves(parts):
+    A = _golden_matrix(parts)
+    y = pa.pvector(1.0, A.rows)
+
+    x, info = pa.cg(A, y, tol=1e-14, maxiter=500)
+    r = A @ x - y
+    assert r.norm() < 1e-5  # reference runs cg unchecked (:708-712); the
+    # hard 1e-9 gates below are on the direct paths, as in the reference
+
+    x = pa.direct_solve(A, y)
+    assert isinstance(x, pa.PVector)
+    assert (A @ x - y).norm() < 1e-9
+
+    factors = pa.lu(A)
+    x2 = factors.solve(y)
+    assert (A @ x2 - y).norm() < 1e-9
+    factors = factors.refactorize(A)
+    x3 = factors.solve(y)
+    assert (A @ x3 - y).norm() < 1e-9
