@@ -32,7 +32,10 @@ from .index_sets import AbstractIndexSet
 
 
 class Exchanger:
-    __slots__ = ("parts_rcv", "parts_snd", "lids_rcv", "lids_snd", "_reverse")
+    __slots__ = (
+        "parts_rcv", "parts_snd", "lids_rcv", "lids_snd", "_reverse",
+        "_table_cache",
+    )
 
     def __init__(self, parts_rcv, parts_snd, lids_rcv, lids_snd):
         self.parts_rcv = parts_rcv
@@ -40,6 +43,7 @@ class Exchanger:
         self.lids_rcv = lids_rcv
         self.lids_snd = lids_snd
         self._reverse = None
+        self._table_cache = {}
 
     @classmethod
     def from_partition(
@@ -137,36 +141,35 @@ class Exchanger:
         (reference: src/Interfaces.jl:891-961). Row widths must agree
         between the sender's and receiver's copy of each exchanged lid."""
 
+        from ..ops.sparse import _expand_ranges
+
         def _flatten(lids: Table, t: Table) -> Table:
-            ptrs = np.asarray(t.ptrs)
-            nn = len(lids.ptrs) - 1
-            new_ptrs = np.zeros(nn + 1, dtype=INDEX_DTYPE)
-            chunks = []
-            for k in range(nn):
-                row_lids = lids.data[lids.ptrs[k] : lids.ptrs[k + 1]]
-                flat = (
-                    np.concatenate(
-                        [np.arange(ptrs[l], ptrs[l + 1]) for l in row_lids]
-                    ).astype(INDEX_DTYPE)
-                    if len(row_lids)
-                    else np.empty(0, dtype=INDEX_DTYPE)
-                )
-                chunks.append(flat)
-                new_ptrs[k + 1] = new_ptrs[k] + len(flat)
-            data = (
-                np.concatenate(chunks).astype(INDEX_DTYPE)
-                if chunks
-                else np.empty(0, dtype=INDEX_DTYPE)
-            )
+            ptrs = np.asarray(t.ptrs, dtype=np.int64)
+            row_lids = np.asarray(lids.data, dtype=np.int64)
+            lens = ptrs[row_lids + 1] - ptrs[row_lids]
+            data = _expand_ranges(ptrs[row_lids], lens).astype(INDEX_DTYPE)
+            cums = np.zeros(len(row_lids) + 1, dtype=np.int64)
+            np.cumsum(lens, out=cums[1:])
+            new_ptrs = cums[np.asarray(lids.ptrs, dtype=np.int64)].astype(INDEX_DTYPE)
             return Table(data, new_ptrs)
 
         values_snd = values_snd if values_snd is not None else values
-        return Exchanger(
-            self.parts_rcv,
-            self.parts_snd,
-            map_parts(_flatten, self.lids_rcv, values),
-            map_parts(_flatten, self.lids_snd, values_snd),
+        # the derived plan depends only on the payload *shape* (the ptrs),
+        # so repeated exchanges of same-shaped Tables (the FEM-assembly
+        # pattern) reuse it instead of re-planning every call
+        key = tuple(
+            np.asarray(t.ptrs).tobytes()
+            for vs in (values, values_snd)
+            for t in vs.part_values()
         )
+        if key not in self._table_cache:
+            self._table_cache[key] = Exchanger(
+                self.parts_rcv,
+                self.parts_snd,
+                map_parts(_flatten, self.lids_rcv, values),
+                map_parts(_flatten, self.lids_snd, values_snd),
+            )
+        return self._table_cache[key]
 
     def __repr__(self):
         return "Exchanger(...)"

@@ -451,13 +451,46 @@ def exchange_coo(
 # ---------------------------------------------------------------------------
 
 
-class LocalMatrixViewPart:
+class _MatrixViewPart:
+    """Shared read/write/accumulate semantics of the matrix views: reads of
+    entries absent from the sparsity pattern return 0; writes to them raise.
+    Subclasses supply `_nz` (index-space mapping -> nz storage position)
+    and `_kind` for diagnostics."""
+
+    _kind = "matrix_view"
+
+    def _nz(self, i, j):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __getitem__(self, ij):
+        i, j = ij
+        k = self._nz(i, j)
+        out = np.where(k >= 0, self.values.data[np.maximum(k, 0)], 0.0)
+        if np.isscalar(i) and np.isscalar(j):
+            return out.reshape(-1)[0]
+        return out
+
+    def __setitem__(self, ij, v):
+        k = self._nz(*ij)
+        check(bool((np.asarray(k) >= 0).all()),
+              f"{self._kind} write to an entry not stored in parent")
+        self.values.data[k] = v
+
+    def add(self, i, j, v):
+        """Scatter-accumulate (the FEM assembly primitive)."""
+        k = self._nz(i, j)
+        check(bool((np.asarray(k) >= 0).all()),
+              f"{self._kind} add to an entry not stored in parent")
+        np.add.at(self.values.data, np.asarray(k), np.asarray(v))
+
+
+class LocalMatrixViewPart(_MatrixViewPart):
     """One part of `local_view(A, rows, cols)`: A's local matrix re-indexed
-    by another (rows, cols) pair's lids. Reads of entries absent from the
-    sparsity pattern return 0; writes to them raise
+    by another (rows, cols) pair's lids
     (reference LocalView semantics: src/Interfaces.jl:1994-2035)."""
 
     __slots__ = ("values", "row_map", "col_map")
+    _kind = "local_view"
 
     def __init__(self, values: CSRMatrix, row_map: np.ndarray, col_map: np.ndarray):
         self.values = values
@@ -477,34 +510,13 @@ class LocalMatrixViewPart:
         )
         return nzindex(self.values, li, lj)
 
-    def __getitem__(self, ij):
-        i, j = ij
-        k = self._nz(i, j)
-        out = np.where(k >= 0, self.values.data[np.maximum(k, 0)], 0.0)
-        if np.isscalar(i) and np.isscalar(j):
-            return out.reshape(-1)[0]
-        return out
 
-    def __setitem__(self, ij, v):
-        i, j = ij
-        k = self._nz(i, j)
-        check(bool((np.asarray(k) >= 0).all()),
-              "local_view write to an entry not stored in parent")
-        self.values.data[k] = v
-
-    def add(self, i, j, v):
-        """Scatter-accumulate (the FEM assembly primitive)."""
-        k = self._nz(i, j)
-        check(bool((np.asarray(k) >= 0).all()),
-              "local_view add to an entry not stored in parent")
-        np.add.at(self.values.data, np.asarray(k), np.asarray(v))
-
-
-class GlobalMatrixViewPart:
+class GlobalMatrixViewPart(_MatrixViewPart):
     """One part of `global_view(A)`: entries addressed by (gi, gj) global
     ids (reference GlobalView: src/Interfaces.jl:2037-2069)."""
 
     __slots__ = ("values", "rows_iset", "cols_iset", "shape")
+    _kind = "global_view"
 
     def __init__(self, values: CSRMatrix, rows_iset, cols_iset, shape):
         self.values = values
@@ -520,26 +532,6 @@ class GlobalMatrixViewPart:
             "global_view: gid not local on this part",
         )
         return nzindex(self.values, li, lj)
-
-    def __getitem__(self, ij):
-        i, j = ij
-        k = self._nz(i, j)
-        out = np.where(k >= 0, self.values.data[np.maximum(k, 0)], 0.0)
-        if np.isscalar(i) and np.isscalar(j):
-            return out.reshape(-1)[0]
-        return out
-
-    def __setitem__(self, ij, v):
-        k = self._nz(*ij)
-        check(bool((np.asarray(k) >= 0).all()),
-              "global_view write to an entry not stored in parent")
-        self.values.data[k] = v
-
-    def add(self, gi, gj, v):
-        k = self._nz(gi, gj)
-        check(bool((np.asarray(k) >= 0).all()),
-              "global_view add to an entry not stored in parent")
-        np.add.at(self.values.data, np.asarray(k), np.asarray(v))
 
 
 def psparse_local_view(A: PSparseMatrix, rows: PRange = None, cols: PRange = None):

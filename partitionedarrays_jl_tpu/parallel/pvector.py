@@ -450,6 +450,7 @@ def local_view(v, rows: Optional[PRange] = None, cols: Optional[PRange] = None) 
         from .psparse import psparse_local_view
 
         return psparse_local_view(v, rows, cols)
+    check(cols is None, "local_view of a PVector takes no cols axis")
     rows = rows if rows is not None else v.rows
 
     def _mk(view_iset, parent_iset, vals):
@@ -464,6 +465,7 @@ def global_view(v, rows: Optional[PRange] = None, cols: Optional[PRange] = None)
         from .psparse import psparse_global_view
 
         return psparse_global_view(v, rows, cols)
+    check(cols is None, "global_view of a PVector takes no cols axis")
     rows = rows or v.rows
     return map_parts(
         lambda i, vals: GlobalViewPart(vals, i), rows.partition, v.values

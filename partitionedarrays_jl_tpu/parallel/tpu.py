@@ -115,6 +115,18 @@ class TPUBackend(AbstractBackend):
 tpu = TPUBackend()
 
 
+def _stage(backend: TPUBackend, arr: np.ndarray, nparts: int):
+    """Host (P, ...) array -> array sharded part-per-device. Uses
+    `make_array_from_callback` so each process materializes only its
+    *addressable* shards — under a multi-host mesh (`jax.distributed`, DCN
+    between slices) every controller holds the same host-side plan and
+    contributes just its local devices' rows; on one host it degenerates to
+    a plain device_put."""
+    jax = _jax()
+    sh = backend.sharding(nparts)
+    return jax.make_array_from_callback(arr.shape, sh, lambda idx: arr[idx])
+
+
 class TPUData(SequentialData):
     """Host-side per-part metadata under the TPU backend: planning values
     live on host exactly as in the sequential backend; only the lowered
@@ -288,7 +300,7 @@ class DeviceVector:
                 iset.num_oids :
             ]
         jax = _jax()
-        data = jax.device_put(stacked, backend.sharding(layout.P))
+        data = _stage(backend, stacked, layout.P)
         return cls(data, v.rows, layout, backend)
 
     def to_pvector(self) -> PVector:
@@ -326,8 +338,8 @@ class DeviceMatrix:
     of the reference SpMV (src/Interfaces.jl:2246-2275) visible to XLA."""
 
     __slots__ = (
-        "oo_vals", "oo_cols", "oh_vals", "oh_cols",
-        "dia_offsets", "dia_vals",
+        "oo_vals", "oo_cols", "oh_vals", "oh_cols", "oh_rows", "oh_nnz",
+        "dia_offsets", "dia_vals", "pallas_plan",
         "rows", "cols", "row_layout", "col_layout", "col_plan", "backend",
         "flops_per_spmv", "_cg_cache",
     )
@@ -359,8 +371,6 @@ class DeviceMatrix:
         Wc = col_layout.W
         oo_vals = np.zeros((P, no_max, L_oo))
         oo_cols = np.full((P, no_max, L_oo), col_layout.trash, dtype=INDEX_DTYPE)
-        oh_vals = np.zeros((P, no_max, L_oh))
-        oh_cols = np.full((P, no_max, L_oh), col_layout.trash, dtype=INDEX_DTYPE)
         nnz = 0
         for p in range(P):
             Eoo = ELLMatrix.from_csr(oo[p], row_width=L_oo)
@@ -368,18 +378,37 @@ class DeviceMatrix:
             oo_vals[p, :m] = Eoo.vals
             # ELL pad cols are 0 with val 0 — safe: slot 0 is a real owned slot
             oo_cols[p, :m] = Eoo.cols  # owned cols: slot == col lid
-            Eoh = ELLMatrix.from_csr(oh[p], row_width=L_oh)
-            oh_vals[p, :m] = Eoh.vals
-            oh_cols[p, :m] = col_layout.no_max + Eoh.cols  # ghost region slots
             nnz += oo[p].nnz + oh[p].nnz
         self.flops_per_spmv = 2 * nnz
+        # A_oh, compact boundary-row form. Only rows touching the ghost
+        # layer carry entries — a surface set (~n^2 of n^3 rows for a 3-D
+        # stencil). TPU gathers run element-at-a-time, so gathering per
+        # boundary row instead of per owned row is the difference between
+        # O(surface) and O(volume) serial work; an empty block (single
+        # part, or interior-only coupling) skips the gather entirely.
+        self.oh_nnz = sum(m.nnz for m in oh)
+        nb_max = max(
+            (int(np.count_nonzero(m.row_lengths())) for m in oh), default=0
+        )
+        nb_max = max(nb_max, 1)
+        oh_rows = np.full((P, nb_max), col_layout.trash, dtype=INDEX_DTYPE)
+        oh_vals = np.zeros((P, nb_max, L_oh))
+        oh_cols = np.full((P, nb_max, L_oh), col_layout.trash, dtype=INDEX_DTYPE)
+        for p in range(P):
+            br = np.nonzero(oh[p].row_lengths())[0]
+            if len(br):
+                Eoh = ELLMatrix.from_csr(oh[p], row_width=L_oh)
+                oh_rows[p, : len(br)] = br
+                oh_vals[p, : len(br)] = Eoh.vals[br]
+                oh_cols[p, : len(br)] = col_layout.no_max + Eoh.cols[br]
         self._cg_cache = {}
         sh = backend.sharding(P)
         dt = A.dtype
-        self.oo_vals = jax.device_put(oo_vals.astype(dt), sh)
-        self.oo_cols = jax.device_put(oo_cols, sh)
-        self.oh_vals = jax.device_put(oh_vals.astype(dt), sh)
-        self.oh_cols = jax.device_put(oh_cols, sh)
+        self.oo_vals = _stage(backend, oo_vals.astype(dt), P)
+        self.oo_cols = _stage(backend, oo_cols, P)
+        self.oh_vals = _stage(backend, oh_vals.astype(dt), P)
+        self.oh_cols = _stage(backend, oh_cols, P)
+        self.oh_rows = _stage(backend, oh_rows, P)
 
         # DIA fast path for the owned-owned block (cols' owned lids number
         # identically to rows' in square operators): entry (r, r+o) goes to
@@ -401,20 +430,36 @@ class DeviceMatrix:
                         np.unique(M.indices.astype(np.int64) - M.row_of_nz()).tolist()
                     )
         if square and 0 < len(offs) <= self.DIA_MAX_OFFSETS:
+            from ..ops.pallas_dia import LANES, plan_dia_pallas
+
             offsets = tuple(sorted(offs))
             D = len(offsets)
-            dia = np.zeros((P, D, no_max))
             off_arr = np.array(offsets)
+            # on a real TPU the band sum runs as a Pallas kernel over
+            # lane-tiled (R, 128) views; pre-stage the values in that shape
+            self.pallas_plan = (
+                plan_dia_pallas(offsets, no_max, itemsize=np.dtype(dt).itemsize)
+                if backend.devices()[0].platform == "tpu"
+                else None
+            )
+            if self.pallas_plan is not None:
+                R = self.pallas_plan["n_rows"]
+                dia = np.zeros((P, D, R * LANES))
+            else:
+                dia = np.zeros((P, D, no_max))
             for p in range(P):
                 M = oo[p]
                 if M.nnz:
                     r = M.row_of_nz()
                     d = np.searchsorted(off_arr, M.indices.astype(np.int64) - r)
                     dia[p, d, r] = M.data
+            if self.pallas_plan is not None:
+                dia = dia.reshape(P, D, R, LANES)
             self.dia_offsets = offsets
-            self.dia_vals = jax.device_put(dia.astype(dt), sh)
+            self.dia_vals = _stage(backend, dia.astype(dt), P)
         else:
             self.dia_offsets = None
+            self.pallas_plan = None
             self.dia_vals = self.oo_vals  # placeholder with a valid sharding
 
 
@@ -474,16 +519,18 @@ def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") ->
         )(x, si, sm, ri)
 
     sh = backend.sharding(plan.layout.P)
-    si = _jax().device_put(plan.snd_idx, sh)
-    sm = _jax().device_put(plan.snd_mask, sh)
-    ri = _jax().device_put(plan.rcv_idx, sh)
+    si = _stage(backend, plan.snd_idx, plan.layout.P)
+    sm = _stage(backend, plan.snd_mask, plan.layout.P)
+    ri = _stage(backend, plan.rcv_idx, plan.layout.P)
     return lambda x: fn(x, si, sm, ri)
 
 
 def _spmv_body(dA: DeviceMatrix):
     """Per-shard overlapped SpMV: pack+permute the halo, compute the A_oo
     partial on pre-exchange owned values (independent of the collective —
-    XLA overlaps them), then unpack and add the A_oh ghost contribution."""
+    XLA overlaps them), then unpack and add the A_oh ghost contribution
+    on the compact boundary-row set."""
+    import jax
     import jax.numpy as jnp
 
     plan = dA.col_plan
@@ -501,25 +548,51 @@ def _spmv_body(dA: DeviceMatrix):
         return acc
 
     offsets = dA.dia_offsets
+    pad = max((abs(o) for o in offsets), default=0) if offsets else 0
+    pplan = dA.pallas_plan
+
+    def _dia_rowsum_pallas(vals, xv):
+        # Pallas hot path (real TPU): one streaming pass at HBM bandwidth;
+        # see ops/pallas_dia.py for the memory schedule
+        from ..ops.pallas_dia import LANES, dia_spmv_pallas
+
+        hp = pplan["halo_rows"] * LANES
+        xp = jnp.pad(
+            xv[:no_max], (hp, pplan["padded_len"] - no_max + hp + LANES)
+        ).reshape(-1, LANES)
+        y = dia_spmv_pallas(
+            vals, xp, offsets, pplan["n_rows"], pplan["halo_rows"],
+            pplan["block_rows"],
+        )
+        return y.reshape(-1)[:no_max]
 
     def _dia_rowsum(vals, xv):
-        # banded fast path: no gather — each diagonal is a rolled slice of
-        # x streamed through the VPU. Ascending-offset order == ascending-
-        # column order per row, so bits match the ELL fold (absent
-        # diagonals add exact zeros).
-        acc = vals[0] * jnp.roll(xv, -offsets[0])[:no_max]
+        # banded fast path: no gather — one zero-padded copy of the owned
+        # region, then each diagonal is a *static slice* of it, so XLA
+        # fuses the whole band sum into one streaming VPU kernel (rolls
+        # would materialize a full copy per diagonal). Ascending-offset
+        # order == ascending-column order per row, so bits match the ELL
+        # fold; pad/absent-diagonal terms are exact zeros (val 0).
+        xp = jnp.pad(xv[:no_max], (pad, pad))
+        acc = vals[0] * jax.lax.slice(xp, (pad + offsets[0],), (pad + offsets[0] + no_max,))
         for d in range(1, len(offsets)):
-            acc = acc + vals[d] * jnp.roll(xv, -offsets[d])[:no_max]
+            o = pad + offsets[d]
+            acc = acc + vals[d] * jax.lax.slice(xp, (o,), (o + no_max,))
         return acc
 
-    def body(xv, oo_v, oo_c, oh_v, oh_c, si, sm, ri):
-        if offsets is not None:
-            partial_ = _dia_rowsum(oo_v, xv)  # owned block, overlaps the wire
+    def body(xv, oo_v, oo_c, oh_v, oh_c, oh_r, si, sm, ri):
+        if offsets is not None:  # owned block first: overlaps the wire
+            rowsum = _dia_rowsum_pallas if pplan is not None else _dia_rowsum
+            partial_ = rowsum(oo_v, xv)
         else:
             partial_ = _ell_rowsum(oo_v, oo_c, xv)
         xv = exch(xv, si, sm, ri)
-        y_o = partial_ + _ell_rowsum(oh_v, oh_c, xv)
-        y = jnp.zeros_like(xv).at[:no_max].set(y_o)
+        y = jnp.zeros_like(xv).at[:no_max].set(partial_)
+        if dA.oh_nnz:
+            # ghost contribution only on the boundary rows (padded rows
+            # target the trash slot with exact-zero values)
+            y = y.at[oh_r].add(_ell_rowsum(oh_v, oh_c, xv))
+            y = y.at[no_max:].set(0)
         return y, xv
 
     return body
@@ -543,21 +616,23 @@ def make_spmv_fn(dA: DeviceMatrix) -> Callable:
     body = _spmv_body(dA)
     plan = dA.col_plan
     sh = dA.backend.sharding(plan.layout.P)
-    si = jax.device_put(plan.snd_idx, sh)
-    sm = jax.device_put(plan.snd_mask, sh)
-    ri = jax.device_put(plan.rcv_idx, sh)
+    si = _stage(dA.backend, plan.snd_idx, plan.layout.P)
+    sm = _stage(dA.backend, plan.snd_mask, plan.layout.P)
+    ri = _stage(dA.backend, plan.rcv_idx, plan.layout.P)
 
     @jax.jit
-    def fn(x, oo_v, oo_c, oh_v, oh_c, si, sm, ri):
-        def shard_fn(xs, a, b, c, d, e, f, g):
-            y, _ = body(xs[0], a[0], b[0], c[0], d[0], e[0], f[0], g[0])
+    def fn(x, oo_v, oo_c, oh_v, oh_c, oh_r, si, sm, ri):
+        def shard_fn(xs, a, b, c, d, e, f, g, h):
+            y, _ = body(xs[0], a[0], b[0], c[0], d[0], e[0], f[0], g[0], h[0])
             return y[None]
 
         return shard_map(
-            shard_fn, mesh=mesh, in_specs=(spec,) * 8, out_specs=spec
-        )(x, oo_v, oo_c, oh_v, oh_c, si, sm, ri)
+            shard_fn, mesh=mesh, in_specs=(spec,) * 9, out_specs=spec
+        )(x, oo_v, oo_c, oh_v, oh_c, oh_r, si, sm, ri)
 
-    return lambda x: fn(x, _oo_operand(dA), dA.oo_cols, dA.oh_vals, dA.oh_cols, si, sm, ri)
+    return lambda x: fn(
+        x, _oo_operand(dA), dA.oo_cols, dA.oh_vals, dA.oh_cols, dA.oh_rows, si, sm, ri
+    )
 
 
 def make_cg_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
@@ -577,19 +652,19 @@ def make_cg_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
     pdot = _pdot_factory(no_max)
     plan = dA.col_plan
     sh = dA.backend.sharding(plan.layout.P)
-    si_d = jax.device_put(plan.snd_idx, sh)
-    sm_d = jax.device_put(plan.snd_mask, sh)
-    ri_d = jax.device_put(plan.rcv_idx, sh)
+    si_d = _stage(dA.backend, plan.snd_idx, plan.layout.P)
+    sm_d = _stage(dA.backend, plan.snd_mask, plan.layout.P)
+    ri_d = _stage(dA.backend, plan.rcv_idx, plan.layout.P)
 
     # per-iteration residual history, fixed-shape for the while_loop carry
     # (capped: a convergence curve beyond this many entries is truncated)
     H = int(min(maxiter + 1, 4096))
 
     @jax.jit
-    def fn(b, x0, oo_v, oo_c, oh_v, oh_c, si, sm, ri):
-        def shard_fn(bs, x0s, a, c, d, e, f, g, h):
+    def fn(b, x0, oo_v, oo_c, oh_v, oh_c, oh_r, si, sm, ri):
+        def shard_fn(bs, x0s, a, c, d, e, f, g, h, i):
             bv, xv = bs[0], x0s[0]
-            mats = (a[0], c[0], d[0], e[0], f[0], g[0], h[0])
+            mats = (a[0], c[0], d[0], e[0], f[0], g[0], h[0], i[0])
 
             def spmv(z):
                 y, _ = body_spmv(z, *mats)
@@ -629,13 +704,14 @@ def make_cg_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
         return shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(spec,) * 9,
+            in_specs=(spec,) * 10,
             out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
             check_vma=False,
-        )(b, x0, oo_v, oo_c, oh_v, oh_c, si, sm, ri)
+        )(b, x0, oo_v, oo_c, oh_v, oh_c, oh_r, si, sm, ri)
 
     return lambda b, x0: fn(
-        b, x0, _oo_operand(dA), dA.oo_cols, dA.oh_vals, dA.oh_cols, si_d, sm_d, ri_d
+        b, x0, _oo_operand(dA), dA.oo_cols, dA.oh_vals, dA.oh_cols, dA.oh_rows,
+        si_d, sm_d, ri_d,
     )
 
 
@@ -695,5 +771,5 @@ def _b_on_cols_layout(b: PVector, dA: DeviceMatrix) -> DeviceVector:
     ):
         stacked[p, : iset.num_oids] = _owned(iset, np.asarray(vals))
     jax = _jax()
-    data = jax.device_put(stacked, dA.backend.sharding(layout.P))
+    data = _stage(dA.backend, stacked, layout.P)
     return DeviceVector(data, dA.cols, layout, dA.backend)
