@@ -45,8 +45,12 @@ def main():
 
     def driver(parts):
         A, b, x_exact, x0 = assemble_poisson(parts, (n, n, n))
+        # scale by 1/16 so the timing chain (repeated application) stays
+        # bounded: the raw 7-point operator amplifies ~12x per step
         A.values = pa.map_parts(
-            lambda M: pa.CSRMatrix(M.indptr, M.indices, M.data.astype(dtype), M.shape),
+            lambda M: pa.CSRMatrix(
+                M.indptr, M.indices, (M.data / 16).astype(dtype), M.shape
+            ),
             A.values,
         )
         A.invalidate_blocks()
@@ -61,31 +65,44 @@ def main():
     spmv = make_spmv_fn(dA)
     flops = dA.flops_per_spmv
 
-    # Device timing by *marginal* chain cost: the axon relay acks
-    # block_until_ready before true completion, so we chain K dependent
-    # SpMVs, force completion with a host scalar fetch, and difference two
-    # chain lengths to cancel the fixed RTT overhead.
+    # Device timing by *marginal* chain cost: the axon relay adds tens of
+    # ms of fixed RTT per dispatch, so we chain K dependent SpMVs in ONE
+    # compiled program, force completion with a host scalar fetch, and
+    # difference two well-separated chain lengths (medians over reps) to
+    # cancel the fixed overhead. The operator is pre-scaled (see driver)
+    # so repeated application stays bounded instead of overflowing, which
+    # would poison the timing.
+    import statistics
     from functools import partial
 
     assert dx.data.shape == spmv(dx.data).shape, "square chain layout expected"
 
     @partial(jax.jit, static_argnums=1)
     def chain(x, k):
-        # K dependent SpMVs in ONE compiled program: per-dispatch relay
-        # overhead (tens of ms through the axon tunnel) stays out of the
-        # marginal per-op cost; the host scalar fetch forces completion.
         return jax.lax.fori_loop(0, k, lambda i, y: spmv(y), x).sum()
 
-    def chain_time(k: int) -> float:
+    def chain_time(k: int, nreps: int = 5) -> float:
         float(chain(dx.data, k))  # warm compile for this k
-        t0 = time.perf_counter()
-        float(chain(dx.data, k))
-        return time.perf_counter() - t0
+        float(chain(dx.data, k))  # settle caches / relay path
+        ts = []
+        for _ in range(nreps):
+            t0 = time.perf_counter()
+            v = float(chain(dx.data, k))
+            ts.append(time.perf_counter() - t0)
+        assert v == v, "chain produced NaN — operator scaling broken"
+        return statistics.median(ts)
 
-    k1, k2 = max(5, reps // 4), reps
-    t1 = min(chain_time(k1) for _ in range(3))
-    t2 = min(chain_time(k2) for _ in range(3))
-    dt = max((t2 - t1) / (k2 - k1), 1e-9)
+    k1, k2 = 10, 10 + max(50, reps)
+    t1 = chain_time(k1)
+    dt = 0.0
+    for _ in range(4):  # lengthen the chain until it dominates RTT jitter
+        t2 = chain_time(k2)
+        dt = (t2 - t1) / (k2 - k1)
+        if dt > 0:
+            break
+        k2 = 2 * k2
+    if dt <= 0:  # still inverted: report the conservative whole-chain cost
+        dt = t2 / k2
     gflops = flops / dt / 1e9
 
     # sequential-oracle timing on the same local problem (NumPy CSR)

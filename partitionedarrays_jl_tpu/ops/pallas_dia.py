@@ -37,6 +37,13 @@ LANES = 128
 DEF_BLOCK_ROWS = 512
 
 
+def _win_rows(block_rows: int, halo_rows: int) -> int:
+    """Rows of the per-block x window (block + halo above/below + one spill
+    row for lane rotation), rounded up to 8 — TPU DMAs want 8-aligned
+    sublane counts."""
+    return -(-(block_rows + 2 * halo_rows + 1) // 8) * 8
+
+
 def _kernel(vals_ref, xw_ref, y_ref, xs_ref, sem, *, qr: Tuple[Tuple[int, int], ...],
             block_rows: int, halo_rows: int):
     import jax.numpy as jnp
@@ -44,9 +51,11 @@ def _kernel(vals_ref, xw_ref, y_ref, xs_ref, sem, *, qr: Tuple[Tuple[int, int], 
     from jax.experimental.pallas import tpu as pltpu
 
     i = pl.program_id(0)
-    # x window for this block: rows [i*BR, i*BR + BR + 2*halo_rows] of the
-    # padded x — one DMA, reused by every diagonal
-    win_rows = block_rows + 2 * halo_rows + 1
+    # x window for this block: rows [i*BR, i*BR + win_rows) of the padded
+    # x — one DMA, reused by every diagonal. The window is rounded up to a
+    # multiple of 8 rows: a DMA whose sublane count is not 8-aligned
+    # faults the chip.
+    win_rows = _win_rows(block_rows, halo_rows)
     dma = pltpu.make_async_copy(
         xw_ref.at[pl.ds(i * block_rows, win_rows), :], xs_ref, sem
     )
@@ -79,9 +88,9 @@ def dia_spmv_pallas(
     """y = sum_d diag(vals[d]) @ shift(x, offsets[d]) on the lane-tiled form.
 
     vals: (D, R, 128) diagonal values, R = n_rows (a multiple of block_rows).
-    x:    (R + 2*halo_rows + 1, 128) — the owned region padded with
-          `halo_rows` zero rows on each side (plus one spill row), so every
-          shifted read stays in range.
+    x:    (R + win_rows - block_rows, 128) with the owned region starting at
+          flat element halo_rows*128, zero-padded on both sides so every
+          shifted read stays in range (use plan_dia_pallas()["x_rows"]).
     offsets: ascending flat-element diagonal offsets; |off| <= halo_rows*128.
     Returns y: (R, 128).
     """
@@ -93,7 +102,8 @@ def dia_spmv_pallas(
     assert R == n_rows and n_rows % block_rows == 0
     qr = tuple(divmod(halo_rows * LANES + off, LANES) for off in offsets)
     grid = (n_rows // block_rows,)
-    win_rows = block_rows + 2 * halo_rows + 1
+    win_rows = _win_rows(block_rows, halo_rows)
+    assert x.shape[0] >= n_rows + win_rows - block_rows, (x.shape, n_rows, win_rows)
     kernel = functools.partial(
         _kernel, qr=qr, block_rows=block_rows, halo_rows=halo_rows
     )
@@ -137,12 +147,10 @@ def plan_dia_pallas(
     tiled_rows = -(-no_max // LANES)
     block_rows = int(min(block_rows, max(8, -(-tiled_rows // 8) * 8)))
     n_rows = -(-no_max // (LANES * block_rows)) * block_rows
+    win_rows = _win_rows(block_rows, halo_rows)
     # VMEM budget check: vals block (double-buffered) + out (x2) + window
     d = len(offsets)
-    vmem = (
-        (2 * d + 2) * block_rows * LANES
-        + (block_rows + 2 * halo_rows + 1) * LANES
-    ) * itemsize
+    vmem = ((2 * d + 2) * block_rows * LANES + win_rows * LANES) * itemsize
     if vmem > 12 * 2**20:
         return None
     return {
@@ -150,4 +158,6 @@ def plan_dia_pallas(
         "halo_rows": int(halo_rows),
         "block_rows": int(block_rows),
         "padded_len": int(n_rows * LANES),
+        # total rows the padded x operand must have (last block's window)
+        "x_rows": int(n_rows + win_rows - block_rows),
     }

@@ -515,7 +515,11 @@ def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") ->
             return body(xs[0], sis[0], sms[0], ris[0])[None]
 
         return shard_map(
-            shard_fn, mesh=mesh, in_specs=(spec, spec, spec, spec), out_specs=spec
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
         )(x, si, sm, ri)
 
     sh = backend.sharding(plan.layout.P)
@@ -558,7 +562,7 @@ def _spmv_body(dA: DeviceMatrix):
 
         hp = pplan["halo_rows"] * LANES
         xp = jnp.pad(
-            xv[:no_max], (hp, pplan["padded_len"] - no_max + hp + LANES)
+            xv[:no_max], (hp, pplan["x_rows"] * LANES - hp - no_max)
         ).reshape(-1, LANES)
         y = dia_spmv_pallas(
             vals, xp, offsets, pplan["n_rows"], pplan["halo_rows"],
@@ -627,7 +631,11 @@ def make_spmv_fn(dA: DeviceMatrix) -> Callable:
             return y[None]
 
         return shard_map(
-            shard_fn, mesh=mesh, in_specs=(spec,) * 9, out_specs=spec
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec,) * 9,
+            out_specs=spec,
+            check_vma=False,
         )(x, oo_v, oo_c, oh_v, oh_c, oh_r, si, sm, ri)
 
     return lambda x: fn(

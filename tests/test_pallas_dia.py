@@ -44,7 +44,7 @@ def test_pallas_matches_band_reference(n, offsets):
         src = np.arange(n) + off
         vals[d, np.arange(n)[(src < 0) | (src >= n)]] = 0.0
     x = rng.standard_normal(n).astype(np.float32)
-    xp = np.pad(x, (H * LANES, plan["padded_len"] - n + (H + 1) * LANES))
+    xp = np.pad(x, (H * LANES, plan["x_rows"] * LANES - H * LANES - n))
 
     y = dia_spmv_pallas(
         np.ascontiguousarray(vals.reshape(len(offsets), R, LANES)),
@@ -69,3 +69,6 @@ def test_plan_geometry():
     assert plan["halo_rows"] == 2  # ceil(130/128)
     assert plan["n_rows"] % 8 == 0
     assert plan["padded_len"] == plan["n_rows"] * LANES >= 1000
+    # the x operand row count is 8-aligned relative to the block grid: the
+    # DMA window (x_rows - n_rows + block_rows) must be a multiple of 8
+    assert (plan["x_rows"] - plan["n_rows"] + plan["block_rows"]) % 8 == 0
