@@ -128,6 +128,188 @@ def dia_spmv_pallas(
     )(vals, x)
 
 
+#: Fixed block geometry of the padded vector layout (see
+#: `parallel/tpu.py:DeviceLayout`): one zero block before the owned
+#: region, one zero reserve block after it, ghosts beyond. Bounds the
+#: supported diagonal offset to BLOCK_ROWS*LANES flat elements.
+PAD_BLOCK_ROWS = 2048
+
+
+def plan_dia_padded(
+    offsets: Sequence[int],
+    no_max: int,
+    n_coded: int,
+    itemsize: int = 4,
+):
+    """Geometry of the coded kernel operating *in-place* on the padded
+    vector layout: vectors are (T*BR, 128) with owned elements at flat
+    offset BR*128; the kernel consumes and produces full vectors, so SpMV
+    does zero layout copies. Returns None when an offset exceeds the
+    fixed pad reserve or VMEM would overflow (fall back to the copying
+    kernels)."""
+    if not offsets:
+        return None
+    BR = PAD_BLOCK_ROWS
+    max_off = max(abs(int(o)) for o in offsets)
+    if max_off > (BR - 8) * LANES:
+        return None
+    halo_rows = -(-max_off // LANES)
+    h8 = -(-halo_rows // 8) * 8
+    win_rows = _win_rows(BR, h8)
+    vmem = (
+        2 * win_rows * LANES * itemsize
+        + 2 * BR * LANES * itemsize
+        + 2 * max(n_coded, 1) * BR * LANES
+    )
+    if vmem > 13 * 2**20:
+        return None
+    n_blocks = -(-no_max // (LANES * BR))
+    return {
+        "block_rows": BR,
+        "halo_rows": h8,
+        "n_blocks": int(n_blocks),
+        "o0": int(BR * LANES),
+        "g0": int((n_blocks + 2) * BR * LANES),
+        "code_len": int(n_blocks * BR * LANES),
+    }
+
+
+def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, y_ref, xs_ref, cs_ref,
+                   xsem, csem, *, qr: Tuple[Tuple[int, int], ...],
+                   kk: Tuple[int, ...], code_row: Tuple[int, ...],
+                   n_blocks: int, block_rows: int, halo_rows: int,
+                   n_coded: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    j = pl.program_id(0)
+    BR = block_rows
+    win_rows = _win_rows(BR, halo_rows)
+
+    def x_dma(slot, blk):
+        return pltpu.make_async_copy(
+            xw_ref.at[pl.ds(blk * BR - halo_rows, win_rows), :],
+            xs_ref.at[slot],
+            xsem.at[slot],
+        )
+
+    def codes_dma(slot, blk):
+        return pltpu.make_async_copy(
+            codes_ref.at[:, pl.ds((blk - 1) * BR, BR), :],
+            cs_ref.at[slot],
+            csem.at[slot],
+        )
+
+    two = jnp.int32(2)
+    slot = jax.lax.rem(j, two)
+
+    @pl.when(j == 0)
+    def _():
+        x_dma(1, 1).start()
+        if n_coded:
+            codes_dma(1, 1).start()
+
+    @pl.when((j >= 1) & (j < n_blocks))
+    def _():
+        nxt = jax.lax.rem(j + 1, two)
+        x_dma(nxt, j + 1).start()
+        if n_coded:
+            codes_dma(nxt, j + 1).start()
+
+    @pl.when((j >= 1) & (j <= n_blocks))
+    def _compute():
+        x_dma(slot, j).wait()
+        if n_coded:
+            codes_dma(slot, j).wait()
+        acc = None
+        for d, (q, r) in enumerate(qr):
+            a = xs_ref[slot, pl.ds(q, BR), :]
+            if r == 0:
+                shifted = a
+            else:
+                b = xs_ref[slot, pl.ds(q + 1, BR), :]
+                shifted = jnp.concatenate([a[:, r:], b[:, :r]], axis=1)
+            if kk[d] == 1:
+                term = cb_ref[d, 0] * shifted
+            else:
+                c = cs_ref[slot, code_row[d]].astype(jnp.int32)
+                v = jnp.where(c == 1, cb_ref[d, 1], cb_ref[d, 0])
+                for k in range(2, kk[d]):
+                    v = jnp.where(c == k, cb_ref[d, k], v)
+                term = v * shifted
+            acc = term if acc is None else acc + term
+        e = (
+            (j - 1) * BR * LANES
+            + jax.lax.broadcasted_iota(jnp.int32, (BR, LANES), 0) * LANES
+            + jax.lax.broadcasted_iota(jnp.int32, (BR, LANES), 1)
+        )
+        y_ref[:] = jnp.where(e < no_ref[0], acc, 0)
+
+    @pl.when((j < 1) | (j > n_blocks))
+    def _zero():
+        y_ref[:] = jnp.zeros_like(y_ref)
+
+
+def dia_coded_padded_pallas(
+    codebook: "jax.Array",  # noqa: F821
+    no: "jax.Array",  # noqa: F821
+    codes: "jax.Array",  # noqa: F821
+    x: "jax.Array",  # noqa: F821
+    offsets: Tuple[int, ...],
+    kk: Tuple[int, ...],
+    code_row: Tuple[int, ...],
+    plan: dict,
+    total_rows: int,
+    interpret: bool = False,
+):
+    """Full-vector coded SpMV on the padded layout: x is a whole
+    (total_rows, 128) padded vector (owned at flat offset plan['o0'],
+    zeros elsewhere up to the ghost region, which the kernel never
+    reads); the result is a whole padded vector with the owned band
+    computed and every other slot exactly zero. codes: (Dc, n_blocks*BR,
+    128) int8."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    D = codebook.shape[0]
+    Dc = codes.shape[0]
+    assert D == len(offsets) == len(kk) == len(code_row)
+    BR, H, nB = plan["block_rows"], plan["halo_rows"], plan["n_blocks"]
+    qr = tuple(divmod(H * LANES + off, LANES) for off in offsets)
+    assert x.shape[0] == total_rows and total_rows % BR == 0
+    assert total_rows >= (nB + 2) * BR
+    win_rows = _win_rows(BR, H)
+    kernel = functools.partial(
+        _padded_kernel, qr=qr, kk=tuple(int(k) for k in kk),
+        code_row=tuple(int(c) for c in code_row), n_blocks=nB,
+        block_rows=BR, halo_rows=H, n_coded=Dc,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(total_rows // BR,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # codebook
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # no
+            pl.BlockSpec(memory_space=pl.ANY),  # codes: manual DMA
+            pl.BlockSpec(memory_space=pl.ANY),  # x: manual DMA
+        ],
+        out_specs=pl.BlockSpec(
+            (BR, LANES), lambda j: (j, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((total_rows, LANES), codebook.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, win_rows, LANES), codebook.dtype),
+            pltpu.VMEM((2, max(Dc, 1), BR, LANES), codes.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(codebook, no, codes, x)
+
+
 def plan_dia_pallas(
     offsets: Sequence[int],
     no_max: int,

@@ -153,26 +153,52 @@ class TPUData(SequentialData):
 
 
 class DeviceLayout:
-    """Slot layout shared by every device object over one PRange."""
+    """Slot layout shared by every device object over one PRange.
 
-    __slots__ = ("P", "W", "no_max", "nh_max", "noids", "nhids", "lid_slots")
+    Two geometries:
 
-    def __init__(self, rows: PRange):
+    * compact (host/CPU): ``[owned | ghosts | trash]`` — minimal storage.
+    * padded (real TPU): ``[zero block | owned blocks | zero reserve
+      block | ghosts | trash | zero tail]`` in units of 2048x128-element
+      blocks (ops/pallas_dia.py:PAD_BLOCK_ROWS). The padded form IS the
+      coded SpMV kernel's operand/result frame, so the hot loop runs with
+      zero layout copies; the pads are the shifted-read halo (invariant:
+      every non-owned, non-ghost slot is exactly 0).
+    """
+
+    __slots__ = (
+        "P", "W", "no_max", "nh_max", "noids", "nhids", "lid_slots",
+        "o0", "g0", "padded",
+    )
+
+    def __init__(self, rows: PRange, padded: bool = False):
         isets = rows.partition.part_values()
         self.P = len(isets)
         self.noids = np.array([i.num_oids for i in isets], dtype=np.int64)
         self.nhids = np.array([i.num_hids for i in isets], dtype=np.int64)
         self.no_max = int(self.noids.max())
         self.nh_max = int(self.nhids.max()) if self.P else 0
-        self.W = self.no_max + self.nh_max + 1
+        self.padded = bool(padded)
+        if padded:
+            from ..ops.pallas_dia import LANES, PAD_BLOCK_ROWS
+
+            blk = PAD_BLOCK_ROWS * LANES
+            n_blocks = -(-self.no_max // blk)
+            self.o0 = blk
+            self.g0 = (n_blocks + 2) * blk
+            self.W = -(-(self.g0 + self.nh_max + 1) // blk) * blk
+        else:
+            self.o0 = 0
+            self.g0 = self.no_max
+            self.W = self.no_max + self.nh_max + 1
         # lid -> slot per part (owned-first contract)
         self.lid_slots = []
         for i in isets:
             check(i.owned_first, "device lowering requires owned-first lid layout")
             slots = np.concatenate(
                 [
-                    np.arange(i.num_oids, dtype=INDEX_DTYPE),
-                    self.no_max + np.arange(i.num_hids, dtype=INDEX_DTYPE),
+                    self.o0 + np.arange(i.num_oids, dtype=INDEX_DTYPE),
+                    self.g0 + np.arange(i.num_hids, dtype=INDEX_DTYPE),
                 ]
             )
             self.lid_slots.append(slots)
@@ -257,7 +283,7 @@ def _shard_exchange(plan: DeviceExchangePlan, combine: str):
 
     R = plan.R
     perms = plan.perms
-    no_max = plan.layout.no_max
+    g0 = plan.layout.g0
 
     def body(xv, si, sm, ri):
         for r in range(R):
@@ -270,7 +296,7 @@ def _shard_exchange(plan: DeviceExchangePlan, combine: str):
             # keep the trash slot clean so padding invariants hold
             xv = xv.at[plan.layout.trash].set(0)
         if combine == "add":
-            xv = xv.at[no_max:].set(0)  # ghost contributions now live on owners
+            xv = xv.at[g0:].set(0)  # ghost contributions now live on owners
         return xv
 
     return body
@@ -289,29 +315,29 @@ class DeviceVector:
 
     @classmethod
     def from_pvector(cls, v: PVector, backend: TPUBackend, layout=None) -> "DeviceVector":
-        layout = layout or device_layout(v.rows)
+        layout = layout or device_layout(v.rows, _padded_for(backend))
+        o0, g0 = layout.o0, layout.g0
         stacked = np.zeros((layout.P, layout.W), dtype=v.dtype)
         for p, (iset, vals) in enumerate(
             zip(v.rows.partition.part_values(), v.values.part_values())
         ):
             vals = np.asarray(vals)
-            stacked[p, : iset.num_oids] = vals[: iset.num_oids]
-            stacked[p, layout.no_max : layout.no_max + iset.num_hids] = vals[
-                iset.num_oids :
-            ]
+            stacked[p, o0 : o0 + iset.num_oids] = vals[: iset.num_oids]
+            stacked[p, g0 : g0 + iset.num_hids] = vals[iset.num_oids :]
         jax = _jax()
         data = _stage(backend, stacked, layout.P)
         return cls(data, v.rows, layout, backend)
 
     def to_pvector(self) -> PVector:
         host = np.asarray(self.data)
+        o0, g0 = self.layout.o0, self.layout.g0
         vals = []
         for p, iset in enumerate(self.rows.partition.part_values()):
             vals.append(
                 np.concatenate(
                     [
-                        host[p, : iset.num_oids],
-                        host[p, self.layout.no_max : self.layout.no_max + iset.num_hids],
+                        host[p, o0 : o0 + iset.num_oids],
+                        host[p, g0 : g0 + iset.num_hids],
                     ]
                 )
             )
@@ -319,16 +345,30 @@ class DeviceVector:
         return PVector(parts._like(vals), self.rows)
 
 
-def device_layout(rows: PRange) -> DeviceLayout:
-    if not hasattr(rows, "_device_layout"):
-        rows._device_layout = DeviceLayout(rows)
-    return rows._device_layout
+def _padded_for(backend: TPUBackend) -> bool:
+    """Real TPUs get the padded (kernel-frame) layout; host/CPU meshes the
+    compact one."""
+    return backend.devices()[0].platform == "tpu"
 
 
-def device_exchange_plan(rows: PRange) -> DeviceExchangePlan:
-    if not hasattr(rows, "_device_plan"):
-        rows._device_plan = DeviceExchangePlan(rows.exchanger, device_layout(rows))
-    return rows._device_plan
+def device_layout(rows: PRange, padded: bool = False) -> DeviceLayout:
+    cache = getattr(rows, "_device_layout", None)
+    if cache is None:
+        cache = rows._device_layout = {}
+    if padded not in cache:
+        cache[padded] = DeviceLayout(rows, padded)
+    return cache[padded]
+
+
+def device_exchange_plan(rows: PRange, padded: bool = False) -> DeviceExchangePlan:
+    cache = getattr(rows, "_device_plan", None)
+    if cache is None:
+        cache = rows._device_plan = {}
+    if padded not in cache:
+        cache[padded] = DeviceExchangePlan(
+            rows.exchanger, device_layout(rows, padded)
+        )
+    return cache[padded]
 
 
 class DeviceMatrix:
@@ -340,8 +380,9 @@ class DeviceMatrix:
     __slots__ = (
         "oo_vals", "oo_cols", "oh_vals", "oh_cols", "oh_rows", "oh_nnz",
         "dia_offsets", "dia_vals", "pallas_plan",
+        "dia_mode", "dia_cb", "dia_no", "dia_codes", "dia_kk", "dia_code_row",
         "rows", "cols", "row_layout", "col_layout", "col_plan", "backend",
-        "flops_per_spmv", "_cg_cache",
+        "padded", "flops_per_spmv", "_cg_cache", "_ops_cache",
     )
 
     #: Use the diagonal (DIA) fast path when the union of A_oo band offsets
@@ -351,24 +392,39 @@ class DeviceMatrix:
     #: operators (FDM/FVM) are exactly this shape.
     DIA_MAX_OFFSETS = 64
 
-    def __init__(self, A: PSparseMatrix, backend: TPUBackend):
+    #: Use the coded-diagonal SpMV when every A_oo diagonal draws its
+    #: values from at most this many distinct floats (per part). Bounds
+    #: the in-kernel decode select chain; genuinely variable-coefficient
+    #: operators exceed it and take the streaming path instead.
+    CODE_MAX_VALUES = 8
+
+    def __init__(self, A: PSparseMatrix, backend: TPUBackend, padded=None):
         from ..ops.sparse import ELLMatrix
 
         jax = _jax()
-        row_layout = device_layout(A.rows)
-        col_layout = device_layout(A.cols)
-        self.rows, self.cols = A.rows, A.cols
-        self.row_layout, self.col_layout = row_layout, col_layout
-        self.col_plan = device_exchange_plan(A.cols)
-        self.backend = backend
-        P = row_layout.P
         oo = A.owned_owned_values.part_values()
         oh = A.owned_ghost_values.part_values()
+        isets = A.rows.partition.part_values()
+        P = len(isets)
+        noids = np.array([i.num_oids for i in isets], dtype=np.int64)
+        no_max = int(noids.max()) if P else 0
+        dt = A.dtype
+        det = self._detect_dia(A, oo, P, noids, no_max, np.dtype(dt).itemsize)
+        if padded is None:
+            # the padded vector frame only pays off when the in-frame coded
+            # kernel can actually run; otherwise stay compact even on TPU
+            padded = _padded_for(backend) and det is not None and det["pplan"] is not None
+        self.padded = bool(padded)
+        row_layout = device_layout(A.rows, self.padded)
+        col_layout = device_layout(A.cols, self.padded)
+        check(row_layout.no_max == no_max, "rows layout mismatch")
+        self.rows, self.cols = A.rows, A.cols
+        self.row_layout, self.col_layout = row_layout, col_layout
+        self.col_plan = device_exchange_plan(A.cols, self.padded)
+        self.backend = backend
         L_oo = max((int(m.row_lengths().max()) if m.nnz else 0 for m in oo), default=0)
         L_oh = max((int(m.row_lengths().max()) if m.nnz else 0 for m in oh), default=0)
         L_oo, L_oh = max(L_oo, 1), max(L_oh, 1)
-        no_max = row_layout.no_max
-        Wc = col_layout.W
         oo_vals = np.zeros((P, no_max, L_oo))
         oo_cols = np.full((P, no_max, L_oo), col_layout.trash, dtype=INDEX_DTYPE)
         nnz = 0
@@ -376,8 +432,8 @@ class DeviceMatrix:
             Eoo = ELLMatrix.from_csr(oo[p], row_width=L_oo)
             m = Eoo.vals.shape[0]
             oo_vals[p, :m] = Eoo.vals
-            # ELL pad cols are 0 with val 0 — safe: slot 0 is a real owned slot
-            oo_cols[p, :m] = Eoo.cols  # owned cols: slot == col lid
+            # ELL pad cols are 0 with val 0 — safe: o0 is a real owned slot
+            oo_cols[p, :m] = col_layout.o0 + Eoo.cols  # owned col slots
             nnz += oo[p].nnz + oh[p].nnz
         self.flops_per_spmv = 2 * nnz
         # A_oh, compact boundary-row form. Only rows touching the ghost
@@ -398,69 +454,173 @@ class DeviceMatrix:
             br = np.nonzero(oh[p].row_lengths())[0]
             if len(br):
                 Eoh = ELLMatrix.from_csr(oh[p], row_width=L_oh)
-                oh_rows[p, : len(br)] = br
+                oh_rows[p, : len(br)] = row_layout.o0 + br
                 oh_vals[p, : len(br)] = Eoh.vals[br]
-                oh_cols[p, : len(br)] = col_layout.no_max + Eoh.cols[br]
+                oh_cols[p, : len(br)] = col_layout.g0 + Eoh.cols[br]
         self._cg_cache = {}
-        sh = backend.sharding(P)
-        dt = A.dtype
+        self._ops_cache = None
         self.oo_vals = _stage(backend, oo_vals.astype(dt), P)
         self.oo_cols = _stage(backend, oo_cols, P)
         self.oh_vals = _stage(backend, oh_vals.astype(dt), P)
         self.oh_cols = _stage(backend, oh_cols, P)
         self.oh_rows = _stage(backend, oh_rows, P)
 
-        # DIA fast path for the owned-owned block (cols' owned lids number
-        # identically to rows' in square operators): entry (r, r+o) goes to
-        # diagonal o. Offsets sorted ascending = ascending column order per
-        # row, so the accumulation order (and the bits) match the ELL/CSR
-        # kernels; absent diagonals contribute exact +0 terms.
-        offs = set()
+        self.dia_mode = None
+        self.dia_offsets = None
+        self.pallas_plan = None
+        self.dia_cb = self.dia_no = self.dia_codes = None
+        self.dia_kk = self.dia_code_row = None
+        self.dia_vals = self.oo_vals  # placeholder with a valid sharding
+        if det is None:
+            return
+        from ..ops.pallas_dia import LANES, plan_dia_pallas
+
+        offsets, dia, uniq, kk = det["offsets"], det["dia"], det["uniq"], det["kk"]
+        code_row, coded, Dc = det["code_row"], det["coded"], det["Dc"]
+        D = len(offsets)
+        self.dia_offsets = offsets
+        if det["coded_ok"] and not (self.padded and det["pplan"] is None):
+            pplan = det["pplan"] if self.padded else None
+            if pplan is not None:
+                # the kernel frame and the vector layout are derived
+                # independently (ops/pallas_dia.py:plan_dia_padded vs
+                # DeviceLayout) — they must agree exactly or the kernel
+                # would read ghosts as halo zeros / mask the wrong rows
+                check(
+                    pplan["o0"] == row_layout.o0
+                    and pplan["g0"] == row_layout.g0
+                    and pplan["o0"] == col_layout.o0,
+                    "padded-frame geometry drifted between plan and layout",
+                )
+            self.dia_mode = "coded"
+            self.dia_kk = kk
+            self.dia_code_row = tuple(code_row)
+            self.pallas_plan = pplan
+            kmax = max(kk)
+            cb = np.zeros((P, D, kmax))
+            for p in range(P):
+                for d in range(D):
+                    u = uniq[p][d]
+                    if len(u) == 0:
+                        u = np.zeros(1)
+                    cb[p, d, : len(u)] = u
+                    cb[p, d, len(u):] = u[0]
+            nlen = pplan["code_len"] if pplan is not None else no_max
+            codes = np.zeros((P, max(Dc, 1), nlen), dtype=np.int8)
+            for p in range(P):
+                for j, d in enumerate(coded):
+                    u = uniq[p][d]
+                    if len(u):
+                        codes[p, j, :no_max] = np.clip(
+                            np.searchsorted(u, dia[p, d]), 0, len(u) - 1
+                        )
+            if pplan is not None:
+                codes = codes.reshape(P, max(Dc, 1), nlen // LANES, LANES)
+            self.dia_cb = _stage(backend, cb.astype(dt), P)
+            self.dia_no = _stage(
+                backend, noids.astype(np.int32).reshape(P, 1), P
+            )
+            self.dia_codes = _stage(backend, codes, P)
+        else:
+            self.dia_mode = "stream"
+            on_tpu = backend.devices()[0].platform == "tpu"
+            self.pallas_plan = (
+                plan_dia_pallas(offsets, no_max, itemsize=np.dtype(dt).itemsize)
+                if on_tpu
+                else None
+            )
+            if self.pallas_plan is not None:
+                R = self.pallas_plan["n_rows"]
+                dia_stage = np.zeros((P, D, R * LANES))
+                dia_stage[:, :, :no_max] = dia
+                dia_stage = dia_stage.reshape(P, D, R, LANES)
+            else:
+                dia_stage = dia
+            self.dia_vals = _stage(backend, dia_stage.astype(dt), P)
+
+    @classmethod
+    def _detect_dia(cls, A, oo, P, noids, no_max, itemsize):
+        """Band structure analysis of the A_oo block, run *before* the
+        layout choice (the padded frame is only worth it when the coded
+        kernel applies). Returns None when A_oo is not a (square, narrow)
+        band; otherwise the dense per-diagonal values plus the
+        coded-diagonal decomposition.
+
+        Coded diagonals: stencil operators (FD/FV, and FE on structured
+        meshes) draw each diagonal's values from a tiny set — one interior
+        value plus a few boundary / Dirichlet variants. When every diagonal
+        has at most CODE_MAX_VALUES distinct values, SpMV streams 1 BYTE
+        per element per non-constant diagonal (an index into a per-diagonal
+        codebook decoded in VMEM) instead of a 4-byte float — and fully
+        constant diagonals stream nothing at all. Bits are preserved:
+        decoding returns the exact stored values and the ascending-offset
+        accumulation order is unchanged."""
+        from ..ops.pallas_dia import plan_dia_padded
+
         square = all(
             np.array_equal(ri.oid_to_gid, ci.oid_to_gid)
             for ri, ci in zip(
                 A.rows.partition.part_values(), A.cols.partition.part_values()
             )
         )
-        if square:
-            for p in range(P):
-                M = oo[p]
-                if M.nnz:
-                    offs.update(
-                        np.unique(M.indices.astype(np.int64) - M.row_of_nz()).tolist()
-                    )
-        if square and 0 < len(offs) <= self.DIA_MAX_OFFSETS:
-            from ..ops.pallas_dia import LANES, plan_dia_pallas
-
-            offsets = tuple(sorted(offs))
-            D = len(offsets)
-            off_arr = np.array(offsets)
-            # on a real TPU the band sum runs as a Pallas kernel over
-            # lane-tiled (R, 128) views; pre-stage the values in that shape
-            self.pallas_plan = (
-                plan_dia_pallas(offsets, no_max, itemsize=np.dtype(dt).itemsize)
-                if backend.devices()[0].platform == "tpu"
-                else None
-            )
-            if self.pallas_plan is not None:
-                R = self.pallas_plan["n_rows"]
-                dia = np.zeros((P, D, R * LANES))
+        if not square:
+            return None
+        offs = set()
+        for p in range(P):
+            M = oo[p]
+            if M.nnz:
+                offs.update(
+                    np.unique(M.indices.astype(np.int64) - M.row_of_nz()).tolist()
+                )
+        if not (0 < len(offs) <= cls.DIA_MAX_OFFSETS):
+            return None
+        offsets = tuple(sorted(offs))
+        D = len(offsets)
+        off_arr = np.array(offsets)
+        # dense per-diagonal values on host: detection + staging source.
+        # Entry (r, r+o) of part p goes to diagonal o; ascending offsets ==
+        # ascending column order per row, so the accumulation order (and
+        # the bits) match the ELL/CSR kernels; absent diagonals contribute
+        # exact +0 terms.
+        dia = np.zeros((P, D, no_max))
+        for p in range(P):
+            M = oo[p]
+            if M.nnz:
+                r = M.row_of_nz()
+                d = np.searchsorted(off_arr, M.indices.astype(np.int64) - r)
+                dia[p, d, r] = M.data
+        uniq = [
+            [np.unique(dia[p, d, : int(noids[p])]) for d in range(D)]
+            for p in range(P)
+        ]
+        kk = tuple(
+            max((len(uniq[p][d]) for p in range(P)), default=1) or 1
+            for d in range(D)
+        )
+        code_row, coded = [], []
+        for d in range(D):
+            if kk[d] > 1:
+                code_row.append(len(coded))
+                coded.append(d)
             else:
-                dia = np.zeros((P, D, no_max))
-            for p in range(P):
-                M = oo[p]
-                if M.nnz:
-                    r = M.row_of_nz()
-                    d = np.searchsorted(off_arr, M.indices.astype(np.int64) - r)
-                    dia[p, d, r] = M.data
-            if self.pallas_plan is not None:
-                dia = dia.reshape(P, D, R, LANES)
-            self.dia_offsets = offsets
-            self.dia_vals = _stage(backend, dia.astype(dt), P)
-        else:
-            self.dia_offsets = None
-            self.pallas_plan = None
-            self.dia_vals = self.oo_vals  # placeholder with a valid sharding
+                code_row.append(-1)
+        coded_ok = max(kk) <= cls.CODE_MAX_VALUES
+        pplan = (
+            plan_dia_padded(offsets, no_max, len(coded), itemsize=itemsize)
+            if coded_ok
+            else None
+        )
+        return {
+            "offsets": offsets,
+            "dia": dia,
+            "uniq": uniq,
+            "kk": kk,
+            "code_row": code_row,
+            "coded": coded,
+            "Dc": len(coded),
+            "coded_ok": coded_ok,
+            "pplan": pplan,
+        }
 
 
 def device_matrix(A: PSparseMatrix, backend: TPUBackend) -> DeviceMatrix:
@@ -477,7 +637,7 @@ def device_matrix(A: PSparseMatrix, backend: TPUBackend) -> DeviceMatrix:
 # ---------------------------------------------------------------------------
 
 
-def _pdot_factory(no_max: int):
+def _pdot_factory(o0: int, no_max: int):
     """Deterministic across-parts dot: per-shard partial (owned region;
     padding is zero by invariant), `all_gather`, fold in part order — the
     compiled form of the sequential `preduce` left-fold, so the reduction
@@ -486,7 +646,7 @@ def _pdot_factory(no_max: int):
     import jax.numpy as jnp
 
     def pdot(a, b):
-        partial_ = jnp.sum(a[:no_max] * b[:no_max])
+        partial_ = jnp.sum(a[o0 : o0 + no_max] * b[o0 : o0 + no_max])
         allp = jax.lax.all_gather(partial_, "parts")
         return jnp.sum(allp)
 
@@ -500,7 +660,7 @@ def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") ->
     import jax
     from jax import shard_map
 
-    plan = device_exchange_plan(rows)
+    plan = device_exchange_plan(rows, _padded_for(backend))
     if combine == "add":
         rev = plan.layout  # reverse plan: swap pack/unpack roles
         rplan = DeviceExchangePlan(rows.exchanger.reverse(), rev)
@@ -529,6 +689,32 @@ def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") ->
     return lambda x: fn(x, si, sm, ri)
 
 
+def _matrix_operands(dA: DeviceMatrix) -> dict:
+    """The sharded operand pytree fed to compiled programs — only what the
+    selected A_oo path actually reads (coded mode drops the O(D*N) values
+    stream entirely: codebook + int8 codes instead)."""
+    if dA._ops_cache is not None:
+        return dA._ops_cache
+    plan = dA.col_plan
+    P = plan.layout.P
+    ops = {
+        "si": _stage(dA.backend, plan.snd_idx, P),
+        "sm": _stage(dA.backend, plan.snd_mask, P),
+        "ri": _stage(dA.backend, plan.rcv_idx, P),
+        "oh_v": dA.oh_vals,
+        "oh_c": dA.oh_cols,
+        "oh_r": dA.oh_rows,
+    }
+    if dA.dia_mode == "coded":
+        ops.update(cb=dA.dia_cb, no=dA.dia_no, codes=dA.dia_codes)
+    elif dA.dia_offsets is not None:
+        ops["oo_v"] = dA.dia_vals
+    else:
+        ops.update(oo_v=dA.oo_vals, oo_c=dA.oo_cols)
+    dA._ops_cache = ops
+    return ops
+
+
 def _spmv_body(dA: DeviceMatrix):
     """Per-shard overlapped SpMV: pack+permute the halo, compute the A_oo
     partial on pre-exchange owned values (independent of the collective —
@@ -539,7 +725,9 @@ def _spmv_body(dA: DeviceMatrix):
 
     plan = dA.col_plan
     exch = _shard_exchange(plan, "set")
-    no_max = dA.row_layout.no_max
+    layout = dA.row_layout
+    no_max = layout.no_max
+    o0, g0 = layout.o0, layout.g0
 
     def _ell_rowsum(vals, cols, xv):
         # strict left-to-right fold over the (static, small) row width, the
@@ -554,18 +742,23 @@ def _spmv_body(dA: DeviceMatrix):
     offsets = dA.dia_offsets
     pad = max((abs(o) for o in offsets), default=0) if offsets else 0
     pplan = dA.pallas_plan
+    mode = dA.dia_mode
 
-    def _dia_rowsum_pallas(vals, xv):
-        # Pallas hot path (real TPU): one streaming pass at HBM bandwidth;
-        # see ops/pallas_dia.py for the memory schedule
-        from ..ops.pallas_dia import LANES, dia_spmv_pallas
+    def _pad_lanes(xv):
+        from ..ops.pallas_dia import LANES
 
         hp = pplan["halo_rows"] * LANES
-        xp = jnp.pad(
-            xv[:no_max], (hp, pplan["x_rows"] * LANES - hp - no_max)
+        return jnp.pad(
+            xv[o0 : o0 + no_max], (hp, pplan["x_rows"] * LANES - hp - no_max)
         ).reshape(-1, LANES)
+
+    def _dia_rowsum_pallas(vals, xv):
+        # Pallas streaming path (real TPU, variable-coefficient band):
+        # see ops/pallas_dia.py for the memory schedule
+        from ..ops.pallas_dia import dia_spmv_pallas
+
         y = dia_spmv_pallas(
-            vals, xp, offsets, pplan["n_rows"], pplan["halo_rows"],
+            vals, _pad_lanes(xv), offsets, pplan["n_rows"], pplan["halo_rows"],
             pplan["block_rows"],
         )
         return y.reshape(-1)[:no_max]
@@ -577,35 +770,69 @@ def _spmv_body(dA: DeviceMatrix):
         # would materialize a full copy per diagonal). Ascending-offset
         # order == ascending-column order per row, so bits match the ELL
         # fold; pad/absent-diagonal terms are exact zeros (val 0).
-        xp = jnp.pad(xv[:no_max], (pad, pad))
+        xp = jnp.pad(xv[o0 : o0 + no_max], (pad, pad))
         acc = vals[0] * jax.lax.slice(xp, (pad + offsets[0],), (pad + offsets[0] + no_max,))
         for d in range(1, len(offsets)):
             o = pad + offsets[d]
             acc = acc + vals[d] * jax.lax.slice(xp, (o,), (o + no_max,))
         return acc
 
-    def body(xv, oo_v, oo_c, oh_v, oh_c, oh_r, si, sm, ri):
-        if offsets is not None:  # owned block first: overlaps the wire
+    kk = dA.dia_kk
+    code_row = dA.dia_code_row
+    interpret = dA.backend.devices()[0].platform != "tpu"
+
+    def _dia_coded_full(cb, no, codes, xv):
+        # zero-copy hot path: xv IS the kernel frame (padded layout); the
+        # result is a full vector with every non-owned slot exactly zero
+        from ..ops.pallas_dia import LANES, dia_coded_padded_pallas
+
+        y = dia_coded_padded_pallas(
+            cb, no.astype(jnp.int32), codes, xv.reshape(-1, LANES), offsets,
+            kk, code_row, pplan, xv.shape[0] // LANES, interpret=interpret,
+        )
+        return y.reshape(-1)
+
+    def _dia_coded_xla(cb, no, codes, xv):
+        xp = jnp.pad(xv[o0 : o0 + no_max], (pad, pad))
+        acc = None
+        for d in range(len(offsets)):
+            o = pad + offsets[d]
+            shifted = jax.lax.slice(xp, (o,), (o + no_max,))
+            if kk[d] == 1:
+                term = cb[d, 0] * shifted
+            else:
+                term = jnp.take(cb[d], codes[code_row[d]].astype(jnp.int32)) * shifted
+            acc = term if acc is None else acc + term
+        return jnp.where(jnp.arange(no_max) < no[0], acc, 0)
+
+    def body(xv, m):
+        full = None
+        if mode == "coded":
+            # coded-diagonal path: 1 byte/element per non-constant
+            # diagonal, decoded against the SMEM codebook — independent of
+            # the wire, so it still overlaps the halo collective
+            if pplan is not None:
+                full = _dia_coded_full(m["cb"], m["no"], m["codes"], xv)
+            else:
+                partial_ = _dia_coded_xla(m["cb"], m["no"], m["codes"], xv)
+        elif offsets is not None:  # owned block first: overlaps the wire
             rowsum = _dia_rowsum_pallas if pplan is not None else _dia_rowsum
-            partial_ = rowsum(oo_v, xv)
+            partial_ = rowsum(m["oo_v"], xv)
         else:
-            partial_ = _ell_rowsum(oo_v, oo_c, xv)
-        xv = exch(xv, si, sm, ri)
-        y = jnp.zeros_like(xv).at[:no_max].set(partial_)
+            partial_ = _ell_rowsum(m["oo_v"], m["oo_c"], xv)
+        xv = exch(xv, m["si"], m["sm"], m["ri"])
+        if full is not None:
+            y = full  # already a complete vector, pads exactly zero
+        else:
+            y = jnp.zeros_like(xv).at[o0 : o0 + no_max].set(partial_)
         if dA.oh_nnz:
             # ghost contribution only on the boundary rows (padded rows
             # target the trash slot with exact-zero values)
-            y = y.at[oh_r].add(_ell_rowsum(oh_v, oh_c, xv))
-            y = y.at[no_max:].set(0)
+            y = y.at[m["oh_r"]].add(_ell_rowsum(m["oh_v"], m["oh_c"], xv))
+            y = y.at[g0:].set(0)
         return y, xv
 
     return body
-
-
-def _oo_operand(dA: "DeviceMatrix"):
-    """The A_oo operand fed to compiled programs: DIA bands when the fast
-    path applies, the padded-ELL values otherwise."""
-    return dA.dia_vals if dA.dia_offsets is not None else dA.oo_vals
 
 
 def make_spmv_fn(dA: DeviceMatrix) -> Callable:
@@ -618,29 +845,33 @@ def make_spmv_fn(dA: DeviceMatrix) -> Callable:
     mesh = dA.backend.mesh(dA.row_layout.P)
     spec = dA.backend.parts_spec()
     body = _spmv_body(dA)
-    plan = dA.col_plan
-    sh = dA.backend.sharding(plan.layout.P)
-    si = _stage(dA.backend, plan.snd_idx, plan.layout.P)
-    sm = _stage(dA.backend, plan.snd_mask, plan.layout.P)
-    ri = _stage(dA.backend, plan.rcv_idx, plan.layout.P)
+    ops = _matrix_operands(dA)
+    specs = jax.tree.map(lambda _: spec, ops)
+    shape = (dA.col_plan.layout.P, dA.col_plan.layout.W)
 
     @jax.jit
-    def fn(x, oo_v, oo_c, oh_v, oh_c, oh_r, si, sm, ri):
-        def shard_fn(xs, a, b, c, d, e, f, g, h):
-            y, _ = body(xs[0], a[0], b[0], c[0], d[0], e[0], f[0], g[0], h[0])
+    def fn(x, m):
+        def shard_fn(xs, ms):
+            y, _ = body(xs[0], {k: v[0] for k, v in ms.items()})
             return y[None]
 
         return shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(spec,) * 9,
+            in_specs=(spec, specs),
             out_specs=spec,
             check_vma=False,
-        )(x, oo_v, oo_c, oh_v, oh_c, oh_r, si, sm, ri)
+        )(x, m)
 
-    return lambda x: fn(
-        x, _oo_operand(dA), dA.oo_cols, dA.oh_vals, dA.oh_cols, dA.oh_rows, si, sm, ri
-    )
+    def run(x):
+        check(
+            tuple(x.shape) == shape,
+            f"spmv: vector laid out {tuple(x.shape)}, matrix expects {shape} "
+            "— build vectors with the matrix's col_layout",
+        )
+        return fn(x, ops)
+
+    return run
 
 
 def make_cg_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
@@ -657,30 +888,32 @@ def make_cg_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
     none_spec = jax.sharding.PartitionSpec()
     body_spmv = _spmv_body(dA)
     no_max = dA.row_layout.no_max
-    pdot = _pdot_factory(no_max)
-    plan = dA.col_plan
-    sh = dA.backend.sharding(plan.layout.P)
-    si_d = _stage(dA.backend, plan.snd_idx, plan.layout.P)
-    sm_d = _stage(dA.backend, plan.snd_mask, plan.layout.P)
-    ri_d = _stage(dA.backend, plan.rcv_idx, plan.layout.P)
+    o0 = dA.row_layout.o0
+    g0 = dA.row_layout.g0
+    pdot = _pdot_factory(o0, no_max)
+    ops = _matrix_operands(dA)
+    specs = jax.tree.map(lambda _: spec, ops)
 
     # per-iteration residual history, fixed-shape for the while_loop carry
     # (capped: a convergence curve beyond this many entries is truncated)
     H = int(min(maxiter + 1, 4096))
 
     @jax.jit
-    def fn(b, x0, oo_v, oo_c, oh_v, oh_c, oh_r, si, sm, ri):
-        def shard_fn(bs, x0s, a, c, d, e, f, g, h, i):
+    def fn(b, x0, m):
+        def shard_fn(bs, x0s, ms):
             bv, xv = bs[0], x0s[0]
-            mats = (a[0], c[0], d[0], e[0], f[0], g[0], h[0], i[0])
+            mats = {k: v[0] for k, v in ms.items()}
 
             def spmv(z):
-                y, _ = body_spmv(z, *mats)
+                y, _ = body_spmv(z, mats)
                 return y
 
             q = spmv(xv)
-            r = (bv - q).at[no_max:].set(0.0)  # rows-range residual, owned only
-            p = jnp.zeros_like(xv).at[:no_max].set(r[:no_max])
+            # rows-range residual, owned region only (pads stay zero)
+            r = jnp.zeros_like(xv).at[o0 : o0 + no_max].set(
+                bv[o0 : o0 + no_max] - q[o0 : o0 + no_max]
+            )
+            p = jnp.zeros_like(xv).at[o0 : o0 + no_max].set(r[o0 : o0 + no_max])
             rs0 = pdot(r, r)
             hist = jnp.full(H, jnp.nan, dtype=bv.dtype).at[0].set(jnp.sqrt(rs0))
 
@@ -696,11 +929,13 @@ def make_cg_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
                 q = spmv(p)
                 pq = pdot(p, q)
                 alpha = rs / pq
-                x = x.at[:no_max].add(alpha * p[:no_max])
-                r = r.at[:no_max].add(-alpha * q[:no_max])
+                x = x.at[o0 : o0 + no_max].add(alpha * p[o0 : o0 + no_max])
+                r = r.at[o0 : o0 + no_max].add(-alpha * q[o0 : o0 + no_max])
                 rs_new = pdot(r, r)
                 beta = rs_new / rs
-                p = p.at[:no_max].set(r[:no_max] + beta * p[:no_max])
+                p = p.at[o0 : o0 + no_max].set(
+                    r[o0 : o0 + no_max] + beta * p[o0 : o0 + no_max]
+                )
                 hist = hist.at[jnp.minimum(it + 1, H - 1)].set(jnp.sqrt(rs_new))
                 return (x, r, p, rs_new, it + 1, hist)
 
@@ -712,15 +947,22 @@ def make_cg_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
         return shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(spec,) * 10,
+            in_specs=(spec, spec, specs),
             out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
             check_vma=False,
-        )(b, x0, oo_v, oo_c, oh_v, oh_c, oh_r, si, sm, ri)
+        )(b, x0, m)
 
-    return lambda b, x0: fn(
-        b, x0, _oo_operand(dA), dA.oo_cols, dA.oh_vals, dA.oh_cols, dA.oh_rows,
-        si_d, sm_d, ri_d,
-    )
+    shape = (dA.col_plan.layout.P, dA.col_plan.layout.W)
+
+    def run(b, x0):
+        check(
+            tuple(b.shape) == shape and tuple(x0.shape) == shape,
+            f"cg: vectors laid out {tuple(b.shape)}/{tuple(x0.shape)}, matrix "
+            f"expects {shape} — build vectors with the matrix's col_layout",
+        )
+        return fn(b, x0, ops)
+
+    return run
 
 
 # ---------------------------------------------------------------------------
@@ -777,7 +1019,9 @@ def _b_on_cols_layout(b: PVector, dA: DeviceMatrix) -> DeviceVector:
     for p, (iset, vals) in enumerate(
         zip(b.rows.partition.part_values(), b.values.part_values())
     ):
-        stacked[p, : iset.num_oids] = _owned(iset, np.asarray(vals))
+        stacked[p, layout.o0 : layout.o0 + iset.num_oids] = _owned(
+            iset, np.asarray(vals)
+        )
     jax = _jax()
     data = _stage(dA.backend, stacked, layout.P)
     return DeviceVector(data, dA.cols, layout, dA.backend)

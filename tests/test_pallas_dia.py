@@ -72,3 +72,62 @@ def test_plan_geometry():
     # the x operand row count is 8-aligned relative to the block grid: the
     # DMA window (x_rows - n_rows + block_rows) must be a multiple of 8
     assert (plan["x_rows"] - plan["n_rows"] + plan["block_rows"]) % 8 == 0
+
+
+def test_padded_kernel_matches_band_reference():
+    """Direct check of the padded-frame coded kernel (the real-TPU hot
+    path) via the Pallas interpreter: full padded vector in, full padded
+    vector out, non-owned slots exactly zero."""
+    from partitionedarrays_jl_tpu.ops.pallas_dia import (
+        PAD_BLOCK_ROWS,
+        dia_coded_padded_pallas,
+        plan_dia_padded,
+    )
+
+    rng = np.random.default_rng(11)
+    offsets = (-LANES * 16, -1, 0, 1, LANES * 16)
+    kk = (1, 3, 2, 3, 1)  # two constant diagonals, three coded
+    code_row = (-1, 0, 1, 2, -1)
+    BRL = PAD_BLOCK_ROWS * LANES
+    no = BRL + 7 * LANES + 13  # two owned blocks, ragged tail
+    plan = plan_dia_padded(offsets, no, n_coded=3)
+    assert plan is not None
+    nB, o0, g0 = plan["n_blocks"], plan["o0"], plan["g0"]
+    assert nB == 2 and o0 == BRL and g0 == 4 * BRL
+    D, Dc, kmax = len(offsets), 3, 3
+    cb = rng.standard_normal((D, kmax)).astype(np.float32)
+    codes = np.zeros((Dc, plan["code_len"]), dtype=np.int8)
+    for d in range(D):
+        if kk[d] > 1:
+            codes[code_row[d], :no] = rng.integers(0, kk[d], no)
+    total = 5 * PAD_BLOCK_ROWS  # one block for ghosts + trash
+    x = np.zeros(total * LANES, dtype=np.float32)
+    x[o0 : o0 + no] = rng.standard_normal(no).astype(np.float32)
+    x[g0 : g0 + 40] = rng.standard_normal(40).astype(np.float32)  # ghosts
+
+    y = dia_coded_padded_pallas(
+        cb,
+        np.array([no], dtype=np.int32),
+        codes.reshape(Dc, -1, LANES),
+        x.reshape(-1, LANES),
+        offsets,
+        kk,
+        code_row,
+        plan,
+        total,
+        interpret=True,
+    )
+    got = np.asarray(y).reshape(-1)
+    vals = np.empty((D, no), dtype=np.float32)
+    for d in range(D):
+        if kk[d] == 1:
+            vals[d] = cb[d, 0]
+        else:
+            vals[d] = cb[d, codes[code_row[d], :no].astype(int)]
+    want = _band_reference(vals, x[o0 : o0 + no], offsets, no)
+    np.testing.assert_allclose(got[o0 : o0 + no], want, rtol=1e-6, atol=1e-6)
+    # every slot outside the owned band — including where the ghosts were —
+    # must come back exactly zero
+    rest = got.copy()
+    rest[o0 : o0 + no] = 0
+    assert not rest.any()
