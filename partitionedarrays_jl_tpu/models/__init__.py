@@ -2,11 +2,14 @@ from .fem_q1 import assemble_fem_q1, fem_q1_driver
 from .poisson_fdm import assemble_poisson, manufactured_solution, poisson_fdm_driver
 from .solvers import (
     PLU,
+    bicgstab,
     cg,
     direct_solve,
     gather_psparse,
     gather_pvector,
+    jacobi_preconditioner,
     lu,
+    pcg,
     scatter_pvector_values,
 )
 
@@ -17,10 +20,13 @@ __all__ = [
     "manufactured_solution",
     "poisson_fdm_driver",
     "PLU",
+    "bicgstab",
     "cg",
     "direct_solve",
     "gather_psparse",
     "gather_pvector",
+    "jacobi_preconditioner",
     "lu",
+    "pcg",
     "scatter_pvector_values",
 ]

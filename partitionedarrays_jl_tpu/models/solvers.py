@@ -80,14 +80,9 @@ def cg(
 
 def _owned_update(dest: PVector, f, src: PVector):
     """dest.owned = f(dest.owned, src.owned), in place; dest and src may
-    live on different (owned-compatible) PRanges."""
-    map_parts(
-        lambda di, dv, si, sv: _write_owned(di, dv, f(_owned(di, dv), _owned(si, sv))),
-        dest.rows.partition,
-        dest.values,
-        src.rows.partition,
-        src.values,
-    )
+    live on different (owned-compatible) PRanges. The one-source special
+    case of `_owned_zip`."""
+    _owned_zip(dest, f, src)
 
 
 def _owned_assign(dest: PVector, src: PVector):
@@ -166,3 +161,172 @@ def direct_solve(A: PSparseMatrix, b: PVector) -> PVector:
     (reference: src/Interfaces.jl:2626-2638). Debug-scale only."""
     x_main = np.linalg.solve(gather_psparse(A).toarray(), gather_pvector(b))
     return scatter_pvector_values(x_main, A.cols)
+
+
+def _owned_zip(dest: PVector, f, *srcs: PVector):
+    """dest.owned = f(dest.owned, *src.owned), in place, across
+    owned-compatible PRanges."""
+    args = [dest.rows.partition, dest.values]
+    for s in srcs:
+        args += [s.rows.partition, s.values]
+
+    def kernel(di, dv, *rest):
+        owned_srcs = [
+            _owned(rest[2 * k], rest[2 * k + 1]) for k in range(len(srcs))
+        ]
+        _write_owned(di, dv, f(_owned(di, dv), *owned_srcs))
+
+    map_parts(kernel, *args)
+
+
+def jacobi_preconditioner(A: PSparseMatrix) -> PVector:
+    """The inverse diagonal of A as a PVector over ``A.cols`` — the
+    classic point-Jacobi preconditioner. Owned entries are 1/diag (zero
+    diagonals pass through as 1); ghost entries are zero (the
+    preconditioner application is owned-local)."""
+    minv = PVector.full(0.0, A.cols, dtype=A.dtype)
+
+    def per_part(iset, M, mv):
+        d = np.ones(iset.num_oids, dtype=M.data.dtype)
+        r = M.row_of_nz()
+        hits = np.nonzero(M.indices == r)[0]
+        d[r[hits]] = M.data[hits]
+        d = np.where(d == 0, 1.0, d)
+        _write_owned(iset, mv, 1.0 / d)
+
+    map_parts(
+        per_part, A.cols.partition, A.owned_owned_values, minv.values
+    )
+    return minv
+
+
+def pcg(
+    A: PSparseMatrix,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    minv: Optional[PVector] = None,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """Preconditioned CG with a diagonal preconditioner ``minv`` (inverse
+    diagonal over A.cols; defaults to `jacobi_preconditioner(A)`).
+    Dispatches to the single compiled device program on the TPU backend;
+    the host loop below runs the identical update sequence, so iteration
+    counts and residual histories agree across backends."""
+    from ..parallel.tpu import TPUBackend, tpu_cg
+
+    if minv is None:
+        minv = jacobi_preconditioner(A)
+    if isinstance(b.values.backend, TPUBackend):
+        return tpu_cg(A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose, minv=minv)
+
+    x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+
+    r = b.copy()
+    q = A @ x
+    _owned_update(r, lambda rv, qv: rv - qv, q)
+    z = PVector.full(0.0, A.cols, dtype=b.dtype)
+    _owned_zip(z, lambda _z, mv, rv: mv * rv, minv, r)
+    p = PVector.full(0.0, A.cols, dtype=b.dtype)
+    _owned_assign(p, z)
+    rs = r.dot(r)
+    rz = r.dot(z)
+    rs0 = rs
+    history = [np.sqrt(rs)]
+    it = 0
+    while np.sqrt(rs) > tol * max(1.0, np.sqrt(rs0)) and it < maxiter:
+        q = A @ p
+        pq = p.dot(q)
+        check(pq != 0.0, "pcg: breakdown, p'Ap == 0")
+        alpha = rz / pq
+        _owned_update(x, lambda xv, pv: xv + alpha * pv, p)
+        _owned_update(r, lambda rv, qv: rv - alpha * qv, q)
+        _owned_zip(z, lambda _z, mv, rv: mv * rv, minv, r)
+        rz_new = r.dot(z)
+        rs = r.dot(r)
+        beta = rz_new / rz
+        _owned_update(p, lambda pv, zv: zv + beta * pv, z)
+        rz = rz_new
+        history.append(np.sqrt(rs))
+        it += 1
+        if verbose:
+            print(f"pcg it={it} residual={np.sqrt(rs):.3e}")
+    return x, {
+        "iterations": it,
+        "residuals": np.array(history),
+        "converged": np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
+    }
+
+
+def bicgstab(
+    A: PSparseMatrix,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """BiCGStab for general (nonsymmetric) operators — the companion
+    Krylov method the reference gets for free from IterativeSolvers.jl
+    (src/Interfaces.jl:2752-2757 makes any of its solvers run
+    distributed). Two SpMVs per iteration. Breakdown exits with
+    ``converged=False``. Compiled to one program on the TPU backend."""
+    from ..parallel.tpu import TPUBackend, tpu_bicgstab
+
+    if isinstance(b.values.backend, TPUBackend):
+        return tpu_bicgstab(A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose)
+
+    x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+
+    r = b.copy()
+    q = A @ x
+    _owned_update(r, lambda rv, qv: rv - qv, q)
+    rhat = PVector.full(0.0, A.cols, dtype=b.dtype)
+    _owned_assign(rhat, r)
+    rcol = PVector.full(0.0, A.cols, dtype=b.dtype)
+    _owned_assign(rcol, r)
+    r = rcol  # residual kept on A.cols so every vector shares one range
+    v = PVector.full(0.0, A.cols, dtype=b.dtype)
+    p = PVector.full(0.0, A.cols, dtype=b.dtype)
+    s = PVector.full(0.0, A.cols, dtype=b.dtype)
+    rho = alpha = omega = 1.0
+    rs = r.dot(r)
+    rs0 = rs
+    history = [np.sqrt(rs)]
+    it = 0
+    ok = True
+    while ok and np.sqrt(rs) > tol * max(1.0, np.sqrt(rs0)) and it < maxiter:
+        rho_new = rhat.dot(r)
+        if rho_new == 0.0 or omega == 0.0:
+            ok = False
+            break
+        beta = (rho_new / rho) * (alpha / omega)
+        ww = omega
+        _owned_zip(p, lambda pv, rv, vv: rv + beta * (pv - ww * vv), r, v)
+        v = A @ p
+        rv_ = rhat.dot(v)
+        if rv_ == 0.0:
+            ok = False
+            break
+        alpha = rho_new / rv_
+        _owned_zip(s, lambda _s, rv, vv: rv - alpha * vv, r, v)
+        t = A @ s
+        tt = t.dot(t)
+        omega = 0.0 if tt == 0.0 else t.dot(s) / tt
+        aa, oo_ = alpha, omega
+        _owned_zip(x, lambda xv, pv, sv: xv + aa * pv + oo_ * sv, p, s)
+        _owned_zip(r, lambda _r, sv, tv: sv - oo_ * tv, s, t)
+        rho = rho_new
+        rs = r.dot(r)
+        history.append(np.sqrt(rs))
+        it += 1
+        if verbose:
+            print(f"bicgstab it={it} residual={np.sqrt(rs):.3e}")
+    return x, {
+        "iterations": it,
+        "residuals": np.array(history),
+        "converged": np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
+    }

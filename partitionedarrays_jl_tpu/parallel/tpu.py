@@ -874,10 +874,14 @@ def make_spmv_fn(dA: DeviceMatrix) -> Callable:
     return run
 
 
-def make_cg_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
+def make_cg_fn(
+    dA: DeviceMatrix, tol: float, maxiter: int, precond: bool = False
+) -> Callable:
     """The whole CG solve as ONE compiled shard_map program:
     `lax.while_loop` whose body does the overlapped SpMV, deterministic
-    all-gather dots, and owned-region axpys. Returns
+    all-gather dots, and owned-region axpys. With ``precond`` the loop is
+    preconditioned CG against a diagonal preconditioner supplied as an
+    extra (P, W) operand (owned slots = inverse diagonal). Returns
     (x_stacked, iterations, final_residual)."""
     import jax
     import jax.numpy as jnp
@@ -899,48 +903,187 @@ def make_cg_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
     H = int(min(maxiter + 1, 4096))
 
     @jax.jit
-    def fn(b, x0, m):
-        def shard_fn(bs, x0s, ms):
+    def fn(b, x0, mv, m):
+        def shard_fn(bs, x0s, mvs, ms):
             bv, xv = bs[0], x0s[0]
             mats = {k: v[0] for k, v in ms.items()}
+            mvv = mvs[0]
 
             def spmv(z):
                 y, _ = body_spmv(z, mats)
                 return y
+
+            def apply_minv(r):
+                if not precond:
+                    return r
+                return jnp.zeros_like(r).at[o0 : o0 + no_max].set(
+                    mvv[o0 : o0 + no_max] * r[o0 : o0 + no_max]
+                )
 
             q = spmv(xv)
             # rows-range residual, owned region only (pads stay zero)
             r = jnp.zeros_like(xv).at[o0 : o0 + no_max].set(
                 bv[o0 : o0 + no_max] - q[o0 : o0 + no_max]
             )
-            p = jnp.zeros_like(xv).at[o0 : o0 + no_max].set(r[o0 : o0 + no_max])
+            z = apply_minv(r)
+            p = jnp.zeros_like(xv).at[o0 : o0 + no_max].set(z[o0 : o0 + no_max])
             rs0 = pdot(r, r)
+            rz0 = pdot(r, z) if precond else rs0
             hist = jnp.full(H, jnp.nan, dtype=bv.dtype).at[0].set(jnp.sqrt(rs0))
 
             def cond(state):
-                _x, _r, _p, rs, it, _h = state
+                _x, _r, _p, _rz, rs, it, _h = state
                 return jnp.logical_and(
                     jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0)),
                     it < maxiter,
                 )
 
             def step(state):
-                x, r, p, rs, it, hist = state
+                x, r, p, rz, rs, it, hist = state
                 q = spmv(p)
                 pq = pdot(p, q)
-                alpha = rs / pq
+                alpha = rz / pq
                 x = x.at[o0 : o0 + no_max].add(alpha * p[o0 : o0 + no_max])
                 r = r.at[o0 : o0 + no_max].add(-alpha * q[o0 : o0 + no_max])
+                z = apply_minv(r)
+                rz_new = pdot(r, z) if precond else None
                 rs_new = pdot(r, r)
-                beta = rs_new / rs
+                if not precond:
+                    rz_new = rs_new
+                beta = rz_new / rz
                 p = p.at[o0 : o0 + no_max].set(
-                    r[o0 : o0 + no_max] + beta * p[o0 : o0 + no_max]
+                    z[o0 : o0 + no_max] + beta * p[o0 : o0 + no_max]
                 )
                 hist = hist.at[jnp.minimum(it + 1, H - 1)].set(jnp.sqrt(rs_new))
-                return (x, r, p, rs_new, it + 1, hist)
+                return (x, r, p, rz_new, rs_new, it + 1, hist)
 
-            x, r, p, rs, it, hist = jax.lax.while_loop(
-                cond, step, (xv, r, p, rs0, jnp.int32(0), hist)
+            x, r, p, rz, rs, it, hist = jax.lax.while_loop(
+                cond, step, (xv, r, p, rz0, rs0, jnp.int32(0), hist)
+            )
+            return x[None], rs, rs0, it, hist
+
+        return shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, specs),
+            out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
+            check_vma=False,
+        )(b, x0, mv, m)
+
+    shape = (dA.col_plan.layout.P, dA.col_plan.layout.W)
+
+    def run(b, x0, mv=None):
+        check(
+            tuple(b.shape) == shape and tuple(x0.shape) == shape,
+            f"cg: vectors laid out {tuple(b.shape)}/{tuple(x0.shape)}, matrix "
+            f"expects {shape} — build vectors with the matrix's col_layout",
+        )
+        if precond:
+            check(mv is not None and tuple(mv.shape) == shape,
+                  "pcg: preconditioner vector must share the matrix layout")
+        return fn(b, x0, b if mv is None else mv, ops)
+
+    return run
+
+
+def make_bicgstab_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
+    """BiCGStab as ONE compiled shard_map program — the Krylov method for
+    nonsymmetric operators (CG's companion in the solver suite). Two
+    overlapped SpMVs per iteration; deterministic fixed-order dots;
+    breakdown (rho or omega denominators hitting zero) exits the loop with
+    converged=False instead of poisoning the state with NaNs."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+
+    mesh = dA.backend.mesh(dA.row_layout.P)
+    spec = dA.backend.parts_spec()
+    none_spec = jax.sharding.PartitionSpec()
+    body_spmv = _spmv_body(dA)
+    no_max = dA.row_layout.no_max
+    o0 = dA.row_layout.o0
+    pdot = _pdot_factory(o0, no_max)
+    ops = _matrix_operands(dA)
+    specs = jax.tree.map(lambda _: spec, ops)
+    H = int(min(maxiter + 1, 4096))
+
+    @jax.jit
+    def fn(b, x0, m):
+        def shard_fn(bs, x0s, ms):
+            bv, xv = bs[0], x0s[0]
+            mats = {k: v[0] for k, v in ms.items()}
+            sl = slice(o0, o0 + no_max)
+
+            def spmv(z):
+                y, _ = body_spmv(z, mats)
+                return y
+
+            def owned(vec, vals):
+                return jnp.zeros_like(vec).at[sl].set(vals)
+
+            q = spmv(xv)
+            r = owned(xv, bv[sl] - q[sl])
+            rhat = r
+            rs0 = pdot(r, r)
+            one = jnp.asarray(1.0, dtype=bv.dtype)
+            hist = jnp.full(H, jnp.nan, dtype=bv.dtype).at[0].set(jnp.sqrt(rs0))
+            zero_v = jnp.zeros_like(xv)
+
+            def cond(state):
+                _x, _r, _p, _v, _rho, _alpha, _omega, rs, it, ok, _h = state
+                return (
+                    (jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0)))
+                    & (it < maxiter)
+                    & ok
+                )
+
+            def step(state):
+                x0_, r0_, p0_, v0_, rho0_, alpha0_, omega0_, rs0_, it, ok0, hist = state
+                rho_new = pdot(rhat, r0_)
+                ok = ok0 & (rho_new != 0) & (omega0_ != 0)
+                beta = jnp.where(ok, (rho_new / rho0_) * (alpha0_ / omega0_), 0)
+                p = p0_.at[sl].set(
+                    r0_[sl] + beta * (p0_[sl] - omega0_ * v0_[sl])
+                )
+                v = spmv(p)
+                rv = pdot(rhat, v)
+                ok = ok & (rv != 0)
+                alpha = jnp.where(ok, rho_new / jnp.where(rv == 0, one, rv), 0)
+                s = owned(r0_, r0_[sl] - alpha * v[sl])
+                t = spmv(s)
+                tt = pdot(t, t)
+                omega = jnp.where(
+                    tt == 0, 0, pdot(t, s) / jnp.where(tt == 0, one, tt)
+                )
+                x = x0_.at[sl].add(alpha * p[sl] + omega * s[sl])
+                r = owned(r0_, s[sl] - omega * t[sl])
+                rs_new = pdot(r, r)
+                hist_new = hist.at[jnp.minimum(it + 1, H - 1)].set(
+                    jnp.sqrt(rs_new)
+                )
+                # on breakdown the step must be a no-op (the host loop
+                # breaks before mutating state): keep the pre-step values,
+                # don't count the iteration, don't log it — cond then
+                # exits with rs unchanged, so converged stays honest
+                keep = lambda new_, old_: jax.tree.map(
+                    lambda a, b: jnp.where(ok, a, b), new_, old_
+                )
+                return (
+                    keep(x, x0_), keep(r, r0_), keep(p, p0_), keep(v, v0_),
+                    jnp.where(ok, rho_new, rho0_),
+                    jnp.where(ok, alpha, alpha0_),
+                    jnp.where(ok, omega, omega0_),
+                    jnp.where(ok, rs_new, rs0_),
+                    jnp.where(ok, it + 1, it), ok,
+                    keep(hist_new, hist),
+                )
+
+            state = (
+                xv, r, zero_v, zero_v, one, one, one, rs0, jnp.int32(0),
+                jnp.bool_(True), hist,
+            )
+            x, r, p, v, rho, alpha, omega, rs, it, ok, hist = (
+                jax.lax.while_loop(cond, step, state)
             )
             return x[None], rs, rs0, it, hist
 
@@ -957,8 +1100,9 @@ def make_cg_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
     def run(b, x0):
         check(
             tuple(b.shape) == shape and tuple(x0.shape) == shape,
-            f"cg: vectors laid out {tuple(b.shape)}/{tuple(x0.shape)}, matrix "
-            f"expects {shape} — build vectors with the matrix's col_layout",
+            f"bicgstab: vectors laid out {tuple(b.shape)}/{tuple(x0.shape)}, "
+            f"matrix expects {shape} — build vectors with the matrix's "
+            "col_layout",
         )
         return fn(b, x0, ops)
 
@@ -970,33 +1114,28 @@ def make_cg_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
 # ---------------------------------------------------------------------------
 
 
-def tpu_cg(
-    A: PSparseMatrix,
-    b: PVector,
-    x0: Optional[PVector] = None,
-    tol: float = 1e-8,
-    maxiter: Optional[int] = None,
-    verbose: bool = False,
-) -> Tuple[PVector, dict]:
-    """Device CG: lower (cached), run the single compiled program, lift the
-    result back to a host PVector over A.cols. The info dict matches the
-    host solver's contract: `residuals` has iterations+1 entries (capped at
-    the compiled history length)."""
+def _run_krylov(A, b, x0, tol, maxiter, verbose, solve, minv=None, name="cg"):
+    """Shared device-Krylov driver: stage vectors in the matrix's col
+    layout, run the single compiled program, lift the result back to a
+    host PVector. The info dict matches the host solvers' contract:
+    `residuals` has iterations+1 entries (capped at the compiled history
+    length)."""
     backend = b.values.backend
-    check(isinstance(backend, TPUBackend), "tpu_cg needs a TPU-backend PVector")
-    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
     dA = device_matrix(A, backend)
     x0 = x0 if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     db = _b_on_cols_layout(b, dA)
     dx0 = DeviceVector.from_pvector(x0, backend, dA.col_layout)
-    solve = _cg_fn_for(dA, tol, maxiter)
-    x_data, rs, rs0, it, hist = solve(db.data, dx0.data)
+    if minv is not None:
+        dmv = DeviceVector.from_pvector(minv, backend, dA.col_layout)
+        x_data, rs, rs0, it, hist = solve(db.data, dx0.data, dmv.data)
+    else:
+        x_data, rs, rs0, it, hist = solve(db.data, dx0.data)
     x = DeviceVector(x_data, A.cols, dA.col_layout, backend).to_pvector()
     rs, rs0, it = float(rs), float(rs0), int(it)
     residuals = np.asarray(hist)[: min(it + 1, len(np.asarray(hist)))]
     if verbose:
         for i, r in enumerate(residuals[1:], start=1):
-            print(f"cg it={i} residual={r:.3e}")
+            print(f"{name} it={i} residual={r:.3e}")
     return x, {
         "iterations": it,
         "residuals": residuals,
@@ -1004,10 +1143,60 @@ def tpu_cg(
     }
 
 
-def _cg_fn_for(dA: DeviceMatrix, tol: float, maxiter: int):
-    key = (float(tol), int(maxiter))
+def tpu_cg(
+    A: PSparseMatrix,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    verbose: bool = False,
+    minv: Optional[PVector] = None,
+) -> Tuple[PVector, dict]:
+    """Device (preconditioned) CG: the whole loop is one compiled
+    shard_map program. `minv` is an optional diagonal preconditioner (a
+    PVector over A.cols holding the inverse diagonal in its owned
+    entries)."""
+    backend = b.values.backend
+    check(isinstance(backend, TPUBackend), "tpu_cg needs a TPU-backend PVector")
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    dA = device_matrix(A, backend)
+    solve = _krylov_fn_for(dA, "cg", tol, maxiter, precond=minv is not None)
+    return _run_krylov(
+        A, b, x0, tol, maxiter, verbose, solve, minv=minv,
+        name="pcg" if minv is not None else "cg",
+    )
+
+
+def tpu_bicgstab(
+    A: PSparseMatrix,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """Device BiCGStab (nonsymmetric Krylov), one compiled program."""
+    backend = b.values.backend
+    check(
+        isinstance(backend, TPUBackend), "tpu_bicgstab needs a TPU-backend PVector"
+    )
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    dA = device_matrix(A, backend)
+    solve = _krylov_fn_for(dA, "bicgstab", tol, maxiter)
+    return _run_krylov(
+        A, b, x0, tol, maxiter, verbose, solve, name="bicgstab"
+    )
+
+
+def _krylov_fn_for(
+    dA: DeviceMatrix, method: str, tol: float, maxiter: int, precond: bool = False
+):
+    key = (method, float(tol), int(maxiter), bool(precond))
     if key not in dA._cg_cache:
-        dA._cg_cache[key] = make_cg_fn(dA, tol, maxiter)
+        if method == "cg":
+            dA._cg_cache[key] = make_cg_fn(dA, tol, maxiter, precond=precond)
+        else:
+            dA._cg_cache[key] = make_bicgstab_fn(dA, tol, maxiter)
     return dA._cg_cache[key]
 
 
