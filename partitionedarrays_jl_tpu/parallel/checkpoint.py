@@ -1,0 +1,186 @@
+"""Checkpoint / resume for partitioned arrays.
+
+The reference has NO checkpoint subsystem — SURVEY.md §5.4 notes the
+nearest machinery is its gather-to-main / scatter-back debug path
+(reference: src/Interfaces.jl:2664-2748). This module builds exactly that
+layer: state is serialized in *partition-independent* form (owned values
+keyed by global ids for vectors, global COO triplets for matrices), so a
+checkpoint written from an N-part run restores onto any other partition —
+including a different part count or a different backend. Combined with the
+solvers' ``x0`` argument this gives restartable Krylov runs.
+
+Format: one ``.npz`` per object (atomic: written to a temp name then
+renamed), plus a ``manifest.json`` per checkpoint directory naming the
+objects and their kinds.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+from ..utils.helpers import check
+from .backends import AbstractPData, map_parts
+from .prange import PRange
+from .psparse import PSparseMatrix, psparse_global_triplets
+from .pvector import PVector, _owned
+
+
+def _global_owned(v: PVector) -> np.ndarray:
+    """Owned values of every part placed at their gids — the
+    partition-independent image of a PVector (ghosts are derived data and
+    are not stored)."""
+    out = np.zeros(v.rows.ngids, dtype=v.dtype)
+    for iset, vals in zip(v.rows.partition.part_values(), v.values.part_values()):
+        out[iset.oid_to_gid] = _owned(iset, np.asarray(vals))
+    return out
+
+
+def save_pvector(path: str, v: PVector) -> None:
+    """Serialize a PVector (owned values by gid) to ``path`` (.npz)."""
+    _atomic_savez(path, kind="pvector", ngids=v.rows.ngids, values=_global_owned(v))
+
+
+def load_pvector(path: str, rows: PRange) -> PVector:
+    """Restore a PVector onto ``rows`` — any partition of the same global
+    size. Ghost entries are filled from the global image (they are exact,
+    not stale), so no post-load exchange is needed."""
+    with np.load(path) as z:
+        check(str(z["kind"]) == "pvector", f"{path} is not a PVector checkpoint")
+        check(
+            int(z["ngids"]) == rows.ngids,
+            f"checkpoint has {int(z['ngids'])} gids, target PRange {rows.ngids}",
+        )
+        glob = z["values"]
+    vals = map_parts(lambda i: glob[i.lid_to_gid], rows.partition)
+    return PVector(vals, rows)
+
+
+def save_psparse(path: str, A: PSparseMatrix) -> None:
+    """Serialize a PSparseMatrix as global owned-row COO triplets (.npz).
+    Ghost-row entries are skipped — call ``A.assemble()`` first if the
+    matrix holds unassembled contributions."""
+    trip = psparse_global_triplets(A)
+    gi_all, gj_all, v_all = [], [], []
+    for (gi, gj, v), iset in zip(trip.part_values(), A.rows.partition.part_values()):
+        owned = iset.lid_to_ohid[iset.gids_to_lids(gi)] >= 0
+        gi_all.append(gi[owned])
+        gj_all.append(gj[owned])
+        v_all.append(v[owned])
+    _atomic_savez(
+        path,
+        kind="psparse",
+        nrows=A.rows.ngids,
+        ncols=A.cols.ngids,
+        gi=np.concatenate(gi_all),
+        gj=np.concatenate(gj_all),
+        v=np.concatenate(v_all),
+    )
+
+
+def load_psparse(
+    path: str,
+    rows: PRange,
+    cols: Optional[PRange] = None,
+) -> PSparseMatrix:
+    """Restore a PSparseMatrix onto ``rows``/``cols``. When ``cols`` is
+    None the column ghost layer is rediscovered from the triplets (the
+    same `add_gids` flow as assembly)."""
+    from .prange import add_gids
+
+    with np.load(path) as z:
+        check(str(z["kind"]) == "psparse", f"{path} is not a PSparseMatrix checkpoint")
+        check(
+            int(z["nrows"]) == rows.ngids,
+            f"checkpoint has {int(z['nrows'])} rows, target PRange {rows.ngids}",
+        )
+        gi, gj, v = z["gi"], z["gj"], z["v"]
+    # each part keeps the triplets whose row it owns: one owner-map build
+    # + one stable sort, instead of a per-part isin scan over all triplets
+    nparts = len(rows.partition.part_values())
+    owner_of_gid = np.empty(rows.ngids, dtype=np.int64)
+    for p, iset in enumerate(rows.partition.part_values()):
+        owner_of_gid[iset.oid_to_gid] = p
+    order = np.argsort(owner_of_gid[gi], kind="stable")
+    bounds = np.searchsorted(owner_of_gid[gi][order], np.arange(nparts + 1))
+    chunks = [order[bounds[p] : bounds[p + 1]] for p in range(nparts)]
+    I = rows.partition._like([gi[c].copy() for c in chunks])
+    J = rows.partition._like([gj[c].copy() for c in chunks])
+    V = rows.partition._like([v[c].copy() for c in chunks])
+    if cols is None:
+        cols = add_gids(rows, J)
+    return PSparseMatrix.from_coo(I, J, V, rows, cols, ids="global")
+
+
+def save_checkpoint(
+    directory: str,
+    objects: Dict[str, Union[PVector, PSparseMatrix]],
+    meta: Optional[dict] = None,
+) -> None:
+    """Write a named set of arrays + user metadata (e.g. the iteration
+    number) as one checkpoint directory. Objects land as ``<name>.npz``;
+    the manifest is written last, so a checkpoint with a readable manifest
+    is complete."""
+    os.makedirs(directory, exist_ok=True)
+    manifest = {"meta": meta or {}, "objects": {}}
+    check(
+        "meta" not in objects,
+        'the object name "meta" is reserved for checkpoint metadata',
+    )
+    for name, obj in objects.items():
+        p = os.path.join(directory, f"{name}.npz")
+        if isinstance(obj, PVector):
+            save_pvector(p, obj)
+            manifest["objects"][name] = "pvector"
+        elif isinstance(obj, PSparseMatrix):
+            save_psparse(p, obj)
+            manifest["objects"][name] = "psparse"
+        else:
+            check(False, f"cannot checkpoint object of type {type(obj).__name__}")
+    tmp = os.path.join(directory, ".manifest.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, os.path.join(directory, "manifest.json"))
+
+
+def load_checkpoint(
+    directory: str,
+    ranges: Dict[str, PRange],
+) -> Dict[str, Union[PVector, PSparseMatrix, dict]]:
+    """Restore every object in a checkpoint directory. ``ranges`` maps
+    object names to target PRanges (for a psparse entry the value may be a
+    ``(rows, cols)`` tuple; a bare PRange rediscovers the column ghosts).
+    Returns the objects plus the saved user metadata under ``"meta"``."""
+    with open(os.path.join(directory, "manifest.json")) as f:
+        manifest = json.load(f)
+    out: Dict[str, Union[PVector, PSparseMatrix, dict]] = {
+        "meta": manifest["meta"]
+    }
+    for name, kind in manifest["objects"].items():
+        check(name in ranges, f"no target PRange given for checkpoint object {name!r}")
+        p = os.path.join(directory, f"{name}.npz")
+        if kind == "pvector":
+            out[name] = load_pvector(p, ranges[name])
+        else:
+            tgt = ranges[name]
+            rows, cols = tgt if isinstance(tgt, tuple) else (tgt, None)
+            out[name] = load_psparse(p, rows, cols)
+    return out
+
+
+def _atomic_savez(path: str, **arrays) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    os.close(fd)
+    try:
+        # np.savez(appends .npz to bare paths) — hand it the open file
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)

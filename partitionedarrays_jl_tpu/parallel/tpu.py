@@ -932,11 +932,17 @@ def make_cg_fn(
             hist = jnp.full(H, jnp.nan, dtype=bv.dtype).at[0].set(jnp.sqrt(rs0))
 
             def cond(state):
-                _x, _r, _p, _rz, rs, it, _h = state
-                return jnp.logical_and(
+                _x, _r, _p, rz, rs, it, _h = state
+                go = jnp.logical_and(
                     jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0)),
                     it < maxiter,
                 )
+                if precond:
+                    # r'M^-1 r == 0 with rs > 0 is a preconditioner
+                    # breakdown (indefinite/zero minv): exit, converged
+                    # stays honest (the host loop raises here instead)
+                    go = jnp.logical_and(go, rz != 0)
+                return go
 
             def step(state):
                 x, r, p, rz, rs, it, hist = state
@@ -981,6 +987,12 @@ def make_cg_fn(
         if precond:
             check(mv is not None and tuple(mv.shape) == shape,
                   "pcg: preconditioner vector must share the matrix layout")
+        else:
+            check(
+                mv is None,
+                "this compiled CG was built without preconditioning — "
+                "rebuild with make_cg_fn(..., precond=True) to use minv",
+            )
         return fn(b, x0, b if mv is None else mv, ops)
 
     return run
@@ -1114,7 +1126,7 @@ def make_bicgstab_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
 # ---------------------------------------------------------------------------
 
 
-def _run_krylov(A, b, x0, tol, maxiter, verbose, solve, minv=None, name="cg"):
+def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg"):
     """Shared device-Krylov driver: stage vectors in the matrix's col
     layout, run the single compiled program, lift the result back to a
     host PVector. The info dict matches the host solvers' contract:
@@ -1162,7 +1174,7 @@ def tpu_cg(
     dA = device_matrix(A, backend)
     solve = _krylov_fn_for(dA, "cg", tol, maxiter, precond=minv is not None)
     return _run_krylov(
-        A, b, x0, tol, maxiter, verbose, solve, minv=minv,
+        A, b, x0, tol, verbose, solve, minv=minv,
         name="pcg" if minv is not None else "cg",
     )
 
@@ -1183,9 +1195,7 @@ def tpu_bicgstab(
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
     dA = device_matrix(A, backend)
     solve = _krylov_fn_for(dA, "bicgstab", tol, maxiter)
-    return _run_krylov(
-        A, b, x0, tol, maxiter, verbose, solve, name="bicgstab"
-    )
+    return _run_krylov(A, b, x0, tol, verbose, solve, name="bicgstab")
 
 
 def _krylov_fn_for(

@@ -1,0 +1,110 @@
+"""Checkpoint/resume: partition-independent save + restore, incl. onto a
+different part count, and a restartable CG run (an aux subsystem the
+reference lacks — SURVEY.md §5.4)."""
+import os
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.models import assemble_poisson, cg, gather_pvector
+
+
+def test_pvector_roundtrip_same_partition(tmp_path):
+    p = str(tmp_path / "v.npz")
+
+    def driver(parts):
+        rows = pa.prange(parts, (8, 8), pa.with_ghost)
+        v = pa.PVector(
+            pa.map_parts(lambda i: i.lid_to_gid * 0.5, rows.partition), rows
+        )
+        pa.save_pvector(p, v)
+        w = pa.load_pvector(p, rows)
+        for a, b in zip(v.values, w.values):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_pvector_restore_onto_different_part_count(tmp_path):
+    p = str(tmp_path / "v.npz")
+
+    def save4(parts):
+        rows = pa.prange(parts, 24)
+        v = pa.PVector(
+            pa.map_parts(lambda i: np.sin(i.lid_to_gid + 0.5), rows.partition), rows
+        )
+        pa.save_pvector(p, v)
+        return gather_pvector(v)
+
+    def load3(parts):
+        rows = pa.prange(parts, 24)
+        w = pa.load_pvector(p, rows)
+        return gather_pvector(w)
+
+    a = pa.prun(save4, pa.sequential, 4)
+    b = pa.prun(load3, pa.sequential, 3)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_mismatched_size_rejected(tmp_path):
+    p = str(tmp_path / "v.npz")
+
+    def driver(parts):
+        rows = pa.prange(parts, 16)
+        pa.save_pvector(p, pa.PVector.full(1.0, rows))
+        bad = pa.prange(parts, 17)
+        with pytest.raises(AssertionError):
+            pa.load_pvector(p, bad)
+        return True
+
+    assert pa.prun(driver, pa.sequential, 2)
+
+
+def test_psparse_roundtrip_and_repartition(tmp_path):
+    p = str(tmp_path / "A.npz")
+    xs = {}
+
+    def save(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (6, 6))
+        pa.save_psparse(p, A)
+        xs["x"] = gather_pvector(x_exact)
+        xs["b"] = gather_pvector(b)
+        return True
+
+    def load(parts):
+        rows = pa.prange(parts, 36)
+        A = pa.load_psparse(p, rows)
+        xv = pa.PVector(
+            pa.map_parts(lambda i: xs["x"][i.lid_to_gid], A.cols.partition), A.cols
+        )
+        b2 = A @ xv
+        np.testing.assert_allclose(gather_pvector(b2), xs["b"], rtol=1e-13)
+        return True
+
+    assert pa.prun(save, pa.sequential, (2, 2))
+    assert pa.prun(load, pa.sequential, 3)  # different count AND layout
+
+
+def test_checkpoint_manifest_and_cg_resume(tmp_path):
+    d = str(tmp_path / "ckpt")
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (10, 10))
+        # uninterrupted run for the gold answer
+        x_full, info_full = cg(A, b, x0=x0, tol=1e-10)
+        # interrupted run: stop early, checkpoint, restore, resume
+        x_half, _ = cg(A, b, x0=x0, tol=1e-10, maxiter=5)
+        pa.save_checkpoint(d, {"x": x_half, "b": b, "A": A}, meta={"it": 5})
+        state = pa.load_checkpoint(
+            d, {"x": A.cols, "b": A.rows, "A": (A.rows, A.cols)}
+        )
+        assert state["meta"]["it"] == 5
+        x_res, info_res = cg(state["A"], state["b"], x0=state["x"], tol=1e-10)
+        assert info_res["converged"]
+        err = np.linalg.norm(gather_pvector(x_res) - gather_pvector(x_full))
+        assert err < 1e-8, err
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))

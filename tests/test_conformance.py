@@ -19,6 +19,11 @@ def parts():
     return pa.sequential.get_part_ids(4)
 
 
+@pytest.fixture
+def parts22():
+    return pa.sequential.get_part_ids((2, 2))
+
+
 # ---------------------------------------------------------------------------
 # the asymmetric 4-part neighbor graph (reference: test_interfaces.jl:19-63)
 # ---------------------------------------------------------------------------
@@ -448,3 +453,53 @@ def test_golden_matrix_solves(parts):
     factors = factors.refactorize(A)
     x3 = factors.solve(y)
     assert (A @ x3 - y).norm() < 1e-9
+
+
+def test_cartesian_uneven_grid_golden(parts22):
+    """The (5,4) grid over a (2,2) part grid — the reference's uneven-
+    remainder fixture (reference: test/test_interfaces.jl:382-470),
+    translated to this framework's conventions: 0-based, C-order gids
+    (gid = i*ncols + j), part axes in the same C-order. The trailing part
+    along the split dimension takes the remainder (5 -> 2+3), exactly as
+    the reference's `_oid_to_gid` does."""
+    r = pa.cartesian_partition(parts22, (5, 4))
+    expected_owned = [
+        [0, 1, 4, 5],
+        [2, 3, 6, 7],
+        [8, 9, 12, 13, 16, 17],
+        [10, 11, 14, 15, 18, 19],
+    ]
+    assert r.ngids == 20
+    for iset, want in zip(r.partition.part_values(), expected_owned):
+        assert iset.oid_to_gid.tolist() == want
+        assert iset.num_hids == 0
+
+    rg = pa.cartesian_partition(parts22, (5, 4), pa.with_ghost)
+    expected_lid_to_gid = [
+        [0, 1, 4, 5, 2, 6, 8, 9, 10],
+        [2, 3, 6, 7, 1, 5, 9, 10, 11],
+        [8, 9, 12, 13, 16, 17, 4, 5, 6, 10, 14, 18],
+        [10, 11, 14, 15, 18, 19, 5, 6, 7, 9, 13, 17],
+    ]
+    expected_owners = [
+        [0, 0, 0, 0, 1, 1, 2, 2, 3],
+        [1, 1, 1, 1, 0, 0, 2, 3, 3],
+        [2, 2, 2, 2, 2, 2, 0, 0, 1, 3, 3, 3],
+        [3, 3, 3, 3, 3, 3, 0, 1, 1, 2, 2, 2],
+    ]
+    for iset, gids, owners in zip(
+        rg.partition.part_values(), expected_lid_to_gid, expected_owners
+    ):
+        assert iset.lid_to_gid.tolist() == gids
+        assert iset.lid_to_part.tolist() == owners
+
+    ci = pa.p_cartesian_indices(parts22, (5, 4))
+    expected_ranges = [
+        ([0, 1], [0, 1]),
+        ([0, 1], [2, 3]),
+        ([2, 3, 4], [0, 1]),
+        ([2, 3, 4], [2, 3]),
+    ]
+    for p, (ri, cj) in enumerate(expected_ranges):
+        got = ci.get_part(p).ranges
+        assert got[0].tolist() == ri and got[1].tolist() == cj
