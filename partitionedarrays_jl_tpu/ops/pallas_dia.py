@@ -174,6 +174,23 @@ def plan_dia_padded(
     }
 
 
+def pack_nibble_codes(codes: np.ndarray) -> np.ndarray:
+    """Pack per-diagonal uint8 codes (< 16) into the kernel's byte streams:
+    two diagonals per byte, low nibble = even coded index. codes has the
+    coded-diagonal axis at position -2: (..., Dc, N) -> (..., ceil(Dc/2), N)
+    int8. This is the ONE definition of the packing convention the
+    `_padded_kernel` decode relies on."""
+    if codes.size and codes.max() >= 16:
+        raise ValueError("nibble packing requires codes < 16 (CODE_MAX_VALUES)")
+    Dc = codes.shape[-2]
+    Dp = max(-(-Dc // 2), 1)
+    packed = np.zeros(codes.shape[:-2] + (Dp,) + codes.shape[-1:], dtype=np.uint8)
+    packed[..., : (Dc + 1) // 2, :] = codes[..., 0:Dc:2, :]
+    if Dc > 1:
+        packed[..., : Dc // 2, :] |= codes[..., 1:Dc:2, :] << 4
+    return packed.view(np.int8)
+
+
 def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, y_ref, xs_ref, cs_ref,
                    xsem, csem, *, qr: Tuple[Tuple[int, int], ...],
                    kk: Tuple[int, ...], code_row: Tuple[int, ...],
@@ -224,6 +241,7 @@ def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, y_ref, xs_ref, cs_ref,
         if n_coded:
             codes_dma(slot, j).wait()
         acc = None
+        streams = {}  # packed byte stream -> int32 form, decoded once
         for d, (q, r) in enumerate(qr):
             a = xs_ref[slot, pl.ds(q, BR), :]
             if r == 0:
@@ -234,7 +252,15 @@ def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, y_ref, xs_ref, cs_ref,
             if kk[d] == 1:
                 term = cb_ref[d, 0] * shifted
             else:
-                c = cs_ref[slot, code_row[d]].astype(jnp.int32)
+                # two diagonals share one int8 stream (4-bit codes, low
+                # nibble = even coded index). Upcast before bit ops — an
+                # i1/int8 born in 32-sublane tiling cannot be relaid out
+                # against f32 by Mosaic — and mask AFTER the shift so the
+                # int8 sign extension cannot leak into the code.
+                ci = code_row[d]
+                if ci // 2 not in streams:
+                    streams[ci // 2] = cs_ref[slot, ci // 2].astype(jnp.int32)
+                c = (streams[ci // 2] >> (4 * (ci % 2))) & 15
                 v = jnp.where(c == 1, cb_ref[d, 1], cb_ref[d, 0])
                 for k in range(2, kk[d]):
                     v = jnp.where(c == k, cb_ref[d, k], v)

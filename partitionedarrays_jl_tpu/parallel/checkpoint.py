@@ -22,7 +22,6 @@ from typing import Dict, Optional, Union
 
 import numpy as np
 
-from ..utils.helpers import check
 from .backends import AbstractPData, map_parts
 from .prange import PRange
 from .psparse import PSparseMatrix, psparse_global_triplets
@@ -49,11 +48,14 @@ def load_pvector(path: str, rows: PRange) -> PVector:
     size. Ghost entries are filled from the global image (they are exact,
     not stale), so no post-load exchange is needed."""
     with np.load(path) as z:
-        check(str(z["kind"]) == "pvector", f"{path} is not a PVector checkpoint")
-        check(
-            int(z["ngids"]) == rows.ngids,
-            f"checkpoint has {int(z['ngids'])} gids, target PRange {rows.ngids}",
-        )
+        # plain raises, not check(): these validate external file input and
+        # must survive PA_TPU_CHECKS=0
+        if str(z["kind"]) != "pvector":
+            raise ValueError(f"{path} is not a PVector checkpoint")
+        if int(z["ngids"]) != rows.ngids:
+            raise ValueError(
+                f"checkpoint has {int(z['ngids'])} gids, target PRange {rows.ngids}"
+            )
         glob = z["values"]
     vals = map_parts(lambda i: glob[i.lid_to_gid], rows.partition)
     return PVector(vals, rows)
@@ -92,11 +94,12 @@ def load_psparse(
     from .prange import add_gids
 
     with np.load(path) as z:
-        check(str(z["kind"]) == "psparse", f"{path} is not a PSparseMatrix checkpoint")
-        check(
-            int(z["nrows"]) == rows.ngids,
-            f"checkpoint has {int(z['nrows'])} rows, target PRange {rows.ngids}",
-        )
+        if str(z["kind"]) != "psparse":
+            raise ValueError(f"{path} is not a PSparseMatrix checkpoint")
+        if int(z["nrows"]) != rows.ngids:
+            raise ValueError(
+                f"checkpoint has {int(z['nrows'])} rows, target PRange {rows.ngids}"
+            )
         gi, gj, v = z["gi"], z["gj"], z["v"]
     # each part keeps the triplets whose row it owns: one owner-map build
     # + one stable sort, instead of a per-part isin scan over all triplets
@@ -126,10 +129,8 @@ def save_checkpoint(
     is complete."""
     os.makedirs(directory, exist_ok=True)
     manifest = {"meta": meta or {}, "objects": {}}
-    check(
-        "meta" not in objects,
-        'the object name "meta" is reserved for checkpoint metadata',
-    )
+    if "meta" in objects:
+        raise ValueError('the object name "meta" is reserved for checkpoint metadata')
     for name, obj in objects.items():
         p = os.path.join(directory, f"{name}.npz")
         if isinstance(obj, PVector):
@@ -139,7 +140,9 @@ def save_checkpoint(
             save_psparse(p, obj)
             manifest["objects"][name] = "psparse"
         else:
-            check(False, f"cannot checkpoint object of type {type(obj).__name__}")
+            raise TypeError(
+                f"cannot checkpoint object of type {type(obj).__name__}"
+            )
     tmp = os.path.join(directory, ".manifest.tmp")
     with open(tmp, "w") as f:
         json.dump(manifest, f, indent=1, sort_keys=True)
@@ -160,7 +163,10 @@ def load_checkpoint(
         "meta": manifest["meta"]
     }
     for name, kind in manifest["objects"].items():
-        check(name in ranges, f"no target PRange given for checkpoint object {name!r}")
+        if name not in ranges:
+            raise ValueError(
+                f"no target PRange given for checkpoint object {name!r}"
+            )
         p = os.path.join(directory, f"{name}.npz")
         if kind == "pvector":
             out[name] = load_pvector(p, ranges[name])

@@ -40,7 +40,7 @@ from .backends import AbstractBackend, PartShape, _as_shape
 from .exchanger import Exchanger
 from .prange import PRange
 from .sequential import SequentialData
-from .pvector import PVector, _owned
+from .pvector import PVector, _ghost, _owned
 from .psparse import PSparseMatrix
 
 
@@ -191,16 +191,15 @@ class DeviceLayout:
             self.o0 = 0
             self.g0 = self.no_max
             self.W = self.no_max + self.nh_max + 1
-        # lid -> slot per part (owned-first contract)
+        # lid -> slot per part, from the signed lid_to_ohid map — any lid
+        # order is supported (owned-first layouts, the common case, just
+        # produce the identity-prefix mapping)
         self.lid_slots = []
         for i in isets:
-            check(i.owned_first, "device lowering requires owned-first lid layout")
-            slots = np.concatenate(
-                [
-                    self.o0 + np.arange(i.num_oids, dtype=INDEX_DTYPE),
-                    self.g0 + np.arange(i.num_hids, dtype=INDEX_DTYPE),
-                ]
-            )
+            ohid = np.asarray(i.lid_to_ohid)
+            slots = np.where(
+                ohid >= 0, self.o0 + ohid, self.g0 + (-ohid - 1)
+            ).astype(INDEX_DTYPE)
             self.lid_slots.append(slots)
 
     @property
@@ -322,9 +321,8 @@ class DeviceVector:
             zip(v.rows.partition.part_values(), v.values.part_values())
         ):
             vals = np.asarray(vals)
-            stacked[p, o0 : o0 + iset.num_oids] = vals[: iset.num_oids]
-            stacked[p, g0 : g0 + iset.num_hids] = vals[iset.num_oids :]
-        jax = _jax()
+            stacked[p, o0 : o0 + iset.num_oids] = _owned(iset, vals)
+            stacked[p, g0 : g0 + iset.num_hids] = _ghost(iset, vals)
         data = _stage(backend, stacked, layout.P)
         return cls(data, v.rows, layout, backend)
 
@@ -333,14 +331,15 @@ class DeviceVector:
         o0, g0 = self.layout.o0, self.layout.g0
         vals = []
         for p, iset in enumerate(self.rows.partition.part_values()):
-            vals.append(
-                np.concatenate(
-                    [
-                        host[p, o0 : o0 + iset.num_oids],
-                        host[p, g0 : g0 + iset.num_hids],
-                    ]
-                )
-            )
+            owned = host[p, o0 : o0 + iset.num_oids]
+            ghost = host[p, g0 : g0 + iset.num_hids]
+            if iset.owned_first:
+                v = np.concatenate([owned, ghost])
+            else:
+                v = np.empty(iset.num_lids, dtype=host.dtype)
+                v[np.asarray(iset.oid_to_lid)] = owned
+                v[np.asarray(iset.hid_to_lid)] = ghost
+            vals.append(v)
         parts = self.rows.partition
         return PVector(parts._like(vals), self.rows)
 
@@ -506,7 +505,7 @@ class DeviceMatrix:
                     cb[p, d, : len(u)] = u
                     cb[p, d, len(u):] = u[0]
             nlen = pplan["code_len"] if pplan is not None else no_max
-            codes = np.zeros((P, max(Dc, 1), nlen), dtype=np.int8)
+            codes = np.zeros((P, max(Dc, 1), nlen), dtype=np.uint8)
             for p in range(P):
                 for j, d in enumerate(coded):
                     u = uniq[p][d]
@@ -515,7 +514,14 @@ class DeviceMatrix:
                             np.searchsorted(u, dia[p, d]), 0, len(u) - 1
                         )
             if pplan is not None:
-                codes = codes.reshape(P, max(Dc, 1), nlen // LANES, LANES)
+                from ..ops.pallas_dia import pack_nibble_codes
+
+                packed = pack_nibble_codes(codes)
+                codes = packed.reshape(
+                    P, packed.shape[1], nlen // LANES, LANES
+                )
+            else:
+                codes = codes.view(np.int8)
             self.dia_cb = _stage(backend, cb.astype(dt), P)
             self.dia_no = _stage(
                 backend, noids.astype(np.int32).reshape(P, 1), P
@@ -606,7 +612,9 @@ class DeviceMatrix:
                 code_row.append(-1)
         coded_ok = max(kk) <= cls.CODE_MAX_VALUES
         pplan = (
-            plan_dia_padded(offsets, no_max, len(coded), itemsize=itemsize)
+            plan_dia_padded(
+                offsets, no_max, -(-len(coded) // 2), itemsize=itemsize
+            )
             if coded_ok
             else None
         )

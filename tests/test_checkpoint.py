@@ -55,7 +55,7 @@ def test_mismatched_size_rejected(tmp_path):
         rows = pa.prange(parts, 16)
         pa.save_pvector(p, pa.PVector.full(1.0, rows))
         bad = pa.prange(parts, 17)
-        with pytest.raises(AssertionError):
+        with pytest.raises(ValueError):
             pa.load_pvector(p, bad)
         return True
 

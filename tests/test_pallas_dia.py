@@ -90,16 +90,20 @@ def test_padded_kernel_matches_band_reference():
     code_row = (-1, 0, 1, 2, -1)
     BRL = PAD_BLOCK_ROWS * LANES
     no = BRL + 7 * LANES + 13  # two owned blocks, ragged tail
-    plan = plan_dia_padded(offsets, no, n_coded=3)
+    plan = plan_dia_padded(offsets, no, n_coded=2)
     assert plan is not None
     nB, o0, g0 = plan["n_blocks"], plan["o0"], plan["g0"]
     assert nB == 2 and o0 == BRL and g0 == 4 * BRL
     D, Dc, kmax = len(offsets), 3, 3
     cb = rng.standard_normal((D, kmax)).astype(np.float32)
-    codes = np.zeros((Dc, plan["code_len"]), dtype=np.int8)
+    codes = np.zeros((Dc, plan["code_len"]), dtype=np.uint8)
     for d in range(D):
         if kk[d] > 1:
             codes[code_row[d], :no] = rng.integers(0, kk[d], no)
+    from partitionedarrays_jl_tpu.ops.pallas_dia import pack_nibble_codes
+
+    packed = pack_nibble_codes(codes)
+    Dp = packed.shape[0]
     total = 5 * PAD_BLOCK_ROWS  # one block for ghosts + trash
     x = np.zeros(total * LANES, dtype=np.float32)
     x[o0 : o0 + no] = rng.standard_normal(no).astype(np.float32)
@@ -108,7 +112,7 @@ def test_padded_kernel_matches_band_reference():
     y = dia_coded_padded_pallas(
         cb,
         np.array([no], dtype=np.int32),
-        codes.reshape(Dc, -1, LANES),
+        packed.reshape(Dp, -1, LANES),
         x.reshape(-1, LANES),
         offsets,
         kk,

@@ -237,3 +237,62 @@ def test_padded_layout_spmv_matches_host():
         return True
 
     assert pa.prun(driver, pa.tpu, (2, 2, 2))
+
+
+def test_compiled_exchange_irregular_graph():
+    """BASELINE config 5's structural core: a fully general (non-Cartesian,
+    asymmetric) ghost graph from an explicit IndexSet partition, lowered to
+    edge-colored ppermute rounds. Halo update and reverse assembly on the
+    compiled path must match the host Exchanger bit-for-bit."""
+    # the 10-gid 4-part fixture (reference: test_interfaces.jl:177-207)
+    LID_TO_GID = [
+        [0, 1, 2, 4, 6, 7],
+        [1, 3, 4, 9],
+        [5, 6, 7, 4, 3, 9],
+        [0, 2, 6, 8, 9],
+    ]
+    LID_TO_PART = [
+        [0, 0, 0, 1, 2, 2],
+        [0, 1, 1, 3],
+        [2, 2, 2, 1, 1, 3],
+        [0, 0, 2, 3, 3],
+    ]
+
+    def driver(parts):
+        partition = pa.map_parts(
+            lambda p: pa.IndexSet(p, LID_TO_GID[p], LID_TO_PART[p]), parts
+        )
+        rows = pa.PRange(10, partition)
+
+        def mk():
+            return pa.PVector(
+                pa.map_parts(
+                    lambda i: np.where(
+                        np.asarray(i.lid_to_part) == i.part,
+                        100.0 + np.asarray(i.lid_to_gid),
+                        -1.0,
+                    ),
+                    rows.partition,
+                ),
+                rows,
+            )
+
+        # owner -> ghost halo update
+        host = pa.exchange_pvector(mk())
+        dv = DeviceVector.from_pvector(mk(), parts.backend)
+        out = make_exchange_fn(rows, parts.backend)(dv.data)
+        got = DeviceVector(out, rows, dv.layout, parts.backend).to_pvector()
+        for a, b in zip(host.values, got.values):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # ghost -> owner assembly (reverse plan, additive combine)
+        vh = mk()
+        pa.assemble(vh)
+        dv2 = DeviceVector.from_pvector(mk(), parts.backend)
+        out2 = make_exchange_fn(rows, parts.backend, combine="add")(dv2.data)
+        got2 = DeviceVector(out2, rows, dv2.layout, parts.backend).to_pvector()
+        for a, b in zip(vh.values, got2.values):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        return True
+
+    assert pa.prun(driver, pa.tpu, 4)
