@@ -1,3 +1,10 @@
+from .elasticity_tet import (
+    assemble_elasticity_tet,
+    elasticity_tet_driver,
+    morton_permutation,
+    p1_elasticity_ke,
+    tet_mesh,
+)
 from .fem_q1 import assemble_fem_q1, fem_q1_driver
 from .poisson_fdm import assemble_poisson, manufactured_solution, poisson_fdm_driver
 from .solvers import (
@@ -14,6 +21,11 @@ from .solvers import (
 )
 
 __all__ = [
+    "assemble_elasticity_tet",
+    "elasticity_tet_driver",
+    "morton_permutation",
+    "p1_elasticity_ke",
+    "tet_mesh",
     "assemble_fem_q1",
     "fem_q1_driver",
     "assemble_poisson",

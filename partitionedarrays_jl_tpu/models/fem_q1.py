@@ -27,7 +27,7 @@ import numpy as np
 from ..parallel.backends import AbstractPData, map_parts
 from ..utils.helpers import check
 from ..parallel.prange import add_gids, cartesian_partition, no_ghost, p_cartesian_indices
-from ..parallel.psparse import PSparseMatrix, assemble_coo
+from ..parallel.psparse import assemble_matrix_from_coo
 from ..parallel.pvector import PVector, global_view
 from .solvers import cg
 
@@ -105,22 +105,10 @@ def assemble_fem_q1(parts: AbstractPData, nodes_per_dim: Sequence[int]):
     J = map_parts(lambda a, b: np.concatenate([a, b[1]]), J, bcoo)
     V = map_parts(lambda a, b: np.concatenate([a, b[2]]), V, bcoo)
 
-    # rows ghosted by the off-owner rows each part touches -> migrate
-    rows = add_gids(rows0, I)
-    I2, J2, V2 = assemble_coo(I, J, V, rows)
-    # migration keeps the shipped triplets locally with value 0 (append-only
-    # semantics); drop everything not on an owned row, then compress over
-    # the ghost-free rows0 and a column map discovered from the kept J
-    def _keep_owned(iset, i, j, v):
-        own = iset.gids_to_lids(np.asarray(i)) >= 0
-        return np.asarray(i)[own], np.asarray(j)[own], np.asarray(v)[own]
-
-    kept = map_parts(_keep_owned, rows0.partition, I2, J2, V2)
-    I2 = map_parts(lambda k: k[0], kept)
-    J2 = map_parts(lambda k: k[1], kept)
-    V2 = map_parts(lambda k: k[2], kept)
-    cols = add_gids(rows0, J2)
-    A = PSparseMatrix.from_coo(I2, J2, V2, rows0, cols, ids="global")
+    # rows ghosted by the off-owner rows each part touches -> migrate,
+    # keep owned, discover column ghosts, compress
+    A = assemble_matrix_from_coo(I, J, V, rows0)
+    cols = A.cols
 
     def _exact(iset):
         c0, c1 = np.unravel_index(iset.lid_to_gid, ns)

@@ -27,7 +27,7 @@ from .backends import AbstractPData, Token, map_parts
 from .collectives import exchange
 from .exchanger import Exchanger, async_exchange_values
 from .index_sets import AbstractIndexSet, GID_DTYPE
-from .prange import PRange, add_gids_inplace, oids_are_equal, lids_are_equal, to_lids, uniform_partition
+from .prange import PRange, add_gids, add_gids_inplace, oids_are_equal, lids_are_equal, to_lids, uniform_partition
 from .pvector import PVector, _owned, _ghost
 
 
@@ -391,6 +391,32 @@ def assemble_coo(
         map_parts(lambda o: o[1], out),
         map_parts(lambda o: o[2], out),
     )
+
+
+def assemble_matrix_from_coo(
+    I: AbstractPData, J: AbstractPData, V: AbstractPData, rows0: PRange
+) -> "PSparseMatrix":
+    """The standard FE/FD assembly pipeline: migrate off-owner triplets to
+    their row owners (`assemble_coo`), drop the zeroed shipped copies and
+    anything not on an owned row, discover the column ghost layer from the
+    kept column gids, and compress (reference end-to-end flow:
+    test/test_fem_sa.jl:76-104 over src/Interfaces.jl:2406-2492).
+
+    ``rows0`` must be ghost-free; the result's rows are ``rows0`` and its
+    cols are ``rows0`` extended by the discovered ghosts."""
+    rows = add_gids(rows0, I)
+    I2, J2, V2 = assemble_coo(I, J, V, rows)
+
+    def _keep_owned(iset, i, j, v):
+        own = iset.gids_to_lids(np.asarray(i)) >= 0
+        return np.asarray(i)[own], np.asarray(j)[own], np.asarray(v)[own]
+
+    kept = map_parts(_keep_owned, rows0.partition, I2, J2, V2)
+    I2 = map_parts(lambda k: k[0], kept)
+    J2 = map_parts(lambda k: k[1], kept)
+    V2 = map_parts(lambda k: k[2], kept)
+    cols = add_gids(rows0, J2)
+    return PSparseMatrix.from_coo(I2, J2, V2, rows0, cols, ids="global")
 
 
 def exchange_coo(

@@ -1,0 +1,111 @@
+"""Unstructured tet elasticity (BASELINE configs[4]): mesh/element sanity,
+partition-independent assembly over an irregular Morton ghost graph, and
+the end-to-end PCG gate (reference tolerance: test/test_fem_sa.jl:137)."""
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.models.elasticity_tet import (
+    assemble_elasticity_tet,
+    elasticity_tet_driver,
+    morton_permutation,
+    p1_elasticity_ke,
+    tet_mesh,
+)
+
+
+def test_tet_mesh_conforming_and_positive():
+    coords, tets, boundary = tet_mesh((4, 5, 3), jitter=0.15, seed=3)
+    e = coords[tets[:, 1:]] - coords[tets[:, :1]]
+    vols = np.linalg.det(e) / 6.0
+    assert (vols > 0).all()
+    # the tet volumes tile the hex cells exactly (conforming split)
+    assert np.isclose(vols.sum(), 3.0 * 4.0 * 2.0)
+    # boundary nodes kept unjittered on the box faces
+    assert np.array_equal(
+        coords[boundary], np.round(coords[boundary])
+    )
+
+
+def test_element_stiffness_symmetric_with_rigid_nullspace():
+    coords, tets, _ = tet_mesh((3, 3, 3), jitter=0.2, seed=1)
+    ke = p1_elasticity_ke(coords, tets)
+    assert np.allclose(ke, np.swapaxes(ke, 1, 2))
+    # translations and infinitesimal rotations produce zero force
+    for e in (0, len(tets) // 2, len(tets) - 1):
+        X = coords[tets[e]]
+        rig = np.zeros((12, 6))
+        for a in range(4):
+            rig[3 * a : 3 * a + 3, :3] = np.eye(3)
+            x, y, z = X[a]
+            rig[3 * a : 3 * a + 3, 3:] = np.array(
+                [[0, -z, y], [z, 0, -x], [-y, x, 0]]
+            )
+        assert np.abs(ke[e] @ rig).max() < 1e-12
+        # PSD apart from the 6 rigid modes
+        w = np.linalg.eigvalsh(ke[e])
+        assert w[:6].max() < 1e-11 and w[6] > 1e-11
+
+
+def test_morton_blocks_are_irregular_neighbor_graph():
+    coords, _, _ = tet_mesh((6, 6, 6), jitter=0.1, seed=0)
+    perm = morton_permutation(coords)
+    assert np.array_equal(np.sort(perm), np.arange(len(coords)))
+
+    def driver(parts):
+        A, b, xh, x0 = assemble_elasticity_tet(parts, (6, 6, 6))
+        ex = A.cols.exchanger
+        nn = [len(np.asarray(p)) for p in ex.parts_rcv.part_values()]
+        counts = [
+            np.diff(np.asarray(t.ptrs)) for t in ex.lids_rcv.part_values()
+        ]
+        return nn, counts
+
+    nn, counts = pa.prun(driver, pa.sequential, 4)
+    # every part has at least 2 neighbors and the per-neighbor message
+    # sizes are NOT all equal: a genuinely variable-size exchange
+    assert min(nn) >= 2
+    sizes = np.concatenate([c for c in counts if len(c)])
+    assert sizes.min() >= 1 and len(np.unique(sizes)) > 1
+
+
+def test_assembly_partition_independent():
+    def rhs(nparts):
+        def d(parts):
+            A, b, xh, x0 = assemble_elasticity_tet(parts, (4, 4, 4))
+            return pa.gather_pvector(b), pa.gather_pvector(A @ xh)
+        return pa.prun(d, pa.sequential, nparts)
+
+    b1, ax1 = rhs(1)
+    b4, ax4 = rhs(4)
+    b6, ax6 = rhs(6)
+    np.testing.assert_allclose(b4, b1, rtol=0, atol=1e-13)
+    np.testing.assert_allclose(b6, b1, rtol=0, atol=1e-13)
+    np.testing.assert_allclose(ax4, ax1, rtol=0, atol=1e-13)
+
+
+@pytest.mark.parametrize("nparts", [4, 7])
+def test_elasticity_end_to_end(nparts):
+    err, info = pa.prun(
+        lambda parts: elasticity_tet_driver(parts, (5, 5, 5)),
+        pa.sequential,
+        nparts,
+    )
+    assert info["converged"]
+    assert err < 1e-5
+
+
+def test_elasticity_tpu_matches_sequential():
+    """Config-5 on the compiled path: the same unstructured system solved
+    under the TPU backend must match the sequential oracle."""
+    def d(backend):
+        def driver(parts):
+            A, b, xh, x0 = assemble_elasticity_tet(parts, (5, 5, 5))
+            x, info = pa.pcg(A, b, x0=x0, tol=1e-12, maxiter=500)
+            return pa.gather_pvector(x), info["iterations"]
+        return pa.prun(driver, backend, 4)
+
+    xs, it_s = d(pa.sequential)
+    xt, it_t = d(pa.tpu)
+    assert it_t == it_s
+    np.testing.assert_allclose(xt, xs, rtol=0, atol=1e-10)
