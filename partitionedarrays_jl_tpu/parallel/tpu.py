@@ -496,23 +496,33 @@ class DeviceMatrix:
             self.dia_code_row = tuple(code_row)
             self.pallas_plan = pplan
             kmax = max(kk)
+            cls_uniq, cls_ids = det["cls_uniq"], det["cls_ids"]
             cb = np.zeros((P, D, kmax))
             for p in range(P):
                 for d in range(D):
-                    u = uniq[p][d]
+                    if cls_uniq is not None and code_row[d] >= 0:
+                        # class mode: slot k of diagonal d = d's value in
+                        # row class k of this part
+                        u = cls_uniq[p][:, d]
+                    else:
+                        u = uniq[p][d]
                     if len(u) == 0:
                         u = np.zeros(1)
                     cb[p, d, : len(u)] = u
                     cb[p, d, len(u):] = u[0]
             nlen = pplan["code_len"] if pplan is not None else no_max
-            codes = np.zeros((P, max(Dc, 1), nlen), dtype=np.uint8)
-            for p in range(P):
-                for j, d in enumerate(coded):
-                    u = uniq[p][d]
-                    if len(u):
-                        codes[p, j, :no_max] = np.clip(
-                            np.searchsorted(u, dia[p, d]), 0, len(u) - 1
-                        )
+            n_streams = 1 if cls_uniq is not None else max(Dc, 1)
+            codes = np.zeros((P, n_streams, nlen), dtype=np.uint8)
+            if cls_uniq is not None:
+                codes[:, 0, :no_max] = cls_ids
+            else:
+                for p in range(P):
+                    for j, d in enumerate(coded):
+                        u = uniq[p][d]
+                        if len(u):
+                            codes[p, j, :no_max] = np.clip(
+                                np.searchsorted(u, dia[p, d]), 0, len(u) - 1
+                            )
             if pplan is not None:
                 from ..ops.pallas_dia import pack_nibble_codes
 
@@ -611,10 +621,30 @@ class DeviceMatrix:
             else:
                 code_row.append(-1)
         coded_ok = max(kk) <= cls.CODE_MAX_VALUES
+        # row-class compression: when the rows of each part fall into few
+        # distinct stencil-value tuples (e.g. interior vs Dirichlet-identity
+        # for the FDM operator), every coded diagonal can read ONE shared
+        # per-row class stream instead of its own — codes shrink from
+        # ceil(Dc/2) byte-streams per row to one, at a select chain of
+        # n_class per diagonal. Only worth it when it removes streams.
+        cls_uniq = cls_ids = None
+        if coded_ok and len(coded) >= 3:
+            cls_uniq, cls_ids, n_class = [], np.zeros((P, no_max), np.uint8), 1
+            for p in range(P):
+                n_o = int(noids[p])
+                u, inv = np.unique(dia[p, :, :n_o].T, axis=0, return_inverse=True)
+                if len(u) > cls.CODE_MAX_VALUES:
+                    cls_uniq = cls_ids = None
+                    break
+                cls_uniq.append(u)
+                cls_ids[p, :n_o] = inv
+                n_class = max(n_class, len(u))
+        if cls_uniq is not None:
+            kk = tuple(n_class if kk[d] > 1 else 1 for d in range(D))
+            code_row = [0 if c >= 0 else -1 for c in code_row]
+        n_streams = 1 if cls_uniq is not None else -(-len(coded) // 2)
         pplan = (
-            plan_dia_padded(
-                offsets, no_max, -(-len(coded) // 2), itemsize=itemsize
-            )
+            plan_dia_padded(offsets, no_max, n_streams, itemsize=itemsize)
             if coded_ok
             else None
         )
@@ -627,6 +657,8 @@ class DeviceMatrix:
             "coded": coded,
             "Dc": len(coded),
             "coded_ok": coded_ok,
+            "cls_uniq": cls_uniq,
+            "cls_ids": cls_ids,
             "pplan": pplan,
         }
 
