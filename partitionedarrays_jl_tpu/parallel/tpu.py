@@ -327,7 +327,9 @@ class DeviceVector:
         return cls(data, v.rows, layout, backend)
 
     def to_pvector(self) -> PVector:
-        host = np.asarray(self.data)
+        from .multihost import fetch_global
+
+        host = fetch_global(self.data)
         o0, g0 = self.layout.o0, self.layout.g0
         vals = []
         for p, iset in enumerate(self.rows.partition.part_values()):

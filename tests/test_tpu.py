@@ -296,3 +296,30 @@ def test_compiled_exchange_irregular_graph():
         return True
 
     assert pa.prun(driver, pa.tpu, 4)
+
+
+def test_multihost_helpers_single_host():
+    """Single-host behavior of the multi-controller helpers: init is a
+    no-op, process 0 is MAIN, and fetch_global round-trips a sharded
+    array (the multi-host escape hatch degrades to device->host copy)."""
+    pa.multihost_init()  # must not raise in a single-process run
+    assert pa.is_main_process()
+
+    def driver(parts):
+        rows = pa.prange(parts, 64)
+        v = pa.PVector(
+            pa.map_parts(
+                lambda i: np.asarray(i.lid_to_gid, dtype=np.float64),
+                rows.partition,
+            ),
+            rows,
+        )
+        dv = DeviceVector.from_pvector(v, parts.backend)
+        host = pa.fetch_global(dv.data)
+        assert host.shape == (4, dv.layout.W)
+        back = dv.to_pvector()
+        for a, b in zip(v.values, back.values):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        return True
+
+    assert pa.prun(driver, pa.tpu, 4)
