@@ -92,17 +92,21 @@ def main():
         assert v == v, "chain produced NaN — operator scaling broken"
         return statistics.median(ts)
 
-    k1, k2 = 10, 10 + max(50, reps)
-    t1 = chain_time(k1)
-    dt = 0.0
-    for _ in range(4):  # lengthen the chain until it dominates RTT jitter
-        t2 = chain_time(k2)
-        dt = (t2 - t1) / (k2 - k1)
-        if dt > 0:
-            break
-        k2 = 2 * k2
-    if dt <= 0:  # still inverted: report the conservative whole-chain cost
-        dt = t2 / k2
+    def measure_once() -> float:
+        k1, k2 = 10, 10 + max(50, reps)
+        t1 = chain_time(k1)
+        dt = 0.0
+        for _ in range(4):  # lengthen the chain until it dominates jitter
+            t2 = chain_time(k2)
+            dt = (t2 - t1) / (k2 - k1)
+            if dt > 0:
+                return dt
+            k2 = 2 * k2
+        return t2 / k2  # still inverted: conservative whole-chain cost
+
+    # the relay's per-process variance is large; take the best of three
+    # full measurements (each already a median over reps)
+    dt = min(measure_once() for _ in range(3))
     gflops = flops / dt / 1e9
 
     # sequential-oracle timing on the same local problem (NumPy CSR)

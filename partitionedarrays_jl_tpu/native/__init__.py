@@ -1,0 +1,185 @@
+"""Native planning accelerator: lazy g++ build + ctypes bindings.
+
+The `.so` is compiled on first import from `planning.cpp` into
+`native/build/` (a few hundred ms, cached by source mtime) and every entry
+point degrades to pure NumPy when the toolchain or the build is missing —
+the library never *requires* the native layer, it just plans ~10x faster
+with it at 1e7+ DOFs. Disable explicitly with PA_TPU_NATIVE=0."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "planning.cpp")
+_SO = os.path.join(_HERE, "build", "libpa_planning.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    # build to a unique temp name and os.replace into place: concurrent
+    # first imports (multi-process launches) must never dlopen a
+    # half-written file
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("PA_TPU_NATIVE", "1") == "0":
+        return None
+    try:
+        fresh = os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        if not fresh and not _build():
+            return None
+        lib = ctypes.CDLL(_SO)
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.pa_box_gids_to_lids.argtypes = [
+            i64p, ctypes.c_int64, i64p, i64p, i64p, ctypes.c_int32, i32p,
+        ]
+        lib.pa_box_gids_to_lids.restype = None
+        lib.pa_lookup_sorted.argtypes = [
+            i64p, ctypes.c_int64, i64p, i32p, ctypes.c_int64, i32p,
+        ]
+        lib.pa_lookup_sorted.restype = ctypes.c_int64
+        f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        for name, fp in (("pa_coo_to_csr_f64", f64p), ("pa_coo_to_csr_f32", f32p)):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                i32p, i32p, fp, ctypes.c_int64, ctypes.c_int64,
+                i32p, i32p, fp, i32p,
+            ]
+            fn.restype = ctypes.c_int64
+        for name, fp in (("pa_csr_split_f64", f64p), ("pa_csr_split_f32", f32p)):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                i32p, i32p, fp, ctypes.c_int64, ctypes.c_int32,
+                i32p, i32p, fp, i32p, i32p, fp,
+            ]
+            fn.restype = None
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def box_gids_to_lids(
+    gids: np.ndarray, grid, lo, hi, out: np.ndarray
+) -> bool:
+    """out[i] = C-order lid of gids[i] inside box [lo, hi) of `grid`, or
+    -1. Returns False (untouched out) when the native layer is absent."""
+    lib = _load()
+    if lib is None or len(grid) > 8:
+        return False
+    g = np.ascontiguousarray(gids, dtype=np.int64)
+    lib.pa_box_gids_to_lids(
+        g,
+        len(g),
+        np.asarray(grid, dtype=np.int64),
+        np.asarray(lo, dtype=np.int64),
+        np.asarray(hi, dtype=np.int64),
+        len(grid),
+        out,
+    )
+    return True
+
+
+def lookup_sorted(
+    gids: np.ndarray, sorted_gids: np.ndarray, lid_of: np.ndarray, out: np.ndarray
+) -> bool:
+    """Fill out[i] (where still -1) with lid_of[searchsorted hit]."""
+    lib = _load()
+    if lib is None:
+        return False
+    g = np.ascontiguousarray(gids, dtype=np.int64)
+    lib.pa_lookup_sorted(
+        g,
+        len(g),
+        np.ascontiguousarray(sorted_gids, dtype=np.int64),
+        np.ascontiguousarray(lid_of, dtype=np.int32),
+        len(sorted_gids),
+        out,
+    )
+    return True
+
+
+_FLOAT_FN = {"float64": "f64", "float32": "f32"}
+
+
+def coo_to_csr(I, J, V, m: int, n: int):
+    """COO -> (indptr, cols, vals) CSR with column-sorted rows and
+    +-accumulated duplicates. None when native is absent or the inputs are
+    out of the int32/float32-64 envelope."""
+    lib = _load()
+    dt = np.dtype(np.asarray(V).dtype).name
+    if (
+        lib is None
+        or dt not in _FLOAT_FN
+        or m >= 2**31
+        or n >= 2**31
+        or len(I) >= 2**31
+    ):
+        return None
+    nnz = len(I)
+    Ic = np.ascontiguousarray(I, dtype=np.int32)
+    Jc = np.ascontiguousarray(J, dtype=np.int32)
+    Vc = np.ascontiguousarray(V)
+    indptr = np.empty(m + 1, dtype=np.int32)
+    cols = np.empty(nnz, dtype=np.int32)
+    vals = np.empty(nnz, dtype=Vc.dtype)
+    cursor = np.empty(max(m, 1), dtype=np.int32)
+    fn = getattr(lib, f"pa_coo_to_csr_{_FLOAT_FN[dt]}")
+    w = fn(Ic, Jc, Vc, nnz, m, indptr, cols, vals, cursor)
+    if w < (nnz * 3) // 4:  # compaction shrank a lot: don't pin dead memory
+        return indptr, cols[:w].copy(), vals[:w].copy()
+    return indptr, cols[:w], vals[:w]
+
+
+def csr_split_by_col(indptr, cols, vals, m: int, thr: int):
+    """Split a full-row CSR at a column threshold into (lo, hi) halves,
+    hi columns remapped by -thr. Returns ((ip, c, v) lo, (ip, c, v) hi)
+    or None when native is absent/ineligible."""
+    lib = _load()
+    dt = np.dtype(np.asarray(vals).dtype).name
+    if lib is None or dt not in _FLOAT_FN or len(cols) >= 2**31:
+        return None
+    n_lo = int(np.count_nonzero(np.asarray(cols) < thr))
+    n_hi = len(cols) - n_lo
+    ip = np.ascontiguousarray(indptr, dtype=np.int32)
+    c = np.ascontiguousarray(cols, dtype=np.int32)
+    v = np.ascontiguousarray(vals)
+    ip_lo = np.empty(m + 1, dtype=np.int32)
+    c_lo = np.empty(n_lo, dtype=np.int32)
+    v_lo = np.empty(n_lo, dtype=v.dtype)
+    ip_hi = np.empty(m + 1, dtype=np.int32)
+    c_hi = np.empty(n_hi, dtype=np.int32)
+    v_hi = np.empty(n_hi, dtype=v.dtype)
+    fn = getattr(lib, f"pa_csr_split_{_FLOAT_FN[dt]}")
+    fn(ip, c, v, m, thr, ip_lo, c_lo, v_lo, ip_hi, c_hi, v_hi)
+    return (ip_lo, c_lo, v_lo), (ip_hi, c_hi, v_hi)

@@ -1,0 +1,209 @@
+// Native planning kernels: the hot host-side loops of the planning phase.
+//
+// The reference is pure Julia — its compiled loops make index planning
+// cheap by construction. Python/NumPy planning pays one full array
+// temporary per operator, which dominates assembly at 1e7+ DOFs; these
+// fused single-pass loops restore compiled-language planning cost. The
+// compute path (XLA/Pallas) is unaffected: this is host metadata work
+// only, the analog of the reference's in-process index arithmetic
+// (reference: src/IndexSets.jl:109-213, src/SparseUtils.jl:44-88).
+//
+// Contract notes:
+// * gids are int64, lids int32 (INDEX_DTYPE), -1 = absent.
+// * All functions are single-threaded (planning runs per part on one
+//   controller core) and allocation-free: callers pass NumPy buffers.
+#include <cstdint>
+#include <algorithm>
+#include <numeric>
+#include <utility>
+#include <vector>
+
+// COO -> CSR with column-sorted rows and +-combined duplicates, one
+// counting pass + one scatter + per-row small sorts — replaces the NumPy
+// argsort + three 1e8-element gathers. `cursor` is caller scratch (m
+// int32). Returns the compacted nnz. Stability: the scatter preserves
+// arrival order per row; the per-row sort is stable; duplicate groups
+// accumulate left-to-right — bit-identical to the reduceat fallback.
+template <typename T>
+static int64_t coo_to_csr_impl(const int32_t* I, const int32_t* J,
+                               const T* V, int64_t nnz, int64_t m,
+                               int32_t* indptr, int32_t* cols_out,
+                               T* vals_out, int32_t* cursor) {
+    for (int64_t r = 0; r <= m; ++r) indptr[r] = 0;
+    for (int64_t k = 0; k < nnz; ++k) ++indptr[I[k] + 1];
+    for (int64_t r = 0; r < m; ++r) indptr[r + 1] += indptr[r];
+    for (int64_t r = 0; r < m; ++r) cursor[r] = indptr[r];
+    for (int64_t k = 0; k < nnz; ++k) {
+        int32_t p = cursor[I[k]]++;
+        cols_out[p] = J[k];
+        vals_out[p] = V[k];
+    }
+    int64_t w = 0;
+    for (int64_t r = 0; r < m; ++r) {
+        int64_t s = indptr[r], e = cursor[r];
+        if (e - s > 64) {  // long row: stable comparison sort on (col, pos)
+            std::vector<std::pair<int32_t, int64_t>> tmp;
+            tmp.reserve(e - s);
+            for (int64_t a = s; a < e; ++a) tmp.emplace_back(cols_out[a], a);
+            std::stable_sort(tmp.begin(), tmp.end(),
+                             [](const auto& x, const auto& y) {
+                                 return x.first < y.first;
+                             });
+            std::vector<T> vtmp(e - s);
+            for (int64_t a = s; a < e; ++a) vtmp[a - s] = vals_out[a];
+            for (int64_t a = s; a < e; ++a) {
+                cols_out[a] = tmp[a - s].first;
+                vals_out[a] = vtmp[tmp[a - s].second - s];
+            }
+        } else {
+            for (int64_t a = s + 1; a < e; ++a) {  // stable insertion sort
+                int32_t c = cols_out[a];
+                T v = vals_out[a];
+                int64_t b = a;
+                while (b > s && cols_out[b - 1] > c) {
+                    cols_out[b] = cols_out[b - 1];
+                    vals_out[b] = vals_out[b - 1];
+                    --b;
+                }
+                cols_out[b] = c;
+                vals_out[b] = v;
+            }
+        }
+        int64_t row_w = w;  // compact + merge duplicates (w <= a always)
+        for (int64_t a = s; a < e; ++a) {
+            if (w > row_w && cols_out[w - 1] == cols_out[a]) {
+                vals_out[w - 1] += vals_out[a];
+            } else {
+                cols_out[w] = cols_out[a];
+                vals_out[w] = vals_out[a];
+                ++w;
+            }
+        }
+        indptr[r] = (int32_t)row_w;
+    }
+    indptr[m] = (int32_t)w;
+    return w;
+}
+
+
+// Split a full-row CSR by a column threshold into (cols < thr) and
+// (cols >= thr, remapped by -thr) halves in one routing pass — the
+// materialized owned|ghost block views. Caller sizes the outputs from a
+// NumPy count; indptrs are written here.
+template <typename T>
+static void csr_split_impl(const int32_t* indptr, const int32_t* cols,
+                           const T* vals, int64_t m, int32_t thr,
+                           int32_t* ip_lo, int32_t* c_lo, T* v_lo,
+                           int32_t* ip_hi, int32_t* c_hi, T* v_hi) {
+    int64_t wl = 0, wh = 0;
+    ip_lo[0] = ip_hi[0] = 0;
+    for (int64_t r = 0; r < m; ++r) {
+        for (int32_t a = indptr[r]; a < indptr[r + 1]; ++a) {
+            if (cols[a] < thr) {
+                c_lo[wl] = cols[a];
+                v_lo[wl++] = vals[a];
+            } else {
+                c_hi[wh] = cols[a] - thr;
+                v_hi[wh++] = vals[a];
+            }
+        }
+        ip_lo[r + 1] = (int32_t)wl;
+        ip_hi[r + 1] = (int32_t)wh;
+    }
+}
+
+
+extern "C" {
+
+// Fused N-D "box" gid -> lid: decompose gid in the global grid, test the
+// owned box [lo, hi), emit the C-order local id or -1 — one pass, no
+// temporaries. ndim <= 8.
+void pa_box_gids_to_lids(const int64_t* gids, int64_t n,
+                         const int64_t* grid, const int64_t* lo,
+                         const int64_t* hi, int32_t ndim, int32_t* out) {
+    int64_t stride[8];   // global-grid C-order strides
+    int64_t bstride[8];  // box C-order strides
+    int64_t total = 1;
+    for (int32_t d = ndim - 1; d >= 0; --d) {
+        stride[d] = total;
+        total *= grid[d];
+    }
+    int64_t btotal = 1;
+    for (int32_t d = ndim - 1; d >= 0; --d) {
+        bstride[d] = btotal;
+        btotal *= hi[d] - lo[d];
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t g = gids[i];
+        if (g < 0 || g >= total) {
+            out[i] = -1;
+            continue;
+        }
+        int64_t lid = 0;
+        bool owned = true;
+        for (int32_t d = 0; d < ndim; ++d) {
+            int64_t c = g / stride[d];
+            g -= c * stride[d];
+            if (c < lo[d] || c >= hi[d]) {
+                owned = false;
+                break;
+            }
+            lid += (c - lo[d]) * bstride[d];
+        }
+        out[i] = owned ? (int32_t)lid : -1;
+    }
+}
+
+// Binary-search gid -> lid over a sorted ghost table, writing lid_of[pos]
+// on hit; entries already >= 0 in `out` (resolved by a cheaper path) are
+// left untouched. Returns the number of misses remaining.
+int64_t pa_lookup_sorted(const int64_t* gids, int64_t n,
+                         const int64_t* sorted_gids, const int32_t* lid_of,
+                         int64_t m, int32_t* out) {
+    int64_t misses = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        if (out[i] >= 0) continue;
+        const int64_t* p =
+            std::lower_bound(sorted_gids, sorted_gids + m, gids[i]);
+        if (p != sorted_gids + m && *p == gids[i]) {
+            out[i] = lid_of[p - sorted_gids];
+        } else {
+            ++misses;
+        }
+    }
+    return misses;
+}
+
+int64_t pa_coo_to_csr_f64(const int32_t* I, const int32_t* J,
+                          const double* V, int64_t nnz, int64_t m,
+                          int32_t* indptr, int32_t* cols_out,
+                          double* vals_out, int32_t* cursor) {
+    return coo_to_csr_impl(I, J, V, nnz, m, indptr, cols_out, vals_out,
+                           cursor);
+}
+
+int64_t pa_coo_to_csr_f32(const int32_t* I, const int32_t* J,
+                          const float* V, int64_t nnz, int64_t m,
+                          int32_t* indptr, int32_t* cols_out,
+                          float* vals_out, int32_t* cursor) {
+    return coo_to_csr_impl(I, J, V, nnz, m, indptr, cols_out, vals_out,
+                           cursor);
+}
+
+void pa_csr_split_f64(const int32_t* indptr, const int32_t* cols,
+                      const double* vals, int64_t m, int32_t thr,
+                      int32_t* ip_lo, int32_t* c_lo, double* v_lo,
+                      int32_t* ip_hi, int32_t* c_hi, double* v_hi) {
+    csr_split_impl(indptr, cols, vals, m, thr, ip_lo, c_lo, v_lo, ip_hi,
+                   c_hi, v_hi);
+}
+
+void pa_csr_split_f32(const int32_t* indptr, const int32_t* cols,
+                      const float* vals, int64_t m, int32_t thr,
+                      int32_t* ip_lo, int32_t* c_lo, float* v_lo,
+                      int32_t* ip_hi, int32_t* c_hi, float* v_hi) {
+    csr_split_impl(indptr, cols, vals, m, thr, ip_lo, c_lo, v_lo, ip_hi,
+                   c_hi, v_hi);
+}
+
+}  // extern "C"

@@ -109,6 +109,34 @@ class PSparseMatrix:
                     "PSparseMatrix blocks require owned-first lid layouts",
                 )
                 no_r, no_c = ri.num_oids, ci.num_oids
+                nh_c = A.shape[1] - no_c
+                if no_r == A.shape[0]:
+                    # no ghost rows (the assembled-operator common case):
+                    # one native routing pass yields oo+oh together
+                    from .. import native
+
+                    halves = native.csr_split_by_col(
+                        A.indptr, A.indices, A.data, no_r, no_c
+                    )
+                    if halves is not None:
+                        (ipo, co, vo), (iph, ch, vh) = halves
+                        empty = CSRMatrix(
+                            np.zeros(1, dtype=INDEX_DTYPE),
+                            np.empty(0, dtype=INDEX_DTYPE),
+                            np.empty(0, dtype=A.data.dtype),
+                            (0, no_c),
+                        )
+                        return {
+                            "oo": CSRMatrix(ipo, co, vo, (no_r, no_c)),
+                            "oh": CSRMatrix(iph, ch, vh, (no_r, nh_c)),
+                            "ho": empty,
+                            "hh": CSRMatrix(
+                                np.zeros(1, dtype=INDEX_DTYPE),
+                                np.empty(0, dtype=INDEX_DTYPE),
+                                np.empty(0, dtype=A.data.dtype),
+                                (0, nh_c),
+                            ),
+                        }
                 o_rows = np.arange(no_r, dtype=INDEX_DTYPE)
                 h_rows = np.arange(no_r, A.shape[0], dtype=INDEX_DTYPE)
                 return {
