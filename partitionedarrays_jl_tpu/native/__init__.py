@@ -72,6 +72,16 @@ def _load() -> Optional[ctypes.CDLL]:
                 i32p, i32p, fp, i32p,
             ]
             fn.restype = ctypes.c_int64
+        lib.pa_unique_small_f64.argtypes = [
+            f64p, ctypes.c_int64, ctypes.c_int64, f64p,
+        ]
+        lib.pa_unique_small_f64.restype = ctypes.c_int64
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.pa_row_classes_f64.argtypes = [
+            f64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, f64p, u8p,
+        ]
+        lib.pa_row_classes_f64.restype = ctypes.c_int64
         for name, fp in (("pa_csr_split_f64", f64p), ("pa_csr_split_f32", f32p)):
             fn = getattr(lib, name)
             fn.argtypes = [
@@ -183,3 +193,48 @@ def csr_split_by_col(indptr, cols, vals, m: int, thr: int):
     fn = getattr(lib, f"pa_csr_split_{_FLOAT_FN[dt]}")
     fn(ip, c, v, m, thr, ip_lo, c_lo, v_lo, ip_hi, c_hi, v_hi)
     return (ip_lo, c_lo, v_lo), (ip_hi, c_hi, v_hi)
+
+
+def unique_small(vals: np.ndarray, K: int):
+    """Sorted distinct values of a 1-D float64 array, capped at K.
+
+    Returns ``(values, ok)``: ok=True with the sorted distinct values
+    when there are at most K of them; ok=False when there are more (the
+    native path then returns values=None, having stopped scanning early;
+    the NumPy fallback returns the full oversized unique array). Callers
+    must branch on ``ok``, not on values being None."""
+    lib = _load()
+    v = np.ascontiguousarray(vals, dtype=np.float64)
+    if lib is None:
+        u = np.unique(v)
+        return u, len(u) <= K
+    table = np.empty(K, dtype=np.float64)
+    cnt = lib.pa_unique_small_f64(v, len(v), K, table)
+    if cnt < 0:
+        return None, False
+    return np.sort(table[:cnt]), True
+
+
+def row_classes(dia: np.ndarray, n: int, K: int):
+    """Row classes (distinct column tuples) of dia[:, :n], a (D, stride)
+    float64 array, capped at K classes.
+
+    Returns ``(class_table, codes, ok)``: ok=True with the (cnt, D)
+    class table and per-row uint8 class ids when there are at most K
+    classes, else ``(None, None, False)``. Native path: first-touch
+    class order, early exit on overflow. NumPy fallback: lexicographic
+    class order — either order selects identical values downstream."""
+    lib = _load()
+    if lib is None:
+        u, inv = np.unique(dia[:, :n].T, axis=0, return_inverse=True)
+        if len(u) > K:
+            return None, None, False
+        return u, inv.astype(np.uint8), True
+    d = np.ascontiguousarray(dia, dtype=np.float64)
+    D, stride = d.shape
+    table = np.empty((K, D), dtype=np.float64)
+    codes = np.empty(n, dtype=np.uint8)
+    cnt = lib.pa_row_classes_f64(d, D, n, stride, K, table, codes)
+    if cnt < 0:
+        return None, None, False
+    return table[:cnt].copy(), codes, True

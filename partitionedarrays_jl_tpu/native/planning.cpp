@@ -206,4 +206,74 @@ void pa_csr_split_f32(const int32_t* indptr, const int32_t* cols,
                    c_hi, v_hi);
 }
 
+// Distinct values of a double array when there are at most K of them:
+// one linear pass against a tiny table — replaces an O(n log n)
+// np.unique sort over 1e8-element stencil diagonals. Returns the count,
+// or -1 as soon as a (K+1)-th distinct value appears. The table is
+// written UNSORTED (caller sorts the <= K values).
+int64_t pa_unique_small_f64(const double* vals, int64_t n, int64_t K,
+                            double* table) {
+    int64_t cnt = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        double v = vals[i];
+        bool found = false;
+        for (int64_t k = 0; k < cnt; ++k) {
+            if (table[k] == v) {
+                found = true;
+                break;
+            }
+        }
+        if (!found) {
+            if (cnt == K) return -1;
+            table[cnt++] = v;
+        }
+    }
+    return cnt;
+}
+
+// Row classes of a (D, n) C-order diagonal-value matrix: distinct
+// D-tuples across rows, at most K of them. Emits codes[r] = class id
+// (first-touch order) and class_table (K x D, row-major). Tiled so the
+// strided per-diagonal reads stay cache-resident. Returns the class
+// count or -1 when a (K+1)-th class appears.
+int64_t pa_row_classes_f64(const double* dia, int64_t D, int64_t n,
+                           int64_t stride, int64_t K, double* class_table,
+                           uint8_t* codes) {
+    const int64_t TILE = 4096;
+    std::vector<double> buf(TILE * D);
+    int64_t cnt = 0;
+    for (int64_t r0 = 0; r0 < n; r0 += TILE) {
+        int64_t len = std::min(TILE, n - r0);
+        for (int64_t d = 0; d < D; ++d)  // sequential reads per diagonal
+            for (int64_t i = 0; i < len; ++i)
+                buf[i * D + d] = dia[d * stride + r0 + i];
+        for (int64_t i = 0; i < len; ++i) {
+            const double* row = &buf[i * D];
+            int64_t hit = -1;
+            for (int64_t k = 0; k < cnt; ++k) {
+                const double* c = &class_table[k * D];
+                bool eq = true;
+                for (int64_t d = 0; d < D; ++d) {
+                    if (c[d] != row[d]) {
+                        eq = false;
+                        break;
+                    }
+                }
+                if (eq) {
+                    hit = k;
+                    break;
+                }
+            }
+            if (hit < 0) {
+                if (cnt == K) return -1;
+                for (int64_t d = 0; d < D; ++d)
+                    class_table[cnt * D + d] = row[d];
+                hit = cnt++;
+            }
+            codes[r0 + i] = (uint8_t)hit;
+        }
+    }
+    return cnt;
+}
+
 }  // extern "C"

@@ -607,10 +607,26 @@ class DeviceMatrix:
                 r = M.row_of_nz()
                 d = np.searchsorted(off_arr, M.indices.astype(np.int64) - r)
                 dia[p, d, r] = M.data
-        uniq = [
-            [np.unique(dia[p, d, : int(noids[p])]) for d in range(D)]
-            for p in range(P)
-        ]
+        # distinct values per diagonal, capped at CODE_MAX_VALUES: the
+        # native single-pass kernel avoids an np.unique sort per diagonal
+        # (7 x O(n log n) over 1e8 rows otherwise). A diagonal with more
+        # distinct values than the cap reports a sentinel count that sends
+        # the whole matrix to the streaming path without finishing the scan.
+        from .. import native
+
+        KMAX = cls.CODE_MAX_VALUES
+        uniq = []
+        for p in range(P):
+            row = []
+            n_o = int(noids[p])
+            for d in range(D):
+                u, ok = native.unique_small(dia[p, d, :n_o], KMAX)
+                if not ok:
+                    # sentinel of KMAX+1 entries: forces coded_ok False
+                    # (streaming path); never read by the staging code
+                    u = np.arange(KMAX + 1, dtype=float)
+                row.append(u)
+            uniq.append(row)
         kk = tuple(
             max((len(uniq[p][d]) for p in range(P)), default=1) or 1
             for d in range(D)
@@ -634,9 +650,9 @@ class DeviceMatrix:
             cls_uniq, cls_ids, n_class = [], np.zeros((P, no_max), np.uint8), 1
             for p in range(P):
                 n_o = int(noids[p])
-                u, inv = np.unique(dia[p, :, :n_o].T, axis=0, return_inverse=True)
-                if len(u) > cls.CODE_MAX_VALUES:
-                    cls_uniq = cls_ids = None
+                u, inv, ok = native.row_classes(dia[p], n_o, KMAX)
+                if not ok:
+                    cls_uniq = cls_ids = None  # > KMAX classes
                     break
                 cls_uniq.append(u)
                 cls_ids[p, :n_o] = inv

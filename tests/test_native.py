@@ -114,3 +114,37 @@ def test_csr_split_matches_csr_block():
     np.testing.assert_array_equal(iph, hi.indptr)
     np.testing.assert_array_equal(ch, hi.indices)
     np.testing.assert_array_equal(vh, hi.data)
+
+
+def test_unique_small_matches_numpy():
+    rng = np.random.default_rng(11)
+    few = rng.choice([1.5, -2.25, 0.0, 7.125], size=5000)
+    u, ok = native.unique_small(few, 8)
+    assert ok
+    np.testing.assert_array_equal(u, np.unique(few))
+    many, ok2 = native.unique_small(rng.standard_normal(100), 8)
+    assert not ok2
+    u0, ok0 = native.unique_small(np.empty(0), 8)
+    assert ok0 and len(u0) == 0
+
+
+def test_row_classes_matches_numpy_fallback():
+    rng = np.random.default_rng(12)
+    D, stride, n = 5, 9000, 8123  # n < stride exercises the strided read
+    base = rng.standard_normal((4, D))  # 4 classes
+    ids = rng.integers(0, 4, size=stride)
+    dia = base[ids].T.copy()
+    table, codes, ok = native.row_classes(dia, n, 8)
+    assert ok
+    saved = _with_native(False)
+    try:
+        t_np, c_np, ok_np = native.row_classes(dia, n, 8)
+    finally:
+        native._lib, native._tried = saved
+    assert ok_np
+    # class ORDER may differ (first-touch vs lexicographic); the decoded
+    # per-row tuples must be identical
+    np.testing.assert_array_equal(table[codes], t_np[c_np])
+    # overflow: > K classes
+    _, _, ok_over = native.row_classes(rng.standard_normal((3, 64)), 64, 8)
+    assert not ok_over
