@@ -423,20 +423,34 @@ class DeviceMatrix:
         self.row_layout, self.col_layout = row_layout, col_layout
         self.col_plan = device_exchange_plan(A.cols, self.padded)
         self.backend = backend
-        L_oo = max((int(m.row_lengths().max()) if m.nnz else 0 for m in oo), default=0)
         L_oh = max((int(m.row_lengths().max()) if m.nnz else 0 for m in oh), default=0)
-        L_oo, L_oh = max(L_oo, 1), max(L_oh, 1)
-        oo_vals = np.zeros((P, no_max, L_oo))
-        oo_cols = np.full((P, no_max, L_oo), col_layout.trash, dtype=INDEX_DTYPE)
-        nnz = 0
-        for p in range(P):
-            Eoo = ELLMatrix.from_csr(oo[p], row_width=L_oo)
-            m = Eoo.vals.shape[0]
-            oo_vals[p, :m] = Eoo.vals
-            # ELL pad cols are 0 with val 0 — safe: o0 is a real owned slot
-            oo_cols[p, :m] = col_layout.o0 + Eoo.cols  # owned col slots
-            nnz += oo[p].nnz + oh[p].nnz
-        self.flops_per_spmv = 2 * nnz
+        L_oh = max(L_oh, 1)
+        self.flops_per_spmv = 2 * sum(
+            oo[p].nnz + oh[p].nnz for p in range(P)
+        )
+        if det is None:
+            # pure-ELL path: the only mode whose compiled program reads
+            # the O(N x row_width) oo value/col arrays — banded operators
+            # (coded or streamed DIA) skip this build and staging entirely
+            L_oo = max(
+                (int(m.row_lengths().max()) if m.nnz else 0 for m in oo),
+                default=0,
+            )
+            L_oo = max(L_oo, 1)
+            oo_vals = np.zeros((P, no_max, L_oo))
+            oo_cols = np.full(
+                (P, no_max, L_oo), col_layout.trash, dtype=INDEX_DTYPE
+            )
+            for p in range(P):
+                Eoo = ELLMatrix.from_csr(oo[p], row_width=L_oo)
+                m = Eoo.vals.shape[0]
+                oo_vals[p, :m] = Eoo.vals
+                # ELL pad cols are 0 with val 0 — safe: o0 is a real slot
+                oo_cols[p, :m] = col_layout.o0 + Eoo.cols
+            self.oo_vals = _stage(backend, oo_vals.astype(dt), P)
+            self.oo_cols = _stage(backend, oo_cols, P)
+        else:
+            self.oo_vals = self.oo_cols = None
         # A_oh, compact boundary-row form. Only rows touching the ghost
         # layer carry entries — a surface set (~n^2 of n^3 rows for a 3-D
         # stencil). TPU gathers run element-at-a-time, so gathering per
@@ -460,8 +474,6 @@ class DeviceMatrix:
                 oh_cols[p, : len(br)] = col_layout.g0 + Eoh.cols[br]
         self._cg_cache = {}
         self._ops_cache = None
-        self.oo_vals = _stage(backend, oo_vals.astype(dt), P)
-        self.oo_cols = _stage(backend, oo_cols, P)
         self.oh_vals = _stage(backend, oh_vals.astype(dt), P)
         self.oh_cols = _stage(backend, oh_cols, P)
         self.oh_rows = _stage(backend, oh_rows, P)
@@ -471,7 +483,7 @@ class DeviceMatrix:
         self.pallas_plan = None
         self.dia_cb = self.dia_no = self.dia_codes = None
         self.dia_kk = self.dia_code_row = None
-        self.dia_vals = self.oo_vals  # placeholder with a valid sharding
+        self.dia_vals = None  # set by the streaming-DIA staging below
         if det is None:
             return
         from ..ops.pallas_dia import LANES, plan_dia_pallas
