@@ -516,6 +516,21 @@ def chebyshev(a: PVector, b: PVector):
     )
 
 
+def minkowski(a: PVector, b: PVector, p: float = 2.0):
+    """Order-p Minkowski distance (reference: the generic Distances.jl
+    partial-eval + eval_reduce mechanism, src/Interfaces.jl:1776-1825;
+    p=1 cityblock, p=2 euclidean)."""
+    s = _metric_reduce(
+        a,
+        b,
+        lambda x, y: float(np.sum(np.abs(x - y) ** p)),
+        operator.add,
+        lambda t: t,
+        0.0,
+    )
+    return float(s ** (1.0 / p))
+
+
 # free-function parity helpers
 def assemble(v: PVector, combine_op=np.add) -> PVector:
     return v.assemble(combine_op)

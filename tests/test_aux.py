@@ -76,6 +76,10 @@ def test_distance_metrics():
         assert pa.euclidean(a, b) == pytest.approx(np.linalg.norm(ref_a - ref_b))
         assert pa.cityblock(a, b) == pytest.approx(np.sum(np.abs(ref_a - ref_b)))
         assert pa.chebyshev(a, b) == pytest.approx(np.max(np.abs(ref_a - ref_b)))
+        for order in (1.0, 2.0, 3.5):
+            assert pa.minkowski(a, b, order) == pytest.approx(
+                np.sum(np.abs(ref_a - ref_b) ** order) ** (1 / order)
+            )
         return True
 
     assert pa.prun(driver, pa.sequential, 4)

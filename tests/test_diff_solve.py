@@ -1,0 +1,101 @@
+"""Differentiable distributed solve: jax.grad through the compiled CG via
+the implicit-function-theorem adjoint (one extra solve per backward pass).
+Checked against central finite differences on a truly SPD system."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.parallel.tpu import (
+    DeviceVector,
+    device_matrix,
+    make_diff_solve_fn,
+)
+
+N = 40
+
+
+def _spd_tridiag(parts):
+    """Eliminated-boundary 1-D Laplacian: genuinely SPD (unlike the
+    Dirichlet-identity-row driver systems, which are nonsymmetric)."""
+    rows = pa.prange(parts, N)
+
+    def coo(i):
+        g = np.asarray(i.oid_to_gid)
+        I = [g]
+        J = [g]
+        V = [np.full(len(g), 2.0)]
+        for off in (-1, 1):
+            gj = g + off
+            k = (gj >= 0) & (gj < N)
+            I.append(g[k])
+            J.append(gj[k])
+            V.append(np.full(int(k.sum()), -1.0))
+        return np.concatenate(I), np.concatenate(J), np.concatenate(V)
+
+    c = pa.map_parts(coo, rows.partition)
+    I = pa.map_parts(lambda t: t[0], c)
+    J = pa.map_parts(lambda t: t[1], c)
+    V = pa.map_parts(lambda t: t[2], c)
+    cols = pa.add_gids(rows, J)
+    return pa.PSparseMatrix.from_coo(I, J, V, rows, cols, ids="global")
+
+
+def test_grad_through_compiled_solve_matches_fd():
+    def driver(parts):
+        A = _spd_tridiag(parts)
+        dA = device_matrix(A, parts.backend)
+        f = make_diff_solve_fn(dA, tol=1e-13)
+        b = pa.PVector(
+            pa.map_parts(
+                lambda i: np.sin(np.asarray(i.lid_to_gid, float)),
+                A.cols.partition,
+            ),
+            A.cols,
+        )
+        db = DeviceVector.from_pvector(b, parts.backend, dA.col_layout)
+        w = np.cos(np.arange(dA.col_layout.W) * 0.1)
+        wj = jnp.asarray(np.tile(w, (dA.col_layout.P, 1)))
+
+        def loss(bv):
+            return jnp.sum((f(bv) * wj) ** 2)
+
+        g = jax.grad(loss)(db.data)
+        b0 = np.asarray(db.data)
+        rng = np.random.default_rng(0)
+        for _ in range(5):
+            p = int(rng.integers(0, dA.col_layout.P))
+            i = dA.col_layout.o0 + int(
+                rng.integers(0, int(dA.col_layout.noids[p]))
+            )
+            eps = 1e-6
+            bp = b0.copy()
+            bp[p, i] += eps
+            bm = b0.copy()
+            bm[p, i] -= eps
+            fd = (
+                float(loss(jnp.asarray(bp))) - float(loss(jnp.asarray(bm)))
+            ) / (2 * eps)
+            an = float(np.asarray(g)[p, i])
+            assert abs(fd - an) / max(abs(an), 1e-10) < 1e-6, (p, i, fd, an)
+        return True
+
+    assert pa.prun(driver, pa.tpu, 4)
+
+
+def test_solution_matches_host_cg():
+    def driver(parts):
+        A = _spd_tridiag(parts)
+        b = pa.PVector.full(1.0, A.cols)
+        x_host, info = pa.cg(A, b, tol=1e-13, maxiter=400)
+        dA = device_matrix(A, parts.backend)
+        f = make_diff_solve_fn(dA, tol=1e-13, maxiter=400)
+        db = DeviceVector.from_pvector(b, parts.backend, dA.col_layout)
+        x_dev = DeviceVector(
+            f(db.data), A.rows, dA.col_layout, parts.backend
+        ).to_pvector()
+        got = pa.gather_pvector(x_dev)
+        np.testing.assert_allclose(got, pa.gather_pvector(x_host), atol=1e-10)
+        return True
+
+    assert pa.prun(driver, pa.tpu, 4)
