@@ -27,6 +27,7 @@ from partitionedarrays_jl_tpu import (
     iscan,
     iscan_all,
     iscan_main,
+    xscan_main,
     map_parts,
     preduce,
     reduce_all,
@@ -208,3 +209,22 @@ def test_discover_parts_snd_error_flag():
             discover_parts_snd(parts_rcv)
     finally:
         ERROR_DISCOVER_PARTS_SND[0] = False
+
+
+def test_xscan_main_and_iscan_main_with_total():
+    """MAIN-resident scan variants (reference: src/Interfaces.jl:291-340):
+    only part 0 receives the scanned sequence; the with_total form also
+    reduces the full sum."""
+    import partitionedarrays_jl_tpu as pa
+
+    def driver(parts):
+        a = map_parts(lambda p: p + 1, parts)  # 1, 2, 3, 4
+        xm = pa.xscan_main(operator.add, a, init=10)
+        np.testing.assert_array_equal(np.asarray(xm.get_part(0)), [10, 11, 13, 16])
+        xm2, total = pa.xscan_main(operator.add, a, init=0, with_total=True)
+        assert total == 10
+        im = iscan_main(operator.add, a, init=0)
+        np.testing.assert_array_equal(np.asarray(im.get_part(0)), [1, 3, 6, 10])
+        return True
+
+    assert sequential.prun(driver, 4)

@@ -138,3 +138,25 @@ def test_jacobi_preconditioner_values():
         return True
 
     assert pa.prun(driver, pa.sequential, (2, 2, 2))
+
+
+def test_plu_factor_reuse_and_refactorize():
+    """PLU factors once on MAIN and solves many right-hand sides; a
+    rescaled operator is handled by refactorize
+    (reference PLU/lu/ldiv!: src/Interfaces.jl:2641-2662)."""
+    def driver(parts):
+        A, b, x_exact, x0 = pa.assemble_poisson(parts, (5, 5, 5))
+        F = pa.lu(A)
+        x1 = F.solve(b)
+        assert (x1 - x_exact).norm() < 1e-9
+        b2 = A @ (x_exact * 2.0)
+        x2 = F.solve(b2)
+        assert (x2 - x_exact * 2.0).norm() < 1e-9
+        # rescaled operator: stale factors are wrong, refactorize fixes
+        A2 = 2.0 * A
+        F.refactorize(A2)
+        x3 = F.solve(b2)
+        assert (x3 - x_exact).norm() < 1e-9
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2, 2))
