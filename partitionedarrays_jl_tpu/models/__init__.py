@@ -9,6 +9,8 @@ from .fem_q1 import assemble_fem_q1, fem_q1_driver
 from .poisson_fdm import assemble_poisson, manufactured_solution, poisson_fdm_driver
 from .solvers import (
     PLU,
+    chebyshev_solve,
+    gershgorin_bounds,
     bicgstab,
     cg,
     direct_solve,
@@ -32,6 +34,8 @@ __all__ = [
     "manufactured_solution",
     "poisson_fdm_driver",
     "PLU",
+    "chebyshev_solve",
+    "gershgorin_bounds",
     "bicgstab",
     "cg",
     "direct_solve",

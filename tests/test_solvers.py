@@ -160,3 +160,64 @@ def test_plu_factor_reuse_and_refactorize():
         return True
 
     assert pa.prun(driver, pa.sequential, (2, 2, 2))
+
+
+def test_chebyshev_solver_both_backends():
+    """Chebyshev iteration (no inner products in the loop — the only
+    per-iteration collective is the SpMV halo) with Gershgorin-estimated
+    spectrum bounds, against the CG solution."""
+    N = 40
+
+    def spd(parts):
+        rows = pa.prange(parts, N)
+
+        def coo(i):
+            g = np.asarray(i.oid_to_gid)
+            I = [g]
+            J = [g]
+            V = [np.full(len(g), 2.0)]
+            for off in (-1, 1):
+                gj = g + off
+                k = (gj >= 0) & (gj < N)
+                I.append(g[k])
+                J.append(gj[k])
+                V.append(np.full(int(k.sum()), -1.0))
+            return np.concatenate(I), np.concatenate(J), np.concatenate(V)
+
+        c = pa.map_parts(coo, rows.partition)
+        cols = pa.add_gids(rows, pa.map_parts(lambda t: t[1], c))
+        return pa.PSparseMatrix.from_coo(
+            pa.map_parts(lambda t: t[0], c),
+            pa.map_parts(lambda t: t[1], c),
+            pa.map_parts(lambda t: t[2], c),
+            rows,
+            cols,
+            ids="global",
+        )
+
+    def driver(parts):
+        A = spd(parts)
+        lmin = 2 - 2 * np.cos(np.pi / (N + 1))
+        lmax = 2 - 2 * np.cos(N * np.pi / (N + 1))
+        glo, ghi = pa.gershgorin_bounds(A)
+        assert glo <= lmin and ghi >= lmax
+        b = pa.PVector.full(1.0, A.cols)
+        x, info = pa.chebyshev_solve(A, b, lmin, lmax, tol=1e-10, maxiter=5000)
+        assert info["converged"]
+        xc, _ = pa.cg(A, b, tol=1e-12)
+        err = np.abs(pa.gather_pvector(x) - pa.gather_pvector(xc)).max()
+        assert err < 1e-7
+        return True
+
+    assert pa.prun(driver, pa.sequential, 4)
+    assert pa.prun(driver, pa.tpu, 4)
+
+
+def test_chebyshev_rejects_bad_bounds():
+    def driver(parts):
+        A, b, xe, x0 = pa.assemble_poisson(parts, (4, 4, 4))
+        with pytest.raises(AssertionError):
+            pa.chebyshev_solve(A, b, lmin=2.0, lmax=1.0)
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2, 2))
