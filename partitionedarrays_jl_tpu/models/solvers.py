@@ -39,8 +39,9 @@ def cg(
     SpMV can halo-update it in place.
 
     Deterministic: all reductions are fixed-order part folds; the residual
-    history is reproducible bit-for-bit for a given backend and matches the
-    sequential oracle on the TPU backend (the BASELINE.md gate).
+    history is reproducible bit-for-bit for a given backend, and on the TPU
+    backend it matches the sequential oracle to FMA rounding with identical
+    iteration counts (exchanges are bit-identical — the BASELINE.md gate).
     """
     from ..parallel.tpu import TPUBackend, tpu_cg
 

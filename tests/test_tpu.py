@@ -198,7 +198,7 @@ def test_coded_dia_mode_spmv_matches_host():
 
 def test_coded_dia_mode_cg_matches_sequential():
     """CG through the coded-DIA path converges identically to the
-    sequential oracle (same iteration count, same solution bits)."""
+    sequential oracle: same iteration count, values to FMA rounding."""
     err_s, info_s = pa.prun(
         poisson_fdm_driver, pa.sequential, (2, 2, 2), (48, 48, 48), tol=1e-8
     )
