@@ -60,11 +60,13 @@ class TPUBackend(AbstractBackend):
     def __init__(self, devices=None):
         self._devices = devices
         self._meshes = {}
+        self._mesh_grid = {}  # nparts -> part-grid shape the mesh was ordered for
 
     def devices(self):
         return self._devices if self._devices is not None else _jax().devices()
 
-    def mesh(self, nparts: int):
+    def mesh(self, nparts: int, grid=None):
+        grid = tuple(grid) if grid is not None else None
         if nparts not in self._meshes:
             jax = _jax()
             devs = self.devices()
@@ -72,10 +74,62 @@ class TPUBackend(AbstractBackend):
                 nparts <= len(devs),
                 f"TPUBackend: {nparts} parts requested but only {len(devs)} devices",
             )
+            ordered = self._topology_order(nparts, devs, grid)
             self._meshes[nparts] = jax.sharding.Mesh(
-                np.array(devs[:nparts]), ("parts",)
+                np.array(ordered), ("parts",)
+            )
+            self._mesh_grid[nparts] = grid
+        elif (
+            grid is not None
+            and len(grid) > 1
+            and self._mesh_grid.get(nparts) != grid
+            and all(
+                getattr(d, "platform", "") == "tpu"
+                for d in self.devices()[:nparts]
+            )
+        ):
+            import warnings
+
+            warnings.warn(
+                f"TPUBackend: the {nparts}-device mesh was ordered for part "
+                f"grid {self._mesh_grid.get(nparts)} and is reused for "
+                f"{grid}; halo neighbors may take multi-hop ICI routes. Use "
+                "a fresh TPUBackend per part-grid shape for topology-aware "
+                "placement.",
+                stacklevel=3,
             )
         return self._meshes[nparts]
+
+    def _topology_order(self, nparts: int, devs, grid):
+        """Device order for the flat ``'parts'`` axis. When the part ids
+        come from an N-D Cartesian grid and the devices are real TPUs, ask
+        `mesh_utils` for a topology-aware N-D device mesh of that shape
+        and flatten it in C order: flat part p then sits on the device at
+        p's grid coordinate of the physical torus, so the halo
+        `ppermute`s between Cartesian neighbors ride single-hop ICI
+        links. Falls back to list order (with a warning on real TPUs) for
+        CPU meshes or any mesh_utils failure."""
+        if (
+            grid is not None
+            and len(grid) > 1
+            and math.prod(grid) == nparts == len(devs)
+            and all(getattr(d, "platform", "") == "tpu" for d in devs)
+        ):
+            try:
+                from jax.experimental import mesh_utils
+
+                nd = mesh_utils.create_device_mesh(grid, devices=devs)
+                return list(np.asarray(nd).reshape(-1))
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"TPUBackend: topology-aware device ordering for part "
+                    f"grid {grid} failed ({e!r}); using list order — halo "
+                    "neighbors may take multi-hop ICI routes.",
+                    stacklevel=3,
+                )
+        return list(devs[:nparts])
 
     def parts_spec(self):
         jax = _jax()
@@ -88,7 +142,7 @@ class TPUBackend(AbstractBackend):
     def get_part_ids(self, nparts: PartShape) -> "TPUData":
         shape = _as_shape(nparts)
         n = math.prod(shape)
-        self.mesh(n)  # validate device count early
+        self.mesh(n, grid=shape)  # validate devices; order the grid on ICI
         return TPUData(list(range(n)), shape, self)
 
     def prun(self, driver, nparts, *args, **kwargs):
