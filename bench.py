@@ -93,7 +93,9 @@ def main():
         return statistics.median(ts)
 
     def measure_once() -> float:
-        k1, k2 = 10, 10 + max(50, reps)
+        # chains long enough that the marginal cost (~reps x dt of signal)
+        # dominates the relay's tens-of-ms RTT jitter
+        k1, k2 = 50, 50 + 8 * max(50, reps)
         t1 = chain_time(k1)
         dt = 0.0
         for _ in range(4):  # lengthen the chain until it dominates jitter
@@ -102,11 +104,16 @@ def main():
             if dt > 0:
                 return dt
             k2 = 2 * k2
-        return t2 / k2  # still inverted: conservative whole-chain cost
+        # still inverted: conservative whole-chain cost of the LAST
+        # measured chain (t2 was taken before the final doubling of k2)
+        return t2 / (k2 // 2)
 
-    # the relay's per-process variance is large; take the best of three
-    # full measurements (each already a median over reps)
-    dt = min(measure_once() for _ in range(3))
+    # the relay's per-process variance is large in BOTH directions (slow
+    # outliers from contention, absurdly fast ones when a short chain's
+    # marginal cost degenerates) — take the median of three full
+    # measurements (each already a median over reps)
+    dts = sorted(measure_once() for _ in range(3))
+    dt = dts[1]
     gflops = flops / dt / 1e9
 
     # sequential-oracle timing on the same local problem (NumPy CSR)
