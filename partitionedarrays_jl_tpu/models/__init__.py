@@ -1,3 +1,4 @@
+from .advection_fv import advection_fv_driver, assemble_advection_fv
 from .elasticity_tet import (
     assemble_elasticity_tet,
     elasticity_tet_driver,
@@ -23,6 +24,8 @@ from .solvers import (
 )
 
 __all__ = [
+    "advection_fv_driver",
+    "assemble_advection_fv",
     "assemble_elasticity_tet",
     "elasticity_tet_driver",
     "morton_permutation",

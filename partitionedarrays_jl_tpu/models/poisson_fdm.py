@@ -19,6 +19,7 @@ from typing import Sequence, Tuple
 import numpy as np
 
 from ..parallel.backends import AbstractPData, map_parts
+from ..utils.helpers import check
 from ..parallel.prange import (
     add_gids,
     cartesian_partition,
@@ -41,17 +42,21 @@ def manufactured_solution(gids: np.ndarray, ngids: Sequence[int]) -> np.ndarray:
     return val
 
 
-def assemble_poisson(parts: AbstractPData, ns: Sequence[int]):
-    """Build the N-D Laplacian PSparseMatrix + manufactured (x̂, b).
-
-    Returns (A, b, x_exact) with:
-    * rows: Cartesian partition of cells, no ghosts (every COO row is owned),
-    * cols: rows + the column ghost layer discovered from the stencil's J
-      gids (`add_gids`, the reference's flow at test/test_fdm.jl:82-100),
-    * b = A @ x̂ computed distributed, so `cg` must return x̂.
-    """
+def assemble_cartesian_stencil(
+    parts: AbstractPData,
+    ns: Sequence[int],
+    center: float,
+    arm_coefs: Sequence[Sequence[float]],
+):
+    """Shared skeleton for Dirichlet-identity Cartesian stencil drivers
+    (Poisson FDM, upwind advection FV): assemble the operator whose
+    interior rows carry `center` on the diagonal and, per dimension d,
+    ``arm_coefs[d] = (coef_minus, coef_plus)`` on the ∓1 neighbors;
+    boundary cells are identity rows. Returns (A, b, x̂, x0) with
+    b = A @ x̂ and x0 carrying the exact boundary values."""
     ns = tuple(int(n) for n in ns)
     dim = len(ns)
+    check(len(arm_coefs) == dim, "one (minus, plus) coefficient pair per dim")
     rows = cartesian_partition(parts, ns, no_ghost)
     cis = p_cartesian_indices(parts, ns, no_ghost)
 
@@ -77,18 +82,17 @@ def assemble_poisson(parts: AbstractPData, ns: Sequence[int]):
         I[:nb_] = gb
         J[:nb_] = gb
         V[:nb_] = 1.0
-        # interior: center 2*dim, neighbors -1
         I[nb_:] = np.tile(gi, 2 * dim + 1)
         pos = nb_
         J[pos : pos + ni] = gi
-        V[pos : pos + ni] = 2.0 * dim
+        V[pos : pos + ni] = center
         pos += ni
         for d in range(dim):
-            for off in (-1, 1):
+            for off, coef in zip((-1, 1), arm_coefs[d]):
                 nb = list(icoords)
                 nb[d] = nb[d] + off
                 J[pos : pos + ni] = np.ravel_multi_index(nb, ns)
-                V[pos : pos + ni] = -1.0
+                V[pos : pos + ni] = coef
                 pos += ni
         return I, J, V
 
@@ -109,9 +113,8 @@ def assemble_poisson(parts: AbstractPData, ns: Sequence[int]):
     b = A @ x_exact
 
     # Start vector with the Dirichlet values imposed exactly: identity rows
-    # then keep a zero residual throughout CG, so the iteration runs on the
-    # reduced (interior) operator, which IS symmetric positive definite —
-    # the same device as the reference driver (test/test_fdm.jl:98-110).
+    # then keep a zero residual throughout the iteration, so it runs on the
+    # reduced (interior) operator (reference: test/test_fdm.jl:98-110).
     def _x0(i):
         coords = np.unravel_index(i.lid_to_gid, ns)
         boundary = np.zeros(i.num_lids, dtype=bool)
@@ -121,6 +124,22 @@ def assemble_poisson(parts: AbstractPData, ns: Sequence[int]):
 
     x0 = PVector(map_parts(_x0, cols.partition), cols)
     return A, b, x_exact, x0
+
+
+def assemble_poisson(parts: AbstractPData, ns: Sequence[int]):
+    """Build the N-D Laplacian PSparseMatrix + manufactured (x̂, b).
+
+    Returns (A, b, x_exact) with:
+    * rows: Cartesian partition of cells, no ghosts (every COO row is owned),
+    * cols: rows + the column ghost layer discovered from the stencil's J
+      gids (`add_gids`, the reference's flow at test/test_fdm.jl:82-100),
+    * b = A @ x̂ computed distributed, so `cg` must return x̂.
+    """
+    ns = tuple(int(n) for n in ns)
+    dim = len(ns)
+    return assemble_cartesian_stencil(
+        parts, ns, 2.0 * dim, [(-1.0, -1.0)] * dim
+    )
 
 
 def poisson_fdm_driver(
