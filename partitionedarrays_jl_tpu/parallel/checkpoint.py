@@ -24,7 +24,7 @@ import numpy as np
 
 from .backends import AbstractPData, map_parts
 from .prange import PRange
-from .psparse import PSparseMatrix, psparse_global_triplets
+from .psparse import PSparseMatrix
 from .pvector import PVector, _owned
 
 
@@ -63,15 +63,16 @@ def load_pvector(path: str, rows: PRange) -> PVector:
 
 def save_psparse(path: str, A: PSparseMatrix) -> None:
     """Serialize a PSparseMatrix as global owned-row COO triplets (.npz).
-    Ghost-row entries are skipped — call ``A.assemble()`` first if the
-    matrix holds unassembled contributions."""
-    trip = psparse_global_triplets(A)
+    Nonzero ghost-row entries (unassembled contributions) are rejected —
+    call ``A.assemble()`` first."""
+    from .psparse import psparse_owned_triplets
+
+    trip = psparse_owned_triplets(A)
     gi_all, gj_all, v_all = [], [], []
-    for (gi, gj, v), iset in zip(trip.part_values(), A.rows.partition.part_values()):
-        owned = iset.lid_to_ohid[iset.gids_to_lids(gi)] >= 0
-        gi_all.append(gi[owned])
-        gj_all.append(gj[owned])
-        v_all.append(v[owned])
+    for gi, gj, v in trip.part_values():
+        gi_all.append(gi)
+        gj_all.append(gj)
+        v_all.append(v)
     _atomic_savez(
         path,
         kind="psparse",

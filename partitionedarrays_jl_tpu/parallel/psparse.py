@@ -618,6 +618,25 @@ def psparse_local_values(A: PSparseMatrix) -> AbstractPData:
     return A.values
 
 
+def psparse_owned_triplets(A: PSparseMatrix) -> AbstractPData:
+    """Per-part (gi, gj, v) of the entries stored on OWNED rows, global
+    numbering — the redistribution/serialization form. Nonzero entries on
+    ghost rows indicate unassembled contributions that would silently
+    vanish; that is rejected (call ``A.assemble()`` first)."""
+
+    def _own(iset, t):
+        gi, gj, v = t
+        owned = iset.lid_to_ohid[iset.gids_to_lids(np.asarray(gi))] >= 0
+        check(
+            bool(np.all(np.asarray(v)[~owned] == 0)),
+            "matrix holds nonzero unassembled ghost-row entries; call "
+            "assemble() before redistributing/serializing",
+        )
+        return gi[owned], gj[owned], v[owned]
+
+    return map_parts(_own, A.rows.partition, psparse_global_triplets(A))
+
+
 def psparse_global_triplets(A: PSparseMatrix) -> AbstractPData:
     """Per-part (gi, gj, v) of all stored entries, in global numbering —
     the building block of the gather/global_view debug paths."""

@@ -1,0 +1,99 @@
+"""In-memory redistribution onto a different partition (load balancing).
+
+The reference stops at gather-to-MAIN + scatter (reference:
+src/Interfaces.jl:2664-2748); here redistribution is scalable: owned
+data migrates directly between old and new owners through the same
+variable-length Table exchange that powers COO assembly — no global
+image, no MAIN bottleneck. The checkpoint layer (checkpoint.py) is the
+disk-mediated sibling of this module.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .backends import AbstractPData, map_parts
+from .index_sets import AbstractIndexSet
+from .prange import PRange, add_gids
+from .psparse import (
+    PSparseMatrix,
+    assemble_coo,
+    assemble_matrix_from_coo,
+    psparse_owned_triplets,
+)
+from .pvector import PVector, _owned, exchange_pvector
+from ..utils.helpers import check
+
+
+def repartition_pvector(v: PVector, new_rows: PRange) -> PVector:
+    """Redistribute a PVector onto `new_rows`: same global index space
+    and the same part grid, any other ownership layout (rebalancing
+    across a different number of parts needs a checkpoint round-trip —
+    see checkpoint.py). Owned values travel old-owner -> new-owner via
+    the assembly exchange; ghost entries of the result are filled by a
+    halo update, so the returned vector is ready for SpMV against
+    operators over `new_rows`."""
+    check(
+        v.rows.ngids == new_rows.ngids,
+        f"repartition: {v.rows.ngids} gids -> {new_rows.ngids}",
+    )
+    check(
+        v.rows.partition.num_parts == new_rows.partition.num_parts,
+        "repartition runs within one part grid; use the checkpoint layer "
+        "to change the part count",
+    )
+
+    def _owned_pairs(iset: AbstractIndexSet, vals):
+        g = np.asarray(iset.oid_to_gid)
+        return g, _owned(iset, np.asarray(vals))
+
+    pairs = map_parts(_owned_pairs, v.rows.partition, v.values)
+    I = map_parts(lambda t: t[0], pairs)
+    V = map_parts(lambda t: t[1], pairs)
+    # route (gid, value) to the new owner: ghost the new partition by the
+    # gids each part currently holds, migrate, keep owned
+    rows_t = add_gids(new_rows, I)
+    J = map_parts(lambda i: np.zeros(len(i), dtype=np.int64), I)
+    I2, _J2, V2 = assemble_coo(I, J, V, rows_t)
+
+    def _fill(iset: AbstractIndexSet, gi, vi):
+        out = np.zeros(iset.num_lids, dtype=np.asarray(vi).dtype)
+        lids = iset.gids_to_lids(np.asarray(gi))
+        own = lids >= 0
+        # the shipped-away copies were zeroed by assemble_coo; only the
+        # surviving (owned-here) pairs carry values
+        sel = own & (np.asarray(iset.lid_to_part)[np.clip(lids, 0, None)] == iset.part)
+        out[lids[sel]] = np.asarray(vi)[sel]
+        return out
+
+    vals = map_parts(_fill, new_rows.partition, I2, V2)
+    out = PVector(vals, new_rows)
+    if new_rows.ghost:
+        exchange_pvector(out)
+    return out
+
+
+def repartition_psparse(A: PSparseMatrix, new_rows: PRange) -> PSparseMatrix:
+    """Redistribute a PSparseMatrix onto the ghost-free partition
+    `new_rows` (same part grid): owned-row triplets migrate to their new
+    row owners and recompress through the standard assembly pipeline;
+    the column ghost layer is rediscovered from the migrated columns.
+    Matrices holding nonzero unassembled ghost-row contributions are
+    rejected (assemble() first)."""
+    check(
+        A.rows.ngids == new_rows.ngids,
+        f"repartition: {A.rows.ngids} rows -> {new_rows.ngids}",
+    )
+    check(
+        A.rows.partition.num_parts == new_rows.partition.num_parts,
+        "repartition runs within one part grid; use the checkpoint layer "
+        "to change the part count",
+    )
+    check(
+        not new_rows.ghost,
+        "repartition_psparse needs a ghost-free target partition",
+    )
+    kept = psparse_owned_triplets(A)
+    I = map_parts(lambda t: t[0], kept)
+    J = map_parts(lambda t: t[1], kept)
+    V = map_parts(lambda t: t[2], kept)
+    return assemble_matrix_from_coo(I, J, V, new_rows)
