@@ -359,6 +359,220 @@ def pcg(
     }
 
 
+def gmres(
+    A: PSparseMatrix,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    restart: int = 30,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    minv: Optional[PVector] = None,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """Restarted GMRES(m) for general (nonsymmetric, possibly indefinite)
+    operators — the workhorse the reference borrows from
+    IterativeSolvers.jl (src/Interfaces.jl:2752-2757 makes `gmres!` run
+    distributed on a PSparseMatrix). Arnoldi with modified Gram-Schmidt
+    on the host; the m+1 basis vectors live on ``A.cols`` so every SpMV
+    halo-updates in place. With ``minv`` (an inverse-diagonal PVector over
+    ``A.cols``) the iteration is left-preconditioned: it solves
+    ``M^{-1} A x = M^{-1} b`` and the reported residuals are in the
+    preconditioned norm. Dispatches to the single compiled shard_map
+    program on the TPU backend (classical Gram-Schmidt with
+    reorthogonalization there — two MXU matmuls instead of a sequential
+    dot chain; host and device agree to rounding, not bit-exactly)."""
+    from ..parallel.tpu import TPUBackend, tpu_gmres
+
+    check(restart >= 1, "gmres: restart dimension must be >= 1")
+    if isinstance(b.values.backend, TPUBackend):
+        return tpu_gmres(
+            A, b, x0=x0, restart=restart, tol=tol, maxiter=maxiter,
+            minv=minv, verbose=verbose,
+        )
+
+    x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    m = restart
+
+    def precond(v):
+        """owned-region M^{-1} v, in place (identity when minv is None)."""
+        if minv is not None:
+            _owned_update(v, lambda vv, mv: mv * vv, minv)
+        return v
+
+    def residual_vec():
+        r = PVector.full(0.0, A.cols, dtype=b.dtype)
+        q = A @ x
+        _owned_zip(r, lambda _r, bv, qv: bv - qv, b, q)
+        return precond(r)
+
+    r = residual_vec()
+    beta = r.norm()
+    rs0 = beta
+    history = [beta]
+    it = 0
+    converged = beta <= tol * max(1.0, rs0)
+    while not converged and it < maxiter:
+        # --- one restart cycle: Arnoldi + incremental Givens LSQ ---
+        V = [r / beta if beta > 0 else r.copy()]
+        H = np.zeros((m + 1, m), dtype=np.float64)
+        cs = np.zeros(m)
+        sn = np.zeros(m)
+        g = np.zeros(m + 1)
+        g[0] = beta
+        j_used = 0
+        for j in range(m):
+            if it >= maxiter:
+                break
+            w = precond(A @ V[j])
+            for i in range(j + 1):  # modified Gram-Schmidt, fixed order
+                hij = w.dot(V[i])
+                H[i, j] = hij
+                _owned_update(w, lambda wv, vv: wv - hij * vv, V[i])
+            hj1 = w.norm()
+            H[j + 1, j] = hj1
+            # apply the accumulated rotations to the new column
+            for i in range(j):
+                t = cs[i] * H[i, j] + sn[i] * H[i + 1, j]
+                H[i + 1, j] = -sn[i] * H[i, j] + cs[i] * H[i + 1, j]
+                H[i, j] = t
+            # new rotation zeroing H[j+1, j]
+            rho = np.hypot(H[j, j], H[j + 1, j])
+            if rho == 0.0:
+                cs[j], sn[j] = 1.0, 0.0
+            else:
+                cs[j], sn[j] = H[j, j] / rho, H[j + 1, j] / rho
+            H[j, j] = rho
+            H[j + 1, j] = 0.0
+            g[j + 1] = -sn[j] * g[j]
+            g[j] = cs[j] * g[j]
+            it += 1
+            j_used = j + 1
+            res = abs(g[j + 1])
+            history.append(res)
+            if verbose:
+                print(f"gmres it={it} residual={res:.3e}")
+            if res <= tol * max(1.0, rs0) or hj1 == 0.0:
+                converged = res <= tol * max(1.0, rs0)
+                break
+            # the next basis vector lives on A.cols (w came out of the
+            # SpMV on A.rows) so the following SpMV can halo-update it
+            vn = PVector.full(0.0, A.cols, dtype=b.dtype)
+            _owned_zip(vn, lambda _v, wv: wv / hj1, w)
+            V.append(vn)
+        # --- solve the j_used x j_used triangular system, update x ---
+        if j_used:
+            y = np.zeros(j_used)
+            for i in range(j_used - 1, -1, -1):
+                y[i] = (g[i] - H[i, i + 1 : j_used] @ y[i + 1 : j_used]) / H[i, i]
+            for i in range(j_used):
+                yi = y[i]
+                _owned_update(x, lambda xv, vv: xv + yi * vv, V[i])
+        r = residual_vec()
+        beta = r.norm()
+        converged = converged or beta <= tol * max(1.0, rs0)
+    return x, {
+        "iterations": it,
+        "residuals": np.array(history),
+        "converged": bool(converged),
+    }
+
+
+def minres(
+    A: PSparseMatrix,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """MINRES (Paige–Saunders) for symmetric — possibly *indefinite* —
+    operators: the gap between CG (needs definiteness) and GMRES (needs
+    O(m) stored vectors). Three-term Lanczos recurrence + one Givens
+    rotation per step; constant memory. Another member of the
+    IterativeSolvers.jl breadth the reference inherits
+    (src/Interfaces.jl:2752-2757). Dispatches to the single compiled
+    shard_map program on the TPU backend; the host loop below runs the
+    identical update sequence."""
+    from ..parallel.tpu import TPUBackend, tpu_minres
+
+    if isinstance(b.values.backend, TPUBackend):
+        return tpu_minres(A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose)
+
+    x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+
+    r = PVector.full(0.0, A.cols, dtype=b.dtype)
+    q0 = A @ x
+    _owned_zip(r, lambda _r, bv, qv: bv - qv, b, q0)
+    beta = r.norm()
+    rs0 = beta
+    history = [beta]
+    if beta == 0.0:
+        return x, {"iterations": 0, "residuals": np.array(history), "converged": True}
+
+    v = r / beta  # Lanczos vector v_1
+    v_old = PVector.full(0.0, A.cols, dtype=b.dtype)
+    w = PVector.full(0.0, A.cols, dtype=b.dtype)
+    w_old = PVector.full(0.0, A.cols, dtype=b.dtype)
+    # Givens state: rotations G_{k-1}, G_k applied to the tridiagonal
+    c_old, s_old = 1.0, 0.0
+    c, s = 1.0, 0.0
+    eta = beta
+    # beta_k is the tridiagonal sub/superdiagonal entry of the CURRENT
+    # column — zero at k=1 (the initial norm beta is not a matrix entry)
+    beta_k = 0.0
+    it = 0
+    res = beta
+    while res > tol * max(1.0, rs0) and it < maxiter:
+        # Lanczos: alpha = v'Av, next = Av - alpha v - beta v_old
+        av = A @ v
+        alpha = v.dot(av)
+        _owned_zip(av, lambda qv, vv, ov: qv - alpha * vv - beta_k * ov, v, v_old)
+        beta_new = av.norm()
+        # two old rotations applied to the new tridiagonal column
+        delta = c * alpha - c_old * s * beta_k
+        gamma2 = s * alpha + c_old * c * beta_k
+        gamma3 = s_old * beta_k
+        # new rotation
+        rho = np.hypot(delta, beta_new)
+        check(rho != 0.0, "minres: breakdown, zero subdiagonal pivot")
+        c_old, s_old = c, s
+        c, s = delta / rho, beta_new / rho
+        # update the solution direction: w_new = (v - γ2 w - γ3 w_old)/ρ.
+        # Rotate buffers first so the 2-ago direction's storage is the one
+        # overwritten (its stale content is the zip dest's own first arg)
+        g2, g3, rr = gamma2, gamma3, rho
+        w, w_old = w_old, w
+        _owned_zip(
+            w,
+            lambda w2ago, vv, wprev: (vv - g2 * wprev - g3 * w2ago) / rr,
+            v, w_old,
+        )
+        step = c * eta
+        _owned_update(x, lambda xv, wv: xv + step * wv, w)
+        eta = -s * eta
+        # advance Lanczos buffers; the next v lives on A.cols (av came out
+        # of the SpMV on A.rows) so the following SpMV can halo-update it
+        vn = PVector.full(0.0, A.cols, dtype=b.dtype)
+        s_beta = beta_new if beta_new > 0 else 1.0
+        _owned_zip(vn, lambda _v, qv: qv / s_beta, av)
+        v_old, v = v, vn
+        beta_k = beta_new
+        res = abs(eta)
+        history.append(res)
+        it += 1
+        if verbose:
+            print(f"minres it={it} residual={res:.3e}")
+        if beta_new == 0.0:  # invariant subspace: exact solve reached
+            break
+    return x, {
+        "iterations": it,
+        "residuals": np.array(history),
+        "converged": res <= tol * max(1.0, rs0),
+    }
+
+
 def bicgstab(
     A: PSparseMatrix,
     b: PVector,

@@ -1311,6 +1311,374 @@ def make_bicgstab_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
     return run
 
 
+def make_gmres_fn(
+    dA: DeviceMatrix, restart: int, tol: float, maxiter: int,
+    precond: bool = False,
+) -> Callable:
+    """Restarted GMRES(m) as ONE compiled shard_map program. The Arnoldi
+    basis is an (m+1, no_max) owned-region array per shard; basis dots run
+    as (m+1, no_max) @ (no_max,) matvecs — MXU work instead of the host's
+    sequential modified-Gram-Schmidt dot chain — with classical
+    Gram-Schmidt *reorthogonalized* (CGS2), whose stability matches MGS.
+    The (m+1) partial dots per orthogonalization ride ONE all-gather.
+    Givens rotations, the small triangular solve, and the restart logic
+    all live in the same program, so a whole restart cycle is a single
+    XLA dispatch loop iteration. With ``precond`` the loop is
+    left-preconditioned by an inverse-diagonal operand (owned slots)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+
+    m = int(restart)
+    # m < 1 would compile an inner loop that never advances `it`, leaving
+    # the outer while spinning on-device forever — reject it up front
+    check(m >= 1, "gmres: restart dimension must be >= 1")
+    mesh = dA.backend.mesh(dA.row_layout.P)
+    spec = dA.backend.parts_spec()
+    none_spec = jax.sharding.PartitionSpec()
+    body_spmv = _spmv_body(dA)
+    no_max = dA.row_layout.no_max
+    o0 = dA.row_layout.o0
+    ops = _matrix_operands(dA)
+    specs = jax.tree.map(lambda _: spec, ops)
+    H = int(min(maxiter + 1, 4096))
+
+    @jax.jit
+    def fn(b, x0, mv, mats_in):
+        def shard_fn(bs, x0s, mvs, ms):
+            bv, xv = bs[0], x0s[0]
+            mats = {k: v[0] for k, v in ms.items()}
+            mvv = mvs[0]
+            sl = slice(o0, o0 + no_max)
+            dt = bv.dtype
+
+            def ogather_sum(partial_):
+                return jnp.sum(jax.lax.all_gather(partial_, "parts"), axis=0)
+
+            def odot(a, b_):
+                return ogather_sum(jnp.sum(a * b_))
+
+            def apply_op(v_owned):
+                """owned (no_max,) -> M^{-1} A v owned (no_max,); the SpMV
+                halo exchange happens inside body_spmv."""
+                z = jnp.zeros_like(bv).at[sl].set(v_owned)
+                y, _ = body_spmv(z, mats)
+                w = y[sl]
+                if precond:
+                    w = mvv[sl] * w
+                return w
+
+            def residual_owned(x):
+                y, _ = body_spmv(x, mats)
+                r = bv[sl] - y[sl]
+                if precond:
+                    r = mvv[sl] * r
+                return r
+
+            r0 = residual_owned(xv)
+            rs0 = odot(r0, r0)
+            tolcmp = tol * jnp.maximum(1.0, jnp.sqrt(rs0))
+            hist = jnp.full(H, jnp.nan, dtype=dt).at[0].set(jnp.sqrt(rs0))
+
+            def inner_cond(st):
+                _V, _R, _cs, _sn, _g, j, it, _h, res, ok = st
+                return (j < m) & (it < maxiter) & ok & (res > tolcmp)
+
+            def inner_step(st):
+                V, R, cs, sn, g, j, it, hist, _res, _ok = st
+                vj = jax.lax.dynamic_slice_in_dim(V, j, 1, 0)[0]
+                w = apply_op(vj)
+                # CGS2: rows of V beyond j are exact zeros, so their dots
+                # vanish — no masking needed anywhere
+                h1 = ogather_sum(jnp.dot(V, w))
+                w = w - jnp.dot(h1, V)
+                h2 = ogather_sum(jnp.dot(V, w))
+                w = w - jnp.dot(h2, V)
+                h = h1 + h2
+                hj1 = jnp.sqrt(odot(w, w))
+
+                def rot(i, hv):
+                    hi, hi1 = hv[i], hv[i + 1]
+                    t = cs[i] * hi + sn[i] * hi1
+                    u = -sn[i] * hi + cs[i] * hi1
+                    on = i < j
+                    return (
+                        hv.at[i].set(jnp.where(on, t, hi))
+                        .at[i + 1].set(jnp.where(on, u, hi1))
+                    )
+
+                h = jax.lax.fori_loop(0, m, rot, h)
+                hjj = h[j]
+                rho = jnp.sqrt(hjj * hjj + hj1 * hj1)
+                safe = rho > 0
+                c_new = jnp.where(safe, hjj / jnp.where(safe, rho, 1.0), 1.0)
+                s_new = jnp.where(safe, hj1 / jnp.where(safe, rho, 1.0), 0.0)
+                cs = cs.at[j].set(c_new)
+                sn = sn.at[j].set(s_new)
+                col = h[:m].at[j].set(rho)
+                R = jax.lax.dynamic_update_slice(
+                    R, col[:, None], (jnp.int32(0), j)
+                )
+                gj = g[j]
+                g = g.at[j].set(c_new * gj).at[j + 1].set(-s_new * gj)
+                res = jnp.abs(g[j + 1])
+                ok = hj1 > 0  # hj1 == 0: lucky breakdown, exit after solve
+                vnext = jnp.where(ok, w / jnp.where(ok, hj1, 1.0), 0.0 * w)
+                V = jax.lax.dynamic_update_slice(
+                    V, vnext[None], (j + 1, jnp.int32(0))
+                )
+                it = it + 1
+                hist = hist.at[jnp.minimum(it, H - 1)].set(res)
+                return (V, R, cs, sn, g, j + 1, it, hist, res, ok)
+
+            def outer_cond(st):
+                _x, it, res, _h, ok = st
+                return (res > tolcmp) & (it < maxiter) & ok
+
+            def outer_step(st):
+                x, it, _res, hist, _ok = st
+                r = residual_owned(x)
+                beta = jnp.sqrt(odot(r, r))
+                bsafe = beta > 0
+                v0 = jnp.where(bsafe, r / jnp.where(bsafe, beta, 1.0), 0.0 * r)
+                V = jnp.zeros((m + 1, no_max), dtype=dt).at[0].set(v0)
+                R = jnp.zeros((m, m), dtype=dt)
+                cs = jnp.zeros(m, dtype=dt)
+                sn = jnp.zeros(m, dtype=dt)
+                g = jnp.zeros(m + 1, dtype=dt).at[0].set(beta)
+                V, R, cs, sn, g, j, it, hist, res, ok = jax.lax.while_loop(
+                    inner_cond, inner_step,
+                    (V, R, cs, sn, g, jnp.int32(0), it, hist,
+                     jnp.asarray(beta, dt), jnp.bool_(True)),
+                )
+                # solve the j x j system embedded in the m x m frame:
+                # unused columns are zero — patch their diagonal to 1 and
+                # zero their rhs so back-substitution leaves y there at 0
+                used = jnp.arange(m) < j
+                Rp = R + jnp.diag(jnp.where(used, 0.0, 1.0).astype(dt))
+                gp = jnp.where(used, g[:m], 0.0)
+                y = jax.scipy.linalg.solve_triangular(Rp, gp, lower=False)
+                x = x.at[sl].add(jnp.dot(y, V[:m]))
+                # the Givens residual estimate drifts from the true
+                # residual under roundoff; the restart recomputes honestly
+                r = residual_owned(x)
+                res = jnp.sqrt(odot(r, r))
+                hist = hist.at[jnp.minimum(it, H - 1)].set(res)
+                return (x, it, res, hist, ok)
+
+            x, it, res, hist, ok = jax.lax.while_loop(
+                outer_cond, outer_step,
+                (xv, jnp.int32(0), jnp.sqrt(rs0), hist, jnp.bool_(True)),
+            )
+            return x[None], res * res, rs0, it, hist
+
+        return shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, specs),
+            out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
+            check_vma=False,
+        )(b, x0, mv, mats_in)
+
+    shape = (dA.col_plan.layout.P, dA.col_plan.layout.W)
+
+    def run(b, x0, mv=None):
+        check(
+            tuple(b.shape) == shape and tuple(x0.shape) == shape,
+            f"gmres: vectors laid out {tuple(b.shape)}/{tuple(x0.shape)}, "
+            f"matrix expects {shape} — build vectors with the matrix's "
+            "col_layout",
+        )
+        if precond:
+            check(mv is not None and tuple(mv.shape) == shape,
+                  "gmres: preconditioner vector must share the matrix layout")
+        else:
+            check(
+                mv is None,
+                "this compiled GMRES was built without preconditioning — "
+                "rebuild with make_gmres_fn(..., precond=True) to use minv",
+            )
+        return fn(b, x0, b if mv is None else mv, ops)
+
+    return run
+
+
+def make_minres_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
+    """MINRES (Paige–Saunders) as ONE compiled shard_map program: the
+    three-term Lanczos recurrence plus one Givens rotation per step, for
+    symmetric — possibly indefinite — operators. Constant memory (no
+    stored basis); per iteration: one overlapped SpMV plus two
+    deterministic all-gather dots. The update sequence is identical to
+    the host loop in models/solvers.py, so iteration counts match the
+    sequential oracle the same way CG's do."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+
+    mesh = dA.backend.mesh(dA.row_layout.P)
+    spec = dA.backend.parts_spec()
+    none_spec = jax.sharding.PartitionSpec()
+    body_spmv = _spmv_body(dA)
+    no_max = dA.row_layout.no_max
+    o0 = dA.row_layout.o0
+    pdot = _pdot_factory(o0, no_max)
+    ops = _matrix_operands(dA)
+    specs = jax.tree.map(lambda _: spec, ops)
+    H = int(min(maxiter + 1, 4096))
+
+    @jax.jit
+    def fn(b, x0, m):
+        def shard_fn(bs, x0s, ms):
+            bv, xv = bs[0], x0s[0]
+            mats = {k: v[0] for k, v in ms.items()}
+            sl = slice(o0, o0 + no_max)
+            one = jnp.asarray(1.0, dtype=bv.dtype)
+
+            def spmv(z):
+                y, _ = body_spmv(z, mats)
+                return y
+
+            def owned(vals):
+                return jnp.zeros_like(xv).at[sl].set(vals)
+
+            q = spmv(xv)
+            r = owned(bv[sl] - q[sl])
+            rs0 = pdot(r, r)
+            beta0 = jnp.sqrt(rs0)
+            bsafe = beta0 > 0
+            v = owned(jnp.where(bsafe, r[sl] / jnp.where(bsafe, beta0, one), 0.0))
+            zero_v = jnp.zeros_like(xv)
+            hist = jnp.full(H, jnp.nan, dtype=bv.dtype).at[0].set(beta0)
+
+            def cond(st):
+                (_x, _v, _vo, _w, _wo, _co, _so, _c, _s, _eta, _bk, res,
+                 it, ok, _h) = st
+                return (
+                    (res > tol * jnp.maximum(1.0, beta0)) & (it < maxiter) & ok
+                )
+
+            def step(st):
+                (x, v, v_old, w, w_old, c_old, s_old, c, s, eta, beta_k,
+                 _res, it, ok, hist) = st
+                av = spmv(v)
+                alpha = pdot(v, av)
+                lan = owned(av[sl] - alpha * v[sl] - beta_k * v_old[sl])
+                beta_new = jnp.sqrt(pdot(lan, lan))
+                delta = c * alpha - c_old * s * beta_k
+                gamma2 = s * alpha + c_old * c * beta_k
+                gamma3 = s_old * beta_k
+                rho = jnp.sqrt(delta * delta + beta_new * beta_new)
+                # valid: this iteration's updates hold (rho == 0 is the
+                # hard-breakdown no-op the host loop raises on). Lucky
+                # breakdown (beta_new == 0 but rho != 0) is a VALID final
+                # iteration — apply it, then exit via ok.
+                valid = rho != 0
+                cont = valid & (beta_new > 0)
+                rho_s = jnp.where(valid, rho, one)
+                c_new = delta / rho_s
+                s_new = beta_new / rho_s
+                w_new = owned(
+                    (v[sl] - gamma2 * w[sl] - gamma3 * w_old[sl]) / rho_s
+                )
+                x_new = x.at[sl].add(c_new * eta * w_new[sl])
+                eta_new = -s_new * eta
+                nsafe = beta_new > 0
+                v_new = owned(
+                    jnp.where(
+                        nsafe, lan[sl] / jnp.where(nsafe, beta_new, one), 0.0
+                    )
+                )
+                res_new = jnp.abs(eta_new)
+                it_new = jnp.where(valid, it + 1, it)
+                keep = lambda new_, old_: jnp.where(valid, new_, old_)
+                hist_new = hist.at[jnp.minimum(it_new, H - 1)].set(
+                    keep(res_new, hist[jnp.minimum(it_new, H - 1)])
+                )
+                return (
+                    keep(x_new, x), keep(v_new, v), keep(v, v_old),
+                    keep(w_new, w), keep(w, w_old),
+                    keep(c, c_old), keep(s, s_old),
+                    keep(c_new, c), keep(s_new, s),
+                    keep(eta_new, eta),
+                    keep(beta_new, beta_k),
+                    keep(res_new, _res),
+                    it_new, ok & cont, hist_new,
+                )
+
+            state = (
+                xv, v, zero_v, zero_v, zero_v, one, 0 * one, one, 0 * one,
+                beta0, 0 * one, beta0, jnp.int32(0), jnp.bool_(True), hist,
+            )
+            (x, v, v_old, w, w_old, c_old, s_old, c, s, eta, beta_k, res,
+             it, ok, hist) = jax.lax.while_loop(cond, step, state)
+            return x[None], res * res, rs0, it, hist
+
+        return shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec, spec, specs),
+            out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
+            check_vma=False,
+        )(b, x0, m)
+
+    shape = (dA.col_plan.layout.P, dA.col_plan.layout.W)
+
+    def run(b, x0):
+        check(
+            tuple(b.shape) == shape and tuple(x0.shape) == shape,
+            f"minres: vectors laid out {tuple(b.shape)}/{tuple(x0.shape)}, "
+            f"matrix expects {shape} — build vectors with the matrix's "
+            "col_layout",
+        )
+        return fn(b, x0, ops)
+
+    return run
+
+
+def tpu_minres(
+    A: PSparseMatrix,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """Device MINRES (symmetric indefinite Krylov), one compiled program."""
+    backend = b.values.backend
+    check(isinstance(backend, TPUBackend), "tpu_minres needs a TPU-backend PVector")
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    dA = device_matrix(A, backend)
+    key = ("minres", float(tol), int(maxiter))
+    if key not in dA._cg_cache:
+        dA._cg_cache[key] = make_minres_fn(dA, tol, maxiter)
+    return _run_krylov(A, b, x0, tol, verbose, dA._cg_cache[key], name="minres")
+
+
+def tpu_gmres(
+    A: PSparseMatrix,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    restart: int = 30,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    minv: Optional[PVector] = None,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """Device restarted GMRES (see make_gmres_fn), one compiled program."""
+    backend = b.values.backend
+    check(isinstance(backend, TPUBackend), "tpu_gmres needs a TPU-backend PVector")
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    dA = device_matrix(A, backend)
+    key = ("gmres", int(restart), float(tol), int(maxiter), minv is not None)
+    if key not in dA._cg_cache:
+        dA._cg_cache[key] = make_gmres_fn(
+            dA, restart, tol, maxiter, precond=minv is not None
+        )
+    return _run_krylov(
+        A, b, x0, tol, verbose, dA._cg_cache[key], minv=minv, name="gmres"
+    )
+
+
 # ---------------------------------------------------------------------------
 # high-level entry points (used by solvers.cg dispatch and PVector methods)
 # ---------------------------------------------------------------------------
