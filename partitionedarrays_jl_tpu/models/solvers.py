@@ -299,6 +299,95 @@ def jacobi_preconditioner(A: PSparseMatrix) -> PVector:
     return minv
 
 
+def decouple_dirichlet(
+    A: PSparseMatrix, b: Optional[PVector] = None
+):
+    """Symmetrize a Dirichlet-identity system without changing its
+    solution. The FDM/FEM driver pattern imposes boundary conditions as
+    diagonal-only rows (reference: test/test_fdm.jl:52-81), which leaves
+    interior→boundary couplings in place — the full matrix is NOT
+    symmetric, which breaks MINRES off the boundary-consistent subspace,
+    V-cycle-preconditioned CG, and exact adjoints through
+    `make_diff_solve_fn` (its docstring warns about this exact shape).
+
+    This routine performs the classic lifting: every coupling A[i, j]
+    into a diagonal-only row j is zeroed (values only — the sparsity
+    pattern is preserved, so device lowerings and exchangers stay
+    valid), and, when ``b`` is given, the known boundary values
+    g_j = b_j / A_jj are folded into the right-hand side:
+    b̂_i = b_i − Σ_j A[i, j]·g_j. The returned (Â, b̂) system is
+    symmetric whenever the interior block of A is, and has the SAME
+    solution as (A, b). Diagonal-only rows with a zero diagonal
+    (structurally singular) are left untouched."""
+    if b is not None:
+        from ..parallel.prange import oids_are_equal
+
+        check(
+            oids_are_equal(b.rows, A.rows),
+            "decouple_dirichlet: b must live on A's row range",
+        )
+
+    # pass 1 over the nonzeros: flag = 1 at owned diagonal-only rows
+    # (nonzero diag, no off-diag values) and g = b/diag there; both
+    # exchanged so each part sees the values for its ghost columns too
+    flag = PVector.full(0.0, A.cols, dtype=A.dtype)
+    g = PVector.full(0.0, A.cols, dtype=A.dtype)
+
+    def _classify(ci, M, fv, gv, *b_args):
+        r = M.row_of_nz()
+        diag = np.zeros(M.shape[0], dtype=M.data.dtype)
+        offsum = np.zeros(M.shape[0], dtype=M.data.dtype)
+        on = M.indices == r
+        np.add.at(diag, r[on], M.data[on])
+        np.add.at(offsum, r[~on], np.abs(M.data[~on]))
+        no = ci.num_oids
+        only = ((offsum == 0) & (diag != 0))[:no]
+        _write_owned(ci, fv, only.astype(M.data.dtype))
+        if b_args:
+            bi, bvals = b_args
+            safe = np.where(diag[:no] == 0, 1.0, diag[:no])
+            bo = _owned(bi, np.asarray(bvals))
+            _write_owned(ci, gv, np.where(only, bo / safe, 0.0))
+
+    if b is not None:
+        map_parts(
+            _classify, A.cols.partition, A.values, flag.values, g.values,
+            b.rows.partition, b.values,
+        )
+        g.exchange()
+    else:
+        map_parts(_classify, A.cols.partition, A.values, flag.values, g.values)
+    flag.exchange()
+
+    # pass 2: one shared kill mask per part drives both the value strip
+    # and the rhs lift
+    b_hat = None if b is None else PVector.full(0.0, b.rows, dtype=b.dtype)
+
+    def _strip_and_lift(M, fv, *b_args):
+        r = M.row_of_nz()
+        kill = (np.asarray(fv)[M.indices] != 0) & (M.indices != r)
+        if b_args:
+            gv, bi, bvals, bhv = b_args
+            corr = np.zeros(M.shape[0], dtype=M.data.dtype)
+            np.add.at(
+                corr, r[kill], M.data[kill] * np.asarray(gv)[M.indices[kill]]
+            )
+            _write_owned(
+                bi, bhv, _owned(bi, np.asarray(bvals)) - corr[: bi.num_oids]
+            )
+        data = np.where(kill, 0.0, M.data)
+        return CSRMatrix(M.indptr, M.indices, data, M.shape)
+
+    if b is None:
+        values = map_parts(_strip_and_lift, A.values, flag.values)
+        return PSparseMatrix(values, A.rows, A.cols)
+    values = map_parts(
+        _strip_and_lift, A.values, flag.values, g.values,
+        b.rows.partition, b.values, b_hat.values,
+    )
+    return PSparseMatrix(values, A.rows, A.cols), b_hat
+
+
 def pcg(
     A: PSparseMatrix,
     b: PVector,
@@ -308,16 +397,22 @@ def pcg(
     maxiter: Optional[int] = None,
     verbose: bool = False,
 ) -> Tuple[PVector, dict]:
-    """Preconditioned CG with a diagonal preconditioner ``minv`` (inverse
-    diagonal over A.cols; defaults to `jacobi_preconditioner(A)`).
-    Dispatches to the single compiled device program on the TPU backend;
-    the host loop below runs the identical update sequence, so iteration
-    counts and residual histories agree across backends."""
+    """Preconditioned CG. ``minv`` is either an inverse-diagonal PVector
+    over A.cols (defaults to `jacobi_preconditioner(A)`) or a *callable*
+    ``minv(r) -> z`` applying any symmetric positive preconditioner — a
+    multigrid V-cycle (`GMGHierarchy` is callable), a polynomial smoother,
+    etc. The diagonal form dispatches to the single compiled device
+    program on the TPU backend; the host loop below runs the identical
+    update sequence, so iteration counts and residual histories agree
+    across backends. Callable preconditioners run the host loop on any
+    backend (each application is itself whatever the callable compiles
+    to)."""
     from ..parallel.tpu import TPUBackend, tpu_cg
 
     if minv is None:
         minv = jacobi_preconditioner(A)
-    if isinstance(b.values.backend, TPUBackend):
+    apply_minv = callable(minv)
+    if isinstance(b.values.backend, TPUBackend) and not apply_minv:
         return tpu_cg(A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose, minv=minv)
 
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
@@ -327,7 +422,14 @@ def pcg(
     q = A @ x
     _owned_update(r, lambda rv, qv: rv - qv, q)
     z = PVector.full(0.0, A.cols, dtype=b.dtype)
-    _owned_zip(z, lambda _z, mv, rv: mv * rv, minv, r)
+
+    def _apply_precond():
+        if apply_minv:
+            _owned_assign(z, minv(r))
+        else:
+            _owned_zip(z, lambda _z, mv, rv: mv * rv, minv, r)
+
+    _apply_precond()
     p = PVector.full(0.0, A.cols, dtype=b.dtype)
     _owned_assign(p, z)
     rs = r.dot(r)
@@ -342,7 +444,7 @@ def pcg(
         alpha = rz / pq
         _owned_update(x, lambda xv, pv: xv + alpha * pv, p)
         _owned_update(r, lambda rv, qv: rv - alpha * qv, q)
-        _owned_zip(z, lambda _z, mv, rv: mv * rv, minv, r)
+        _apply_precond()
         rz_new = r.dot(z)
         rs = r.dot(r)
         beta = rz_new / rz
@@ -453,7 +555,9 @@ def gmres(
             if verbose:
                 print(f"gmres it={it} residual={res:.3e}")
             if res <= tol * max(1.0, rs0) or hj1 == 0.0:
-                converged = res <= tol * max(1.0, rs0)
+                # the Givens estimate drifts from the true residual under
+                # roundoff — convergence is only declared from the honest
+                # recomputation after the x update (as the device path does)
                 break
             # the next basis vector lives on A.cols (w came out of the
             # SpMV on A.rows) so the following SpMV can halo-update it
@@ -470,7 +574,7 @@ def gmres(
                 _owned_update(x, lambda xv, vv: xv + yi * vv, V[i])
         r = residual_vec()
         beta = r.norm()
-        converged = converged or beta <= tol * max(1.0, rs0)
+        converged = beta <= tol * max(1.0, rs0)
     return x, {
         "iterations": it,
         "residuals": np.array(history),

@@ -422,7 +422,11 @@ def assemble_coo(
 
 
 def assemble_matrix_from_coo(
-    I: AbstractPData, J: AbstractPData, V: AbstractPData, rows0: PRange
+    I: AbstractPData,
+    J: AbstractPData,
+    V: AbstractPData,
+    rows0: PRange,
+    cols0: Optional[PRange] = None,
 ) -> "PSparseMatrix":
     """The standard FE/FD assembly pipeline: migrate off-owner triplets to
     their row owners (`assemble_coo`), drop the zeroed shipped copies and
@@ -431,7 +435,9 @@ def assemble_matrix_from_coo(
     test/test_fem_sa.jl:76-104 over src/Interfaces.jl:2406-2492).
 
     ``rows0`` must be ghost-free; the result's rows are ``rows0`` and its
-    cols are ``rows0`` extended by the discovered ghosts."""
+    cols are ``cols0`` (for rectangular operators — restriction/
+    prolongation transfers, least-squares blocks) or ``rows0`` when
+    omitted, extended by the discovered ghosts."""
     rows = add_gids(rows0, I)
     I2, J2, V2 = assemble_coo(I, J, V, rows)
 
@@ -443,7 +449,7 @@ def assemble_matrix_from_coo(
     I2 = map_parts(lambda k: k[0], kept)
     J2 = map_parts(lambda k: k[1], kept)
     V2 = map_parts(lambda k: k[2], kept)
-    cols = add_gids(rows0, J2)
+    cols = add_gids(rows0 if cols0 is None else cols0, J2)
     return PSparseMatrix.from_coo(I2, J2, V2, rows0, cols, ids="global")
 
 
