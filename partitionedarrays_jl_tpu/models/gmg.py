@@ -316,7 +316,18 @@ def gmg_solve(
     """Stationary V-cycle iteration: x ← x + Vcycle(b − A x) until the
     residual drops by `tol`. Grid-independent convergence: the iteration
     count stays O(10) as the grid is refined — the property no Krylov
-    method on its own can offer."""
+    method on its own can offer. On the TPU backend the ENTIRE iteration
+    — every level's SpMVs, halo permutes, smoothing sweeps, transfers,
+    and the dense coarse solve — runs as one compiled program
+    (parallel/tpu_gmg.py)."""
+    from ..parallel.tpu import TPUBackend
+
+    if isinstance(b.values.backend, TPUBackend):
+        from ..parallel.tpu_gmg import tpu_gmg_solve
+
+        return tpu_gmg_solve(
+            hierarchy, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose
+        )
     lvl0 = hierarchy.levels[0]
     A = lvl0.A
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)

@@ -412,8 +412,23 @@ def pcg(
     if minv is None:
         minv = jacobi_preconditioner(A)
     apply_minv = callable(minv)
-    if isinstance(b.values.backend, TPUBackend) and not apply_minv:
-        return tpu_cg(A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose, minv=minv)
+    if isinstance(b.values.backend, TPUBackend):
+        from .gmg import GMGHierarchy
+
+        if isinstance(minv, GMGHierarchy):
+            # the V-cycle preconditioner compiles INTO the CG loop: one
+            # program for the whole multigrid-preconditioned solve
+            from ..parallel.tpu_gmg import tpu_gmg_pcg
+
+            check(
+                minv.levels[0].A is A,
+                "pcg: the hierarchy's fine operator must be A itself",
+            )
+            return tpu_gmg_pcg(
+                minv, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose
+            )
+        if not apply_minv:
+            return tpu_cg(A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose, minv=minv)
 
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids

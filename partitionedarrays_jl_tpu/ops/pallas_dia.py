@@ -195,7 +195,8 @@ def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, y_ref, xs_ref, cs_ref,
                    xsem, csem, *, qr: Tuple[Tuple[int, int], ...],
                    kk: Tuple[int, ...], code_row: Tuple[int, ...],
                    n_blocks: int, block_rows: int, halo_rows: int,
-                   n_coded: int):
+                   n_coded: int,
+                   cls_pattern: Tuple[Tuple[bool, ...], ...] = None):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -240,32 +241,65 @@ def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, y_ref, xs_ref, cs_ref,
         x_dma(slot, j).wait()
         if n_coded:
             codes_dma(slot, j).wait()
-        acc = None
-        streams = {}  # packed byte stream -> int32 form, decoded once
-        for d, (q, r) in enumerate(qr):
+
+        def shift_of(q, r):
             a = xs_ref[slot, pl.ds(q, BR), :]
             if r == 0:
-                shifted = a
-            else:
-                b = xs_ref[slot, pl.ds(q + 1, BR), :]
-                shifted = jnp.concatenate([a[:, r:], b[:, :r]], axis=1)
-            if kk[d] == 1:
-                term = cb_ref[d, 0] * shifted
-            else:
-                # two diagonals share one int8 stream (4-bit codes, low
-                # nibble = even coded index). Upcast before bit ops — an
-                # i1/int8 born in 32-sublane tiling cannot be relaid out
-                # against f32 by Mosaic — and mask AFTER the shift so the
-                # int8 sign extension cannot leak into the code.
-                ci = code_row[d]
-                if ci // 2 not in streams:
-                    streams[ci // 2] = cs_ref[slot, ci // 2].astype(jnp.int32)
-                c = (streams[ci // 2] >> (4 * (ci % 2))) & 15
-                v = jnp.where(c == 1, cb_ref[d, 1], cb_ref[d, 0])
-                for k in range(2, kk[d]):
-                    v = jnp.where(c == k, cb_ref[d, k], v)
-                term = v * shifted
-            acc = term if acc is None else acc + term
+                return a
+            b = xs_ref[slot, pl.ds(q + 1, BR), :]
+            return jnp.concatenate([a[:, r:], b[:, :r]], axis=1)
+
+        if cls_pattern is not None:
+            # row-class fast path: rows fall into K = len(cls_pattern)
+            # stencil classes sharing ONE code stream. Instead of a
+            # K-deep select per diagonal, accumulate one candidate sum
+            # per class — skipping coefficients that are zero in every
+            # part (static pattern) — and select ONCE by class id. Each
+            # class sum runs the same ascending-offset term order as the
+            # host CSR kernel over that class's stored entries (the
+            # skipped terms are the host's absent entries), so agreement
+            # with the select path and the host oracle holds to
+            # FMA-contraction rounding — the documented determinism
+            # contract (docs/performance.md).
+            sh = [shift_of(q, r) for (q, r) in qr]
+            c = (cs_ref[slot, 0].astype(jnp.int32)) & 15
+            accs = []
+            for k, pat in enumerate(cls_pattern):
+                acc_k = None
+                for d in range(len(qr)):
+                    if pat[d]:
+                        # constant diagonals (kk == 1) store one slot,
+                        # replicated across classes by the staging code
+                        term = cb_ref[d, min(k, kk[d] - 1)] * sh[d]
+                        acc_k = term if acc_k is None else acc_k + term
+                if acc_k is None:
+                    acc_k = jnp.zeros_like(sh[0])
+                accs.append(acc_k)
+            acc = accs[0]
+            for k in range(1, len(accs)):
+                acc = jnp.where(c == k, accs[k], acc)
+        else:
+            acc = None
+            streams = {}  # packed byte stream -> int32 form, decoded once
+            for d, (q, r) in enumerate(qr):
+                shifted = shift_of(q, r)
+                if kk[d] == 1:
+                    term = cb_ref[d, 0] * shifted
+                else:
+                    # two diagonals share one int8 stream (4-bit codes, low
+                    # nibble = even coded index). Upcast before bit ops — an
+                    # i1/int8 born in 32-sublane tiling cannot be relaid out
+                    # against f32 by Mosaic — and mask AFTER the shift so the
+                    # int8 sign extension cannot leak into the code.
+                    ci = code_row[d]
+                    if ci // 2 not in streams:
+                        streams[ci // 2] = cs_ref[slot, ci // 2].astype(jnp.int32)
+                    c = (streams[ci // 2] >> (4 * (ci % 2))) & 15
+                    v = jnp.where(c == 1, cb_ref[d, 1], cb_ref[d, 0])
+                    for k in range(2, kk[d]):
+                        v = jnp.where(c == k, cb_ref[d, k], v)
+                    term = v * shifted
+                acc = term if acc is None else acc + term
         e = (
             (j - 1) * BR * LANES
             + jax.lax.broadcasted_iota(jnp.int32, (BR, LANES), 0) * LANES
@@ -289,13 +323,16 @@ def dia_coded_padded_pallas(
     plan: dict,
     total_rows: int,
     interpret: bool = False,
+    cls_pattern: Tuple[Tuple[bool, ...], ...] = None,
 ):
     """Full-vector coded SpMV on the padded layout: x is a whole
     (total_rows, 128) padded vector (owned at flat offset plan['o0'],
     zeros elsewhere up to the ghost region, which the kernel never
     reads); the result is a whole padded vector with the owned band
     computed and every other slot exactly zero. codes: (Dc, n_blocks*BR,
-    128) int8."""
+    128) int8. ``cls_pattern`` (row-class mode only, all coded diagonals
+    on stream 0): K per-class nonzero masks over the diagonals enabling
+    the per-class-accumulator decode — see `_padded_kernel`."""
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -303,6 +340,9 @@ def dia_coded_padded_pallas(
     D = codebook.shape[0]
     Dc = codes.shape[0]
     assert D == len(offsets) == len(kk) == len(code_row)
+    if cls_pattern is not None:
+        assert all(c <= 0 for c in code_row), "class mode uses stream 0 only"
+        assert all(len(p) == D for p in cls_pattern)
     BR, H, nB = plan["block_rows"], plan["halo_rows"], plan["n_blocks"]
     qr = tuple(divmod(H * LANES + off, LANES) for off in offsets)
     assert x.shape[0] == total_rows and total_rows % BR == 0
@@ -312,6 +352,7 @@ def dia_coded_padded_pallas(
         _padded_kernel, qr=qr, kk=tuple(int(k) for k in kk),
         code_row=tuple(int(c) for c in code_row), n_blocks=nB,
         block_rows=BR, halo_rows=H, n_coded=Dc,
+        cls_pattern=cls_pattern,
     )
     return pl.pallas_call(
         kernel,

@@ -29,6 +29,7 @@ scatter lanes so no dynamic shapes or bound checks reach the compiled code.
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 from typing import Callable, Optional, Tuple
 
@@ -436,6 +437,7 @@ class DeviceMatrix:
         "oo_vals", "oo_cols", "oh_vals", "oh_cols", "oh_rows", "oh_nnz",
         "dia_offsets", "dia_vals", "pallas_plan",
         "dia_mode", "dia_cb", "dia_no", "dia_codes", "dia_kk", "dia_code_row",
+        "dia_cls_pattern",
         "rows", "cols", "row_layout", "col_layout", "col_plan", "backend",
         "padded", "flops_per_spmv", "_cg_cache", "_ops_cache",
     )
@@ -516,7 +518,10 @@ class DeviceMatrix:
             (int(np.count_nonzero(m.row_lengths())) for m in oh), default=0
         )
         nb_max = max(nb_max, 1)
-        oh_rows = np.full((P, nb_max), col_layout.trash, dtype=INDEX_DTYPE)
+        # pad slots target the ROW frame's trash slot — the SpMV result
+        # lives in the row layout, whose width can be smaller than the
+        # column frame's for rectangular operators
+        oh_rows = np.full((P, nb_max), row_layout.trash, dtype=INDEX_DTYPE)
         oh_vals = np.zeros((P, nb_max, L_oh))
         oh_cols = np.full((P, nb_max, L_oh), col_layout.trash, dtype=INDEX_DTYPE)
         for p in range(P):
@@ -537,6 +542,7 @@ class DeviceMatrix:
         self.pallas_plan = None
         self.dia_cb = self.dia_no = self.dia_codes = None
         self.dia_kk = self.dia_code_row = None
+        self.dia_cls_pattern = None
         self.dia_vals = None  # set by the streaming-DIA staging below
         if det is None:
             return
@@ -600,6 +606,21 @@ class DeviceMatrix:
                 )
             else:
                 codes = codes.view(np.int8)
+            # row-class fast path (see ops/pallas_dia.py:_padded_kernel):
+            # per-class static nonzero masks over the diagonals. A slot is
+            # skippable only when zero in EVERY part (one compiled program
+            # serves all shards); K is capped so the K live accumulator
+            # blocks stay within VMEM pressure limits.
+            self.dia_cls_pattern = None
+            if (
+                cls_uniq is not None
+                and 1 < kmax <= 4
+                and os.environ.get("PA_TPU_CLASS_ACC", "1") != "0"
+            ):
+                self.dia_cls_pattern = tuple(
+                    tuple(bool(np.any(cb[:, d, k] != 0)) for d in range(D))
+                    for k in range(kmax)
+                )
             self.dia_cb = _stage(backend, cb.astype(dt), P)
             self.dia_no = _stage(
                 backend, noids.astype(np.int32).reshape(P, 1), P
@@ -913,6 +934,7 @@ def _spmv_body(dA: DeviceMatrix):
         y = dia_coded_padded_pallas(
             cb, no.astype(jnp.int32), codes, xv.reshape(-1, LANES), offsets,
             kk, code_row, pplan, xv.shape[0] // LANES, interpret=interpret,
+            cls_pattern=dA.dia_cls_pattern,
         )
         return y.reshape(-1)
 
@@ -948,7 +970,12 @@ def _spmv_body(dA: DeviceMatrix):
         if full is not None:
             y = full  # already a complete vector, pads exactly zero
         else:
-            y = jnp.zeros_like(xv).at[o0 : o0 + no_max].set(partial_)
+            # the product lives in the ROW-layout frame: for rectangular
+            # operators (restriction/prolongation transfers) the column
+            # frame can be narrower than the row count
+            y = jnp.zeros(layout.W, dtype=xv.dtype).at[
+                o0 : o0 + no_max
+            ].set(partial_)
         if dA.oh_nnz:
             # ghost contribution only on the boundary rows (padded rows
             # target the trash slot with exact-zero values)
@@ -1247,7 +1274,9 @@ def make_bicgstab_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
                 p = p0_.at[sl].set(
                     r0_[sl] + beta * (p0_[sl] - omega0_ * v0_[sl])
                 )
-                v = spmv(p)
+                # re-embed the row-frame product into the column frame:
+                # v rides the while_loop carry alongside col-frame vectors
+                v = jnp.zeros_like(p).at[sl].set(spmv(p)[sl])
                 rv = pdot(rhat, v)
                 ok = ok & (rv != 0)
                 alpha = jnp.where(ok, rho_new / jnp.where(rv == 0, one, rv), 0)
@@ -1432,13 +1461,14 @@ def make_gmres_fn(
                 return (V, R, cs, sn, g, j + 1, it, hist, res, ok)
 
             def outer_cond(st):
-                _x, it, res, _h, ok = st
+                _x, _r, it, res, _h, ok = st
                 return (res > tolcmp) & (it < maxiter) & ok
 
             def outer_step(st):
-                x, it, _res, hist, _ok = st
-                r = residual_owned(x)
-                beta = jnp.sqrt(odot(r, r))
+                # the residual vector rides the carry: it was honestly
+                # recomputed at the end of the previous cycle (or at loop
+                # entry), so the cycle does not re-derive it
+                x, r, it, beta, hist, _ok = st
                 bsafe = beta > 0
                 v0 = jnp.where(bsafe, r / jnp.where(bsafe, beta, 1.0), 0.0 * r)
                 V = jnp.zeros((m + 1, no_max), dtype=dt).at[0].set(v0)
@@ -1464,11 +1494,11 @@ def make_gmres_fn(
                 r = residual_owned(x)
                 res = jnp.sqrt(odot(r, r))
                 hist = hist.at[jnp.minimum(it, H - 1)].set(res)
-                return (x, it, res, hist, ok)
+                return (x, r, it, res, hist, ok)
 
-            x, it, res, hist, ok = jax.lax.while_loop(
+            x, r_c, it, res, hist, ok = jax.lax.while_loop(
                 outer_cond, outer_step,
-                (xv, jnp.int32(0), jnp.sqrt(rs0), hist, jnp.bool_(True)),
+                (xv, r0, jnp.int32(0), jnp.sqrt(rs0), hist, jnp.bool_(True)),
             )
             return x[None], res * res, rs0, it, hist
 

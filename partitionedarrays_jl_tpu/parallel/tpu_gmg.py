@@ -1,0 +1,427 @@
+"""Compiled geometric multigrid: the WHOLE V-cycle — every level's
+overlapped SpMV, halo `ppermute` rounds, Jacobi sweeps, inter-level
+transfers, and the dense coarse solve — as one `shard_map` program, and a
+V-cycle-preconditioned CG whose entire iteration (outer Krylov loop +
+inner multigrid preconditioner) is a single XLA dispatch.
+
+This is the TPU-native payoff of building the hierarchy from static
+plans: the host V-cycle in models/gmg.py issues ~#levels × #sweeps eager
+ops per cycle, while here XLA sees the full dataflow — every exchange is
+a static `ppermute` round schedule, every transfer a static slice copy —
+and can fuse/overlap across level boundaries.
+
+Layout invariants this file relies on (see DeviceLayout): all layouts
+over the same owned partition share `o0` and `no_max`, so moving a
+vector between the A/R/P operand frames of one level is a static
+owned-slice copy. The coarse solve is a replicated dense mat-vec against
+the host-precomputed inverse (every shard computes the identical coarse
+correction — deterministic by construction)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils.helpers import check
+from .pvector import PVector
+from .tpu import (
+    DeviceVector,
+    TPUBackend,
+    _matrix_operands,
+    _pdot_factory,
+    _spmv_body,
+    _stage,
+    device_matrix,
+)
+
+
+def _device_hierarchy(h, backend: TPUBackend):
+    """Stage every level of a models.gmg.GMGHierarchy for the device:
+    DeviceMatrix per operator, the inverse diagonal in the level's column
+    frame, and the dense coarse inverse + gid maps. Cached on the
+    hierarchy per backend."""
+    cache = getattr(h, "_device_cache", None)
+    if cache is None:
+        cache = h._device_cache = {}
+    key = id(backend)
+    if key in cache:
+        return cache[key]
+
+    from ..models.solvers import gather_psparse
+
+    levels = []
+    for lvl in h.levels:
+        dA = device_matrix(lvl.A, backend)
+        dR = device_matrix(lvl.R, backend)
+        dP = device_matrix(lvl.P, backend)
+        dinv = DeviceVector.from_pvector(lvl.dinv, backend, dA.col_layout).data
+        levels.append({"dA": dA, "dR": dR, "dP": dP, "dinv": dinv})
+
+    Ac = gather_psparse(h.coarse_A).toarray()
+    cinv = np.linalg.inv(Ac)
+    # per-part global positions of the coarsest owned slots (pad -> nc,
+    # the extra zero slot of the padded global vector)
+    cl = levels[-1]["dR"].row_layout  # coarsest rows layout
+    nc = h.coarse_A.rows.ngids
+    gmap = np.full((cl.P, cl.no_max), nc, dtype=np.int32)
+    for p, iset in enumerate(h.coarse_A.rows.partition.part_values()):
+        gmap[p, : iset.num_oids] = np.asarray(iset.oid_to_gid, dtype=np.int32)
+    dt = levels[0]["dinv"].dtype
+    staged = {
+        "levels": levels,
+        "cinv": np.asarray(cinv, dtype=dt),  # replicated, not sharded
+        "gmap": _stage(backend, gmap, cl.P),
+        "nc": int(nc),
+    }
+    cache[key] = staged
+    return staged
+
+
+def _gmg_operands(dh):
+    """The sharded operand pytree for the compiled programs (the coarse
+    inverse rides separately — it is replicated, not sharded)."""
+    return {
+        "lv": [
+            {
+                "A": _matrix_operands(l["dA"]),
+                "R": _matrix_operands(l["dR"]),
+                "P": _matrix_operands(l["dP"]),
+                "dinv": l["dinv"],
+            }
+            for l in dh["levels"]
+        ],
+        "gmap": dh["gmap"],
+    }
+
+
+def _vcycle_shard_body(h, dh):
+    """Returns vcycle(b_vec, mats, cinv) -> correction, both in level-0's
+    A column frame, usable inside any shard_map program. `mats` is the
+    per-shard (leading part axis stripped) form of `_gmg_operands`."""
+    import jax
+    import jax.numpy as jnp
+
+    bodies = [
+        {
+            "A": _spmv_body(l["dA"]),
+            "R": _spmv_body(l["dR"]),
+            "P": _spmv_body(l["dP"]),
+        }
+        for l in dh["levels"]
+    ]
+    pre, post, omega = h.pre, h.post, h.omega
+    nc = dh["nc"]
+    L = len(dh["levels"])
+
+    def vcycle(b_vec, mats, cinv):
+        def solve_level(level, b_l):
+            lv = dh["levels"][level]
+            m = mats["lv"][level]
+            # every operand frame has its OWN geometry: on real TPU the
+            # (coded, square) level operator takes the padded layout
+            # while the rectangular transfers take the compact one, so
+            # o0 differs between frames — every cross-frame move below
+            # names its source and destination slices explicitly
+            LA = lv["dA"].col_plan.layout  # level vectors live here
+            LAr = lv["dA"].row_layout  # A product frame
+            LR = lv["dR"].col_plan.layout  # restriction input frame
+            LRr = lv["dR"].row_layout  # restriction product frame
+            LP = lv["dP"].col_plan.layout  # prolongation input frame
+            LPr = lv["dP"].row_layout  # prolongation product frame
+            no = LA.no_max
+            sl = slice(LA.o0, LA.o0 + no)
+            dinv = m["dinv"]
+
+            def spmv_A(z):
+                # product re-embedded into the level's column frame
+                y, _ = bodies[level]["A"](z, m["A"])
+                return jnp.zeros_like(z).at[sl].set(
+                    y[LAr.o0 : LAr.o0 + no]
+                )
+
+            # pre-smooth from x = 0: the first sweep collapses to
+            # x = omega * dinv * b (A @ 0 == 0 exactly — same values the
+            # host loop computes, minus the wasted SpMV)
+            if pre == 0:
+                x = jnp.zeros_like(b_l)
+            else:
+                x = jnp.zeros_like(b_l).at[sl].set(omega * dinv[sl] * b_l[sl])
+            for _ in range(max(pre - 1, 0)):
+                q = spmv_A(x)
+                x = x.at[sl].add(omega * dinv[sl] * (b_l[sl] - q[sl]))
+            # residual into R's column frame
+            q = spmv_A(x)
+            r = jnp.zeros(LR.W, dtype=b_l.dtype).at[
+                LR.o0 : LR.o0 + no
+            ].set(b_l[sl] - q[sl])
+            rc, _ = bodies[level]["R"](r, m["R"])
+            # rc owned (coarse) sits in R's product frame
+            csl = slice(LRr.o0, LRr.o0 + LRr.no_max)
+            if level + 1 == L:
+                # dense coarse solve, replicated: gather every shard's
+                # owned coarse residual AND gid map (the gmap operand is
+                # sharded — each shard holds only its own row), place by
+                # gid, one mat-vec with the host-precomputed inverse,
+                # read back my slots. Identical on every shard.
+                rc_all = jax.lax.all_gather(rc[csl], "parts")  # (P, no_c)
+                gm_all = jax.lax.all_gather(mats["gmap"], "parts")
+                glob = jnp.zeros(nc + 1, dtype=b_l.dtype).at[
+                    gm_all.reshape(-1)
+                ].set(rc_all.reshape(-1))
+                ec_glob = jnp.concatenate(
+                    [cinv @ glob[:nc], jnp.zeros(1, dtype=b_l.dtype)]
+                )
+                ec_own = ec_glob[mats["gmap"]]
+            else:
+                nxt = dh["levels"][level + 1]["dA"].col_plan.layout
+                bc = jnp.zeros(nxt.W, dtype=b_l.dtype).at[
+                    nxt.o0 : nxt.o0 + nxt.no_max
+                ].set(rc[csl])
+                ec = solve_level(level + 1, bc)
+                ec_own = ec[nxt.o0 : nxt.o0 + nxt.no_max]
+            # prolongate: coarse correction into P's column frame; the
+            # fine product comes back in P's row frame
+            ecp = jnp.zeros(LP.W, dtype=b_l.dtype).at[
+                LP.o0 : LP.o0 + LP.no_max
+            ].set(ec_own)
+            ef, _ = bodies[level]["P"](ecp, m["P"])
+            x = x.at[sl].add(ef[LPr.o0 : LPr.o0 + no])
+            for _ in range(post):
+                q = spmv_A(x)
+                x = x.at[sl].add(omega * dinv[sl] * (b_l[sl] - q[sl]))
+            return x
+
+        return solve_level(0, b_vec)
+
+    return vcycle
+
+
+def _shard_ops(jax, ms):
+    """Strip the leading (length-1) shard axis from every leaf."""
+    return jax.tree.map(lambda v: v[0], ms)
+
+
+def make_gmg_solve_fn(h, backend: TPUBackend, tol: float, maxiter: int):
+    """The stationary V-cycle iteration x <- x + Vcycle(b - A x) as ONE
+    compiled program (the device form of models.gmg.gmg_solve)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+
+    dh = _device_hierarchy(h, backend)
+    dA0 = dh["levels"][0]["dA"]
+    mesh = backend.mesh(dA0.row_layout.P)
+    spec = backend.parts_spec()
+    none_spec = jax.sharding.PartitionSpec()
+    L0 = dA0.col_plan.layout
+    pdot = _pdot_factory(L0.o0, L0.no_max)
+    body_A0 = _spmv_body(dA0)
+    vcycle = _vcycle_shard_body(h, dh)
+    ops = _gmg_operands(dh)
+    specs = jax.tree.map(lambda _: spec, ops)
+    H = int(min(maxiter + 1, 4096))
+
+    @jax.jit
+    def fn(b, x0, cinv, m):
+        def shard_fn(bs, x0s, cinv_r, ms):
+            bv, xv = bs[0], x0s[0]
+            mats = _shard_ops(jax, ms)
+            no = L0.no_max
+            sl = slice(L0.o0, L0.o0 + no)
+            Lr = dA0.row_layout  # the A product frame (o0 may differ)
+
+            def residual(x):
+                y, _ = body_A0(x, mats["lv"][0]["A"])
+                return jnp.zeros_like(x).at[sl].set(
+                    bv[sl] - y[Lr.o0 : Lr.o0 + no]
+                )
+
+            r = residual(xv)
+            rs0 = pdot(r, r)
+            hist = jnp.full(H, jnp.nan, dtype=bv.dtype).at[0].set(
+                jnp.sqrt(rs0)
+            )
+
+            def cond(st):
+                _x, rs, it, _h = st
+                return (
+                    jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0))
+                ) & (it < maxiter)
+
+            def step(st):
+                x, _rs, it, hist = st
+                r = residual(x)
+                e = vcycle(r, mats, cinv_r)
+                x = x.at[sl].add(e[sl])
+                r = residual(x)
+                rs = pdot(r, r)
+                it = it + 1
+                hist = hist.at[jnp.minimum(it, H - 1)].set(jnp.sqrt(rs))
+                return (x, rs, it, hist)
+
+            x, rs, it, hist = jax.lax.while_loop(
+                cond, step, (xv, rs0, jnp.int32(0), hist)
+            )
+            return x[None], rs, rs0, it, hist
+
+        return shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec, spec, none_spec, specs),
+            out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
+            check_vma=False,
+        )(b, x0, cinv, m)
+
+    def run(b, x0):
+        return fn(b, x0, dh["cinv"], ops)
+
+    return run
+
+
+def make_gmg_pcg_fn(h, backend: TPUBackend, tol: float, maxiter: int):
+    """V-cycle-preconditioned CG as ONE compiled program: the classic
+    outer PCG recurrence with z = Vcycle(r) inlined — Krylov loop,
+    multigrid preconditioner, halo exchanges and coarse solve all inside
+    a single `lax.while_loop`."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+
+    dh = _device_hierarchy(h, backend)
+    dA0 = dh["levels"][0]["dA"]
+    mesh = backend.mesh(dA0.row_layout.P)
+    spec = backend.parts_spec()
+    none_spec = jax.sharding.PartitionSpec()
+    L0 = dA0.col_plan.layout
+    pdot = _pdot_factory(L0.o0, L0.no_max)
+    body_A0 = _spmv_body(dA0)
+    vcycle = _vcycle_shard_body(h, dh)
+    ops = _gmg_operands(dh)
+    specs = jax.tree.map(lambda _: spec, ops)
+    H = int(min(maxiter + 1, 4096))
+
+    @jax.jit
+    def fn(b, x0, cinv, m):
+        def shard_fn(bs, x0s, cinv_r, ms):
+            bv, xv = bs[0], x0s[0]
+            mats = _shard_ops(jax, ms)
+            no = L0.no_max
+            sl = slice(L0.o0, L0.o0 + no)
+            Lr = dA0.row_layout  # the A product frame (o0 may differ)
+
+            def spmv(z):
+                # product re-embedded into the column frame every vector
+                # of the loop lives in
+                y, _ = body_A0(z, mats["lv"][0]["A"])
+                return jnp.zeros_like(z).at[sl].set(
+                    y[Lr.o0 : Lr.o0 + no]
+                )
+
+            def apply_minv(r):
+                return vcycle(r, mats, cinv_r)
+
+            q = spmv(xv)
+            r = jnp.zeros_like(xv).at[sl].set(bv[sl] - q[sl])
+            z = apply_minv(r)
+            p = jnp.zeros_like(xv).at[sl].set(z[sl])
+            rs0 = pdot(r, r)
+            rz0 = pdot(r, z)
+            hist = jnp.full(H, jnp.nan, dtype=bv.dtype).at[0].set(
+                jnp.sqrt(rs0)
+            )
+
+            def cond(st):
+                _x, _r, _p, rz, rs, it, _h = st
+                go = (
+                    jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0))
+                ) & (it < maxiter)
+                return go & (rz != 0)
+
+            def step(st):
+                x, r, p, rz, rs, it, hist = st
+                q = spmv(p)
+                pq = pdot(p, q)
+                alpha = rz / pq
+                x = x.at[sl].add(alpha * p[sl])
+                r = r.at[sl].add(-alpha * q[sl])
+                z = apply_minv(r)
+                rz_new = pdot(r, z)
+                rs_new = pdot(r, r)
+                beta = rz_new / rz
+                p = p.at[sl].set(z[sl] + beta * p[sl])
+                hist = hist.at[jnp.minimum(it + 1, H - 1)].set(
+                    jnp.sqrt(rs_new)
+                )
+                return (x, r, p, rz_new, rs_new, it + 1, hist)
+
+            x, r, p, rz, rs, it, hist = jax.lax.while_loop(
+                cond, step, (xv, r, p, rz0, rs0, jnp.int32(0), hist)
+            )
+            return x[None], rs, rs0, it, hist
+
+        return shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec, spec, none_spec, specs),
+            out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
+            check_vma=False,
+        )(b, x0, cinv, m)
+
+    def run(b, x0):
+        return fn(b, x0, dh["cinv"], ops)
+
+    return run
+
+
+def _run_gmg(h, b, x0, tol, maxiter, verbose, make_fn, name):
+    from .tpu import _run_krylov
+
+    backend = b.values.backend
+    cache = getattr(h, "_fn_cache", None)
+    if cache is None:
+        cache = h._fn_cache = {}
+    key = (name, id(backend), float(tol), int(maxiter))
+    if key not in cache:
+        cache[key] = make_fn()
+    # the compiled fns share the Krylov (b, x0) -> 5-tuple contract, so
+    # the staging/lifting/info logic is _run_krylov's verbatim
+    return _run_krylov(
+        h.levels[0].A, b, x0, tol, verbose, cache[key], name=name
+    )
+
+
+def tpu_gmg_solve(
+    h,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    tol: float = 1e-8,
+    maxiter: int = 100,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """Compiled stationary V-cycle iteration (device form of gmg_solve)."""
+    backend = b.values.backend
+    check(isinstance(backend, TPUBackend), "tpu_gmg_solve needs the TPU backend")
+    return _run_gmg(
+        h, b, x0, tol, maxiter, verbose,
+        lambda: make_gmg_solve_fn(h, backend, tol, maxiter), "gmg",
+    )
+
+
+def tpu_gmg_pcg(
+    h,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """Compiled V-cycle-preconditioned CG (device form of
+    pcg(A, b, minv=hierarchy))."""
+    backend = b.values.backend
+    check(isinstance(backend, TPUBackend), "tpu_gmg_pcg needs the TPU backend")
+    if maxiter is None:
+        maxiter = 4 * int(h.levels[0].A.rows.ngids)
+    return _run_gmg(
+        h, b, x0, tol, maxiter, verbose,
+        lambda: make_gmg_pcg_fn(h, backend, tol, maxiter), "pcg+gmg",
+    )

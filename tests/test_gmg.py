@@ -156,3 +156,62 @@ def test_gmg_hierarchy_rejects_mismatched_dims():
         return True
 
     assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_compiled_vcycle_iteration_parity():
+    """On the TPU backend the whole V-cycle (and the V-cycle-preconditioned
+    CG) runs as ONE compiled program (parallel/tpu_gmg.py); iteration
+    counts must match the host oracle exactly, and solutions to rounding."""
+
+    def driver(parts):
+        ns = (16, 16, 16)
+        A, b, x_exact, _ = _poisson(parts, ns)
+        Ah, bh = pa.decouple_dirichlet(A, b)
+        h = pa.gmg_hierarchy(parts, Ah, ns, coarse_threshold=100, pre=2, post=2)
+        x1, i1 = pa.gmg_solve(h, bh, tol=1e-9)
+        x2, i2 = pa.pcg(Ah, bh, minv=h, tol=1e-9)
+        e1 = np.abs(pa.gather_pvector(x1) - pa.gather_pvector(x_exact)).max()
+        e2 = np.abs(pa.gather_pvector(x2) - pa.gather_pvector(x_exact)).max()
+        assert i1["converged"] and i2["converged"]
+        return i1["iterations"], i2["iterations"], e1, e2
+
+    s1, s2, es1, es2 = pa.prun(driver, pa.sequential, (2, 2, 2))
+    t1, t2, et1, et2 = pa.prun(driver, pa.tpu, (2, 2, 2))
+    assert (s1, s2) == (t1, t2), ((s1, s2), (t1, t2))
+    assert max(es1, es2, et1, et2) < 1e-6
+    np.testing.assert_allclose(et1, es1, rtol=1e-5)
+    np.testing.assert_allclose(et2, es2, rtol=1e-5)
+
+
+def test_compiled_vcycle_mixed_padded_compact_frames(monkeypatch):
+    """The real-TPU frame configuration: the square coded level operator
+    takes the PADDED kernel frame (o0 = one pad block) while the
+    rectangular transfers stay compact (o0 = 0). Forcing `_padded_for`
+    on the CPU mesh reproduces it with the Pallas kernel interpreted —
+    this is the layout mix the compiled V-cycle's cross-frame slices
+    must survive (a plain-CPU run cannot catch it: every frame is
+    compact there)."""
+    import importlib
+
+    tpu_mod = importlib.import_module("partitionedarrays_jl_tpu.parallel.tpu")
+    monkeypatch.setattr(tpu_mod, "_padded_for", lambda backend: True)
+
+    def driver(parts):
+        ns = (12, 12, 12)
+        A, b, x_exact, _ = _poisson(parts, ns)
+        Ah, bh = pa.decouple_dirichlet(A, b)
+        h = pa.gmg_hierarchy(parts, Ah, ns, coarse_threshold=100)
+        x, info = pa.gmg_solve(h, bh, tol=1e-8)
+        assert info["converged"], info
+        err = np.abs(pa.gather_pvector(x) - pa.gather_pvector(x_exact)).max()
+        assert err < 1e-6, err
+        # the level operator really must have taken the padded frame for
+        # this test to mean anything
+        from partitionedarrays_jl_tpu.parallel.tpu import device_matrix
+
+        dA0 = device_matrix(h.levels[0].A, parts.backend)
+        dP0 = device_matrix(h.levels[0].P, parts.backend)
+        assert dA0.padded and not dP0.padded
+        return True
+
+    assert pa.prun(driver, pa.tpu, (2, 2, 2))

@@ -135,3 +135,76 @@ def test_padded_kernel_matches_band_reference():
     rest = got.copy()
     rest[o0 : o0 + no] = 0
     assert not rest.any()
+
+
+def test_padded_kernel_class_accumulator_path():
+    """Row-class fast path: K per-class accumulators + ONE select must
+    reproduce the per-diagonal-select path bit-for-bit on rows whose
+    class coefficients are dense, and match the band reference even with
+    zero-skipped coefficients (the skipped terms are the host kernel's
+    absent entries)."""
+    from partitionedarrays_jl_tpu.ops.pallas_dia import (
+        PAD_BLOCK_ROWS,
+        dia_coded_padded_pallas,
+        pack_nibble_codes,
+        plan_dia_padded,
+    )
+
+    rng = np.random.default_rng(5)
+    offsets = (-LANES * 4, -1, 0, 1, LANES * 4)
+    D, K = len(offsets), 2
+    kk = (K,) * D
+    code_row = (0,) * D
+    BRL = PAD_BLOCK_ROWS * LANES
+    no = BRL + 3 * LANES + 9
+    plan = plan_dia_padded(offsets, no, n_coded=1)
+    assert plan is not None
+    o0, g0 = plan["o0"], plan["g0"]
+    # class 0: dense interior stencil; class 1: diagonal-only (Dirichlet)
+    cb = np.zeros((D, K), dtype=np.float32)
+    cb[:, 0] = rng.standard_normal(D).astype(np.float32)
+    cb[2, 1] = 1.0
+    cls_pattern = tuple(
+        tuple(bool(cb[d, k] != 0) for d in range(D)) for k in range(K)
+    )
+    codes = np.zeros((1, plan["code_len"]), dtype=np.uint8)
+    codes[0, :no] = rng.integers(0, K, no)
+    packed = pack_nibble_codes(codes)
+    total = plan["n_blocks"] + 3
+    x = np.zeros(total * BRL, dtype=np.float32)
+    x[o0 : o0 + no] = rng.standard_normal(no).astype(np.float32)
+
+    args = (
+        cb,
+        np.array([no], dtype=np.int32),
+        packed.reshape(packed.shape[0], -1, LANES),
+        x.reshape(-1, LANES),
+        offsets,
+        kk,
+        code_row,
+        plan,
+        total * PAD_BLOCK_ROWS,
+    )
+    y_fast = np.asarray(
+        dia_coded_padded_pallas(*args, interpret=True, cls_pattern=cls_pattern)
+    ).reshape(-1)
+    y_sel = np.asarray(
+        dia_coded_padded_pallas(*args, interpret=True)
+    ).reshape(-1)
+    # vs the select path: same per-row term sequence (minus exact-zero
+    # skipped terms), so agreement holds to FMA-contraction rounding —
+    # XLA may fuse the mul+add chains differently between the two
+    # lowerings, which moves individual terms by an ulp
+    np.testing.assert_allclose(y_fast, y_sel, rtol=5e-7, atol=5e-7)
+    # rows of the diagonal-only class take exactly one product — both
+    # paths must agree bitwise there (no accumulation to contract)
+    cls1 = np.zeros_like(y_fast, dtype=bool)
+    cls1[o0 : o0 + no] = codes[0, :no] == 1
+    np.testing.assert_array_equal(y_fast[cls1], y_sel[cls1])
+    # vs the band reference with decoded per-element values
+    vals = cb[np.arange(D)[:, None], codes[0, :no][None, :].astype(int)]
+    want = _band_reference(vals.astype(np.float32), x[o0 : o0 + no], offsets, no)
+    np.testing.assert_allclose(y_fast[o0 : o0 + no], want, rtol=1e-6, atol=1e-6)
+    rest = y_fast.copy()
+    rest[o0 : o0 + no] = 0
+    assert not rest.any()
