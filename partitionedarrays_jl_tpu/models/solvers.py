@@ -404,8 +404,11 @@ def pcg(
     etc. The diagonal form dispatches to the single compiled device
     program on the TPU backend; the host loop below runs the identical
     update sequence, so iteration counts and residual histories agree
-    across backends. Callable preconditioners run the host loop on any
-    backend (each application is itself whatever the callable compiles
+    across backends. A `GMGHierarchy` preconditioner on the TPU backend
+    compiles INTO the CG loop (one program for the whole multigrid-
+    preconditioned solve — parallel/tpu_gmg.py; the hierarchy must be
+    built on this exact `A`); any other callable runs the host loop on
+    any backend (each application is whatever the callable compiles
     to)."""
     from ..parallel.tpu import TPUBackend, tpu_cg
 

@@ -236,31 +236,32 @@ def make_gmg_solve_fn(h, backend: TPUBackend, tol: float, maxiter: int):
                     bv[sl] - y[Lr.o0 : Lr.o0 + no]
                 )
 
-            r = residual(xv)
-            rs0 = pdot(r, r)
+            r0 = residual(xv)
+            rs0 = pdot(r0, r0)
             hist = jnp.full(H, jnp.nan, dtype=bv.dtype).at[0].set(
                 jnp.sqrt(rs0)
             )
 
             def cond(st):
-                _x, rs, it, _h = st
+                _x, _r, rs, it, _h = st
                 return (
                     jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0))
                 ) & (it < maxiter)
 
             def step(st):
-                x, _rs, it, hist = st
-                r = residual(x)
+                # the residual rides the carry — computed once per
+                # iteration (like the host loop), not re-derived on entry
+                x, r, _rs, it, hist = st
                 e = vcycle(r, mats, cinv_r)
                 x = x.at[sl].add(e[sl])
                 r = residual(x)
                 rs = pdot(r, r)
                 it = it + 1
                 hist = hist.at[jnp.minimum(it, H - 1)].set(jnp.sqrt(rs))
-                return (x, rs, it, hist)
+                return (x, r, rs, it, hist)
 
-            x, rs, it, hist = jax.lax.while_loop(
-                cond, step, (xv, rs0, jnp.int32(0), hist)
+            x, r, rs, it, hist = jax.lax.while_loop(
+                cond, step, (xv, r0, rs0, jnp.int32(0), hist)
             )
             return x[None], rs, rs0, it, hist
 
