@@ -22,7 +22,7 @@ from ..utils.helpers import check
 from ..parallel.backends import map_parts
 from ..parallel.prange import PRange
 from ..parallel.psparse import PSparseMatrix, psparse_global_triplets
-from ..parallel.pvector import PVector, _owned, _write_owned
+from ..parallel.pvector import PVector, _assign_full, _owned, _write_owned
 
 
 def cg(
@@ -109,6 +109,79 @@ def gershgorin_bounds(A: PSparseMatrix) -> Tuple[float, float]:
     per = map_parts(_bounds, A.rows.partition, A.cols.partition, A.values)
     lo = preduce(min, map_parts(lambda t: t[0], per), init=np.inf)
     hi = preduce(max, map_parts(lambda t: t[1], per), init=-np.inf)
+    return float(lo), float(hi)
+
+
+def lanczos_bounds(
+    A: PSparseMatrix,
+    iters: int = 30,
+    seed: int = 0,
+    safety: Tuple[float, float] = (0.5, 1.05),
+) -> Tuple[float, float]:
+    """Extremal-eigenvalue estimates for symmetric ``A`` by a k-step
+    Lanczos recurrence (the practical companion to `gershgorin_bounds`,
+    whose lower bound is useless for Laplacians): returns
+    ``(ritz_min * safety[0], ritz_max * safety[1])``.
+
+    Semantics to respect: the largest Ritz value converges to λmax from
+    BELOW and the smallest to λmin from ABOVE, so the margins widen the
+    interval outward on BOTH ends, sign-aware: for an SPD spectrum the
+    defaults reproduce the classic (0.5·ritz_min, 1.05·ritz_max); for
+    indefinite or negative spectra the margins still push lo down and hi
+    up (a naive multiplicative scale would invert direction on negative
+    Ritz values). The start vector is seeded per part (deterministic
+    across runs and backends)."""
+    check(iters >= 2, "lanczos_bounds needs at least 2 iterations")
+
+    def _rand(iset):
+        rng = np.random.default_rng(seed + int(iset.part))
+        vals = np.zeros(iset.num_lids)
+        out = rng.standard_normal(iset.num_oids)
+        return _write_owned(iset, vals, out)
+
+    v = PVector(map_parts(_rand, A.cols.partition), A.cols)
+    nrm = v.norm()
+    check(nrm > 0, "lanczos_bounds: zero start vector")
+    v = v / nrm
+    v_old = PVector.full(0.0, A.cols, dtype=v.dtype)
+    beta = 0.0
+    alphas, betas = [], []
+    for _ in range(int(iters)):
+        av = A @ v
+        alpha = float(v.dot(av))
+        alphas.append(alpha)
+        bk = beta
+        vo = v_old
+        lan = PVector.full(0.0, A.cols, dtype=v.dtype)
+        _owned_zip(
+            lan, lambda _l, qv, vv, ov: qv - alpha * vv - bk * ov, av, v, vo
+        )
+        beta = float(lan.norm())
+        if beta <= 1e-14 * max(abs(a) for a in alphas):
+            break  # invariant subspace: the Ritz values are exact
+        betas.append(beta)
+        v_old, v = v, lan / beta
+    k = len(alphas)
+    T = np.diag(np.array(alphas))
+    if k > 1:
+        off = np.array(betas[: k - 1])
+        T += np.diag(off, 1) + np.diag(off, -1)
+    ritz = np.linalg.eigvalsh(T)
+    spread = max(float(ritz[-1] - ritz[0]), 1e-30)
+    r0, r1 = float(ritz[0]), float(ritz[-1])
+    # Lanczos converges fast at the dominant (large-|λ|) end and slowly
+    # at the near-zero end, so the strong margin (safety[0], a toward-
+    # zero halving that can never cross zero) goes to whichever extreme
+    # is near zero, and the mild outward inflation (safety[1]) to the
+    # dominant end(s). Indefinite spectra have two dominant ends.
+    s0, s1 = float(safety[0]), float(safety[1])
+    if r0 > 0.0:  # positive spectrum: min is the near-zero end
+        lo, hi = r0 * s0, r1 * s1
+    elif r1 < 0.0:  # negative spectrum: max is the near-zero end
+        lo, hi = r0 * s1, r1 * s0
+    else:  # indefinite (or an exactly-zero extreme): inflate both ends
+        lo = r0 * s1 if r0 != 0.0 else -(s1 - 1.0) * spread
+        hi = r1 * s1 if r1 != 0.0 else (s1 - 1.0) * spread
     return float(lo), float(hi)
 
 
@@ -299,6 +372,21 @@ def jacobi_preconditioner(A: PSparseMatrix) -> PVector:
     return minv
 
 
+def _spilu_factor(M: CSRMatrix, drop_tol, fill_factor):
+    """Threshold-ILU factorization of one local CSR block (None for an
+    empty block) — shared by the Schwarz-family preconditioners."""
+    from scipy.sparse import csr_matrix
+    from scipy.sparse.linalg import spilu
+
+    if M.shape[0] == 0 or M.nnz == 0:
+        return None
+    sp = csr_matrix((M.data, M.indices, M.indptr), shape=M.shape).tocsc()
+    kw = {"fill_factor": fill_factor}
+    if drop_tol is not None:
+        kw["drop_tol"] = drop_tol
+    return spilu(sp, **kw)
+
+
 def block_jacobi_ilu(A: PSparseMatrix, drop_tol=None, fill_factor=10):
     """Additive-Schwarz (non-overlapping block-Jacobi) preconditioner
     with a threshold incomplete-LU (ILUT, scipy ``spilu``) factorization
@@ -317,22 +405,12 @@ def block_jacobi_ilu(A: PSparseMatrix, drop_tol=None, fill_factor=10):
     practice, fine in the well-conditioned regime, but on severely
     ill-conditioned systems expect extra iterations (an exact-symmetry
     alternative is an incomplete Cholesky, which scipy does not ship)."""
-    from scipy.sparse import csr_matrix
-    from scipy.sparse.linalg import spilu
-
     from ..parallel.backends import get_part_ids
 
-    factors = []
-    for M in A.owned_owned_values.part_values():
-        if M.shape[0] == 0:
-            factors.append(None)
-            continue
-        sp = csr_matrix((M.data, M.indices, M.indptr), shape=M.shape).tocsc()
-        kw = {"fill_factor": fill_factor}
-        if drop_tol is not None:
-            kw["drop_tol"] = drop_tol
-        factors.append(spilu(sp, **kw))
-
+    factors = [
+        _spilu_factor(M, drop_tol, fill_factor)
+        for M in A.owned_owned_values.part_values()
+    ]
     parts = get_part_ids(A.values)
 
     def apply(r: PVector) -> PVector:
@@ -347,6 +425,91 @@ def block_jacobi_ilu(A: PSparseMatrix, drop_tol=None, fill_factor=10):
             per_part,
             parts, z.rows.partition, z.values, r.rows.partition, r.values,
         )
+        return z
+
+    return apply
+
+
+def additive_schwarz(
+    A: PSparseMatrix, mode: str = "asm", drop_tol=None, fill_factor=10
+):
+    """Overlapping-Schwarz preconditioner (one layer of overlap): each
+    part factors the extended block over its owned rows PLUS the rows of
+    its column-ghost layer — obtained by replicating owner rows along
+    the ghost graph (`exchange_coo`, the reference's
+    async_exchange!(I,J,V,rows) — src/Interfaces.jl:2494-2592). An
+    application fills the overlap with ONE halo exchange, solves each
+    extended block locally, and combines:
+
+    * ``mode='asm'`` (default): z = Σ_p Rᵀ_p B⁻¹_p R_p r — overlap
+      corrections are ASSEMBLED back (ghost→owner add). Symmetric for
+      symmetric blocks, the right companion for `pcg`.
+    * ``mode='ras'``: each part keeps only the owned slice of its
+      correction (restricted AS) — fewer iterations in practice but a
+      strongly NONsymmetric operator: use with `gmres` (the solver here
+      that takes a preconditioner for nonsymmetric systems), NOT with
+      CG (conjugacy collapses and PCG stalls).
+
+    Returns a callable for ``minv=``. The overlap typically cuts
+    iterations vs `block_jacobi_ilu` at the cost of factoring slightly
+    larger blocks."""
+    check(mode in ("asm", "ras"), "additive_schwarz: mode is 'asm' or 'ras'")
+    from ..parallel.backends import get_part_ids
+    from ..parallel.prange import add_gids
+    from ..parallel.psparse import exchange_coo, psparse_owned_triplets
+
+    # extended row range: owned rows + the column-ghost gids (overlap 1)
+    ghost_gids = map_parts(
+        lambda ci: np.asarray(ci.lid_to_gid)[
+            np.asarray(ci.lid_to_ohid) < 0
+        ],
+        A.cols.partition,
+    )
+    rows_ext = add_gids(A.rows, ghost_gids)
+    trip = psparse_owned_triplets(A)
+    I = map_parts(lambda t: t[0], trip)
+    J = map_parts(lambda t: t[1], trip)
+    V = map_parts(lambda t: t[2], trip)
+    I2, J2, V2 = exchange_coo(I, J, V, rows_ext)
+
+    # per part: square local block over the extended row set (couplings
+    # leaving the overlap region are dropped — standard RAS truncation)
+    factors = []
+    for iset, gi, gj, v in zip(
+        rows_ext.partition.part_values(),
+        I2.part_values(), J2.part_values(), V2.part_values(),
+    ):
+        nl = iset.num_lids
+        li = iset.gids_to_lids(np.asarray(gi, dtype=np.int64))
+        lj = iset.gids_to_lids(np.asarray(gj, dtype=np.int64))
+        keep = (li >= 0) & (lj >= 0)
+        if nl == 0 or not np.any(keep):
+            factors.append(None)
+            continue
+        B = compresscoo(li[keep], lj[keep], np.asarray(v)[keep], nl, nl)
+        factors.append(_spilu_factor(B, drop_tol, fill_factor))
+
+    parts = get_part_ids(A.values)
+
+    def apply(r: PVector) -> PVector:
+        # residual on the extended range, overlap filled by ONE exchange
+        re = PVector.full(0.0, rows_ext, dtype=r.dtype)
+        _owned_zip(re, lambda _e, rv: rv, r)
+        re.exchange()
+        ze = PVector.full(0.0, rows_ext, dtype=r.dtype)
+
+        def per_part(p, ei, ev, zev):
+            ilu = factors[int(p)]
+            if ilu is not None:
+                _assign_full(zev, ilu.solve(np.asarray(ev)))
+
+        map_parts(per_part, parts, re.rows.partition, re.values, ze.values)
+        if mode == "asm":
+            # ghost corrections flow back to their owners and add
+            ze.assemble()
+        # else RAS: overlap corrections are simply dropped
+        z = PVector.full(0.0, A.cols, dtype=r.dtype)
+        _owned_zip(z, lambda _z, zev: zev, ze)
         return z
 
     return apply
@@ -553,11 +716,15 @@ def gmres(
     preconditioned norm. Dispatches to the single compiled shard_map
     program on the TPU backend (classical Gram-Schmidt with
     reorthogonalization there — two MXU matmuls instead of a sequential
-    dot chain; host and device agree to rounding, not bit-exactly)."""
+    dot chain; host and device agree to rounding, not bit-exactly).
+    ``minv`` may also be a *callable* ``minv(r) -> z`` (e.g. a
+    `GMGHierarchy` or `block_jacobi_ilu`); callable preconditioners run
+    the host loop on any backend."""
     from ..parallel.tpu import TPUBackend, tpu_gmres
 
     check(restart >= 1, "gmres: restart dimension must be >= 1")
-    if isinstance(b.values.backend, TPUBackend):
+    apply_minv = callable(minv)
+    if isinstance(b.values.backend, TPUBackend) and not apply_minv:
         return tpu_gmres(
             A, b, x0=x0, restart=restart, tol=tol, maxiter=maxiter,
             minv=minv, verbose=verbose,
@@ -569,7 +736,11 @@ def gmres(
 
     def precond(v):
         """owned-region M^{-1} v, in place (identity when minv is None)."""
-        if minv is not None:
+        if minv is None:
+            return v
+        if apply_minv:
+            _owned_assign(v, minv(v))
+        else:
             _owned_update(v, lambda vv, mv: mv * vv, minv)
         return v
 

@@ -1,0 +1,83 @@
+"""Per-iteration cost of the compiled CG program on one real chip.
+
+The whole Krylov loop is one `lax.while_loop` program ending in host
+scalar fetches, so a K-iteration solve IS a K-step dependency chain —
+exactly the shape the relay-safe methodology wants (docs/performance.md):
+difference two iteration counts far apart, median of several rounds.
+
+Prints one line: per-iteration microseconds and the derived effective
+SpMV+vector-op throughput. Run on the default (real TPU) platform.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu.models import assemble_poisson
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        DeviceVector, TPUBackend, _b_on_cols_layout, device_matrix,
+        make_cg_fn,
+    )
+
+    n = int(os.environ.get("PA_BENCH_N", "192"))
+    backend = TPUBackend(devices=jax.devices()[:1])
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (n, n, n))
+        A.values = pa.map_parts(
+            lambda M: pa.CSRMatrix(
+                M.indptr, M.indices, (M.data / 16.0).astype(np.float32), M.shape
+            ),
+            A.values,
+        )
+        A.invalidate_blocks()
+        b.values = pa.map_parts(lambda x: np.asarray(x, np.float32), b.values)
+        x0.values = pa.map_parts(lambda x: np.asarray(x, np.float32), x0.values)
+        return A, b, x0
+
+    A, b, x0 = pa.prun(driver, backend, (1, 1, 1))
+    dA = device_matrix(A, backend)
+    db = _b_on_cols_layout(b, dA)
+    dx0 = DeviceVector.from_pvector(x0, backend, dA.col_layout)
+
+    K0, K1 = 100, 500
+    # compile each K-program ONCE; only the timed executions repeat
+    solves = {k: make_cg_fn(dA, tol=0.0, maxiter=k) for k in (K0, K1)}
+    for s in solves.values():  # warm: the solve ends in host scalars
+        _ = [float(v) for v in s(db.data, dx0.data, None)[1:4]]
+
+    def run_k(k):
+        solve = solves[k]
+        ts = []
+        for _i in range(5):
+            t0 = time.perf_counter()
+            out = solve(db.data, dx0.data, None)
+            _ = float(out[1])  # host fetch closes the chain
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    per_it = []
+    for _round in range(3):
+        t0, t1 = run_k(K0), run_k(K1)
+        per_it.append((t1 - t0) / (K1 - K0))
+    dt = float(np.median(per_it))
+    flops = dA.flops_per_spmv  # one SpMV per CG iteration
+    print(
+        f"cg_per_iteration_us={dt * 1e6:.1f} "
+        f"spmv_equiv_gflops={flops / dt / 1e9:.1f} "
+        f"(n={n}^3, f32, one chip; includes 2 dots + 3 axpys + halo no-op)"
+    )
+
+
+if __name__ == "__main__":
+    main()
