@@ -185,6 +185,157 @@ def lanczos_bounds(
     return float(lo), float(hi)
 
 
+def lobpcg(
+    A: PSparseMatrix,
+    nev: int = 1,
+    X0=None,
+    minv=None,
+    tol: float = 1e-6,
+    maxiter: int = 200,
+    largest: bool = False,
+    seed: int = 0,
+    verbose: bool = False,
+):
+    """Locally-optimal block preconditioned conjugate gradients: the
+    ``nev`` smallest (or largest) eigenpairs of symmetric ``A`` — the
+    distributed eigensolver the reference inherits from
+    IterativeSolvers.jl's `lobpcg` (src/Interfaces.jl:2752-2757 makes it
+    run on a PSparseMatrix). All tall-skinny algebra is PVector blocks
+    (owned dots + cross-part reduce); the 3·nev-dimensional
+    Rayleigh–Ritz eigenproblem is solved replicated on the host.
+    ``minv`` is an optional preconditioner: an inverse-diagonal PVector
+    or any callable ``minv(r) -> z`` (a `GMGHierarchy`,
+    `additive_schwarz(mode='asm')`, ...).
+
+    Returns ``(eigenvalues (nev,), eigenvectors: list of PVector,
+    info)``."""
+    check(nev >= 1, "lobpcg: nev must be >= 1")
+    m = int(nev)
+
+    def _rand_block():
+        out = []
+        for k in range(m):
+            def _rand(iset, k=k):
+                rng = np.random.default_rng(seed + 7919 * k + int(iset.part))
+                vals = np.zeros(iset.num_lids)
+                return _write_owned(iset, vals, rng.standard_normal(iset.num_oids))
+
+            out.append(PVector(map_parts(_rand, A.cols.partition), A.cols))
+        return out
+
+    X = [v.copy() for v in X0] if X0 is not None else _rand_block()
+    check(len(X) == m, "lobpcg: X0 must hold nev vectors")
+
+    def _apply_m(r):
+        if minv is None:
+            return r.copy()
+        if callable(minv):
+            return minv(r)
+        z = PVector.full(0.0, A.cols, dtype=r.dtype)
+        _owned_zip(z, lambda _z, mv, rv: mv * rv, minv, r)
+        return z
+
+    def _gram(U, V):
+        return np.array([[float(u.dot(v)) for v in V] for u in U])
+
+    def _combine(blocks, C):
+        """rows of C weight the concatenated blocks into new vectors."""
+        out = []
+        for j in range(C.shape[1]):
+            w = PVector.full(0.0, A.cols, dtype=X[0].dtype)
+            for c, v in zip(C[:, j], blocks):
+                if c != 0.0:
+                    cc = float(c)
+                    _owned_update(w, lambda wv, vv: wv + cc * vv, v)
+            out.append(w)
+        return out
+
+    def _orthonormalize(U):
+        """Gram-based orthonormalization (replicated small eigh)."""
+        G = _gram(U, U)
+        w, Q = np.linalg.eigh(G)
+        keep = w > w[-1] * 1e-12
+        C = Q[:, keep] / np.sqrt(w[keep])
+        return _combine(U, C)
+
+    X = _orthonormalize(X)
+    P: list = []
+    sgn = -1.0 if largest else 1.0
+    history = []
+    it = 0
+    lam = np.zeros(m)
+    converged = False
+    AX = None
+    while it < maxiter:
+        if AX is None:
+            AX = [A @ x for x in X]
+        lam = np.array([float(x.dot(ax)) for x, ax in zip(X, AX)])
+        R = []
+        for x, ax, l in zip(X, AX, lam):
+            r = PVector.full(0.0, A.cols, dtype=x.dtype)
+            ll = float(l)
+            _owned_zip(r, lambda _r, av, xv: av - ll * xv, ax, x)
+            R.append(r)
+        rnorms = np.array([float(r.norm()) for r in R])
+        history.append(rnorms.copy())
+        if verbose:
+            print(f"lobpcg it={it} max|r|={rnorms.max():.3e}")
+        if np.all(rnorms <= tol * np.maximum(1.0, np.abs(lam))):
+            converged = True
+            break
+        # normalize the search directions: near convergence W (and P)
+        # have tiny norms, and unscaled they fall below the whitening
+        # drop threshold — the span is scale-invariant, so unit-norm them
+        def _unit(vs):
+            out = []
+            for v in vs:
+                n = float(v.norm())
+                if n > 0:
+                    out.append(v / n)
+            return out
+
+        W = _unit([_apply_m(r) for r in R])
+        P = _unit(P)
+        S = X + W + P
+        # Rayleigh–Ritz on span(S): solve the (dense, replicated) pencil
+        AS = AX + [A @ v for v in S[m:]]
+        G_a = _gram(S, AS)
+        G_m = _gram(S, S)
+        # drop near-dependent directions for a stable generalized eigh
+        w_m, Q_m = np.linalg.eigh(G_m)
+        keep = w_m > w_m[-1] * 1e-10
+        B = Q_m[:, keep] / np.sqrt(w_m[keep])
+        w_r, Q_r = np.linalg.eigh(sgn * (B.T @ G_a @ B))
+        C = B @ Q_r[:, :m]  # coefficients of the new X in S
+        X_new = _combine(S, C)
+        # implicit P: the part of the new X not coming from the old X
+        C_p = C.copy()
+        C_p[:m, :] = 0.0
+        P = _combine(S, C_p)
+        X = X_new
+        # A-images combine with the SAME coefficients — saves m SpMVs
+        # (and their halo rounds) per iteration
+        AX = _combine(AS, C)
+        it += 1
+    if not converged:
+        # maxiter exit happens AFTER X was replaced: recompute the
+        # Rayleigh quotients so the returned (lam, X) pairs agree
+        AX = [A @ x for x in X]
+        lam = np.array([float(x.dot(ax)) for x, ax in zip(X, AX)])
+    order = np.argsort(sgn * lam)
+    lam = lam[order]
+    X = [X[int(k)] for k in order]
+    return (
+        lam,
+        X,
+        {
+            "iterations": it,
+            "residual_norms": np.array(history),
+            "converged": converged,
+        },
+    )
+
+
 def chebyshev_solve(
     A: PSparseMatrix,
     b: PVector,
@@ -378,8 +529,13 @@ def _spilu_factor(M: CSRMatrix, drop_tol, fill_factor):
     from scipy.sparse import csr_matrix
     from scipy.sparse.linalg import spilu
 
-    if M.shape[0] == 0 or M.nnz == 0:
+    if M.shape[0] == 0:
         return None
+    check(
+        M.nnz > 0,
+        "spilu: a part's block is structurally zero — the preconditioner "
+        "would silently map its residual to zero",
+    )
     sp = csr_matrix((M.data, M.indices, M.indptr), shape=M.shape).tocsc()
     kw = {"fill_factor": fill_factor}
     if drop_tol is not None:

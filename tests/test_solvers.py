@@ -28,6 +28,37 @@ def _err(x, x_exact):
     return float(np.linalg.norm(gather_pvector(x) - gather_pvector(x_exact)))
 
 
+def _stencil_1d(parts, N, diag, off_val=-1.0):
+    """Shared 1-D 3-point stencil fixture: tridiag(off_val, diag, off_val)
+    over a 1-D block partition — the known-spectrum operator
+    (eigenvalues diag + 2*off_val*cos(k*pi/(N+1))) used across the
+    spectrum/eigensolver tests."""
+    rows = pa.prange(parts, N)
+
+    def coo(i):
+        g = np.asarray(i.oid_to_gid)
+        I = [g]
+        J = [g]
+        V = [np.full(len(g), diag)]
+        for off in (-1, 1):
+            gj = g + off
+            k = (gj >= 0) & (gj < N)
+            I.append(g[k])
+            J.append(gj[k])
+            V.append(np.full(int(k.sum()), off_val))
+        return np.concatenate(I), np.concatenate(J), np.concatenate(V)
+
+    c = pa.map_parts(coo, rows.partition)
+    cols = pa.add_gids(rows, pa.map_parts(lambda t: t[1], c))
+    return pa.PSparseMatrix.from_coo(
+        pa.map_parts(lambda t: t[0], c),
+        pa.map_parts(lambda t: t[1], c),
+        pa.map_parts(lambda t: t[2], c),
+        rows, cols, ids="global",
+    )
+
+
+
 def test_pcg_converges_sequential():
     def driver(parts):
         A, b, x_exact, x0 = _setup(parts)
@@ -168,35 +199,8 @@ def test_chebyshev_solver_both_backends():
     spectrum bounds, against the CG solution."""
     N = 40
 
-    def spd(parts):
-        rows = pa.prange(parts, N)
-
-        def coo(i):
-            g = np.asarray(i.oid_to_gid)
-            I = [g]
-            J = [g]
-            V = [np.full(len(g), 2.0)]
-            for off in (-1, 1):
-                gj = g + off
-                k = (gj >= 0) & (gj < N)
-                I.append(g[k])
-                J.append(gj[k])
-                V.append(np.full(int(k.sum()), -1.0))
-            return np.concatenate(I), np.concatenate(J), np.concatenate(V)
-
-        c = pa.map_parts(coo, rows.partition)
-        cols = pa.add_gids(rows, pa.map_parts(lambda t: t[1], c))
-        return pa.PSparseMatrix.from_coo(
-            pa.map_parts(lambda t: t[0], c),
-            pa.map_parts(lambda t: t[1], c),
-            pa.map_parts(lambda t: t[2], c),
-            rows,
-            cols,
-            ids="global",
-        )
-
     def driver(parts):
-        A = spd(parts)
+        A = _stencil_1d(parts, N, 2.0)
         lmin = 2 - 2 * np.cos(np.pi / (N + 1))
         lmax = 2 - 2 * np.cos(N * np.pi / (N + 1))
         glo, ghi = pa.gershgorin_bounds(A)
@@ -327,29 +331,7 @@ def test_minres_symmetric_indefinite():
     sigma = 1.0  # spectrum of the stencil is (0, 4): strictly inside
 
     def driver(parts):
-        rows = pa.prange(parts, N)
-
-        def coo(i):
-            g = np.asarray(i.oid_to_gid)
-            I = [g]
-            J = [g]
-            V = [np.full(len(g), 2.0 - sigma)]
-            for off in (-1, 1):
-                gj = g + off
-                k = (gj >= 0) & (gj < N)
-                I.append(g[k])
-                J.append(gj[k])
-                V.append(np.full(int(k.sum()), -1.0))
-            return np.concatenate(I), np.concatenate(J), np.concatenate(V)
-
-        c = pa.map_parts(coo, rows.partition)
-        cols = pa.add_gids(rows, pa.map_parts(lambda t: t[1], c))
-        A = pa.PSparseMatrix.from_coo(
-            pa.map_parts(lambda t: t[0], c),
-            pa.map_parts(lambda t: t[1], c),
-            pa.map_parts(lambda t: t[2], c),
-            rows, cols, ids="global",
-        )
+        A = _stencil_1d(parts, N, 2.0 - sigma)
         # indefiniteness: eigenvalues 2-σ-2cos(kπ/(N+1)) straddle zero
         lo, hi = pa.gershgorin_bounds(A)
         assert lo < 0 < hi
@@ -410,29 +392,7 @@ def test_lanczos_bounds_bracket_known_spectrum():
     N = 40
 
     def driver(parts):
-        rows = pa.prange(parts, N)
-
-        def coo(i):
-            g = np.asarray(i.oid_to_gid)
-            I = [g]
-            J = [g]
-            V = [np.full(len(g), 2.0)]
-            for off in (-1, 1):
-                gj = g + off
-                k = (gj >= 0) & (gj < N)
-                I.append(g[k])
-                J.append(gj[k])
-                V.append(np.full(int(k.sum()), -1.0))
-            return np.concatenate(I), np.concatenate(J), np.concatenate(V)
-
-        c = pa.map_parts(coo, rows.partition)
-        cols = pa.add_gids(rows, pa.map_parts(lambda t: t[1], c))
-        A = pa.PSparseMatrix.from_coo(
-            pa.map_parts(lambda t: t[0], c),
-            pa.map_parts(lambda t: t[1], c),
-            pa.map_parts(lambda t: t[2], c),
-            rows, cols, ids="global",
-        )
+        A = _stencil_1d(parts, N, 2.0)
         lmin_true = 2 - 2 * np.cos(np.pi / (N + 1))
         lmax_true = 2 - 2 * np.cos(N * np.pi / (N + 1))
         lo, hi = pa.lanczos_bounds(A, iters=30)
@@ -527,44 +487,77 @@ def test_lanczos_bounds_indefinite_and_negative_spectra():
     Ritz values)."""
     N = 40
 
-    def stencil(parts, diag):
-        rows = pa.prange(parts, N)
-
-        def coo(i):
-            g = np.asarray(i.oid_to_gid)
-            I = [g]
-            J = [g]
-            V = [np.full(len(g), diag)]
-            for off in (-1, 1):
-                gj = g + off
-                k = (gj >= 0) & (gj < N)
-                I.append(g[k])
-                J.append(gj[k])
-                V.append(np.full(int(k.sum()), 1.0 if diag < 0 else -1.0))
-            return np.concatenate(I), np.concatenate(J), np.concatenate(V)
-
-        c = pa.map_parts(coo, rows.partition)
-        cols = pa.add_gids(rows, pa.map_parts(lambda t: t[1], c))
-        return pa.PSparseMatrix.from_coo(
-            pa.map_parts(lambda t: t[0], c),
-            pa.map_parts(lambda t: t[1], c),
-            pa.map_parts(lambda t: t[2], c),
-            rows, cols, ids="global",
-        )
 
     def driver(parts):
         th = np.pi / (N + 1)
         # negative-definite: spectrum of -(2,-1 stencil) = (-4, 0)
-        An = stencil(parts, -2.0)
+        An = _stencil_1d(parts, N, -2.0, off_val=1.0)
         lmin = -(2 - 2 * np.cos(N * th))
         lmax = -(2 - 2 * np.cos(th))
         lo, hi = pa.lanczos_bounds(An, iters=30)
         assert lo <= lmin and hi >= lmax, (lo, lmin, lmax, hi)
         # indefinite: spectrum of (1,-1 stencil) straddles zero
-        Ai = stencil(parts, 1.0)
+        Ai = _stencil_1d(parts, N, 1.0)
         lo2, hi2 = pa.lanczos_bounds(Ai, iters=30)
         assert lo2 < 0 < hi2
         assert lo2 <= 1 - 2 * np.cos(N * th) and hi2 >= 1 - 2 * np.cos(th)
         return True
 
     assert pa.prun(driver, pa.sequential, 4)
+
+
+def test_lobpcg_eigenpairs():
+    """Distributed LOBPCG vs the 1-D Laplacian's known spectrum: smallest
+    and largest blocks, plus preconditioned acceleration (the
+    IterativeSolvers.jl `lobpcg` parity item,
+    reference src/Interfaces.jl:2752-2757)."""
+    N = 40
+
+
+    def driver(parts):
+        A = _stencil_1d(parts, N, 2.0)
+        th = np.pi / (N + 1)
+        true_small = np.array([2 - 2 * np.cos(k * th) for k in (1, 2, 3)])
+        lam, X, info = pa.lobpcg(A, nev=3, tol=1e-6, maxiter=300)
+        assert info["converged"], info["iterations"]
+        np.testing.assert_allclose(lam, true_small, rtol=1e-7)
+        # the pairs satisfy A x = λ x to the requested tolerance
+        r0 = np.linalg.norm(
+            pa.gather_pvector(A @ X[0]) - lam[0] * pa.gather_pvector(X[0])
+        )
+        assert r0 < 1e-5, r0
+
+        true_large = np.array([2 - 2 * np.cos(k * th) for k in (N, N - 1)])
+        lamL, _, infoL = pa.lobpcg(A, nev=2, largest=True, tol=1e-6, maxiter=300)
+        assert infoL["converged"]
+        np.testing.assert_allclose(lamL, true_large, rtol=1e-7)
+
+        # a preconditioner accelerates markedly
+        m = pa.block_jacobi_ilu(A, fill_factor=20)
+        lam2, _, info2 = pa.lobpcg(A, nev=3, minv=m, tol=1e-6, maxiter=300)
+        assert info2["converged"]
+        assert info2["iterations"] < info["iterations"] // 2, (
+            info2["iterations"], info["iterations"],
+        )
+        np.testing.assert_allclose(lam2, true_small, rtol=1e-7)
+        return True
+
+    assert pa.prun(driver, pa.sequential, 4)
+
+
+def test_lobpcg_matches_lanczos_extremes():
+    """Consistency between the two spectrum tools on the Poisson
+    operator: LOBPCG's converged extremes must lie inside the
+    lanczos_bounds interval."""
+
+    def driver(parts):
+        A, b, _, _ = pa.assemble_poisson(parts, (8, 8))
+        Ah = pa.decouple_dirichlet(A)
+        lo, hi = pa.lanczos_bounds(Ah, iters=40)
+        lam_s, _, i1 = pa.lobpcg(Ah, nev=1, tol=1e-6, maxiter=400)
+        lam_l, _, i2 = pa.lobpcg(Ah, nev=1, largest=True, tol=1e-6, maxiter=400)
+        assert i1["converged"] and i2["converged"]
+        assert lo <= lam_s[0] <= lam_l[0] <= hi
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
