@@ -208,9 +208,23 @@ def lobpcg(
     `additive_schwarz(mode='asm')`, ...).
 
     Returns ``(eigenvalues (nev,), eigenvectors: list of PVector,
-    info)``."""
+    info)``. On the TPU backend (diagonal or no preconditioner) the
+    WHOLE eigensolve — block SpMVs, Gram matmuls, and the Rayleigh–Ritz
+    `eigh` — runs as one compiled program (parallel/tpu_lobpcg.py);
+    callable preconditioners run the host loop on any backend. The two
+    paths stabilize the basis differently (dropping vs masked penalty),
+    so they agree on eigenpairs, not on iteration counts."""
     check(nev >= 1, "lobpcg: nev must be >= 1")
     m = int(nev)
+    from ..parallel.tpu import TPUBackend
+
+    if isinstance(A.values.backend, TPUBackend) and not callable(minv):
+        from ..parallel.tpu_lobpcg import tpu_lobpcg
+
+        return tpu_lobpcg(
+            A, nev=m, X0=X0, minv=minv, tol=tol, maxiter=maxiter,
+            largest=largest, seed=seed, verbose=verbose,
+        )
 
     def _rand_block():
         out = []

@@ -215,3 +215,31 @@ def test_compiled_vcycle_mixed_padded_compact_frames(monkeypatch):
         return True
 
     assert pa.prun(driver, pa.tpu, (2, 2, 2))
+
+
+def test_gmg_deep_coarsening_empty_coarse_parts():
+    """Aggressive coarsening can leave coarse grids with fewer cells
+    than parts (empty parts on coarse levels); the hierarchy, the host
+    V-cycle, and the compiled program must all survive it with
+    iteration parity."""
+
+    def driver(parts):
+        ns = (17, 17)
+        A, b, x_exact, _ = _poisson(parts, ns)
+        Ah, bh = pa.decouple_dirichlet(A, b)
+        h = pa.gmg_hierarchy(parts, Ah, ns, coarse_threshold=8)
+        # the (3, 3) coarse grid split over a (2, 4) part grid leaves
+        # genuinely empty parts in one dimension
+        assert any(
+            i.num_oids == 0
+            for i in h.coarse_A.rows.partition.part_values()
+        )
+        x, info = pa.gmg_solve(h, bh, tol=1e-9)
+        assert info["converged"]
+        err = np.abs(pa.gather_pvector(x) - pa.gather_pvector(x_exact)).max()
+        assert err < 1e-6, err
+        return info["iterations"]
+
+    it_s = pa.prun(driver, pa.sequential, (2, 4))
+    it_t = pa.prun(driver, pa.tpu, (2, 4))
+    assert it_s == it_t, (it_s, it_t)
