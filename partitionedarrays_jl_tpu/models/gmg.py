@@ -209,14 +209,17 @@ class GMGHierarchy:
         omega: float = 0.8,
         pre: int = 1,
         post: int = 1,
+        cycle: str = "v",
     ):
         check(len(levels) >= 1, "hierarchy needs at least one fine level")
+        check(cycle in ("v", "w"), "cycle is 'v' or 'w'")
         self.levels = levels
         self.coarse_A = coarse_A
         self.coarse_solver = PLU(coarse_A)
         self.omega = float(omega)
         self.pre = int(pre)
         self.post = int(post)
+        self.cycle = cycle
 
     # -- smoothing: weighted Jacobi, all owned-region algebra ----------
     def _smooth(self, lvl: GMGLevel, b: PVector, x: PVector, sweeps: int):
@@ -232,7 +235,8 @@ class GMGHierarchy:
     def vcycle(
         self, b: PVector, x: Optional[PVector] = None, level: int = 0
     ) -> PVector:
-        """One V(pre, post)-cycle for A_level x = b; x defaults to zero.
+        """One multigrid cycle (V or W per ``self.cycle``; pre/post
+        smoothing sweeps) for A_level x = b; x defaults to zero.
         b lives on the level's row range (or anything owned-compatible);
         the result lives on the level's column range."""
         if level == len(self.levels):
@@ -248,6 +252,10 @@ class GMGHierarchy:
         _owned_zip(r, lambda _r, bv, qv: bv - qv, b, q)
         rc = lvl.R @ r
         ec = self.vcycle(rc, None, level + 1)
+        if self.cycle == "w" and level + 1 < len(self.levels):
+            # W-cycle: a second coarse-level pass, warm-started — the
+            # O(2^levels) coarse work buys a better coarse correction
+            ec = self.vcycle(rc, ec, level + 1)
         # lift the coarse correction onto P's column range and prolongate
         ec_p = PVector.full(0.0, lvl.P.cols, dtype=b.dtype)
         _owned_zip(ec_p, lambda _e, ev: ev, ec)
@@ -257,7 +265,8 @@ class GMGHierarchy:
         return x
 
     # callable-preconditioner contract: z = M^{-1} r by one zero-start
-    # V-cycle (symmetric for SPD A when pre == post).
+    # cycle (V or W; symmetric for SPD A when pre == post — the W-cycle's
+    # doubled coarse visits preserve symmetry, at O(2^levels) coarse cost).
     def __call__(self, r: PVector) -> PVector:
         return self.vcycle(r)
 
@@ -271,6 +280,7 @@ def gmg_hierarchy(
     omega: float = 0.8,
     pre: int = 1,
     post: int = 1,
+    cycle: str = "v",
 ) -> GMGHierarchy:
     """Build the variational hierarchy for a Cartesian-grid operator
     ``A`` over ``dims`` (A.rows must be the ghost-free Cartesian
@@ -302,7 +312,9 @@ def gmg_hierarchy(
         len(levels) >= 1,
         "gmg_hierarchy: grid too small to coarsen — use a direct solver",
     )
-    return GMGHierarchy(levels, A_l, omega=omega, pre=pre, post=post)
+    return GMGHierarchy(
+        levels, A_l, omega=omega, pre=pre, post=post, cycle=cycle
+    )
 
 
 def gmg_solve(

@@ -1,4 +1,4 @@
-"""Compiled geometric multigrid: the WHOLE V-cycle — every level's
+"""Compiled geometric multigrid: the WHOLE cycle (V or W) — every level's
 overlapped SpMV, halo `ppermute` rounds, Jacobi sweeps, inter-level
 transfers, and the dense coarse solve — as one `shard_map` program, and a
 V-cycle-preconditioned CG whose entire iteration (outer Krylov loop +
@@ -110,11 +110,12 @@ def _vcycle_shard_body(h, dh):
         for l in dh["levels"]
     ]
     pre, post, omega = h.pre, h.post, h.omega
+    w_cycle = h.cycle == "w"
     nc = dh["nc"]
     L = len(dh["levels"])
 
     def vcycle(b_vec, mats, cinv):
-        def solve_level(level, b_l):
+        def solve_level(level, b_l, x0_l=None):
             lv = dh["levels"][level]
             m = mats["lv"][level]
             # every operand frame has its OWN geometry: on real TPU the
@@ -139,14 +140,23 @@ def _vcycle_shard_body(h, dh):
                     y[LAr.o0 : LAr.o0 + no]
                 )
 
-            # pre-smooth from x = 0: the first sweep collapses to
-            # x = omega * dinv * b (A @ 0 == 0 exactly — same values the
-            # host loop computes, minus the wasted SpMV)
-            if pre == 0:
-                x = jnp.zeros_like(b_l)
+            # pre-smooth. From x = 0 (the V entry) the first sweep
+            # collapses to x = omega * dinv * b (A @ 0 == 0 exactly —
+            # same values the host loop computes, minus the wasted
+            # SpMV); a warm start (the second W-cycle pass) runs full
+            # sweeps.
+            if x0_l is None:
+                if pre == 0:
+                    x = jnp.zeros_like(b_l)
+                else:
+                    x = jnp.zeros_like(b_l).at[sl].set(
+                        omega * dinv[sl] * b_l[sl]
+                    )
+                sweeps_left = max(pre - 1, 0)
             else:
-                x = jnp.zeros_like(b_l).at[sl].set(omega * dinv[sl] * b_l[sl])
-            for _ in range(max(pre - 1, 0)):
+                x = x0_l
+                sweeps_left = pre
+            for _ in range(sweeps_left):
                 q = spmv_A(x)
                 x = x.at[sl].add(omega * dinv[sl] * (b_l[sl] - q[sl]))
             # residual into R's column frame
@@ -178,6 +188,9 @@ def _vcycle_shard_body(h, dh):
                     nxt.o0 : nxt.o0 + nxt.no_max
                 ].set(rc[csl])
                 ec = solve_level(level + 1, bc)
+                if w_cycle:
+                    # second coarse pass, warm-started (W-cycle γ = 2)
+                    ec = solve_level(level + 1, bc, ec)
                 ec_own = ec[nxt.o0 : nxt.o0 + nxt.no_max]
             # prolongate: coarse correction into P's column frame; the
             # fine product comes back in P's row frame
@@ -399,7 +412,7 @@ def tpu_gmg_solve(
     maxiter: int = 100,
     verbose: bool = False,
 ) -> Tuple[PVector, dict]:
-    """Compiled stationary V-cycle iteration (device form of gmg_solve)."""
+    """Compiled stationary cycle iteration (device form of gmg_solve)."""
     backend = b.values.backend
     check(isinstance(backend, TPUBackend), "tpu_gmg_solve needs the TPU backend")
     return _run_gmg(

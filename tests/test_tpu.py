@@ -323,3 +323,37 @@ def test_multihost_helpers_single_host():
         return True
 
     assert pa.prun(driver, pa.tpu, 4)
+
+
+def test_padded_frame_solver_parity(monkeypatch):
+    """Force the real-TPU padded kernel frame on the CPU mesh (Pallas
+    interpret mode): the compiled CG and SpMV must agree with the host
+    oracle exactly as the compact frame does. Without this, padded-frame
+    bugs are only observable on real hardware."""
+    import importlib
+
+    tpu_mod = importlib.import_module("partitionedarrays_jl_tpu.parallel.tpu")
+    monkeypatch.setattr(tpu_mod, "_padded_for", lambda backend: True)
+
+    from partitionedarrays_jl_tpu.parallel.tpu import TPUBackend, device_matrix
+
+    def driver(parts):
+        A, b, x_exact, x0 = pa.assemble_poisson(parts, (8, 8, 8))
+        x, info = pa.cg(A, b, x0=x0, tol=1e-9)
+        assert info["converged"]
+        err = np.abs(pa.gather_pvector(x) - pa.gather_pvector(x_exact)).max()
+        padded = (
+            device_matrix(A, parts.backend).padded
+            if isinstance(parts.backend, TPUBackend)
+            else None
+        )
+        return float(err), info["iterations"], padded
+
+    err_t, it_t, padded = pa.prun(driver, pa.tpu, (2, 2, 2))
+    # the padded DeviceMatrix must actually have been selected
+    assert padded
+    err_s, it_s, _ = pa.prun(driver, pa.sequential, (2, 2, 2))
+    assert it_s == it_t, (it_s, it_t)
+    # both solve errors are ~1e-9 magnitudes; compare to rounding noise
+    np.testing.assert_allclose(err_t, err_s, rtol=1e-5, atol=1e-12)
+    assert err_s < 1e-6 and err_t < 1e-6
