@@ -116,15 +116,20 @@ def main():
     dt = dts[1]
     gflops = flops / dt / 1e9
 
-    # sequential-oracle timing on the same local problem (NumPy CSR)
+    # sequential-oracle timing on the same local problem (NumPy CSR).
+    # Median of per-run times, not a mean: host contention (background
+    # compiles, the relay client) produces slow outliers that made the
+    # reported ratio swing 3x between otherwise identical runs.
     M = A.values.part_values()[0]
     xv = np.asarray(x.values.part_values()[0], dtype=dtype)
-    host_reps = max(1, min(5, reps // 10))
-    csr_spmv(M, xv)
-    t0 = time.perf_counter()
+    host_reps = max(3, min(7, reps // 7))
+    csr_spmv(M, xv)  # warm
+    host_ts = []
     for _ in range(host_reps):
+        t0 = time.perf_counter()
         csr_spmv(M, xv)
-    host_dt = (time.perf_counter() - t0) / host_reps
+        host_ts.append(time.perf_counter() - t0)
+    host_dt = statistics.median(host_ts)
     host_gflops = flops / host_dt / 1e9
 
     print(

@@ -15,6 +15,11 @@ import sys
 # The sitecustomize also pre-imports jax, so env vars alone are too late —
 # the config must be updated through the API as well.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The suite's error-path probes assert that contract checks raise; a
+# stripped-checks environment (PA_TPU_CHECKS=0) is a production tuning,
+# not a supported test configuration — pin checks on before the package
+# reads the flag at import.
+os.environ["PA_TPU_CHECKS"] = "1"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

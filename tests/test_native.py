@@ -1,5 +1,6 @@
 """Native planning accelerator: equivalence with the NumPy fallback and
 graceful degradation when disabled."""
+import os
 import shutil
 
 import numpy as np
@@ -7,6 +8,14 @@ import pytest
 
 import partitionedarrays_jl_tpu as pa
 from partitionedarrays_jl_tpu import native
+
+# these tests compare the native kernels against the fallback, so they
+# need the native layer; under PA_TPU_NATIVE=0 the rest of the suite IS
+# the fallback coverage
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PA_TPU_NATIVE") == "0",
+    reason="native layer disabled via PA_TPU_NATIVE=0",
+)
 
 
 def _with_native(enabled):
