@@ -616,9 +616,9 @@ def additive_schwarz(
       symmetric blocks, the right companion for `pcg`.
     * ``mode='ras'``: each part keeps only the owned slice of its
       correction (restricted AS) — fewer iterations in practice but a
-      strongly NONsymmetric operator: use with `gmres` (the solver here
-      that takes a preconditioner for nonsymmetric systems), NOT with
-      CG (conjugacy collapses and PCG stalls).
+      strongly NONsymmetric operator: use with `gmres` or `bicgstab`
+      (both take ``minv``), NOT with CG (conjugacy collapses and PCG
+      stalls).
 
     Returns a callable for ``minv=``. The overlap typically cuts
     iterations vs `block_jacobi_ilu` at the cost of factoring slightly
@@ -1095,17 +1095,41 @@ def bicgstab(
     x0: Optional[PVector] = None,
     tol: float = 1e-8,
     maxiter: Optional[int] = None,
+    minv=None,
     verbose: bool = False,
 ) -> Tuple[PVector, dict]:
     """BiCGStab for general (nonsymmetric) operators — the companion
     Krylov method the reference gets for free from IterativeSolvers.jl
     (src/Interfaces.jl:2752-2757 makes any of its solvers run
     distributed). Two SpMVs per iteration. Breakdown exits with
-    ``converged=False``. Compiled to one program on the TPU backend."""
+    ``converged=False``. Compiled to one program on the TPU backend.
+
+    ``minv`` enables RIGHT preconditioning (solve A·M⁻¹ y = b, x = M⁻¹y —
+    residuals stay the TRUE residuals, unlike left preconditioning):
+    either an inverse-diagonal PVector over A.cols, or any callable
+    ``minv(v) -> z`` (`additive_schwarz(mode='ras')` is the natural
+    companion for nonsymmetric systems). The diagonal form compiles into
+    the device program; callables run the host loop on any backend."""
     from ..parallel.tpu import TPUBackend, tpu_bicgstab
 
-    if isinstance(b.values.backend, TPUBackend):
-        return tpu_bicgstab(A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose)
+    apply_minv = callable(minv)
+    if isinstance(b.values.backend, TPUBackend) and not apply_minv:
+        return tpu_bicgstab(
+            A, b, x0=x0, tol=tol, maxiter=maxiter, minv=minv, verbose=verbose
+        )
+
+    def precond(v):
+        """K⁻¹ v as a fresh vector on A.cols; the identity returns v
+        itself (aliasing is safe — the unpreconditioned loop used the
+        direction vectors directly)."""
+        if minv is None:
+            return v
+        z = PVector.full(0.0, A.cols, dtype=b.dtype)
+        if apply_minv:
+            _owned_assign(z, minv(v))
+        else:
+            _owned_zip(z, lambda _z, mv, vv: mv * vv, minv, v)
+        return z
 
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
@@ -1135,18 +1159,21 @@ def bicgstab(
         beta = (rho_new / rho) * (alpha / omega)
         ww = omega
         _owned_zip(p, lambda pv, rv, vv: rv + beta * (pv - ww * vv), r, v)
-        v = A @ p
+        phat = precond(p)  # right preconditioning: v = A K^-1 p
+        v = A @ phat
         rv_ = rhat.dot(v)
         if rv_ == 0.0:
             ok = False
             break
         alpha = rho_new / rv_
         _owned_zip(s, lambda _s, rv, vv: rv - alpha * vv, r, v)
-        t = A @ s
+        shat = precond(s)
+        t = A @ shat
         tt = t.dot(t)
         omega = 0.0 if tt == 0.0 else t.dot(s) / tt
         aa, oo_ = alpha, omega
-        _owned_zip(x, lambda xv, pv, sv: xv + aa * pv + oo_ * sv, p, s)
+        # the solution update uses the PRECONDITIONED directions
+        _owned_zip(x, lambda xv, pv, sv: xv + aa * pv + oo_ * sv, phat, shat)
         _owned_zip(r, lambda _r, sv, tv: sv - oo_ * tv, s, t)
         rho = rho_new
         rs = r.dot(r)

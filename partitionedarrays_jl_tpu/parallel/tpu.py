@@ -1215,12 +1215,16 @@ def make_diff_solve_fn(
     return f
 
 
-def make_bicgstab_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
+def make_bicgstab_fn(
+    dA: DeviceMatrix, tol: float, maxiter: int, precond: bool = False
+) -> Callable:
     """BiCGStab as ONE compiled shard_map program — the Krylov method for
     nonsymmetric operators (CG's companion in the solver suite). Two
     overlapped SpMVs per iteration; deterministic fixed-order dots;
     breakdown (rho or omega denominators hitting zero) exits the loop with
-    converged=False instead of poisoning the state with NaNs."""
+    converged=False instead of poisoning the state with NaNs. With
+    ``precond`` the loop is RIGHT-preconditioned against an
+    inverse-diagonal operand (residuals stay true residuals)."""
     import jax
     import jax.numpy as jnp
     from jax import shard_map
@@ -1237,15 +1241,22 @@ def make_bicgstab_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
     H = int(min(maxiter + 1, 4096))
 
     @jax.jit
-    def fn(b, x0, m):
-        def shard_fn(bs, x0s, ms):
+    def fn(b, x0, mv, m):
+        def shard_fn(bs, x0s, mvs, ms):
             bv, xv = bs[0], x0s[0]
             mats = {k: v[0] for k, v in ms.items()}
+            mvv = mvs[0]
             sl = slice(o0, o0 + no_max)
 
             def spmv(z):
                 y, _ = body_spmv(z, mats)
                 return y
+
+            def apply_k(z):
+                """right preconditioner K^-1 z in the column frame."""
+                if not precond:
+                    return z
+                return jnp.zeros_like(z).at[sl].set(mvv[sl] * z[sl])
 
             def owned(vec, vals):
                 return jnp.zeros_like(vec).at[sl].set(vals)
@@ -1274,19 +1285,23 @@ def make_bicgstab_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
                 p = p0_.at[sl].set(
                     r0_[sl] + beta * (p0_[sl] - omega0_ * v0_[sl])
                 )
-                # re-embed the row-frame product into the column frame:
-                # v rides the while_loop carry alongside col-frame vectors
-                v = jnp.zeros_like(p).at[sl].set(spmv(p)[sl])
+                # right preconditioning: v = A K^-1 p. Re-embed the
+                # row-frame product into the column frame: v rides the
+                # while_loop carry alongside col-frame vectors
+                phat = apply_k(p)
+                v = jnp.zeros_like(p).at[sl].set(spmv(phat)[sl])
                 rv = pdot(rhat, v)
                 ok = ok & (rv != 0)
                 alpha = jnp.where(ok, rho_new / jnp.where(rv == 0, one, rv), 0)
                 s = owned(r0_, r0_[sl] - alpha * v[sl])
-                t = spmv(s)
+                shat = apply_k(s)
+                t = spmv(shat)
                 tt = pdot(t, t)
                 omega = jnp.where(
                     tt == 0, 0, pdot(t, s) / jnp.where(tt == 0, one, tt)
                 )
-                x = x0_.at[sl].add(alpha * p[sl] + omega * s[sl])
+                # the solution update uses the PRECONDITIONED directions
+                x = x0_.at[sl].add(alpha * phat[sl] + omega * shat[sl])
                 r = owned(r0_, s[sl] - omega * t[sl])
                 rs_new = pdot(r, r)
                 hist_new = hist.at[jnp.minimum(it + 1, H - 1)].set(
@@ -1321,21 +1336,30 @@ def make_bicgstab_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
         return shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(spec, spec, specs),
+            in_specs=(spec, spec, spec, specs),
             out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
             check_vma=False,
-        )(b, x0, m)
+        )(b, x0, mv, m)
 
     shape = (dA.col_plan.layout.P, dA.col_plan.layout.W)
 
-    def run(b, x0):
+    def run(b, x0, mv=None):
         check(
             tuple(b.shape) == shape and tuple(x0.shape) == shape,
             f"bicgstab: vectors laid out {tuple(b.shape)}/{tuple(x0.shape)}, "
             f"matrix expects {shape} — build vectors with the matrix's "
             "col_layout",
         )
-        return fn(b, x0, ops)
+        if precond:
+            check(mv is not None and tuple(mv.shape) == shape,
+                  "bicgstab: preconditioner vector must share the matrix layout")
+        else:
+            check(
+                mv is None,
+                "this compiled BiCGStab was built without preconditioning — "
+                "rebuild with make_bicgstab_fn(..., precond=True) to use minv",
+            )
+        return fn(b, x0, b if mv is None else mv, ops)
 
     return run
 
@@ -1925,17 +1949,19 @@ def tpu_bicgstab(
     x0: Optional[PVector] = None,
     tol: float = 1e-8,
     maxiter: Optional[int] = None,
+    minv: Optional[PVector] = None,
     verbose: bool = False,
 ) -> Tuple[PVector, dict]:
-    """Device BiCGStab (nonsymmetric Krylov), one compiled program."""
+    """Device BiCGStab (nonsymmetric Krylov), one compiled program;
+    ``minv`` is an optional inverse-diagonal RIGHT preconditioner."""
     backend = b.values.backend
     check(
         isinstance(backend, TPUBackend), "tpu_bicgstab needs a TPU-backend PVector"
     )
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
     dA = device_matrix(A, backend)
-    solve = _krylov_fn_for(dA, "bicgstab", tol, maxiter)
-    return _run_krylov(A, b, x0, tol, verbose, solve, name="bicgstab")
+    solve = _krylov_fn_for(dA, "bicgstab", tol, maxiter, precond=minv is not None)
+    return _run_krylov(A, b, x0, tol, verbose, solve, minv=minv, name="bicgstab")
 
 
 def _krylov_fn_for(
@@ -1946,7 +1972,9 @@ def _krylov_fn_for(
         if method == "cg":
             dA._cg_cache[key] = make_cg_fn(dA, tol, maxiter, precond=precond)
         else:
-            dA._cg_cache[key] = make_bicgstab_fn(dA, tol, maxiter)
+            dA._cg_cache[key] = make_bicgstab_fn(
+                dA, tol, maxiter, precond=precond
+            )
     return dA._cg_cache[key]
 
 
