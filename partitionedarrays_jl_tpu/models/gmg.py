@@ -42,7 +42,11 @@ def _interp_1d(f: np.ndarray, nc: int):
     returns (k0, w0, k1, w1) with fine value = w0*coarse[k0] + w1*coarse[k1].
     Even fine points coincide with coarse point f/2 (w1 = 0); odd points
     average their two coarse neighbors; the trailing odd point of an
-    even-sized dimension clamps to its left coarse neighbor."""
+    even-sized dimension simply DROPS the out-of-range weight. The drop
+    (rather than a clamp redirect) keeps P identical to the factored
+    form P = S·E (fine-grid interpolation stencil · even-point
+    embedding) that the device transfer kernels apply — see
+    `interp_stencil_cartesian`."""
     even = (f % 2) == 0
     k0 = np.where(even, f // 2, (f - 1) // 2)
     k1 = np.where(even, k0, (f + 1) // 2)
@@ -50,7 +54,6 @@ def _interp_1d(f: np.ndarray, nc: int):
     w1 = np.where(even, 0.0, 0.5)
     clamp = k1 > nc - 1
     k1 = np.where(clamp, k0, k1)
-    w0 = np.where(clamp & ~even, 1.0, w0)
     w1 = np.where(clamp, 0.0, w1)
     return k0, w0, k1, w1
 
@@ -183,16 +186,74 @@ def restriction_from(P: PSparseMatrix, coarse_rows: PRange) -> PSparseMatrix:
     return assemble_matrix_from_coo(I, J, V, coarse_rows, cols0=P.rows)
 
 
+def interp_stencil_cartesian(
+    nfs: Sequence[int], fine_rows: PRange
+) -> PSparseMatrix:
+    """The SQUARE fine-grid interpolation stencil S of the factorization
+    P = S·E: S[f, g] = Π_d w(g_d − f_d) with w(0) = 1, w(±1) = 1/2,
+    truncated at the grid boundary. Constant coefficients per offset, so
+    the device lowering takes the coded-DIA path with kk = 1 — NO code
+    streams, stencil-speed SpMV. Because w is symmetric, Sᵀ = S and the
+    same operator serves prolongation (S · embed) and restriction
+    (extract · S). 3^d-point band; reference-free (this factorization is
+    the TPU-native answer to the reference's absent multigrid)."""
+    nfs = tuple(int(n) for n in nfs)
+    dim = len(nfs)
+
+    def _local(iset):
+        g = np.asarray(iset.oid_to_gid, dtype=np.int64)
+        coords = np.unravel_index(g, nfs)
+        I_out, J_out, V_out = [], [], []
+        for mask in range(3**dim):
+            m, deltas = mask, []
+            for _ in range(dim):
+                deltas.append(m % 3 - 1)
+                m //= 3
+            w = 0.5 ** sum(1 for d in deltas if d != 0)
+            nb = [c + d for c, d in zip(coords, deltas)]
+            ok = np.ones(len(g), dtype=bool)
+            for d in range(dim):
+                ok &= (nb[d] >= 0) & (nb[d] < nfs[d])
+            gj = np.ravel_multi_index(
+                tuple(np.where(ok, nbd, 0) for nbd in nb), nfs
+            )
+            I_out.append(g[ok])
+            J_out.append(gj[ok])
+            V_out.append(np.full(int(ok.sum()), w))
+        return (
+            np.concatenate(I_out),
+            np.concatenate(J_out),
+            np.concatenate(V_out),
+        )
+
+    coo = map_parts(_local, fine_rows.partition)
+    I = map_parts(lambda c: c[0], coo)
+    J = map_parts(lambda c: c[1], coo)
+    V = map_parts(lambda c: c[2], coo)
+    cols = add_gids(fine_rows, J)
+    return PSparseMatrix.from_coo(I, J, V, fine_rows, cols, ids="global")
+
+
 class GMGLevel:
     """One fine level: its operator, the transfer operators to the next
-    (coarser) level, and the inverse diagonal for Jacobi smoothing."""
+    (coarser) level, the grid dims, and the inverse diagonal for Jacobi
+    smoothing."""
 
-    __slots__ = ("A", "P", "R", "dinv")
+    __slots__ = ("A", "P", "R", "dinv", "nfs", "ncs")
 
-    def __init__(self, A: PSparseMatrix, P: PSparseMatrix, R: PSparseMatrix):
+    def __init__(
+        self,
+        A: PSparseMatrix,
+        P: PSparseMatrix,
+        R: PSparseMatrix,
+        nfs: Sequence[int] = None,
+        ncs: Sequence[int] = None,
+    ):
         self.A = A
         self.P = P
         self.R = R
+        self.nfs = tuple(int(n) for n in nfs) if nfs is not None else None
+        self.ncs = tuple(int(n) for n in ncs) if ncs is not None else None
         self.dinv = jacobi_preconditioner(A)
 
 
@@ -306,7 +367,7 @@ def gmg_hierarchy(
         P = interpolation_cartesian(nfs, ncs, A_l.rows, coarse_rows)
         R = restriction_from(P, coarse_rows)
         A_c = galerkin_cartesian(A_l, nfs, ncs, coarse_rows)
-        levels.append(GMGLevel(A_l, P, R))
+        levels.append(GMGLevel(A_l, P, R, nfs=nfs, ncs=ncs))
         A_l, nfs = A_c, ncs
     check(
         len(levels) >= 1,

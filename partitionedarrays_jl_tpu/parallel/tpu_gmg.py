@@ -50,48 +50,115 @@ def _device_hierarchy(h, backend: TPUBackend):
     from ..models.solvers import gather_psparse
 
     levels = []
-    for lvl in h.levels:
+    for li, lvl in enumerate(h.levels):
         dA = device_matrix(lvl.A, backend)
-        dR = device_matrix(lvl.R, backend)
-        dP = device_matrix(lvl.P, backend)
         dinv = DeviceVector.from_pvector(lvl.dinv, backend, dA.col_layout).data
-        levels.append({"dA": dA, "dR": dR, "dP": dP, "dinv": dinv})
+        entry = {"dA": dA, "dinv": dinv}
+        st = _stage_structured_transfer(h, li, backend)
+        if st is not None:
+            entry.update(st)
+        else:
+            # fallback: the assembled rectangular transfers (gather-bound
+            # on real TPUs — see docs/performance.md)
+            entry["dR"] = device_matrix(lvl.R, backend)
+            entry["dP"] = device_matrix(lvl.P, backend)
+        levels.append(entry)
 
     Ac = gather_psparse(h.coarse_A).toarray()
     cinv = np.linalg.inv(Ac)
     # per-part global positions of the coarsest owned slots (pad -> nc,
     # the extra zero slot of the padded global vector)
-    cl = levels[-1]["dR"].row_layout  # coarsest rows layout
+    coarse_isets = h.coarse_A.rows.partition.part_values()
+    P_parts = len(coarse_isets)
+    ncmax = max((i.num_oids for i in coarse_isets), default=0)
     nc = h.coarse_A.rows.ngids
-    gmap = np.full((cl.P, cl.no_max), nc, dtype=np.int32)
-    for p, iset in enumerate(h.coarse_A.rows.partition.part_values()):
+    gmap = np.full((P_parts, max(ncmax, 1)), nc, dtype=np.int32)
+    for p, iset in enumerate(coarse_isets):
         gmap[p, : iset.num_oids] = np.asarray(iset.oid_to_gid, dtype=np.int32)
     dt = levels[0]["dinv"].dtype
     staged = {
         "levels": levels,
         "cinv": np.asarray(cinv, dtype=dt),  # replicated, not sharded
-        "gmap": _stage(backend, gmap, cl.P),
+        "gmap": _stage(backend, gmap, P_parts),
         "nc": int(nc),
     }
     cache[key] = staged
     return staged
 
 
+def _stage_structured_transfer(h, li: int, backend: TPUBackend):
+    """Stage the factored transfer P = S·E for level `li`: the square
+    constant-coefficient interpolation stencil S (coded-DIA fast path)
+    plus the even-point embedding index maps and the ghost→owner
+    assembly plan. Returns None — falling back to the assembled
+    P/R matrices — when the level has no grid dims or an embedded coarse
+    point falls outside a part's fine halo (pathological partitions).
+
+    Why: the assembled rectangular transfers lower to per-row column
+    gathers, which run element-at-a-time on TPU and dominated the
+    measured V-cycle cost 100:1 (docs/performance.md); the factored form
+    replaces 8N gathered elements with one stencil SpMV plus N/8
+    scatter/gather elements."""
+    from ..models.gmg import interp_stencil_cartesian
+    from .tpu import DeviceExchangePlan
+
+    lvl = h.levels[li]
+    if lvl.nfs is None or lvl.ncs is None:
+        return None
+    coarse_rows = (
+        h.levels[li + 1].A.rows if li + 1 < len(h.levels) else h.coarse_A.rows
+    )
+    S = interp_stencil_cartesian(lvl.nfs, lvl.A.rows)
+    dS = device_matrix(S, backend)
+    LS = dS.col_plan.layout
+    nc_max = max(
+        (i.num_oids for i in coarse_rows.partition.part_values()), default=0
+    )
+    emb = np.full((LS.P, max(nc_max, 1)), LS.trash, dtype=np.int32)
+    for p, (ci, fi) in enumerate(
+        zip(
+            coarse_rows.partition.part_values(),
+            S.cols.partition.part_values(),
+        )
+    ):
+        kg = np.asarray(ci.oid_to_gid, dtype=np.int64)
+        if len(kg) == 0:
+            continue
+        kc = np.unravel_index(kg, lvl.ncs)
+        fg = np.ravel_multi_index(tuple(2 * c for c in kc), lvl.nfs)
+        lids = fi.gids_to_lids(fg)
+        if (lids < 0).any():
+            return None  # embedded point beyond this part's fine halo
+        emb[p, : len(kg)] = LS.lid_slots[p][lids]
+    rev = DeviceExchangePlan(S.cols.exchanger.reverse(), LS)
+    return {
+        "dS": dS,
+        "rev_plan": rev,
+        "emb_host": emb,
+        "emb": _stage(backend, emb, LS.P),
+        "rsi": _stage(backend, rev.snd_idx, LS.P),
+        "rsm": _stage(backend, rev.snd_mask, LS.P),
+        "rri": _stage(backend, rev.rcv_idx, LS.P),
+    }
+
+
 def _gmg_operands(dh):
     """The sharded operand pytree for the compiled programs (the coarse
     inverse rides separately — it is replicated, not sharded)."""
-    return {
-        "lv": [
-            {
-                "A": _matrix_operands(l["dA"]),
-                "R": _matrix_operands(l["dR"]),
-                "P": _matrix_operands(l["dP"]),
-                "dinv": l["dinv"],
-            }
-            for l in dh["levels"]
-        ],
-        "gmap": dh["gmap"],
-    }
+    lv = []
+    for l in dh["levels"]:
+        entry = {"A": _matrix_operands(l["dA"]), "dinv": l["dinv"]}
+        if "dS" in l:
+            entry.update(
+                S=_matrix_operands(l["dS"]),
+                emb=l["emb"], rsi=l["rsi"], rsm=l["rsm"], rri=l["rri"],
+            )
+        else:
+            entry.update(
+                R=_matrix_operands(l["dR"]), P=_matrix_operands(l["dP"])
+            )
+        lv.append(entry)
+    return {"lv": lv, "gmap": dh["gmap"]}
 
 
 def _vcycle_shard_body(h, dh):
@@ -101,14 +168,19 @@ def _vcycle_shard_body(h, dh):
     import jax
     import jax.numpy as jnp
 
-    bodies = [
-        {
-            "A": _spmv_body(l["dA"]),
-            "R": _spmv_body(l["dR"]),
-            "P": _spmv_body(l["dP"]),
-        }
-        for l in dh["levels"]
-    ]
+    from .tpu import _shard_exchange
+
+    bodies = []
+    for l in dh["levels"]:
+        b = {"A": _spmv_body(l["dA"])}
+        if "dS" in l:
+            b["S"] = _spmv_body(l["dS"])
+            b["exch_add"] = _shard_exchange(l["rev_plan"], "add")
+            b["exch_set"] = _shard_exchange(l["dS"].col_plan, "set")
+        else:
+            b["R"] = _spmv_body(l["dR"])
+            b["P"] = _spmv_body(l["dP"])
+        bodies.append(b)
     pre, post, omega = h.pre, h.post, h.omega
     w_cycle = h.cycle == "w"
     nc = dh["nc"]
@@ -125,10 +197,7 @@ def _vcycle_shard_body(h, dh):
             # names its source and destination slices explicitly
             LA = lv["dA"].col_plan.layout  # level vectors live here
             LAr = lv["dA"].row_layout  # A product frame
-            LR = lv["dR"].col_plan.layout  # restriction input frame
-            LRr = lv["dR"].row_layout  # restriction product frame
-            LP = lv["dP"].col_plan.layout  # prolongation input frame
-            LPr = lv["dP"].row_layout  # prolongation product frame
+            structured = "dS" in lv
             no = LA.no_max
             sl = slice(LA.o0, LA.o0 + no)
             dinv = m["dinv"]
@@ -159,21 +228,41 @@ def _vcycle_shard_body(h, dh):
             for _ in range(sweeps_left):
                 q = spmv_A(x)
                 x = x.at[sl].add(omega * dinv[sl] * (b_l[sl] - q[sl]))
-            # residual into R's column frame
             q = spmv_A(x)
-            r = jnp.zeros(LR.W, dtype=b_l.dtype).at[
-                LR.o0 : LR.o0 + no
-            ].set(b_l[sl] - q[sl])
-            rc, _ = bodies[level]["R"](r, m["R"])
-            # rc owned (coarse) sits in R's product frame
-            csl = slice(LRr.o0, LRr.o0 + LRr.no_max)
+            if structured:
+                # factored restriction R = Eᵀ·S: stencil-apply the fine
+                # residual (coded-DIA speed), refresh ghosts so embedded
+                # points owned elsewhere are readable, extract the
+                # even-point slots — no per-row gathers
+                LS = lv["dS"].col_plan.layout
+                LSr = lv["dS"].row_layout
+                rS = jnp.zeros(LS.W, dtype=b_l.dtype).at[
+                    LS.o0 : LS.o0 + no
+                ].set(b_l[sl] - q[sl])
+                w, _ = bodies[level]["S"](rS, m["S"])
+                v = jnp.zeros(LS.W, dtype=b_l.dtype).at[
+                    LS.o0 : LS.o0 + no
+                ].set(w[LSr.o0 : LSr.o0 + no])
+                v = bodies[level]["exch_set"](
+                    v, m["S"]["si"], m["S"]["sm"], m["S"]["ri"]
+                )
+                rc_own = v[m["emb"]]  # pads read the (zero) trash slot
+            else:
+                # assembled restriction matrix (fallback path)
+                LR = lv["dR"].col_plan.layout
+                LRr = lv["dR"].row_layout
+                r = jnp.zeros(LR.W, dtype=b_l.dtype).at[
+                    LR.o0 : LR.o0 + no
+                ].set(b_l[sl] - q[sl])
+                rc, _ = bodies[level]["R"](r, m["R"])
+                rc_own = rc[LRr.o0 : LRr.o0 + LRr.no_max]
             if level + 1 == L:
                 # dense coarse solve, replicated: gather every shard's
                 # owned coarse residual AND gid map (the gmap operand is
                 # sharded — each shard holds only its own row), place by
                 # gid, one mat-vec with the host-precomputed inverse,
                 # read back my slots. Identical on every shard.
-                rc_all = jax.lax.all_gather(rc[csl], "parts")  # (P, no_c)
+                rc_all = jax.lax.all_gather(rc_own, "parts")  # (P, no_c)
                 gm_all = jax.lax.all_gather(mats["gmap"], "parts")
                 glob = jnp.zeros(nc + 1, dtype=b_l.dtype).at[
                     gm_all.reshape(-1)
@@ -186,19 +275,35 @@ def _vcycle_shard_body(h, dh):
                 nxt = dh["levels"][level + 1]["dA"].col_plan.layout
                 bc = jnp.zeros(nxt.W, dtype=b_l.dtype).at[
                     nxt.o0 : nxt.o0 + nxt.no_max
-                ].set(rc[csl])
+                ].set(rc_own)
                 ec = solve_level(level + 1, bc)
                 if w_cycle:
                     # second coarse pass, warm-started (W-cycle γ = 2)
                     ec = solve_level(level + 1, bc, ec)
                 ec_own = ec[nxt.o0 : nxt.o0 + nxt.no_max]
-            # prolongate: coarse correction into P's column frame; the
-            # fine product comes back in P's row frame
-            ecp = jnp.zeros(LP.W, dtype=b_l.dtype).at[
-                LP.o0 : LP.o0 + LP.no_max
-            ].set(ec_own)
-            ef, _ = bodies[level]["P"](ecp, m["P"])
-            x = x.at[sl].add(ef[LPr.o0 : LPr.o0 + no])
+            if structured:
+                # factored prolongation P = S·E: scatter the coarse
+                # correction onto the even fine points (N/8 elements),
+                # assemble embedded-into-ghost values to their owners,
+                # then one stencil SpMV
+                LS = lv["dS"].col_plan.layout
+                LSr = lv["dS"].row_layout
+                z = jnp.zeros(LS.W, dtype=b_l.dtype).at[m["emb"]].set(
+                    ec_own
+                ).at[LS.trash].set(0.0)
+                z = bodies[level]["exch_add"](
+                    z, m["rsi"], m["rsm"], m["rri"]
+                )
+                ef, _ = bodies[level]["S"](z, m["S"])
+                x = x.at[sl].add(ef[LSr.o0 : LSr.o0 + no])
+            else:
+                LP = lv["dP"].col_plan.layout
+                LPr = lv["dP"].row_layout
+                ecp = jnp.zeros(LP.W, dtype=b_l.dtype).at[
+                    LP.o0 : LP.o0 + LP.no_max
+                ].set(ec_own)
+                ef, _ = bodies[level]["P"](ecp, m["P"])
+                x = x.at[sl].add(ef[LPr.o0 : LPr.o0 + no])
             for _ in range(post):
                 q = spmv_A(x)
                 x = x.at[sl].add(omega * dinv[sl] * (b_l[sl] - q[sl]))

@@ -271,11 +271,25 @@ def test_w_cycle_host_and_compiled():
 
     it_v = run(pa.sequential, "v")
     it_w = run(pa.sequential, "w")
-    # strict: on this deterministic problem W beats V; a plumbing
-    # regression that drops the cycle kwarg would give equality
-    assert it_w < it_v, (it_w, it_v)
+    assert it_w <= it_v, (it_w, it_v)
     it_w_t = run(pa.tpu, "w")
     assert it_w_t == it_w, (it_w_t, it_w)
+
+    # plumbing guard that cannot pass by convergence coincidence: one
+    # W-cycle at depth 3 visits the coarse solver 2^(L-1) = 4 times
+    def count_coarse(parts):
+        ns = (20, 20, 20)
+        A, b, _, _ = _poisson(parts, ns)
+        Ah, bh = pa.decouple_dirichlet(A, b)
+        h = pa.gmg_hierarchy(parts, Ah, ns, coarse_threshold=30, cycle="w")
+        assert len(h.levels) == 3
+        calls = []
+        orig = h.coarse_solver.solve
+        h.coarse_solver.solve = lambda v: (calls.append(1), orig(v))[1]
+        h.vcycle(bh)
+        return len(calls)
+
+    assert pa.prun(count_coarse, pa.sequential, (2, 2, 2)) == 4
 
 
 def test_gmg_variable_coefficient_operator():
