@@ -250,7 +250,44 @@ def lobpcg(
         return z
 
     def _gram(U, V):
-        return np.array([[float(u.dot(v)) for v in V] for u in U])
+        # ONE distributed reduce per Gram product, not one per entry:
+        # each part forms its whole owned-block partial U_p V_pᵀ in a
+        # single matmul, and the small |U|×|V| partials fold in part
+        # order — the eager analog of the device path's one all_gather
+        # per Gram matmul. The old per-entry u.dot(v) issued (3m)²
+        # sequential cross-part reductions per iteration.
+        ku, kv = len(U), len(V)
+        if ku == 0 or kv == 0:
+            return np.zeros((ku, kv))
+        # each vector rides with its OWN partition (blocks like AS live
+        # on A.rows, not A.cols — owned-compatible but not lid-identical)
+        args = []
+        for w in (*U, *V):
+            args.append(w.rows.partition)
+            args.append(w.values)
+
+        def _partial(*vals):
+            Uo = np.stack(
+                [
+                    _owned(vals[2 * i], np.asarray(vals[2 * i + 1]))
+                    for i in range(ku)
+                ]
+            )
+            Vo = np.stack(
+                [
+                    _owned(
+                        vals[2 * (ku + i)], np.asarray(vals[2 * (ku + i) + 1])
+                    )
+                    for i in range(kv)
+                ]
+            )
+            return Uo @ Vo.T
+
+        partials = map_parts(_partial, *args)
+        from ..parallel.collectives import preduce
+        import operator
+
+        return preduce(operator.add, partials, np.zeros((ku, kv)))
 
     def _combine(blocks, C):
         """rows of C weight the concatenated blocks into new vectors."""
@@ -1052,7 +1089,13 @@ def minres(
         gamma3 = s_old * beta_k
         # new rotation
         rho = np.hypot(delta, beta_new)
-        check(rho != 0.0, "minres: breakdown, zero subdiagonal pivot")
+        if rho == 0.0:
+            # hard breakdown: no rotation can advance this step. Exit
+            # with converged=False — the same no-op contract as the
+            # compiled path (tpu.py make_minres_fn), so host and device
+            # behave identically (a check() here would also divide by
+            # zero under PA_TPU_CHECKS=0 and NaN-poison the iterate).
+            break
         c_old, s_old = c, s
         c, s = delta / rho, beta_new / rho
         # update the solution direction: w_new = (v - γ2 w - γ3 w_old)/ρ.

@@ -28,6 +28,7 @@ scatter lanes so no dynamic shapes or bound checks reach the compiled code.
 """
 from __future__ import annotations
 
+import itertools
 import math
 import os
 from functools import partial
@@ -51,6 +52,9 @@ def _jax():
     return jax
 
 
+_backend_tokens = itertools.count()
+
+
 class TPUBackend(AbstractBackend):
     """Each part is one device of a 1-D mesh over axis ``'parts'``.
 
@@ -62,6 +66,9 @@ class TPUBackend(AbstractBackend):
         self._devices = devices
         self._meshes = {}
         self._mesh_grid = {}  # nparts -> part-grid shape the mesh was ordered for
+        # stable cache identity: id(backend) can be recycled after GC,
+        # which would hand back device buffers staged for a dead backend
+        self._token = next(_backend_tokens)
 
     def devices(self):
         return self._devices if self._devices is not None else _jax().devices()
@@ -769,9 +776,10 @@ class DeviceMatrix:
 
 
 def device_matrix(A: PSparseMatrix, backend: TPUBackend) -> DeviceMatrix:
-    # cached ON the matrix object so the lowering's lifetime is tied to A
-    # (an external id()-keyed dict would go stale when ids are recycled)
-    key = id(backend)
+    # cached ON the matrix object so the lowering's lifetime is tied to A;
+    # keyed by the backend's stable token (an id() key could be recycled
+    # after GC and hand back buffers staged for a dead backend)
+    key = backend._token
     if key not in A._device:
         A._device[key] = DeviceMatrix(A, backend)
     return A._device[key]
@@ -1623,7 +1631,8 @@ def make_minres_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
                 gamma3 = s_old * beta_k
                 rho = jnp.sqrt(delta * delta + beta_new * beta_new)
                 # valid: this iteration's updates hold (rho == 0 is the
-                # hard-breakdown no-op the host loop raises on). Lucky
+                # hard-breakdown no-op; the host loop breaks out with
+                # converged=False on it, matching this path). Lucky
                 # breakdown (beta_new == 0 but rho != 0) is a VALID final
                 # iteration — apply it, then exit via ok.
                 valid = rho != 0

@@ -43,7 +43,7 @@ def _device_hierarchy(h, backend: TPUBackend):
     cache = getattr(h, "_device_cache", None)
     if cache is None:
         cache = h._device_cache = {}
-    key = id(backend)
+    key = backend._token
     if key in cache:
         return cache[key]
 
@@ -499,7 +499,7 @@ def _run_gmg(h, b, x0, tol, maxiter, verbose, make_fn, name):
     cache = getattr(h, "_fn_cache", None)
     if cache is None:
         cache = h._fn_cache = {}
-    key = (name, id(backend), float(tol), int(maxiter))
+    key = (name, backend._token, float(tol), int(maxiter))
     if key not in cache:
         cache[key] = make_fn()
     # the compiled fns share the Krylov (b, x0) -> 5-tuple contract, so
