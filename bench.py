@@ -1,13 +1,27 @@
-"""Benchmark harness: PSparseMatrix SpMV GFLOPS/chip (3-D Poisson FDM).
+"""Benchmark harness: PSparseMatrix SpMV GFLOPS/chip (3-D Poisson FDM)
+plus the `exchange!` halo microbench (BASELINE.json configs[1]).
 
-Prints ONE JSON line:
+Prints TWO JSON lines, each
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+The halo line comes first; the LAST line is the primary SpMV metric (the
+position the round-1 driver parsed).
 
-Metric (BASELINE.json): the compiled ELL SpMV throughput of the 7-point
-3-D Poisson operator on one chip. The reference publishes no absolute
-numbers (BASELINE.md: "published": {}), so `vs_baseline` reports the
-speedup over this repo's own sequential (NumPy CSR) oracle on the same
-problem — the honest stand-in for the reference's CPU execution model.
+SpMV metric: the compiled SpMV throughput of the 7-point 3-D Poisson
+operator on one chip. The reference publishes no absolute numbers
+(BASELINE.md: "published": {}), so `vs_baseline` reports the speedup
+over this repo's own sequential (NumPy CSR) oracle on the same problem —
+the honest stand-in for the reference's CPU execution model.
+
+Halo metric: per-chip payload bandwidth of the compiled halo exchange
+(pack gather -> `ppermute` -> unpack scatter) for part 0 of the 8-part
+2x2x2 partition of the same grid — the workload of reference
+test/test_fdm.jl:8-120 over the Exchanger of src/Interfaces.jl:846-889.
+Only one chip is reachable, so the `ppermute`s are self-loops: the wire
+hop is a device-local copy and the measured cost is the per-chip
+pack/unpack kernel path (the plan itself is the real 8-part plan, whose
+multi-part execution is validated on the virtual mesh by the test
+suite). `vs_baseline` is the speedup over the sequential backend's
+eager 8-part exchange on the same PRange.
 
 Run with the default environment (real TPU via the axon platform); do NOT
 set the virtual-CPU test flags here.
@@ -22,6 +36,132 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
+
+
+def marginal_chain_time(run_chain, k1: int, k2: int, nreps: int = 5) -> float:
+    """Shared marginal-cost timing protocol (docs/performance.md): per
+    chain length, warm twice then take the median of `nreps` timed runs;
+    difference two well-separated lengths so the relay's fixed RTT
+    cancels; double the long chain until the marginal cost comes out
+    positive (relay jitter can invert short differences); report the
+    median of three full measurements. `run_chain(k)` must execute one
+    compiled k-step dependency chain ending in a host scalar fetch."""
+    import statistics
+
+    def chain_time(k: int) -> float:
+        run_chain(k)
+        run_chain(k)
+        ts = []
+        for _ in range(nreps):
+            t0 = time.perf_counter()
+            v = run_chain(k)
+            ts.append(time.perf_counter() - t0)
+        assert v == v, "chain produced NaN — operator scaling broken"
+        return statistics.median(ts)
+
+    def measure_once() -> float:
+        t1 = chain_time(k1)
+        kk2 = k2
+        for _ in range(4):
+            t2 = chain_time(kk2)
+            dt = (t2 - t1) / (kk2 - k1)
+            if dt > 0:
+                return dt
+            kk2 = 2 * kk2
+        # still inverted: conservative whole-chain cost of the LAST
+        # measured chain (t2 was taken before the final doubling)
+        return t2 / (kk2 // 2)
+
+    dts = sorted(measure_once() for _ in range(3))
+    return dts[1]
+
+
+def bench_halo(n: int, backend, pa) -> dict:
+    """Per-chip halo-exchange payload bandwidth (see module docstring)."""
+    import statistics
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from partitionedarrays_jl_tpu.parallel.sequential import SequentialBackend
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        DeviceExchangePlan, _stage, device_layout,
+    )
+
+    dtype = np.float32
+    # the real 8-part plan, built host-side exactly as a 2x2x2 run would
+    seq = SequentialBackend()
+    rows = pa.prun(
+        lambda parts: pa.prange(parts, (n, n, n), pa.with_ghost),
+        seq, (2, 2, 2),
+    )
+    layout = device_layout(rows, False)
+    plan = DeviceExchangePlan(rows.exchanger, layout)
+    p0 = 0
+    # payload: each ghost entry of part 0 lands once per exchange
+    hids = rows.partition.part_values()[p0].num_hids
+    payload_bytes = hids * np.dtype(dtype).itemsize
+    si = _stage(backend, plan.snd_idx[p0][None], 1)
+    sm = _stage(backend, plan.snd_mask[p0][None], 1)
+    ri = _stage(backend, plan.rcv_idx[p0][None], 1)
+    mesh = backend.mesh(1)
+    spec = backend.parts_spec()
+    R, trash = plan.R, layout.trash
+    x0 = np.zeros((1, layout.W), dtype=dtype)
+    x0[0, layout.o0 : layout.o0 + layout.no_max] = 1.0
+    x = jax.device_put(
+        x0, jax.sharding.NamedSharding(mesh, spec)
+    )
+
+    @partial(jax.jit, static_argnums=4)
+    def chain(x, si, sm, ri, k):
+        def shard_fn(xs, sis, sms, ris):
+            xv, siv, smv, riv = xs[0], sis[0], sms[0], ris[0]
+
+            def step(_, xv):
+                # part 0's rounds of the 8-part plan; the ppermute hop is
+                # a self-loop on the 1-device mesh (see module docstring)
+                for r in range(R):
+                    buf = jnp.where(smv[r], xv[siv[r]], 0)
+                    buf = jax.lax.ppermute(buf, "parts", perm=((0, 0),))
+                    xv = xv.at[riv[r]].set(buf)
+                    xv = xv.at[trash].set(0)
+                return xv
+
+            return jax.lax.fori_loop(0, k, step, xv)[None]
+
+        from jax import shard_map
+
+        return shard_map(
+            shard_fn, mesh=mesh, in_specs=(spec,) * 4, out_specs=spec,
+            check_vma=False,
+        )(x, si, sm, ri).sum()
+
+    dt = marginal_chain_time(lambda k: float(chain(x, si, sm, ri, k)), 50, 850)
+    bw = payload_bytes / dt
+
+    # sequential-oracle comparand: the eager 8-part exchange (numpy
+    # pack/copy/unpack through the same Exchanger) on the same PRange,
+    # per-part marginal = total / 8
+    v = pa.prun(
+        lambda parts: pa.PVector.full(np.float32(1.0), rows, dtype=dtype),
+        seq, (2, 2, 2),
+    )
+    v.exchange()  # warm
+    host_ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        v.exchange()
+        host_ts.append(time.perf_counter() - t0)
+    host_dt = statistics.median(host_ts) / 8
+    host_bw = payload_bytes / host_dt
+    return {
+        "metric": f"halo_exchange_bytes_per_s_per_chip_poisson3d_{n}cube_f32",
+        "value": round(bw, 1),
+        "unit": "B/s",
+        "vs_baseline": round(bw / host_bw, 3),
+    }
 
 
 def main():
@@ -81,39 +221,11 @@ def main():
     def chain(x, k):
         return jax.lax.fori_loop(0, k, lambda i, y: spmv(y), x).sum()
 
-    def chain_time(k: int, nreps: int = 5) -> float:
-        float(chain(dx.data, k))  # warm compile for this k
-        float(chain(dx.data, k))  # settle caches / relay path
-        ts = []
-        for _ in range(nreps):
-            t0 = time.perf_counter()
-            v = float(chain(dx.data, k))
-            ts.append(time.perf_counter() - t0)
-        assert v == v, "chain produced NaN — operator scaling broken"
-        return statistics.median(ts)
-
-    def measure_once() -> float:
-        # chains long enough that the marginal cost (~reps x dt of signal)
-        # dominates the relay's tens-of-ms RTT jitter
-        k1, k2 = 50, 50 + 8 * max(50, reps)
-        t1 = chain_time(k1)
-        dt = 0.0
-        for _ in range(4):  # lengthen the chain until it dominates jitter
-            t2 = chain_time(k2)
-            dt = (t2 - t1) / (k2 - k1)
-            if dt > 0:
-                return dt
-            k2 = 2 * k2
-        # still inverted: conservative whole-chain cost of the LAST
-        # measured chain (t2 was taken before the final doubling of k2)
-        return t2 / (k2 // 2)
-
-    # the relay's per-process variance is large in BOTH directions (slow
-    # outliers from contention, absurdly fast ones when a short chain's
-    # marginal cost degenerates) — take the median of three full
-    # measurements (each already a median over reps)
-    dts = sorted(measure_once() for _ in range(3))
-    dt = dts[1]
+    # chains long enough that the marginal cost (~reps x dt of signal)
+    # dominates the relay's tens-of-ms RTT jitter
+    dt = marginal_chain_time(
+        lambda k: float(chain(dx.data, k)), 50, 50 + 8 * max(50, reps)
+    )
     gflops = flops / dt / 1e9
 
     # sequential-oracle timing on the same local problem (NumPy CSR).
@@ -131,6 +243,12 @@ def main():
         host_ts.append(time.perf_counter() - t0)
     host_dt = statistics.median(host_ts)
     host_gflops = flops / host_dt / 1e9
+
+    # halo microbench first; the primary SpMV metric stays the LAST line
+    try:
+        print(json.dumps(bench_halo(n, backend, pa)), flush=True)
+    except Exception as e:  # the halo leg must never mask the primary metric
+        print(f"halo bench failed: {type(e).__name__}: {e}", file=sys.stderr)
 
     print(
         json.dumps(

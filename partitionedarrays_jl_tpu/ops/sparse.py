@@ -18,14 +18,14 @@ from typing import Callable, Optional, Tuple
 
 import numpy as np
 
-from ..utils.helpers import check
+from ..utils.helpers import check, strict_bits
 from ..utils.table import INDEX_DTYPE
 
 
 class CSRMatrix:
     """Host CSR with sorted, deduplicated column indices per row."""
 
-    __slots__ = ("indptr", "indices", "data", "shape", "_keys")
+    __slots__ = ("indptr", "indices", "data", "shape", "_keys", "_ell")
 
     def __init__(self, indptr, indices, data, shape: Tuple[int, int]):
         self.indptr = np.asarray(indptr, dtype=INDEX_DTYPE)
@@ -34,6 +34,7 @@ class CSRMatrix:
         self.shape = (int(shape[0]), int(shape[1]))
         check(len(self.indptr) == self.shape[0] + 1, "bad indptr length")
         self._keys = None
+        self._ell = None  # lazily cached ELL form (strict-mode SpMV)
 
     @property
     def nnz(self) -> int:
@@ -183,9 +184,31 @@ def nziterator(A: CSRMatrix):
 def csr_spmv(A: CSRMatrix, x: np.ndarray, y: Optional[np.ndarray] = None,
              alpha: float = 1.0, beta: float = 0.0) -> np.ndarray:
     """Host CSR SpMV: y = beta*y + alpha*A@x. Deterministic per-row
-    left-to-right accumulation order (column-sorted rows + reduceat) — the
-    order the device ELL kernel reproduces for bit-exactness."""
+    accumulation (column-sorted rows + reduceat). In strict-bits mode the
+    row sum is instead an explicit left-to-right fold over ELL-padded row
+    slots — the exact order of the device `_ell_rowsum` kernel; reduceat's
+    internal order is a NumPy implementation detail (pairwise-flavored)
+    that the device cannot reproduce."""
     check(len(x) >= A.shape[1], "x too short for A")
+    if strict_bits():
+        if A._ell is None:
+            A._ell = ELLMatrix.from_csr(A)
+        E = A._ell
+        xv = np.asarray(x)
+        L = E.vals.shape[1]
+        if L == 0 or E.vals.shape[0] == 0:
+            rowsum = np.zeros(A.shape[0], dtype=A.dtype)
+        else:
+            # pad slots carry val 0 / col 0: +0.0 terms, rounding-neutral
+            acc = E.vals[:, 0] * xv[E.cols[:, 0]]
+            for l in range(1, L):
+                acc = acc + E.vals[:, l] * xv[E.cols[:, l]]
+            rowsum = acc
+        if y is None:
+            return alpha * rowsum
+        y *= beta
+        y += alpha * rowsum
+        return y
     prod = A.data * np.asarray(x)[A.indices]
     starts = A.indptr[:-1]
     rowsum = np.zeros(A.shape[0], dtype=prod.dtype if prod.size else A.dtype)

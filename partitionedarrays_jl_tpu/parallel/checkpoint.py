@@ -119,20 +119,271 @@ def load_psparse(
     return PSparseMatrix.from_coo(I, J, V, rows, cols, ids="global")
 
 
+def save_pvector_sharded(directory: str, v: PVector) -> None:
+    """Serialize a PVector as one ``.npz`` per part (owned gids + owned
+    values) under ``directory`` — NO part ever materializes the global
+    vector, so this scales to sizes where `save_pvector`'s gather-to-one-
+    host image is a wall (the 1e8-DOF configs of tools/scale_check.py).
+    The shard set is still partition-independent: gid-keyed shards
+    restore onto any partition, any part count.
+
+    Crash-atomic in place: shards are written under a fresh generation
+    tag and ``index.json`` (naming that generation) is replaced last, so
+    a crash mid-save leaves the previous generation fully readable —
+    never a mix of old and new shards."""
+    gen = _new_generation()
+    os.makedirs(directory, exist_ok=True)
+    isets = v.rows.partition.part_values()
+    vals = v.values.part_values()
+    dtype = None
+    for p, (iset, vv) in enumerate(zip(isets, vals)):
+        owned = _owned(iset, np.asarray(vv))
+        dtype = owned.dtype
+        _atomic_savez(
+            os.path.join(directory, _shard_name(p, gen)),
+            kind="pvector_shard",
+            gids=np.asarray(iset.oid_to_gid, dtype=np.int64),
+            values=owned,
+        )
+    _commit_index(
+        directory,
+        {
+            "kind": "pvector",
+            "ngids": int(v.rows.ngids),
+            "nshards": len(isets),
+            "gen": gen,
+            "dtype": np.dtype(dtype if dtype is not None else v.dtype).name,
+        },
+    )
+
+
+def load_pvector_sharded(directory: str, rows: PRange) -> PVector:
+    """Restore a sharded PVector onto ``rows`` (any partition of the same
+    global size), streaming one shard at a time — peak host memory is one
+    shard plus the target's own local arrays. Ghost entries whose owner
+    values appear in some shard are filled exactly, so no post-load
+    exchange is needed (same contract as `load_pvector`).
+
+    Routing per shard is O(n log n), part-count-independent: owned slots
+    fill through an owner split (one argsort), ghost slots through a
+    per-part binary search of that part's (few, surface-sized) ghost gids
+    against the shard — not a full per-part scan of every shard."""
+    idx = _read_index(directory, "pvector")
+    if int(idx["ngids"]) != rows.ngids:
+        raise ValueError(
+            f"checkpoint has {idx['ngids']} gids, target PRange {rows.ngids}"
+        )
+    isets = rows.partition.part_values()
+    dtype = np.dtype(idx.get("dtype", "float64"))
+    out = [np.zeros(i.num_lids, dtype=dtype) for i in isets]
+    owner_of = _owner_fn(rows)
+    gen = idx.get("gen")
+    hid_gids = [
+        np.asarray(i.lid_to_gid)[np.asarray(i.hid_to_lid)] for i in isets
+    ]
+    for s in range(int(idx["nshards"])):
+        with np.load(os.path.join(directory, _shard_name(s, gen))) as z:
+            gids, values = z["gids"], z["values"]
+        # owned routing: one owner split per shard
+        ow = owner_of(gids)
+        order = np.argsort(ow, kind="stable")
+        bounds = np.searchsorted(ow[order], np.arange(len(isets) + 1))
+        sort_g = None
+        for p, iset in enumerate(isets):
+            chunk = order[bounds[p] : bounds[p + 1]]
+            if len(chunk):
+                lids = iset.gids_to_lids(gids[chunk])
+                m = lids >= 0
+                out[p][lids[m]] = values[chunk[m]]
+            # ghost fill: look THIS part's ghost gids up in the shard
+            hg = hid_gids[p]
+            if len(hg):
+                if sort_g is None:
+                    sort_g = np.argsort(gids, kind="stable")
+                    sg = gids[sort_g]
+                pos = np.searchsorted(sg, hg)
+                ok = pos < len(sg)
+                ok[ok] = sg[pos[ok]] == hg[ok]
+                if ok.any():
+                    hl = np.asarray(isets[p].hid_to_lid)[ok]
+                    out[p][hl] = values[sort_g[pos[ok]]]
+    return PVector(rows.partition._like(out), rows)
+
+
+def save_psparse_sharded(directory: str, A: PSparseMatrix) -> None:
+    """Serialize a PSparseMatrix as one global-COO ``.npz`` per part
+    (each part's owned-row triplets) — the sharded form of
+    `save_psparse`, with the same assembled-matrix contract and the same
+    generation-tagged crash atomicity as `save_pvector_sharded`."""
+    from .psparse import psparse_owned_triplets
+
+    gen = _new_generation()
+    os.makedirs(directory, exist_ok=True)
+    trip = psparse_owned_triplets(A).part_values()
+    dtype = None
+    for p, (gi, gj, v) in enumerate(trip):
+        v = np.asarray(v)
+        dtype = v.dtype
+        _atomic_savez(
+            os.path.join(directory, _shard_name(p, gen)),
+            kind="psparse_shard",
+            gi=np.asarray(gi, dtype=np.int64),
+            gj=np.asarray(gj, dtype=np.int64),
+            v=v,
+        )
+    _commit_index(
+        directory,
+        {
+            "kind": "psparse",
+            "nrows": int(A.rows.ngids),
+            "ncols": int(A.cols.ngids),
+            "nshards": len(trip),
+            "gen": gen,
+            "dtype": np.dtype(dtype if dtype is not None else A.dtype).name,
+        },
+    )
+
+
+def load_psparse_sharded(
+    directory: str,
+    rows: PRange,
+    cols: Optional[PRange] = None,
+) -> PSparseMatrix:
+    """Restore a sharded PSparseMatrix onto ``rows``/``cols``, streaming
+    one shard at a time; each target part keeps the triplets whose row it
+    owns. Routing is one owner split (argsort + searchsorted) per shard —
+    part-count-independent, the same pattern as `load_psparse`."""
+    idx = _read_index(directory, "psparse")
+    if int(idx["nrows"]) != rows.ngids:
+        raise ValueError(
+            f"checkpoint has {idx['nrows']} rows, target PRange {rows.ngids}"
+        )
+    isets = rows.partition.part_values()
+    P = len(isets)
+    dtype = np.dtype(idx.get("dtype", "float64"))
+    gi_p = [[] for _ in range(P)]
+    gj_p = [[] for _ in range(P)]
+    v_p = [[] for _ in range(P)]
+    owner_of = _owner_fn(rows)
+    gen = idx.get("gen")
+    for s in range(int(idx["nshards"])):
+        with np.load(os.path.join(directory, _shard_name(s, gen))) as z:
+            gi, gj, v = z["gi"], z["gj"], z["v"]
+        ow = owner_of(gi)
+        order = np.argsort(ow, kind="stable")
+        bounds = np.searchsorted(ow[order], np.arange(P + 1))
+        for p in range(P):
+            chunk = order[bounds[p] : bounds[p + 1]]
+            if len(chunk):
+                gi_p[p].append(gi[chunk])
+                gj_p[p].append(gj[chunk])
+                v_p[p].append(v[chunk])
+
+    def _cat(chunks, dt):
+        return [
+            np.concatenate(c) if c else np.empty(0, dtype=dt) for c in chunks
+        ]
+
+    I = rows.partition._like(_cat(gi_p, np.int64))
+    J = rows.partition._like(_cat(gj_p, np.int64))
+    V = rows.partition._like(_cat(v_p, dtype))
+    if cols is None:
+        from .prange import add_gids
+
+        cols = add_gids(rows, J)
+    return PSparseMatrix.from_coo(I, J, V, rows, cols, ids="global")
+
+
+def _owner_fn(rows: PRange):
+    """gid -> owner part, preferring the PRange's lazy arithmetic map
+    (no global array); falls back to a one-pass owner table."""
+    if rows.gid_to_part is not None:
+        return lambda g: np.asarray(rows.gid_to_part(np.asarray(g)))
+    owner_of_gid = np.empty(rows.ngids, dtype=np.int32)
+    for p, iset in enumerate(rows.partition.part_values()):
+        owner_of_gid[np.asarray(iset.oid_to_gid)] = p
+    return lambda g: owner_of_gid[np.asarray(g)]
+
+
+def _new_generation() -> str:
+    import secrets
+
+    return secrets.token_hex(4)
+
+
+def _shard_name(p: int, gen: Optional[str]) -> str:
+    return f"shard{p:05d}-{gen}.npz" if gen else f"shard{p:05d}.npz"
+
+
+def _commit_index(directory: str, idx: dict) -> None:
+    """Atomically publish the new generation, then best-effort remove
+    shards of older generations (their index is gone; a crash between the
+    two steps only leaks orphan files, never corrupts a read)."""
+    _atomic_json(os.path.join(directory, "index.json"), idx)
+    gen = idx["gen"]
+    for f in os.listdir(directory):
+        if f.startswith("shard") and f.endswith(".npz") and f"-{gen}." not in f:
+            try:
+                os.unlink(os.path.join(directory, f))
+            except OSError:
+                pass
+
+
+def _read_index(directory: str, kind: str) -> dict:
+    p = os.path.join(directory, "index.json")
+    if not os.path.isfile(p):
+        raise ValueError(f"{directory} is not a sharded checkpoint (no index.json)")
+    with open(p) as f:
+        idx = json.load(f)
+    if idx.get("kind") != kind:
+        raise ValueError(
+            f"{directory} holds a {idx.get('kind')!r} checkpoint, not {kind!r}"
+        )
+    return idx
+
+
+def _atomic_json(path: str, obj: dict) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".json.tmp")
+    os.close(fd)
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def save_checkpoint(
     directory: str,
     objects: Dict[str, Union[PVector, PSparseMatrix]],
     meta: Optional[dict] = None,
+    sharded: bool = False,
 ) -> None:
     """Write a named set of arrays + user metadata (e.g. the iteration
-    number) as one checkpoint directory. Objects land as ``<name>.npz``;
-    the manifest is written last, so a checkpoint with a readable manifest
-    is complete."""
+    number) as one checkpoint directory. Objects land as ``<name>.npz``
+    (or, with ``sharded=True``, as per-part shard directories ``<name>/``
+    that never materialize a global array on one host); the manifest is
+    written last, so a checkpoint with a readable manifest is complete."""
     os.makedirs(directory, exist_ok=True)
     manifest = {"meta": meta or {}, "objects": {}}
     if "meta" in objects:
         raise ValueError('the object name "meta" is reserved for checkpoint metadata')
     for name, obj in objects.items():
+        if sharded:
+            p = os.path.join(directory, name)
+            if isinstance(obj, PVector):
+                save_pvector_sharded(p, obj)
+                manifest["objects"][name] = "pvector_sharded"
+            elif isinstance(obj, PSparseMatrix):
+                save_psparse_sharded(p, obj)
+                manifest["objects"][name] = "psparse_sharded"
+            else:
+                raise TypeError(
+                    f"cannot checkpoint object of type {type(obj).__name__}"
+                )
+            continue
         p = os.path.join(directory, f"{name}.npz")
         if isinstance(obj, PVector):
             save_pvector(p, obj)
@@ -168,13 +419,25 @@ def load_checkpoint(
             raise ValueError(
                 f"no target PRange given for checkpoint object {name!r}"
             )
-        p = os.path.join(directory, f"{name}.npz")
         if kind == "pvector":
-            out[name] = load_pvector(p, ranges[name])
+            out[name] = load_pvector(
+                os.path.join(directory, f"{name}.npz"), ranges[name]
+            )
+        elif kind == "pvector_sharded":
+            out[name] = load_pvector_sharded(
+                os.path.join(directory, name), ranges[name]
+            )
         else:
             tgt = ranges[name]
             rows, cols = tgt if isinstance(tgt, tuple) else (tgt, None)
-            out[name] = load_psparse(p, rows, cols)
+            if kind == "psparse_sharded":
+                out[name] = load_psparse_sharded(
+                    os.path.join(directory, name), rows, cols
+                )
+            else:
+                out[name] = load_psparse(
+                    os.path.join(directory, f"{name}.npz"), rows, cols
+                )
     return out
 
 

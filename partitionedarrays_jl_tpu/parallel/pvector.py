@@ -25,7 +25,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from ..utils.helpers import check
+from ..utils.helpers import check, pairwise_sum, strict_bits
 from .backends import AbstractPData, Token, map_parts
 from .collectives import preduce
 from .exchanger import async_exchange_values
@@ -239,8 +239,18 @@ class PVector:
 
     def dot(self, other: "PVector"):
         """Reference: src/Interfaces.jl:1985-1992."""
+        if strict_bits():
+            # strict mode: the fixed-tree pairwise partial the compiled
+            # dot reproduces exactly (np.dot's BLAS order is unspecified)
+            part_dot = lambda i, a, oi, b: pairwise_sum(  # noqa: E731
+                _owned(i, a) * _owned(oi, b)
+            )
+        else:
+            part_dot = lambda i, a, oi, b: np.dot(  # noqa: E731
+                _owned(i, a), _owned(oi, b)
+            )
         partials = map_parts(
-            lambda i, a, oi, b: np.dot(_owned(i, a), _owned(oi, b)),
+            part_dot,
             self.rows.partition,
             self.values,
             other.rows.partition,

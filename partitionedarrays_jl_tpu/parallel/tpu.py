@@ -36,7 +36,7 @@ from typing import Callable, Optional, Tuple
 
 import numpy as np
 
-from ..utils.helpers import check
+from ..utils.helpers import check, strict_bits
 from ..utils.table import INDEX_DTYPE
 from .backends import AbstractBackend, PartShape, _as_shape
 from .exchanger import Exchanger
@@ -473,7 +473,16 @@ class DeviceMatrix:
         noids = np.array([i.num_oids for i in isets], dtype=np.int64)
         no_max = int(noids.max()) if P else 0
         dt = A.dtype
-        det = self._detect_dia(A, oo, P, noids, no_max, np.dtype(dt).itemsize)
+        # strict-bits mode forces the pure-ELL lowering: its two-phase
+        # (A_oo fold, then A_oh fold added) left-to-right accumulation is
+        # the exact order of the host csr_spmv + mul_into pair, whereas
+        # the DIA kernels sum in frame-offset order, which interleaves
+        # ghost terms on boundary rows (equal only to rounding)
+        det = (
+            None
+            if strict_bits()
+            else self._detect_dia(A, oo, P, noids, no_max, np.dtype(dt).itemsize)
+        )
         if padded is None:
             # the padded vector frame only pays off when the in-frame coded
             # kernel can actually run; otherwise stay compact even on TPU
@@ -779,7 +788,7 @@ def device_matrix(A: PSparseMatrix, backend: TPUBackend) -> DeviceMatrix:
     # cached ON the matrix object so the lowering's lifetime is tied to A;
     # keyed by the backend's stable token (an id() key could be recycled
     # after GC and hand back buffers staged for a dead backend)
-    key = backend._token
+    key = (backend._token, strict_bits())
     if key not in A._device:
         A._device[key] = DeviceMatrix(A, backend)
     return A._device[key]
@@ -790,13 +799,54 @@ def device_matrix(A: PSparseMatrix, backend: TPUBackend) -> DeviceMatrix:
 # ---------------------------------------------------------------------------
 
 
+def _strict_rounded_product(t):
+    """Strict mode: force `t` (a product about to be accumulated) to its
+    own IEEE rounding, blocking XLA's mul+add -> FMA contraction. Two
+    fences are needed: an `optimization_barrier` at the HLO level, and a
+    data-dependent select at codegen level — the CPU backend's LLVM
+    pipeline contracts straight through a bare barrier (measured: 321/1000
+    elements differ on a random axpy), while the select breaks the
+    fadd(fmul(..)) pattern it matches on. ``t == t`` is True except for
+    NaN, where a strict-mode run is already broken."""
+    import jax
+    import jax.numpy as jnp
+
+    t = jax.lax.optimization_barrier(t)
+    return jnp.where(t == t, t, jnp.zeros_like(t))
+
+
 def _pdot_factory(o0: int, no_max: int):
     """Deterministic across-parts dot: per-shard partial (owned region;
     padding is zero by invariant), `all_gather`, fold in part order — the
     compiled form of the sequential `preduce` left-fold, so the reduction
-    order (and hence bits) matches the oracle."""
+    order (and hence bits) matches the oracle.
+
+    In strict-bits mode the per-shard partial is the fixed-tree pairwise
+    sum of separately-rounded products (`utils.helpers.pairwise_sum` runs
+    the identical tree on host), and the cross-part fold is an explicit
+    left fold — bit-identical to the sequential `PVector.dot`."""
     import jax
     import jax.numpy as jnp
+
+    if strict_bits():
+
+        def pdot(a, b):
+            t = _strict_rounded_product(
+                a[o0 : o0 + no_max] * b[o0 : o0 + no_max]
+            )
+            n = 1 << int(no_max - 1).bit_length() if no_max > 1 else 1
+            t = jnp.pad(t, (0, n - no_max))
+            while n > 1:
+                t = t[0::2] + t[1::2]
+                n //= 2
+            partial_ = t[0] if no_max else jnp.zeros((), a.dtype)
+            allp = jax.lax.all_gather(partial_, "parts")
+            acc = allp[0]
+            for i in range(1, allp.shape[0]):
+                acc = acc + allp[i]
+            return acc
+
+        return pdot
 
     def pdot(a, b):
         partial_ = jnp.sum(a[o0 : o0 + no_max] * b[o0 : o0 + no_max])
@@ -882,14 +932,21 @@ def _spmv_body(dA: DeviceMatrix):
     no_max = layout.no_max
     o0, g0 = layout.o0, layout.g0
 
+    strict = strict_bits()  # captured at trace/build time
+
+    def _rp(t):
+        # strict mode: round each product separately before accumulation
+        # (the one rounding difference vs the NumPy oracle)
+        return _strict_rounded_product(t) if strict else t
+
     def _ell_rowsum(vals, cols, xv):
         # strict left-to-right fold over the (static, small) row width, the
         # same accumulation order as the host CSR kernel's reduceat — keeps
         # the device result bit-comparable with the sequential oracle
         L = vals.shape[-1]
-        acc = vals[:, 0] * xv[cols[:, 0]]
+        acc = _rp(vals[:, 0] * xv[cols[:, 0]])
         for l in range(1, L):
-            acc = acc + vals[:, l] * xv[cols[:, l]]
+            acc = acc + _rp(vals[:, l] * xv[cols[:, l]])
         return acc
 
     offsets = dA.dia_offsets
@@ -1056,6 +1113,12 @@ def make_cg_fn(
     pdot = _pdot_factory(o0, no_max)
     ops = _matrix_operands(dA)
     specs = jax.tree.map(lambda _: spec, ops)
+    strict = strict_bits()
+
+    def _rp(t):
+        # strict mode: round the axpy products separately (block FMA
+        # contraction) so the update arithmetic matches the host loop's
+        return _strict_rounded_product(t) if strict else t
 
     # per-iteration residual history, fixed-shape for the while_loop carry
     # (capped: a convergence curve beyond this many entries is truncated)
@@ -1108,8 +1171,8 @@ def make_cg_fn(
                 q = spmv(p)
                 pq = pdot(p, q)
                 alpha = rz / pq
-                x = x.at[o0 : o0 + no_max].add(alpha * p[o0 : o0 + no_max])
-                r = r.at[o0 : o0 + no_max].add(-alpha * q[o0 : o0 + no_max])
+                x = x.at[o0 : o0 + no_max].add(_rp(alpha * p[o0 : o0 + no_max]))
+                r = r.at[o0 : o0 + no_max].add(_rp(-alpha * q[o0 : o0 + no_max]))
                 z = apply_minv(r)
                 rz_new = pdot(r, z) if precond else None
                 rs_new = pdot(r, r)
@@ -1117,7 +1180,7 @@ def make_cg_fn(
                     rz_new = rs_new
                 beta = rz_new / rz
                 p = p.at[o0 : o0 + no_max].set(
-                    z[o0 : o0 + no_max] + beta * p[o0 : o0 + no_max]
+                    z[o0 : o0 + no_max] + _rp(beta * p[o0 : o0 + no_max])
                 )
                 hist = hist.at[jnp.minimum(it + 1, H - 1)].set(jnp.sqrt(rs_new))
                 return (x, r, p, rz_new, rs_new, it + 1, hist)

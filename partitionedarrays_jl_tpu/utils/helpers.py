@@ -43,6 +43,37 @@ def checks_enabled() -> bool:
     return _CHECKS_ENABLED
 
 
+def strict_bits() -> bool:
+    """Opt-in bit-exactness mode (``PA_TPU_STRICT_BITS=1``), the literal
+    form of the BASELINE.md "bit-exact vs SequentialBackend" gate: the
+    device lowering blocks FMA contraction (products round separately,
+    as NumPy's do), takes the fold-order-matching ELL SpMV path, and both
+    host and device dots use the same fixed-tree pairwise sum. Costs
+    throughput; the default mode agrees with the oracle to FMA rounding
+    instead. Read dynamically (not at import) so tests can toggle it."""
+    return os.environ.get("PA_TPU_STRICT_BITS", "0") == "1"
+
+
+def pairwise_sum(v):
+    """Fixed-tree pairwise sum: pad to the next power of two with exact
+    zeros, then halve until one element. The identical tree runs in the
+    compiled dot (parallel/tpu.py:_pdot_factory, strict path), making the
+    per-part partials bit-identical on host and device. Zero tail slots
+    are rounding-neutral, so trees padded to different power-of-two
+    lengths agree bit-for-bit as long as the real data is a prefix."""
+    import numpy as np
+
+    v = np.asarray(v)
+    if v.size == 0:
+        return v.dtype.type(0.0) if v.dtype.kind == "f" else 0.0
+    n = 1 << int(v.size - 1).bit_length() if v.size > 1 else 1
+    if v.size < n:
+        v = np.concatenate([v, np.zeros(n - v.size, dtype=v.dtype)])
+    while v.size > 1:
+        v = v[0::2] + v[1::2]
+    return v[0]
+
+
 def check(condition, msg: str = "check failed") -> None:
     """Cheap contract assertion, strippable via PA_TPU_CHECKS=0.
 
