@@ -99,4 +99,7 @@ def fetch_global(data) -> np.ndarray:
         return np.asarray(data)
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(data, tiled=False))
+    # tiled=True: reassemble the GLOBAL array (the only mode supported
+    # for non-fully-addressable inputs) — shape matches the single-host
+    # np.asarray path
+    return np.asarray(multihost_utils.process_allgather(data, tiled=True))

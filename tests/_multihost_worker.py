@@ -1,0 +1,74 @@
+"""Worker for the two-process multi-host smoke test (spawned by
+tests/test_multihost.py — the `mpiexec` analog of the reference's MPI
+suite, reference: test/mpi/mpiexec.jl:3-15, run over `jax.distributed`
+on CPU instead of an MPI launcher).
+
+argv: <coordinator_port> <process_id> <num_processes>
+Each process contributes 4 virtual CPU devices; the global mesh spans 8.
+"""
+import os
+import sys
+
+port, pid, nprocs = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+os.environ["JAX_ENABLE_X64"] = "true"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import partitionedarrays_jl_tpu as pa  # noqa: E402
+
+# join the cluster BEFORE any backend use (jax.devices() would pin the
+# local-only runtime)
+pa.multihost_init(
+    coordinator_address=f"localhost:{port}",
+    num_processes=nprocs,
+    process_id=pid,
+)
+assert jax.process_count() == nprocs, jax.process_count()
+devs = jax.devices()
+assert len(devs) == 4 * nprocs, devs
+local = [d for d in devs if d.process_index == jax.process_index()]
+assert len(local) == 4, local
+assert pa.is_main_process() == (pid == 0)
+
+from partitionedarrays_jl_tpu.models import poisson_fdm_driver  # noqa: E402
+from partitionedarrays_jl_tpu.parallel.tpu import TPUBackend  # noqa: E402
+
+backend = TPUBackend(devices=devs)
+err, info = pa.prun(
+    poisson_fdm_driver, backend, (2, 2, 2), (8, 8, 8), tol=1e-8, maxiter=200
+)
+assert info["iterations"] > 0, info
+assert err < 1e-5, err
+
+# cross-process replication check: every controller must see the same
+# compiled-solve result (replicated planning + deterministic collectives)
+from partitionedarrays_jl_tpu.parallel.multihost import fetch_global  # noqa: E402
+import numpy as np  # noqa: E402
+
+one_per_proc = [
+    next(d for d in devs if d.process_index == p) for p in range(nprocs)
+]
+mine = np.full((nprocs,), err)  # this controller's value in every slot
+ga = jax.make_array_from_callback(
+    (nprocs,),
+    jax.sharding.NamedSharding(
+        jax.sharding.Mesh(np.array(one_per_proc), ("h",)),
+        jax.sharding.PartitionSpec("h"),
+    ),
+    lambda idx: mine[idx],
+)
+vals = fetch_global(ga)  # slot p = process p's locally computed err
+assert np.allclose(vals, err), vals
+
+print(f"MULTIHOST_OK pid={pid} err={err:.3e} iters={info['iterations']}")
