@@ -637,8 +637,118 @@ def block_jacobi_ilu(A: PSparseMatrix, drop_tol=None, fill_factor=10):
     return apply
 
 
+def _ic0_factor(M: CSRMatrix, shift: float = 0.0, auto_shift: bool = True):
+    """IC(0) of one local SPD CSR block: returns a solver object with a
+    ``solve(r)`` applying (L Lᵀ)⁻¹, or None for an empty block.
+
+    IC(0) is breakdown-free only for M-matrices (e.g. the Poisson
+    stencil); general SPD blocks (elasticity) can hit a non-positive
+    pivot. With ``auto_shift`` (Manteuffel's remedy) the diagonal is
+    scaled by (1+α) with escalating α until the factorization exists —
+    a weaker but valid symmetric preconditioner. Raises only when even
+    α = 1 fails (the block is not SPD at all)."""
+    from scipy.sparse import csr_matrix
+    from scipy.sparse.linalg import spsolve_triangular
+
+    from .. import native
+
+    n = M.shape[0]
+    if n == 0:
+        return None
+    check(
+        M.nnz > 0,
+        "ic0: a part's block is structurally zero — the preconditioner "
+        "would silently map its residual to zero",
+    )
+    # IC(0) reads only the lower triangle — on a nonsymmetric block that
+    # would SILENTLY factor the wrong operator (observed: the
+    # row-replacement-BC elasticity fixture is nonsymmetric and PCG with
+    # the symmetrized factor diverges). Refuse instead.
+    sp = csr_matrix((M.data, M.indices, M.indptr), shape=M.shape)
+    asym = abs(sp - sp.T).max() if M.nnz else 0.0
+    if asym > 1e-12 * max(abs(sp).max(), 1.0):
+        raise ValueError(
+            f"ic0: block is not symmetric (max |A - A'| = {asym:.2e}) — "
+            "incomplete Cholesky requires an SPD block; use "
+            "block_jacobi_ilu / additive_schwarz(factor='ilu') for "
+            "nonsymmetric operators"
+        )
+    # lower triangle (diagonal last per row; rows are column-sorted)
+    r = M.row_of_nz()
+    keep = M.indices <= r
+    li, lj, lv0 = r[keep], M.indices[keep], M.data[keep].astype(np.float64)
+    # a structurally missing diagonal fails identically at every shift —
+    # diagnose it up front instead of reporting a misleading pivot error
+    L0 = compresscoo(li, lj, lv0, n, n)
+    last = L0.indices[np.maximum(L0.indptr[1:], 1) - 1]
+    row_has = (L0.indptr[1:] > L0.indptr[:-1]) & (last == np.arange(n))
+    if not row_has.all():
+        raise ValueError(
+            f"ic0: local row {int(np.nonzero(~row_has)[0][0])} has no "
+            "stored diagonal entry — IC(0) needs a full diagonal"
+        )
+    shifts = [shift]
+    if auto_shift:
+        shifts += [a for a in (1e-3, 1e-2, 1e-1, 1.0) if a > shift]
+    lvals = fail = None
+    for a in shifts:
+        lv = np.where(li == lj, lv0 * (1.0 + a), lv0) if a else lv0
+        L = compresscoo(li, lj, lv, n, n)
+        lvals, fail = native.ic0(L.indptr, L.indices, L.data, n)
+        if lvals is not None:
+            break
+    if lvals is None:
+        raise np.linalg.LinAlgError(
+            f"ic0: non-positive pivot at local row {fail} even with the "
+            "maximum diagonal shift — the block is not SPD; use "
+            "block_jacobi_ilu"
+        )
+    Lm = csr_matrix((lvals, L.indices, L.indptr), shape=(n, n))
+    Lt = Lm.T.tocsr()
+
+    class _IC0:
+        def solve(self, rv):
+            y = spsolve_triangular(Lm, rv, lower=True)
+            return spsolve_triangular(Lt, y, lower=False)
+
+    return _IC0()
+
+
+def block_jacobi_ic0(A: PSparseMatrix, shift: float = 0.0):
+    """Block-Jacobi preconditioner with a zero-fill incomplete CHOLESKY
+    factorization of each part's owned-owned block — the exactly
+    symmetric companion to `block_jacobi_ilu` for SPD operators (an LU
+    keeps CG's conjugacy only approximately; L Lᵀ keeps it exactly).
+    scipy ships no incomplete Cholesky, so the factorization is this
+    framework's own kernel (native/planning.cpp:pa_ic0_f64, with a NumPy
+    fallback). Returns a callable for ``pcg(A, b, minv=...)``."""
+    from ..parallel.backends import get_part_ids
+
+    factors = [
+        _ic0_factor(M, shift) for M in A.owned_owned_values.part_values()
+    ]
+    parts = get_part_ids(A.values)
+
+    def apply(r: PVector) -> PVector:
+        z = PVector.full(0.0, A.cols, dtype=r.dtype)
+
+        def per_part(p, zi, zv, ri_, rv):
+            f = factors[int(p)]
+            if f is not None:
+                _write_owned(zi, zv, f.solve(_owned(ri_, np.asarray(rv))))
+
+        map_parts(
+            per_part,
+            parts, z.rows.partition, z.values, r.rows.partition, r.values,
+        )
+        return z
+
+    return apply
+
+
 def additive_schwarz(
-    A: PSparseMatrix, mode: str = "asm", drop_tol=None, fill_factor=10
+    A: PSparseMatrix, mode: str = "asm", drop_tol=None, fill_factor=10,
+    factor: str = "ilu", shift: float = 0.0,
 ):
     """Overlapping-Schwarz preconditioner (one layer of overlap): each
     part factors the extended block over its owned rows PLUS the rows of
@@ -659,8 +769,22 @@ def additive_schwarz(
 
     Returns a callable for ``minv=``. The overlap typically cuts
     iterations vs `block_jacobi_ilu` at the cost of factoring slightly
-    larger blocks."""
+    larger blocks. ``factor='ic0'`` swaps the block ILUT for the exactly
+    symmetric incomplete Cholesky (SPD extended blocks; see
+    `block_jacobi_ic0`) — with ``mode='asm'`` that makes the whole
+    preconditioner symmetric, the right companion for `pcg`."""
     check(mode in ("asm", "ras"), "additive_schwarz: mode is 'asm' or 'ras'")
+    check(factor in ("ilu", "ic0"), "additive_schwarz: factor is 'ilu' or 'ic0'")
+    check(
+        factor == "ilu" or drop_tol is None,
+        "additive_schwarz: drop_tol tunes the ILUT blocks — IC(0) is "
+        "zero-fill by definition (use shift= for its Manteuffel knob)",
+    )
+    check(
+        factor == "ic0" or shift == 0.0,
+        "additive_schwarz: shift is the IC(0) Manteuffel knob — the ILUT "
+        "blocks take drop_tol/fill_factor instead",
+    )
     from ..parallel.backends import get_part_ids
     from ..parallel.prange import add_gids
     from ..parallel.psparse import exchange_coo, psparse_owned_triplets
@@ -694,7 +818,11 @@ def additive_schwarz(
             factors.append(None)
             continue
         B = compresscoo(li[keep], lj[keep], np.asarray(v)[keep], nl, nl)
-        factors.append(_spilu_factor(B, drop_tol, fill_factor))
+        factors.append(
+            _ic0_factor(B, shift)
+            if factor == "ic0"
+            else _spilu_factor(B, drop_tol, fill_factor)
+        )
 
     parts = get_part_ids(A.values)
 
@@ -1021,6 +1149,129 @@ def gmres(
             for i in range(j_used):
                 yi = y[i]
                 _owned_update(x, lambda xv, vv: xv + yi * vv, V[i])
+        r = residual_vec()
+        beta = r.norm()
+        converged = beta <= tol * max(1.0, rs0)
+    return x, {
+        "iterations": it,
+        "residuals": np.array(history),
+        "converged": bool(converged),
+    }
+
+
+def fgmres(
+    A: PSparseMatrix,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    restart: int = 30,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    minv=None,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """FLEXIBLE restarted GMRES (Saad '93): right-preconditioned Arnoldi
+    that stores the preconditioned basis Z alongside V, so ``minv`` may
+    change from one application to the next — the outer Krylov method
+    for *inner iterative* preconditioners (a coarse `cg` run, a V-cycle
+    with its own tolerance, a Schwarz sweep whose blocks adapt), which
+    plain left-preconditioned `gmres` cannot tolerate. Costs one extra
+    stored basis block (Z) per restart cycle over `gmres`.
+
+    ``minv`` is a callable ``minv(r) -> z`` (possibly stateful /
+    iteration-varying), an inverse-diagonal PVector over ``A.cols``
+    (e.g. `jacobi_preconditioner`), or None (then this is
+    right-preconditioned GMRES with M = I and its residual history is in
+    the TRUE residual norm — unlike `gmres`, whose history with minv is
+    in the preconditioned norm)."""
+    check(restart >= 1, "fgmres: restart dimension must be >= 1")
+    apply_minv = callable(minv)
+
+    x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    m = restart
+
+    def precond(v):
+        """z = M^{-1} v as a FRESH vector on A.cols (v is kept — it stays
+        in the V basis)."""
+        if minv is None:
+            z = PVector.full(0.0, A.cols, dtype=b.dtype)
+            _owned_assign(z, v)
+            return z
+        if apply_minv:
+            z = minv(v)
+            zz = PVector.full(0.0, A.cols, dtype=b.dtype)
+            _owned_assign(zz, z)
+            return zz
+        z = PVector.full(0.0, A.cols, dtype=b.dtype)
+        _owned_zip(z, lambda _z, vv, mv: mv * vv, v, minv)
+        return z
+
+    def residual_vec():
+        # TRUE residual: right preconditioning never touches the norm
+        r = PVector.full(0.0, A.cols, dtype=b.dtype)
+        q = A @ x
+        _owned_zip(r, lambda _r, bv, qv: bv - qv, b, q)
+        return r
+
+    r = residual_vec()
+    beta = r.norm()
+    rs0 = beta
+    history = [beta]
+    it = 0
+    converged = beta <= tol * max(1.0, rs0)
+    while not converged and it < maxiter:
+        V = [r / beta if beta > 0 else r.copy()]
+        Z = []
+        H = np.zeros((m + 1, m), dtype=np.float64)
+        cs = np.zeros(m)
+        sn = np.zeros(m)
+        g = np.zeros(m + 1)
+        g[0] = beta
+        j_used = 0
+        for j in range(m):
+            if it >= maxiter:
+                break
+            Z.append(precond(V[j]))
+            w = A @ Z[j]
+            for i in range(j + 1):  # modified Gram-Schmidt, fixed order
+                hij = w.dot(V[i])
+                H[i, j] = hij
+                _owned_update(w, lambda wv, vv: wv - hij * vv, V[i])
+            hj1 = w.norm()
+            H[j + 1, j] = hj1
+            for i in range(j):
+                t = cs[i] * H[i, j] + sn[i] * H[i + 1, j]
+                H[i + 1, j] = -sn[i] * H[i, j] + cs[i] * H[i + 1, j]
+                H[i, j] = t
+            rho = np.hypot(H[j, j], H[j + 1, j])
+            if rho == 0.0:
+                cs[j], sn[j] = 1.0, 0.0
+            else:
+                cs[j], sn[j] = H[j, j] / rho, H[j + 1, j] / rho
+            H[j, j] = rho
+            H[j + 1, j] = 0.0
+            g[j + 1] = -sn[j] * g[j]
+            g[j] = cs[j] * g[j]
+            it += 1
+            j_used = j + 1
+            res = abs(g[j + 1])
+            history.append(res)
+            if verbose:
+                print(f"fgmres it={it} residual={res:.3e}")
+            if res <= tol * max(1.0, rs0) or hj1 == 0.0:
+                break
+            vn = PVector.full(0.0, A.cols, dtype=b.dtype)
+            _owned_zip(vn, lambda _v, wv: wv / hj1, w)
+            V.append(vn)
+        if j_used:
+            y = np.zeros(j_used)
+            for i in range(j_used - 1, -1, -1):
+                y[i] = (g[i] - H[i, i + 1 : j_used] @ y[i + 1 : j_used]) / H[i, i]
+            for i in range(j_used):
+                yi = y[i]
+                # the update rides the PRECONDITIONED basis Z — the one
+                # line that makes the method flexible
+                _owned_update(x, lambda xv, zv: xv + yi * zv, Z[i])
         r = residual_vec()
         beta = r.norm()
         converged = beta <= tol * max(1.0, rs0)

@@ -82,6 +82,8 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, f64p, u8p,
         ]
         lib.pa_row_classes_f64.restype = ctypes.c_int64
+        lib.pa_ic0_f64.argtypes = [i32p, i32p, f64p, ctypes.c_int64, f64p]
+        lib.pa_ic0_f64.restype = ctypes.c_int64
         for name, fp in (("pa_csr_split_f64", f64p), ("pa_csr_split_f32", f32p)):
             fn = getattr(lib, name)
             fn.argtypes = [
@@ -213,6 +215,52 @@ def unique_small(vals: np.ndarray, K: int):
     if cnt < 0:
         return None, False
     return np.sort(table[:cnt]), True
+
+
+def ic0(indptr, cols, a_vals, n: int):
+    """Zero-fill incomplete Cholesky of the LOWER triangle (diagonal
+    last per row, column-sorted CSR). Returns ``(l_vals, fail_row)``:
+    on success fail_row is -1; on a non-positive pivot at row i,
+    ``(None, i)``. Pure-NumPy fallback when the native layer is absent
+    (same algorithm, Python loops — fine at block scale)."""
+    lib = _load()
+    ip = np.ascontiguousarray(indptr, dtype=np.int32)
+    cc = np.ascontiguousarray(cols, dtype=np.int32)
+    av = np.ascontiguousarray(a_vals, dtype=np.float64)
+    lv = np.empty_like(av)
+    if lib is not None:
+        rc = lib.pa_ic0_f64(ip, cc, av, n, lv)
+        if rc < 0:
+            return None, int(-rc - 1)
+        return lv, -1
+    for i in range(n):
+        s_i, e_i = ip[i], ip[i + 1]
+        if e_i == s_i or cc[e_i - 1] != i:
+            return None, i
+        for idx in range(s_i, e_i):
+            j = cc[idx]
+            s = av[idx]
+            pi, pj = s_i, ip[j]
+            ej = ip[j + 1]
+            while pi < idx and pj < ej - 1:
+                ci, cj = cc[pi], cc[pj]
+                if ci == cj:
+                    if ci >= j:
+                        break
+                    s -= lv[pi] * lv[pj]
+                    pi += 1
+                    pj += 1
+                elif ci < cj:
+                    pi += 1
+                else:
+                    pj += 1
+            if j < i:
+                lv[idx] = s / lv[ej - 1]
+            else:
+                if s <= 0.0:
+                    return None, i
+                lv[idx] = np.sqrt(s)
+    return lv, -1
 
 
 def row_classes(dia: np.ndarray, n: int, K: int):

@@ -12,6 +12,7 @@
 // * gids are int64, lids int32 (INDEX_DTYPE), -1 = absent.
 // * All functions are single-threaded (planning runs per part on one
 //   controller core) and allocation-free: callers pass NumPy buffers.
+#include <cmath>
 #include <cstdint>
 #include <algorithm>
 #include <numeric>
@@ -274,6 +275,48 @@ int64_t pa_row_classes_f64(const double* dia, int64_t D, int64_t n,
         }
     }
     return cnt;
+}
+
+
+// Zero-fill incomplete Cholesky IC(0) of a symmetric matrix given as its
+// LOWER triangle (diagonal included) in CSR with column-sorted rows.
+// a_vals in, l_vals out (same pattern). The intersection sum per entry is
+// a two-pointer merge over the column-sorted rows. Returns 0 on success,
+// -(i+1) when row i's pivot is non-positive (caller shifts or falls back).
+int64_t pa_ic0_f64(const int32_t* indptr, const int32_t* cols,
+                   const double* a_vals, int64_t n, double* l_vals) {
+    for (int64_t i = 0; i < n; ++i) {
+        const int32_t s_i = indptr[i], e_i = indptr[i + 1];
+        if (e_i == s_i || cols[e_i - 1] != (int32_t)i) return -(i + 1);
+        for (int32_t idx = s_i; idx < e_i; ++idx) {
+            const int32_t j = cols[idx];
+            // sum_{k in pattern(i) cap pattern(j), k < j} L[i,k]*L[j,k]
+            double s = a_vals[idx];
+            int32_t pi = s_i, pj = indptr[j];
+            const int32_t ej = indptr[j + 1];
+            while (pi < idx && pj < ej - 1) {  // strictly below j
+                const int32_t ci = cols[pi], cj = cols[pj];
+                if (ci == cj) {
+                    if (ci >= j) break;
+                    s -= l_vals[pi] * l_vals[pj];
+                    ++pi;
+                    ++pj;
+                } else if (ci < cj) {
+                    ++pi;
+                } else {
+                    ++pj;
+                }
+            }
+            if (j < (int32_t)i) {
+                const double d = l_vals[ej - 1];  // L[j,j], already done
+                l_vals[idx] = s / d;
+            } else {
+                if (s <= 0.0) return -(i + 1);
+                l_vals[idx] = sqrt(s);
+            }
+        }
+    }
+    return 0;
 }
 
 }  // extern "C"

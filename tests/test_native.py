@@ -157,3 +157,31 @@ def test_row_classes_matches_numpy_fallback():
     # overflow: > K classes
     _, _, ok_over = native.row_classes(rng.standard_normal((3, 64)), 64, 8)
     assert not ok_over
+
+
+def test_ic0_native_matches_fallback_and_is_exact_when_full():
+    """IC(0): native kernel vs the pure-NumPy fallback, and exactness on
+    a tridiagonal SPD matrix (full lower pattern -> IC(0) IS Cholesky)."""
+    import scipy.sparse as sp
+
+    n = 64
+    rng = np.random.default_rng(7)
+    d = 2.0 + rng.random(n)
+    A = sp.diags([-np.ones(n - 1), d, -np.ones(n - 1)], [-1, 0, 1]).tocsr()
+    low = sp.tril(A).tocsr()
+    low.sort_indices()
+    lv, fail = native.ic0(low.indptr, low.indices, low.data, n)
+    assert fail == -1
+    saved = _with_native(False)
+    try:
+        lv_np, fail_np = native.ic0(low.indptr, low.indices, low.data, n)
+    finally:
+        native._lib, native._tried = saved
+    assert fail_np == -1
+    np.testing.assert_allclose(lv, lv_np, rtol=1e-14)
+    L = sp.csr_matrix((lv, low.indices, low.indptr), shape=(n, n))
+    np.testing.assert_allclose((L @ L.T).toarray(), A.toarray(), atol=1e-12)
+    # breakdown reporting: an indefinite diagonal fails at its row
+    bad = sp.diags([np.where(np.arange(n) == 5, -1.0, 1.0)], [0]).tocsr()
+    lv_b, fail_b = native.ic0(bad.indptr, bad.indices, bad.data, n)
+    assert lv_b is None and fail_b == 5
