@@ -32,6 +32,7 @@ def cg(
     tol: float = 1e-8,
     maxiter: Optional[int] = None,
     verbose: bool = False,
+    pipelined: bool = False,
 ) -> Tuple[PVector, dict]:
     """Conjugate gradients for SPD `A`. The start vector lives on
     ``A.cols`` — the PRange carrying the column ghost layer — mirroring the
@@ -42,12 +43,23 @@ def cg(
     history is reproducible bit-for-bit for a given backend, and on the TPU
     backend it matches the sequential oracle to FMA rounding with identical
     iteration counts (exchanges are bit-identical — the BASELINE.md gate).
+
+    ``pipelined=True`` selects the lag-1 form on the TPU backend: the
+    solution update x += α·p applies one iteration late, fused into the
+    next SpMV kernel's streaming pass (tpu.py:make_cg_fn — the x pass is
+    the loop's one VMEM-spilling HBM sweep). Every scalar follows the
+    textbook recurrence, so the iteration trajectory is identical; on
+    the host backend the flag is a no-op (eager NumPy has no fusion to
+    exploit — the standard loop IS the lag-1 loop's value sequence).
     """
     from ..parallel.tpu import TPUBackend, tpu_cg
 
     if isinstance(b.values.backend, TPUBackend):
         # Device path: the whole loop is one compiled shard_map program.
-        return tpu_cg(A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose)
+        return tpu_cg(
+            A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose,
+            pipelined=pipelined,
+        )
 
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids

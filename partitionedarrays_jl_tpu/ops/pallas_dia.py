@@ -165,6 +165,7 @@ def plan_dia_padded(
         return None
     n_blocks = -(-no_max // (LANES * BR))
     return {
+        "vmem": int(vmem),
         "block_rows": BR,
         "halo_rows": h8,
         "n_blocks": int(n_blocks),
@@ -191,16 +192,29 @@ def pack_nibble_codes(codes: np.ndarray) -> np.ndarray:
     return packed.view(np.int8)
 
 
-def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, y_ref, xs_ref, cs_ref,
-                   xsem, csem, *, qr: Tuple[Tuple[int, int], ...],
+def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, *refs,
+                   qr: Tuple[Tuple[int, int], ...],
                    kk: Tuple[int, ...], code_row: Tuple[int, ...],
                    n_blocks: int, block_rows: int, halo_rows: int,
                    n_coded: int,
-                   cls_pattern: Tuple[Tuple[bool, ...], ...] = None):
+                   cls_pattern: Tuple[Tuple[bool, ...], ...] = None,
+                   has_axpy: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if has_axpy:
+        # lagged-axpy fusion (pipelined CG): while the VPU-bound SpMV
+        # streams, the DMA engines also move one block each of the
+        # PREVIOUS search direction and the solution accumulator, and the
+        # kernel applies x += alpha*p_prev on the owned band — the lone
+        # HBM pass that otherwise costs ~1/3 of a CG iteration rides the
+        # kernel's spare DMA bandwidth instead.
+        (pp_ref, xin_ref, alpha_ref, y_ref, xout_ref,
+         xs_ref, cs_ref, xsem, csem) = refs
+    else:
+        y_ref, xs_ref, cs_ref, xsem, csem = refs
 
     j = pl.program_id(0)
     BR = block_rows
@@ -311,6 +325,31 @@ def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, y_ref, xs_ref, cs_ref,
     def _zero():
         y_ref[:] = jnp.zeros_like(y_ref)
 
+    if has_axpy:
+        # frame block j holds owned elements (j-1)*BR*LANES..; pads,
+        # ghost and trash slots copy through unchanged (x keeps its
+        # zero-ghost invariant — the host loop never touches them either)
+        @pl.when((j >= 1) & (j <= n_blocks))
+        def _axpy():
+            e2 = (
+                (j - 1) * block_rows * LANES
+                + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_rows, LANES), 0
+                ) * LANES
+                + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_rows, LANES), 1
+                )
+            )
+            xout_ref[:] = jnp.where(
+                e2 < no_ref[0],
+                xin_ref[:] + alpha_ref[0] * pp_ref[:],
+                xin_ref[:],
+            )
+
+        @pl.when((j < 1) | (j > n_blocks))
+        def _axpy_copy():
+            xout_ref[:] = xin_ref[:]
+
 
 def dia_coded_padded_pallas(
     codebook: "jax.Array",  # noqa: F821
@@ -324,6 +363,7 @@ def dia_coded_padded_pallas(
     total_rows: int,
     interpret: bool = False,
     cls_pattern: Tuple[Tuple[bool, ...], ...] = None,
+    axpy: Tuple["jax.Array", "jax.Array", "jax.Array"] = None,  # noqa: F821
 ):
     """Full-vector coded SpMV on the padded layout: x is a whole
     (total_rows, 128) padded vector (owned at flat offset plan['o0'],
@@ -332,7 +372,16 @@ def dia_coded_padded_pallas(
     computed and every other slot exactly zero. codes: (Dc, n_blocks*BR,
     128) int8. ``cls_pattern`` (row-class mode only, all coded diagonals
     on stream 0): K per-class nonzero masks over the diagonals enabling
-    the per-class-accumulator decode — see `_padded_kernel`."""
+    the per-class-accumulator decode — see `_padded_kernel`.
+
+    ``axpy=(pprev, xacc, alpha)`` additionally applies the lagged
+    solution update of pipelined CG in the same pass: returns
+    ``(y, xacc')`` with ``xacc' = xacc + alpha*pprev`` on the owned band
+    (other slots copy through; xacc aliased in/out, alpha a (1,)-shaped
+    SMEM scalar). The update rides the kernel's spare DMA bandwidth
+    instead of its own HBM pass (tpu.py:make_cg_fn); callers must first
+    check `axpy_vmem_ok(plan)` — the plan's VMEM gate does not include
+    the three extra double-buffered pipeline blocks."""
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -352,29 +401,59 @@ def dia_coded_padded_pallas(
         _padded_kernel, qr=qr, kk=tuple(int(k) for k in kk),
         code_row=tuple(int(c) for c in code_row), n_blocks=nB,
         block_rows=BR, halo_rows=H, n_coded=Dc,
-        cls_pattern=cls_pattern,
+        cls_pattern=cls_pattern, has_axpy=axpy is not None,
     )
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # codebook
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # no
+        pl.BlockSpec(memory_space=pl.ANY),  # codes: manual DMA
+        pl.BlockSpec(memory_space=pl.ANY),  # x: manual DMA
+    ]
+    y_spec = pl.BlockSpec(
+        (BR, LANES), lambda j: (j, 0), memory_space=pltpu.VMEM
+    )
+    y_shape = jax.ShapeDtypeStruct((total_rows, LANES), codebook.dtype)
+    scratch = [
+        pltpu.VMEM((2, win_rows, LANES), codebook.dtype),
+        pltpu.VMEM((2, max(Dc, 1), BR, LANES), codes.dtype),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
+    if axpy is None:
+        return pl.pallas_call(
+            kernel,
+            grid=(total_rows // BR,),
+            in_specs=in_specs,
+            out_specs=y_spec,
+            out_shape=y_shape,
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )(codebook, no, codes, x)
+    pprev, xacc, alpha = axpy
+    assert pprev.shape == x.shape == xacc.shape
+    blk = pl.BlockSpec((BR, LANES), lambda j: (j, 0), memory_space=pltpu.VMEM)
     return pl.pallas_call(
         kernel,
         grid=(total_rows // BR,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # codebook
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # no
-            pl.BlockSpec(memory_space=pl.ANY),  # codes: manual DMA
-            pl.BlockSpec(memory_space=pl.ANY),  # x: manual DMA
+        in_specs=in_specs + [
+            blk,  # pprev
+            blk,  # xacc in
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # alpha
         ],
-        out_specs=pl.BlockSpec(
-            (BR, LANES), lambda j: (j, 0), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((total_rows, LANES), codebook.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((2, win_rows, LANES), codebook.dtype),
-            pltpu.VMEM((2, max(Dc, 1), BR, LANES), codes.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
+        out_specs=[y_spec, blk],
+        out_shape=[y_shape, jax.ShapeDtypeStruct(xacc.shape, xacc.dtype)],
+        input_output_aliases={5: 1},
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(codebook, no, codes, x)
+    )(codebook, no, codes, x, pprev, xacc, alpha)
+
+
+def axpy_vmem_ok(plan: dict, itemsize: int = 4) -> bool:
+    """Whether the fused-axpy variant's three extra double-buffered
+    (BR, 128) pipeline blocks still fit the VMEM budget the plan was
+    gated on."""
+    extra = 6 * plan["block_rows"] * LANES * itemsize
+    return plan.get("vmem", 0) + extra <= 13 * 2**20
 
 
 def plan_dia_pallas(

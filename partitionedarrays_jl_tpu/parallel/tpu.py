@@ -918,11 +918,20 @@ def _matrix_operands(dA: DeviceMatrix) -> dict:
     return ops
 
 
-def _spmv_body(dA: DeviceMatrix):
+def _spmv_body(dA: DeviceMatrix, axpy: bool = False):
     """Per-shard overlapped SpMV: pack+permute the halo, compute the A_oo
     partial on pre-exchange owned values (independent of the collective —
     XLA overlaps them), then unpack and add the A_oh ghost contribution
-    on the compact boundary-row set."""
+    on the compact boundary-row set.
+
+    With ``axpy=True`` the returned body has the signature
+    ``body(xv, m, xacc, pprev, alpha) -> (y, xacc')`` and ALSO applies
+    the lagged solution update ``xacc' = xacc + alpha*pprev`` (owned
+    region). On the padded coded path the update rides the Pallas
+    kernel's spare DMA bandwidth (see pipelined CG in `make_cg_fn` —
+    measured: the standalone x pass costs ~1/3 of a CG iteration because
+    x spills the loop's VMEM-resident working set); elsewhere it is the
+    plain in-loop update (same values, no overlap)."""
     import jax
     import jax.numpy as jnp
 
@@ -1016,14 +1025,46 @@ def _spmv_body(dA: DeviceMatrix):
             acc = term if acc is None else acc + term
         return jnp.where(jnp.arange(no_max) < no[0], acc, 0)
 
-    def body(xv, m):
+    if axpy and pplan is not None and dA.dia_cb is not None:
+        from ..ops.pallas_dia import axpy_vmem_ok
+
+        # the plan's VMEM gate did not include the axpy variant's three
+        # extra double-buffered pipeline blocks — re-check headroom and
+        # fall back to the plain lagged update when it is gone
+        _axpy_in_kernel = axpy_vmem_ok(
+            pplan, itemsize=np.dtype(dA.dia_cb.dtype).itemsize
+        )
+    else:
+        _axpy_in_kernel = False
+
+    def _dia_coded_full_axpy(cb, no, codes, xv, xacc, pprev, alpha):
+        from ..ops.pallas_dia import LANES, dia_coded_padded_pallas
+
+        y, xacc2 = dia_coded_padded_pallas(
+            cb, no.astype(jnp.int32), codes, xv.reshape(-1, LANES),
+            offsets, kk, code_row, pplan, xv.shape[0] // LANES,
+            interpret=interpret, cls_pattern=dA.dia_cls_pattern,
+            axpy=(
+                pprev.reshape(-1, LANES), xacc.reshape(-1, LANES),
+                jnp.reshape(alpha, (1,)).astype(xv.dtype),
+            ),
+        )
+        return y.reshape(-1), xacc2.reshape(-1)
+
+    def body(xv, m, *ax):
         full = None
+        xacc2 = None
         if mode == "coded":
             # coded-diagonal path: 1 byte/element per non-constant
             # diagonal, decoded against the SMEM codebook — independent of
             # the wire, so it still overlaps the halo collective
             if pplan is not None:
-                full = _dia_coded_full(m["cb"], m["no"], m["codes"], xv)
+                if axpy and _axpy_in_kernel:
+                    full, xacc2 = _dia_coded_full_axpy(
+                        m["cb"], m["no"], m["codes"], xv, *ax
+                    )
+                else:
+                    full = _dia_coded_full(m["cb"], m["no"], m["codes"], xv)
             else:
                 partial_ = _dia_coded_xla(m["cb"], m["no"], m["codes"], xv)
         elif offsets is not None:  # owned block first: overlaps the wire
@@ -1031,6 +1072,13 @@ def _spmv_body(dA: DeviceMatrix):
             partial_ = rowsum(m["oo_v"], xv)
         else:
             partial_ = _ell_rowsum(m["oo_v"], m["oo_c"], xv)
+        if axpy and xacc2 is None:
+            # fallback paths: the plain (unfused) lagged update — same
+            # values and order as the standard recurrence's axpy
+            xacc, pprev, alpha = ax
+            colL = dA.col_plan.layout
+            cs = slice(colL.o0, colL.o0 + colL.no_max)
+            xacc2 = xacc.at[cs].add(_rp(alpha * pprev[cs]))
         xv = exch(xv, m["si"], m["sm"], m["ri"])
         if full is not None:
             y = full  # already a complete vector, pads exactly zero
@@ -1046,7 +1094,7 @@ def _spmv_body(dA: DeviceMatrix):
             # target the trash slot with exact-zero values)
             y = y.at[m["oh_r"]].add(_ell_rowsum(m["oh_v"], m["oh_c"], xv))
             y = y.at[g0:].set(0)
-        return y, xv
+        return (y, xacc2) if axpy else (y, xv)
 
     return body
 
@@ -1091,14 +1139,27 @@ def make_spmv_fn(dA: DeviceMatrix) -> Callable:
 
 
 def make_cg_fn(
-    dA: DeviceMatrix, tol: float, maxiter: int, precond: bool = False
+    dA: DeviceMatrix, tol: float, maxiter: int, precond: bool = False,
+    pipelined: bool = False,
 ) -> Callable:
     """The whole CG solve as ONE compiled shard_map program:
     `lax.while_loop` whose body does the overlapped SpMV, deterministic
     all-gather dots, and owned-region axpys. With ``precond`` the loop is
     preconditioned CG against a diagonal preconditioner supplied as an
     extra (P, W) operand (owned slots = inverse diagonal). Returns
-    (x_stacked, iterations, final_residual)."""
+    (x_stacked, iterations, final_residual).
+
+    ``pipelined=True`` (unpreconditioned only) is the lag-1 form: the
+    solution update x += α·p is applied one iteration LATE, fused into
+    the next iteration's SpMV kernel where it rides spare DMA bandwidth
+    (`_spmv_body(axpy=True)`), with one flush after the loop. Motivation
+    (measured, 192³ f32 one chip): r/p/q stay VMEM-resident across the
+    loop so their updates are nearly free, but adding x to the working
+    set spills — the lone x pass costs ~80 µs of the 242 µs iteration.
+    Every scalar (α, β, residuals) follows the textbook recurrence on
+    the same dots in the same order, so the iteration trajectory is
+    IDENTICAL to the standard form — only where x materializes changes
+    (validated in tests/test_tpu.py)."""
     import jax
     import jax.numpy as jnp
     from jax import shard_map
@@ -1107,9 +1168,15 @@ def make_cg_fn(
     spec = dA.backend.parts_spec()
     none_spec = jax.sharding.PartitionSpec()
     body_spmv = _spmv_body(dA)
+    body_axpy = _spmv_body(dA, axpy=True) if pipelined else None
     no_max = dA.row_layout.no_max
     o0 = dA.row_layout.o0
     g0 = dA.row_layout.g0
+    check(
+        not (pipelined and precond),
+        "make_cg_fn: the pipelined (lag-1) form is unpreconditioned-only "
+        "— drop precond or pipelined",
+    )
     pdot = _pdot_factory(o0, no_max)
     ops = _matrix_operands(dA)
     specs = jax.tree.map(lambda _: spec, ops)
@@ -1185,9 +1252,45 @@ def make_cg_fn(
                 hist = hist.at[jnp.minimum(it + 1, H - 1)].set(jnp.sqrt(rs_new))
                 return (x, r, p, rz_new, rs_new, it + 1, hist)
 
-            x, r, p, rz, rs, it, hist = jax.lax.while_loop(
-                cond, step, (xv, r, p, rz0, rs0, jnp.int32(0), hist)
+            if not pipelined:
+                x, r, p, rz, rs, it, hist = jax.lax.while_loop(
+                    cond, step, (xv, r, p, rz0, rs0, jnp.int32(0), hist)
+                )
+                return x[None], rs, rs0, it, hist
+
+            sl = slice(o0, o0 + no_max)
+
+            def cond_pipe(state):
+                _x, _r, _p, _pp, _ap, rs, it, _h = state
+                return (
+                    jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0))
+                ) & (it < maxiter)
+
+            def step_pipe(state):
+                x, r, p, p_prev, alpha_prev, rs, it, hist = state
+                # the SpMV also flushes LAST iteration's x update inside
+                # the kernel's streaming pass
+                q, x = body_axpy(
+                    p, mats, x, p_prev, alpha_prev
+                )
+                pq = pdot(p, q)
+                alpha = rs / pq
+                r = r.at[sl].add(_rp(-alpha * q[sl]))
+                rs_new = pdot(r, r)
+                beta = rs_new / rs
+                p_new = p.at[sl].set(r[sl] + _rp(beta * p[sl]))
+                hist = hist.at[jnp.minimum(it + 1, H - 1)].set(
+                    jnp.sqrt(rs_new)
+                )
+                return (x, r, p_new, p, alpha, rs_new, it + 1, hist)
+
+            zero = jnp.zeros((), bv.dtype)
+            x, r, p, p_prev, alpha_prev, rs, it, hist = jax.lax.while_loop(
+                cond_pipe, step_pipe,
+                (xv, r, p, jnp.zeros_like(p), zero, rs0, jnp.int32(0), hist),
             )
+            # flush the final lagged update (no-op when zero iterations)
+            x = x.at[sl].add(_rp(alpha_prev * p_prev[sl]))
             return x[None], rs, rs0, it, hist
 
         return shard_map(
@@ -1999,16 +2102,20 @@ def tpu_cg(
     maxiter: Optional[int] = None,
     verbose: bool = False,
     minv: Optional[PVector] = None,
+    pipelined: bool = False,
 ) -> Tuple[PVector, dict]:
     """Device (preconditioned) CG: the whole loop is one compiled
     shard_map program. `minv` is an optional diagonal preconditioner (a
     PVector over A.cols holding the inverse diagonal in its owned
-    entries)."""
+    entries). ``pipelined`` selects the lag-1 form with the solution
+    update fused into the SpMV kernel (see `make_cg_fn`)."""
     backend = b.values.backend
     check(isinstance(backend, TPUBackend), "tpu_cg needs a TPU-backend PVector")
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
     dA = device_matrix(A, backend)
-    solve = _krylov_fn_for(dA, "cg", tol, maxiter, precond=minv is not None)
+    solve = _krylov_fn_for(
+        dA, "cg", tol, maxiter, precond=minv is not None, pipelined=pipelined
+    )
     return _run_krylov(
         A, b, x0, tol, verbose, solve, minv=minv,
         name="pcg" if minv is not None else "cg",
@@ -2037,12 +2144,15 @@ def tpu_bicgstab(
 
 
 def _krylov_fn_for(
-    dA: DeviceMatrix, method: str, tol: float, maxiter: int, precond: bool = False
+    dA: DeviceMatrix, method: str, tol: float, maxiter: int,
+    precond: bool = False, pipelined: bool = False,
 ):
-    key = (method, float(tol), int(maxiter), bool(precond))
+    key = (method, float(tol), int(maxiter), bool(precond), bool(pipelined))
     if key not in dA._cg_cache:
         if method == "cg":
-            dA._cg_cache[key] = make_cg_fn(dA, tol, maxiter, precond=precond)
+            dA._cg_cache[key] = make_cg_fn(
+                dA, tol, maxiter, precond=precond, pipelined=pipelined
+            )
         else:
             dA._cg_cache[key] = make_bicgstab_fn(
                 dA, tol, maxiter, precond=precond

@@ -357,3 +357,50 @@ def test_padded_frame_solver_parity(monkeypatch):
     # both solve errors are ~1e-9 magnitudes; compare to rounding noise
     np.testing.assert_allclose(err_t, err_s, rtol=1e-5, atol=1e-12)
     assert err_s < 1e-6 and err_t < 1e-6
+
+
+def test_pipelined_cg_matches_standard():
+    """The lag-1 (pipelined) form: the solution update rides the next
+    SpMV; every scalar follows the textbook recurrence, so the residual
+    HISTORY must match the standard device loop essentially exactly and
+    the solutions must agree to rounding."""
+    import jax
+
+    from partitionedarrays_jl_tpu.models import assemble_poisson, gather_pvector
+    from partitionedarrays_jl_tpu.parallel.tpu import TPUBackend
+
+    ns = (8, 8, 8)
+    tol = 1e-9
+
+    def run(backend, pipelined):
+        def driver(parts):
+            A, b, x_exact, x0 = assemble_poisson(parts, ns)
+            x, info = pa.cg(
+                A, b, x0=x0, tol=tol, maxiter=500, pipelined=pipelined
+            )
+            r = b - A @ x
+            return gather_pvector(x), info, r.norm()
+
+        return pa.prun(driver, backend, (2, 2, 2))
+
+    backend = TPUBackend(devices=jax.devices()[:8])
+    xs, is_, _ = run(pa.sequential, False)
+    xd0, id0, rd0 = run(backend, False)
+    xd1, id1, rd1 = run(backend, True)
+    for info in (is_, id0, id1):
+        assert info["converged"], info
+    # identical trajectory: same dots, same order -> same iterations and
+    # (to rounding) the same residual history as the standard device loop
+    assert id1["iterations"] == id0["iterations"]
+    n = id0["iterations"] + 1
+    np.testing.assert_allclose(
+        np.asarray(id1["residuals"])[:n],
+        np.asarray(id0["residuals"])[:n],
+        rtol=1e-12,
+    )
+    np.testing.assert_allclose(np.asarray(xd1), np.asarray(xd0), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(xd1), xs, atol=1e-8)
+    # honest recomputed residuals meet the relative tolerance
+    r0 = float(is_["residuals"][0])
+    for rr in (rd0, rd1):
+        assert float(rr) <= tol * max(1.0, r0) * 1.5, (float(rr), r0)

@@ -51,31 +51,44 @@ def main():
     dx0 = DeviceVector.from_pvector(x0, backend, dA.col_layout)
 
     K0, K1 = 100, 500
-    # compile each K-program ONCE; only the timed executions repeat
-    solves = {k: make_cg_fn(dA, tol=0.0, maxiter=k) for k in (K0, K1)}
-    for s in solves.values():  # warm: the solve ends in host scalars
-        _ = [float(v) for v in s(db.data, dx0.data, None)[1:4]]
-
-    def run_k(k):
-        solve = solves[k]
-        ts = []
-        for _i in range(5):
-            t0 = time.perf_counter()
-            out = solve(db.data, dx0.data, None)
-            _ = float(out[1])  # host fetch closes the chain
-            ts.append(time.perf_counter() - t0)
-        return float(np.median(ts))
-
-    per_it = []
-    for _round in range(3):
-        t0, t1 = run_k(K0), run_k(K1)
-        per_it.append((t1 - t0) / (K1 - K0))
-    dt = float(np.median(per_it))
     flops = dA.flops_per_spmv  # one SpMV per CG iteration
+
+    def measure(pipelined: bool) -> float:
+        # compile each K-program ONCE; only the timed executions repeat
+        solves = {
+            k: make_cg_fn(dA, tol=0.0, maxiter=k, pipelined=pipelined)
+            for k in (K0, K1)
+        }
+        for s in solves.values():  # warm: the solve ends in host scalars
+            _ = [float(v) for v in s(db.data, dx0.data, None)[1:4]]
+
+        def run_k(k):
+            solve = solves[k]
+            ts = []
+            for _i in range(5):
+                t0 = time.perf_counter()
+                out = solve(db.data, dx0.data, None)
+                _ = float(out[1])  # host fetch closes the chain
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts))
+
+        per_it = []
+        for _round in range(3):
+            t0, t1 = run_k(K0), run_k(K1)
+            per_it.append((t1 - t0) / (K1 - K0))
+        return float(np.median(per_it))
+
+    dt = measure(False)
     print(
         f"cg_per_iteration_us={dt * 1e6:.1f} "
         f"spmv_equiv_gflops={flops / dt / 1e9:.1f} "
         f"(n={n}^3, f32, one chip; includes 2 dots + 3 axpys + halo no-op)"
+    )
+    dtf = measure(True)
+    print(
+        f"pipelined_cg_per_iteration_us={dtf * 1e6:.1f} "
+        f"spmv_equiv_gflops={flops / dtf / 1e9:.1f} "
+        f"speedup_vs_standard={dt / dtf:.3f}x"
     )
 
 
