@@ -1,0 +1,143 @@
+"""Irregular-graph SpMV throughput on one chip (BASELINE configs[5]):
+the Morton-ordered unstructured-tet elasticity operator. The generic
+lowering is padded-ELL, whose per-element gathers run element-at-a-time
+on TPU; the shipped fast path is the node-block BSR lowering
+(`DeviceMatrix._detect_bsr`): one gather index per bs×bs block + batched
+einsum block products (measured 27x over ELL when first prototyped).
+This tool records the before/after on the real integrated paths.
+
+    python tools/bench_irregular.py          # 32^3 nodes = 98k dofs
+    PA_IRR_N=24 python tools/bench_irregular.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu.models import assemble_elasticity_tet
+    from partitionedarrays_jl_tpu.ops.sparse import csr_spmv
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        DeviceMatrix,
+        DeviceVector,
+        TPUBackend,
+        device_matrix,
+        make_spmv_fn,
+    )
+
+    n = int(os.environ.get("PA_IRR_N", "32"))
+    backend = TPUBackend(devices=jax.devices()[:1])
+
+    def driver(parts):
+        t0 = time.perf_counter()
+        A, b, xe, x0 = assemble_elasticity_tet(parts, (n, n, n))
+        print(
+            f"assembled {n}^3 nodes = {A.rows.ngids/1e3:.0f}k dofs "
+            f"in {time.perf_counter()-t0:.1f}s",
+            flush=True,
+        )
+        A.values = pa.map_parts(
+            lambda M: pa.CSRMatrix(
+                M.indptr, M.indices,
+                (M.data / np.abs(M.data).max()).astype(np.float32), M.shape
+            ),
+            A.values,
+        )
+        A.invalidate_blocks()
+        xe.values = pa.map_parts(lambda v: np.asarray(v, np.float32), xe.values)
+        return A, xe
+
+    A, xe = pa.prun(driver, backend, 1)
+    M = A.values.part_values()[0]
+    lengths = np.diff(M.indptr)
+    L = int(lengths.max())
+    nnz, rows = int(M.nnz), M.shape[0]
+    print(
+        f"nnz={nnz/1e6:.1f}M rows={rows/1e3:.0f}k ELL width L={L} "
+        f"(mean row {nnz/rows:.1f}) padding overhead {rows*L/nnz:.2f}x",
+        flush=True,
+    )
+
+    import statistics
+    from functools import partial
+
+    def measure(dA, label):
+        dx = DeviceVector.from_pvector(xe, backend, dA.col_layout)
+        spmv = make_spmv_fn(dA)
+        flops = dA.flops_per_spmv
+
+        @partial(jax.jit, static_argnums=1)
+        def chain(x, k):
+            return jax.lax.fori_loop(
+                0, k, lambda i, y: spmv(y) * np.float32(1e-3), x
+            ).sum()
+
+        def chain_time(k, nreps=5):
+            float(chain(dx.data, k))
+            float(chain(dx.data, k))
+            ts = []
+            for _ in range(nreps):
+                t0 = time.perf_counter()
+                v = float(chain(dx.data, k))
+                ts.append(time.perf_counter() - t0)
+            assert v == v
+            return statistics.median(ts)
+
+        def measure_once():
+            k1, k2 = 20, 220
+            t1 = chain_time(k1)
+            for _ in range(4):
+                t2 = chain_time(k2)
+                dt = (t2 - t1) / (k2 - k1)
+                if dt > 0:
+                    return dt
+                k2 *= 2
+            return t2 / (k2 // 2)
+
+        dt = sorted(measure_once() for _ in range(3))[1]
+        print(
+            f"{label}: {dt*1e6:.1f} us -> {flops/dt/1e9:.1f} GFLOP/s",
+            flush=True,
+        )
+        return dt
+
+    # integrated default: the BSR node-block path
+    dA = device_matrix(A, backend)
+    assert dA.bsr_bs == 3, f"expected 3x3 BSR lowering, got {dA.bsr_bs}"
+    dt_bsr = measure(dA, "BSR(3x3) SpMV (default lowering)")
+
+    # forced generic ELL (the pre-round-2 lowering), same matrix
+    os.environ["PA_TPU_BSR"] = "0"
+    try:
+        dA_ell = DeviceMatrix(A, backend)
+    finally:
+        del os.environ["PA_TPU_BSR"]
+    assert dA_ell.bsr_bs is None and dA_ell.dia_mode is None
+    dt_ell = measure(dA_ell, "padded-ELL SpMV (PA_TPU_BSR=0)")
+
+    xv = np.asarray(xe.values.part_values()[0], dtype=np.float32)
+    csr_spmv(M, xv)
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        csr_spmv(M, xv)
+        ts.append(time.perf_counter() - t0)
+    host_dt = statistics.median(ts)
+    print(
+        f"host oracle: {host_dt*1e3:.1f} ms; BSR vs ELL {dt_ell/dt_bsr:.1f}x, "
+        f"BSR vs host {host_dt/dt_bsr:.1f}x",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""1e8-DOF end-to-end assemble + solve on one chip, with a JSON artifact
+(BASELINE.json configs[3]-scale evidence; reference anchor: the
+strong-scaling FE workload of /root/reference/README.md:49-63).
+
+Assembles the 464^3 (= 99.9M DOF) 3-D Poisson operator on host, lowers
+it to the coded-DIA device form, runs ONE compiled CG solve to 1e-5, and
+records every phase in ``SCALE_BENCH.json`` (repo root) plus a final
+JSON line on stdout. Shrink with PA_SCALE_N for smoke runs.
+
+    python tools/bench_scale.py            # 464^3, writes SCALE_BENCH.json
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu.models import assemble_poisson
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        DeviceVector,
+        TPUBackend,
+        _b_on_cols_layout,
+        device_matrix,
+        make_cg_fn,
+    )
+
+    n = int(os.environ.get("PA_SCALE_N", "464"))
+    tol = float(os.environ.get("PA_SCALE_TOL", "1e-5"))
+    out_path = os.environ.get(
+        "PA_SCALE_OUT",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "SCALE_BENCH.json"),
+    )
+    backend = TPUBackend(devices=jax.devices()[:1])
+    rec = {"n": n, "dofs": n**3, "dtype": "float32", "tol": tol}
+
+    def driver(parts):
+        t0 = time.perf_counter()
+        A, b, xe, x0 = assemble_poisson(parts, (n, n, n))
+        rec["assembly_s"] = round(time.perf_counter() - t0, 2)
+        print(f"assembly {n}^3 = {n**3/1e6:.1f}M DOFs: {rec['assembly_s']}s", flush=True)
+
+        t0 = time.perf_counter()
+        A.values = pa.map_parts(
+            lambda M: pa.CSRMatrix(
+                M.indptr, M.indices, M.data.astype(np.float32), M.shape
+            ),
+            A.values,
+        )
+        A.invalidate_blocks()
+        b.values = pa.map_parts(lambda v: np.asarray(v, np.float32), b.values)
+        xe.values = pa.map_parts(lambda v: np.asarray(v, np.float32), xe.values)
+        Ah, bh = pa.decouple_dirichlet(A, b)
+        rec["cast_decouple_s"] = round(time.perf_counter() - t0, 2)
+
+        t0 = time.perf_counter()
+        dA = device_matrix(Ah, backend)
+        rec["lowering_s"] = round(time.perf_counter() - t0, 2)
+        rec["dia_mode"] = dA.dia_mode
+        rec["nnz"] = int(dA.flops_per_spmv // 2)
+        print(
+            f"lowering: {rec['lowering_s']}s mode={dA.dia_mode} "
+            f"nnz={rec['nnz']/1e6:.0f}M",
+            flush=True,
+        )
+
+        t0 = time.perf_counter()
+        db = _b_on_cols_layout(bh, dA)
+        x0v = pa.PVector.full(0.0, Ah.cols, dtype=np.float32)
+        dx0 = DeviceVector.from_pvector(x0v, backend, dA.col_layout)
+        solve = make_cg_fn(dA, tol=tol, maxiter=20000)
+        rec["staging_s"] = round(time.perf_counter() - t0, 2)
+
+        # compile (first call) separated from the steady-state solve
+        t0 = time.perf_counter()
+        out = solve(db.data, dx0.data, None)
+        it = int(out[3])
+        rec["first_solve_s"] = round(time.perf_counter() - t0, 2)
+        t0 = time.perf_counter()
+        out = solve(db.data, dx0.data, None)
+        rs, rs0, it = float(out[1]), float(out[2]), int(out[3])
+        rec["solve_s"] = round(time.perf_counter() - t0, 2)
+        rec["iterations"] = it
+        rec["rel_residual"] = float(np.sqrt(rs) / max(1.0, np.sqrt(rs0)))
+        rec["converged"] = bool(np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)))
+        rec["per_iteration_ms"] = round(rec["solve_s"] * 1e3 / max(it, 1), 3)
+        rec["spmv_equiv_gflops"] = round(
+            dA.flops_per_spmv * it / rec["solve_s"] / 1e9, 1
+        )
+
+        # solution quality vs the manufactured solution (err checked the
+        # reference's way: test_fdm.jl's norm(x - x_exact) gate)
+        x = DeviceVector(out[0], Ah.cols, dA.col_layout, backend).to_pvector()
+        err = float((x - xe).norm() / xe.norm())
+        rec["rel_err_vs_exact"] = err
+        print(
+            f"solve: {rec['solve_s']}s, {it} iterations, "
+            f"rel_res={rec['rel_residual']:.2e}, rel_err={err:.2e}",
+            flush=True,
+        )
+        assert rec["converged"], rec
+        return True
+
+    pa.prun(driver, backend, (1, 1, 1))
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+    print(json.dumps({"metric": f"e2e_solve_s_poisson3d_{n}cube_f32",
+                      "value": rec["solve_s"], "unit": "s",
+                      "vs_baseline": rec["per_iteration_ms"]}))
+
+
+if __name__ == "__main__":
+    main()
