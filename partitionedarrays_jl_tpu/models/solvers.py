@@ -229,8 +229,14 @@ def lobpcg(
     check(nev >= 1, "lobpcg: nev must be >= 1")
     m = int(nev)
     from ..parallel.tpu import TPUBackend
+    from .gmg import GMGHierarchy
 
-    if isinstance(A.values.backend, TPUBackend) and not callable(minv):
+    if isinstance(A.values.backend, TPUBackend) and (
+        not callable(minv) or isinstance(minv, GMGHierarchy)
+    ):
+        # diagonal OR multigrid preconditioners compile to one program
+        # (the V-cycle inlines per residual block row); other callables
+        # run the host loop below
         from ..parallel.tpu_lobpcg import tpu_lobpcg
 
         return tpu_lobpcg(

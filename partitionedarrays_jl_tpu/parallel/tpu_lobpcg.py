@@ -35,8 +35,12 @@ from .tpu import (
 
 
 def make_lobpcg_fn(
-    dA, nev: int, tol: float, maxiter: int, largest: bool, precond: bool
+    dA, nev: int, tol: float, maxiter: int, largest: bool, precond: bool,
+    gmg_h=None,
 ):
+    """``gmg_h`` (a models.gmg.GMGHierarchy) inlines the ENTIRE multigrid
+    V-cycle as the preconditioner applied to each residual block row —
+    multigrid-preconditioned modal analysis as ONE compiled program."""
     import jax
     import jax.numpy as jnp
     from jax import shard_map
@@ -53,14 +57,27 @@ def make_lobpcg_fn(
     ops = _matrix_operands(dA)
     specs = jax.tree.map(lambda _: spec, ops)
     sgn = -1.0 if largest else 1.0
+    if gmg_h is not None:
+        from .tpu_gmg import (
+            _device_hierarchy, _gmg_operands, _shard_ops, _vcycle_shard_body,
+        )
+
+        dh = _device_hierarchy(gmg_h, dA.backend)
+        vcycle = _vcycle_shard_body(gmg_h, dh)
+        gops = _gmg_operands(dh)
+        gspecs = jax.tree.map(lambda _: spec, gops)
+        cinv_host = dh["cinv"]
 
     @jax.jit
-    def fn(X0, mv, mats_in):
-        def shard_fn(X0s, mvs, ms):
+    def fn(X0, mv, mats_in, *g):
+        def shard_fn(X0s, mvs, ms, *gs):
             X = X0s[0]  # (m, no) owned block
             mats = {k: v[0] for k, v in ms.items()}
             mvv = mvs[0]
             dt = X.dtype
+            if gmg_h is not None:
+                gmat = _shard_ops(jax, gs[0])
+                cinv_r = gs[1]
 
             def gsum(partial_):
                 return jnp.sum(jax.lax.all_gather(partial_, "parts"), axis=0)
@@ -112,7 +129,14 @@ def make_lobpcg_fn(
             def step(st):
                 X, AX, P, AP, lam, _res, it, hist = st
                 R = AX - lam[:, None] * X
-                if precond:
+                if gmg_h is not None:
+                    # one full V-cycle per residual block row, inlined
+                    def prec_one(r_owned):
+                        rv = jnp.zeros(L.W, dtype=dt).at[sl].set(r_owned)
+                        return vcycle(rv, gmat, cinv_r)[sl]
+
+                    W = jnp.stack([prec_one(R[i]) for i in range(m)])
+                elif precond:
                     W = R * mvv[None, sl]
                 else:
                     W = R
@@ -165,15 +189,20 @@ def make_lobpcg_fn(
             order = jnp.argsort(sgn * lam)
             return X[order][None], lam[order], res[order], it, hist
 
+        in_specs = (spec, spec, specs)
+        if gmg_h is not None:
+            in_specs = in_specs + (gspecs, none_spec)
         return shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(spec, spec, specs),
+            in_specs=in_specs,
             out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
             check_vma=False,
-        )(X0, mv, mats_in)
+        )(X0, mv, mats_in, *g)
 
     def run(X0, mv):
+        if gmg_h is not None:
+            return fn(X0, X0 if mv is None else mv, ops, gops, cinv_host)
         return fn(X0, X0 if mv is None else mv, ops)
 
     return run
@@ -192,22 +221,47 @@ def tpu_lobpcg(
 ):
     """Device LOBPCG (see make_lobpcg_fn): X0/minv are staged into the
     matrix's column layout; eigenvectors come back as PVectors."""
+    from ..models.gmg import GMGHierarchy
+
     backend = A.values.backend if hasattr(A.values, "backend") else None
     check(isinstance(backend, TPUBackend), "tpu_lobpcg needs the TPU backend")
+    gmg_h = minv if isinstance(minv, GMGHierarchy) else None
     check(
-        minv is None or isinstance(minv, PVector),
-        "tpu_lobpcg takes a diagonal PVector preconditioner — for callable "
-        "preconditioners use models.solvers.lobpcg (host loop)",
+        minv is None or gmg_h is not None or isinstance(minv, PVector),
+        "tpu_lobpcg takes a diagonal PVector or GMGHierarchy "
+        "preconditioner — for other callables use models.solvers.lobpcg "
+        "(host loop)",
     )
     m = int(nev)
     dA = device_matrix(A, backend)
     L = dA.col_plan.layout
-    key = ("lobpcg", m, float(tol), int(maxiter), bool(largest), minv is not None)
-    if key not in dA._cg_cache:
-        dA._cg_cache[key] = make_lobpcg_fn(
-            dA, m, tol, maxiter, largest, minv is not None
+    if gmg_h is not None:
+        # the hierarchy's level-0 operator must share A's device frame
+        dA0 = device_matrix(gmg_h.levels[0].A, backend)
+        check(
+            dA0.col_plan.layout.W == L.W and dA0.col_plan.layout.o0 == L.o0,
+            "tpu_lobpcg: the hierarchy's level-0 frame differs from A's — "
+            "build the hierarchy from the operator being solved",
         )
-    solve = dA._cg_cache[key]
+        cache = getattr(gmg_h, "_fn_cache", None)
+        if cache is None:
+            cache = gmg_h._fn_cache = {}
+        key = ("lobpcg", backend._token, m, float(tol), int(maxiter), bool(largest))
+        if key not in cache:
+            cache[key] = make_lobpcg_fn(
+                dA, m, tol, maxiter, largest, False, gmg_h=gmg_h
+            )
+        solve = cache[key]
+    else:
+        key = (
+            "lobpcg", m, float(tol), int(maxiter), bool(largest),
+            minv is not None,
+        )
+        if key not in dA._cg_cache:
+            dA._cg_cache[key] = make_lobpcg_fn(
+                dA, m, tol, maxiter, largest, minv is not None
+            )
+        solve = dA._cg_cache[key]
 
     dt = A.dtype
     P = L.P
@@ -223,7 +277,7 @@ def tpu_lobpcg(
                 rng = np.random.default_rng(seed + 7919 * k + int(iset.part))
                 Xs[p, k, : iset.num_oids] = rng.standard_normal(iset.num_oids)
     X0d = _stage(backend, Xs, P)
-    if minv is not None:
+    if minv is not None and gmg_h is None:
         mv = DeviceVector.from_pvector(minv, backend, L).data
     else:
         mv = None
