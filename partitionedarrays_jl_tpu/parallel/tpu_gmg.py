@@ -131,7 +131,7 @@ def _stage_structured_transfer(h, li: int, backend: TPUBackend):
             return None  # embedded point beyond this part's fine halo
         emb[p, : len(kg)] = LS.lid_slots[p][lids]
     rev = DeviceExchangePlan(S.cols.exchanger.reverse(), LS)
-    return {
+    out = {
         "dS": dS,
         "rev_plan": rev,
         "emb_host": emb,
@@ -140,6 +140,80 @@ def _stage_structured_transfer(h, li: int, backend: TPUBackend):
         "rsm": _stage(backend, rev.snd_mask, LS.P),
         "rri": _stage(backend, rev.rcv_idx, LS.P),
     }
+    import os
+
+    # The strided-box embedding measured SLOWER on the real chip than the
+    # element gathers it replaces (A/B at 192³ f32: 11.31 vs 7.91 ms per
+    # GMG-PCG iteration): the stride-2 extraction on the minor (lane)
+    # axis forces Mosaic relayouts that cost more than the N/8 gathers.
+    # Default ON for host/CPU meshes, OFF on real TPUs; PA_TPU_GMG_BOX
+    # overrides either way.
+    on_tpu = backend.devices()[0].platform == "tpu"
+    if os.environ.get("PA_TPU_GMG_BOX", "0" if on_tpu else "1") != "0":
+        fast = _embedding_box_fast_path(lvl, coarse_rows, S, LS, emb)
+        if fast is not None:
+            out["emb_fast"] = fast
+    return out
+
+
+def _embedding_box_fast_path(lvl, coarse_rows, S, LS, emb):
+    """When every part's owned fine/coarse regions are EQUAL axis-aligned
+    boxes whose coarse points are exactly the part's own even fine points
+    (the common evenly-split Cartesian case), the embedding extraction /
+    scatter is a strided reshape-slice — no per-element gathers (measured
+    dominant in the 192³ V-cycle: ~1.8M gathered+scattered elements per
+    level-0 transfer pair) and no cross-part ghost traffic. Returns
+    ``(fine_box, coarse_box, starts)`` — one static descriptor valid for
+    ALL shards (SPMD uniformity) — or None."""
+    dim = len(lvl.nfs)
+    descr = None
+    for p, (ci, fi) in enumerate(
+        zip(
+            coarse_rows.partition.part_values(),
+            S.cols.partition.part_values(),
+        )
+    ):
+        if fi.num_oids == 0 or ci.num_oids == 0:
+            return None
+        fg = np.asarray(fi.oid_to_gid, dtype=np.int64)
+        cg = np.asarray(ci.oid_to_gid, dtype=np.int64)
+        fc = np.stack(np.unravel_index(fg, lvl.nfs))  # (dim, no_f)
+        cc = np.stack(np.unravel_index(cg, lvl.ncs))
+        lo_f, hi_f = fc.min(axis=1), fc.max(axis=1) + 1
+        lo_c, hi_c = cc.min(axis=1), cc.max(axis=1) + 1
+        fb = tuple(int(x) for x in hi_f - lo_f)
+        cb = tuple(int(x) for x in hi_c - lo_c)
+        if int(np.prod(fb)) != fi.num_oids or int(np.prod(cb)) != ci.num_oids:
+            return None  # owned set is not a box
+        st = tuple(int(2 * lo_c[d] - lo_f[d]) for d in range(dim))
+        if any(s < 0 or s > 1 for s in st):
+            return None  # a coarse point falls outside this part's box
+        if any(st[d] + 2 * (cb[d] - 1) >= fb[d] for d in range(dim)):
+            return None
+        cand = (fb, cb, st)
+        if descr is None:
+            descr = cand
+        elif cand != descr:
+            return None  # shards differ: one compiled program can't serve
+        # the reshape path reads slots o0+lid directly — owned slots must
+        # be the contiguous identity map (owned-first layouts are, but
+        # verify rather than assume)
+        if not np.array_equal(
+            LS.lid_slots[p][: fi.num_oids],
+            LS.o0 + np.arange(fi.num_oids, dtype=LS.lid_slots[p].dtype),
+        ):
+            return None
+        # verify ORDER: emb row p must equal the slots of the box's even
+        # points in row-major (coarse-scan) order, with no ghost reads
+        fine_idx = np.arange(fi.num_oids, dtype=np.int64).reshape(fb)
+        sl = tuple(slice(st[d], st[d] + 2 * cb[d], 2) for d in range(dim))
+        lids = fine_idx[sl].reshape(-1)
+        expect = LS.lid_slots[p][lids]
+        if not np.array_equal(emb[p, : len(expect)], expect):
+            return None
+        if (emb[p, len(expect):] != LS.trash).any():
+            return None
+    return descr
 
 
 def _gmg_operands(dh):
@@ -240,13 +314,29 @@ def _vcycle_shard_body(h, dh):
                     LS.o0 : LS.o0 + no
                 ].set(b_l[sl] - q[sl])
                 w, _ = bodies[level]["S"](rS, m["S"])
-                v = jnp.zeros(LS.W, dtype=b_l.dtype).at[
-                    LS.o0 : LS.o0 + no
-                ].set(w[LSr.o0 : LSr.o0 + no])
-                v = bodies[level]["exch_set"](
-                    v, m["S"]["si"], m["S"]["sm"], m["S"]["ri"]
-                )
-                rc_own = v[m["emb"]]  # pads read the (zero) trash slot
+                fast = lv.get("emb_fast")
+                if fast is not None:
+                    # equal-box shards: the even-point extraction is a
+                    # strided reshape-slice of the OWN box — no gather,
+                    # no ghost refresh (verified at staging: every
+                    # embedded point is an own even point)
+                    fb, cb, st = fast
+                    box = w[LSr.o0 : LSr.o0 + no].reshape(fb)
+                    box = box[
+                        tuple(
+                            slice(st[d], st[d] + 2 * cb[d], 2)
+                            for d in range(len(fb))
+                        )
+                    ]
+                    rc_own = box.reshape(-1)
+                else:
+                    v = jnp.zeros(LS.W, dtype=b_l.dtype).at[
+                        LS.o0 : LS.o0 + no
+                    ].set(w[LSr.o0 : LSr.o0 + no])
+                    v = bodies[level]["exch_set"](
+                        v, m["S"]["si"], m["S"]["sm"], m["S"]["ri"]
+                    )
+                    rc_own = v[m["emb"]]  # pads read the (zero) trash slot
             else:
                 # assembled restriction matrix (fallback path)
                 LR = lv["dR"].col_plan.layout
@@ -288,12 +378,38 @@ def _vcycle_shard_body(h, dh):
                 # then one stencil SpMV
                 LS = lv["dS"].col_plan.layout
                 LSr = lv["dS"].row_layout
-                z = jnp.zeros(LS.W, dtype=b_l.dtype).at[m["emb"]].set(
-                    ec_own
-                ).at[LS.trash].set(0.0)
-                z = bodies[level]["exch_add"](
-                    z, m["rsi"], m["rsm"], m["rri"]
-                )
+                fast = lv.get("emb_fast")
+                if fast is not None:
+                    # scatter-free: interleave zeros axis by axis (pure
+                    # reshapes), shift by the parity offset, crop to the
+                    # fine box
+                    fb, cb, st = fast
+                    t = ec_own.reshape(cb)
+                    for ax in range(len(cb)):
+                        t = jnp.stack(
+                            [t, jnp.zeros_like(t)], axis=ax + 1
+                        ).reshape(
+                            t.shape[:ax]
+                            + (2 * t.shape[ax],)
+                            + t.shape[ax + 1 :]
+                        )
+                    pads = [
+                        (st[d], max(0, fb[d] - 2 * cb[d] - st[d]))
+                        for d in range(len(fb))
+                    ]
+                    t = jnp.pad(t, pads)[
+                        tuple(slice(0, fb[d]) for d in range(len(fb)))
+                    ]
+                    z = jnp.zeros(LS.W, dtype=b_l.dtype).at[
+                        LS.o0 : LS.o0 + no
+                    ].set(t.reshape(-1))
+                else:
+                    z = jnp.zeros(LS.W, dtype=b_l.dtype).at[m["emb"]].set(
+                        ec_own
+                    ).at[LS.trash].set(0.0)
+                    z = bodies[level]["exch_add"](
+                        z, m["rsi"], m["rsm"], m["rri"]
+                    )
                 ef, _ = bodies[level]["S"](z, m["S"])
                 x = x.at[sl].add(ef[LSr.o0 : LSr.o0 + no])
             else:
