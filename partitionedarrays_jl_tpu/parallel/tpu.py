@@ -863,17 +863,26 @@ class DeviceMatrix:
         }
 
 
+def _lowering_env_key() -> tuple:
+    """The ONE resolution of every env mode that changes a DeviceMatrix
+    lowering. Each cache of anything staged/compiled from a DeviceMatrix
+    must include this tuple in its key (device_matrix itself, the GMG
+    hierarchy/fn caches, ...), or a flipped flag silently serves a stale
+    lowering. Adding a new lowering-affecting mode? Add it HERE — every
+    keyed cache picks it up."""
+    return (
+        strict_bits(),
+        os.environ.get("PA_TPU_BSR", "1") != "0",
+        os.environ.get("PA_TPU_CLASS_ACC", "1") != "0",
+    )
+
+
 def device_matrix(A: PSparseMatrix, backend: TPUBackend) -> DeviceMatrix:
     # cached ON the matrix object so the lowering's lifetime is tied to A;
     # keyed by the backend's stable token (an id() key could be recycled
-    # after GC and hand back buffers staged for a dead backend)
-    # every env mode that changes the lowering must key the cache, or a
-    # flipped flag would silently hand back the old lowering
-    key = (
-        backend._token,
-        strict_bits(),
-        os.environ.get("PA_TPU_BSR", "1") != "0",
-    )
+    # after GC and hand back buffers staged for a dead backend) plus
+    # every lowering-affecting env mode
+    key = (backend._token,) + _lowering_env_key()
     if key not in A._device:
         A._device[key] = DeviceMatrix(A, backend)
     return A._device[key]
@@ -891,13 +900,16 @@ def _strict_rounded_product(t):
     data-dependent select at codegen level — the CPU backend's LLVM
     pipeline contracts straight through a bare barrier (measured: 321/1000
     elements differ on a random axpy), while the select breaks the
-    fadd(fmul(..)) pattern it matches on. ``t == t`` is True except for
-    NaN, where a strict-mode run is already broken."""
+    fadd(fmul(..)) pattern it matches on. The select's false branch is an
+    explicit NaN (not 0) so a NaN-poisoned operand keeps poisoning the
+    result as it does in default mode and on the host; the true branch is
+    `t` itself, so finite values — including -0.0, which the host oracle
+    produces for e.g. a -1·0 product — pass through bit-unchanged."""
     import jax
     import jax.numpy as jnp
 
     t = jax.lax.optimization_barrier(t)
-    return jnp.where(t == t, t, jnp.zeros_like(t))
+    return jnp.where(t == t, t, jnp.full_like(t, jnp.nan))
 
 
 def _pdot_factory(o0: int, no_max: int):
@@ -1165,9 +1177,13 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False):
             cl = dA.col_plan.layout
             yn = xv[cl.o0 : cl.o0 + cl.no_max].reshape(-1, bs)
             xg = yn[m["bsr_c"]]  # (nn, Lb, bs)
+            # HIGHEST precision: at DEFAULT the TPU MXU would run this f32
+            # dot as lossy bf16 passes, silently breaking the "matches the
+            # sequential oracle to FMA rounding" accuracy contract
             partial_ = jnp.einsum(
                 "nlij,nlj->ni", m["bsr_v"], xg,
                 preferred_element_type=xv.dtype,
+                precision=jax.lax.Precision.HIGHEST,
             ).reshape(-1)
         else:
             partial_ = _ell_rowsum(m["oo_v"], m["oo_c"], xv)
@@ -1271,11 +1287,13 @@ def make_cg_fn(
     no_max = dA.row_layout.no_max
     o0 = dA.row_layout.o0
     g0 = dA.row_layout.g0
-    check(
-        not (pipelined and precond),
-        "make_cg_fn: the pipelined (lag-1) form is unpreconditioned-only "
-        "— drop precond or pipelined",
-    )
+    if pipelined and precond:
+        # unconditional (not check()): with PA_TPU_CHECKS=0 a stripped
+        # guard would silently drop the preconditioner and change results
+        raise ValueError(
+            "make_cg_fn: the pipelined (lag-1) form is unpreconditioned-"
+            "only — drop precond or pipelined"
+        )
     pdot = _pdot_factory(o0, no_max)
     ops = _matrix_operands(dA)
     specs = jax.tree.map(lambda _: spec, ops)

@@ -35,15 +35,37 @@ from .tpu import (
 )
 
 
+def _box_enabled(backend: TPUBackend) -> bool:
+    """The ONE resolution of PA_TPU_GMG_BOX (used by both the staging
+    site and every cache key — they must never disagree, or a stale
+    lowering is served): default ON for host/CPU meshes, OFF on real
+    TPUs where the A/B measured the box path slower (Mosaic relayouts on
+    minor-axis strides; see _stage_structured_transfer)."""
+    import os
+
+    on_tpu = backend.devices()[0].platform == "tpu"
+    return os.environ.get("PA_TPU_GMG_BOX", "0" if on_tpu else "1") != "0"
+
+
+def _gmg_env_key(backend: TPUBackend):
+    """Every env mode that changes the staged lowering must key the
+    caches: the resolved PA_TPU_GMG_BOX value (it selects the emb_fast
+    descriptor) plus the shared DeviceMatrix lowering modes — ONE
+    helper, so the two key sites can never drift apart."""
+    from .tpu import _lowering_env_key
+
+    return (_box_enabled(backend),) + _lowering_env_key()
+
+
 def _device_hierarchy(h, backend: TPUBackend):
     """Stage every level of a models.gmg.GMGHierarchy for the device:
     DeviceMatrix per operator, the inverse diagonal in the level's column
     frame, and the dense coarse inverse + gid maps. Cached on the
-    hierarchy per backend."""
+    hierarchy per backend and per lowering-affecting env mode."""
     cache = getattr(h, "_device_cache", None)
     if cache is None:
         cache = h._device_cache = {}
-    key = backend._token
+    key = (backend._token,) + _gmg_env_key(backend)
     if key in cache:
         return cache[key]
 
@@ -140,16 +162,13 @@ def _stage_structured_transfer(h, li: int, backend: TPUBackend):
         "rsm": _stage(backend, rev.snd_mask, LS.P),
         "rri": _stage(backend, rev.rcv_idx, LS.P),
     }
-    import os
-
     # The strided-box embedding measured SLOWER on the real chip than the
     # element gathers it replaces (A/B at 192³ f32: 11.31 vs 7.91 ms per
     # GMG-PCG iteration): the stride-2 extraction on the minor (lane)
     # axis forces Mosaic relayouts that cost more than the N/8 gathers.
-    # Default ON for host/CPU meshes, OFF on real TPUs; PA_TPU_GMG_BOX
-    # overrides either way.
-    on_tpu = backend.devices()[0].platform == "tpu"
-    if os.environ.get("PA_TPU_GMG_BOX", "0" if on_tpu else "1") != "0":
+    # _box_enabled defaults it ON for host/CPU meshes, OFF on real TPUs;
+    # PA_TPU_GMG_BOX overrides either way.
+    if _box_enabled(backend):
         fast = _embedding_box_fast_path(lvl, coarse_rows, S, LS, emb)
         if fast is not None:
             out["emb_fast"] = fast
@@ -638,7 +657,9 @@ def _run_gmg(h, b, x0, tol, maxiter, verbose, make_fn, name):
     cache = getattr(h, "_fn_cache", None)
     if cache is None:
         cache = h._fn_cache = {}
-    key = (name, backend._token, float(tol), int(maxiter))
+    key = (name, backend._token, float(tol), int(maxiter)) + _gmg_env_key(
+        backend
+    )
     if key not in cache:
         cache[key] = make_fn()
     # the compiled fns share the Krylov (b, x0) -> 5-tuple contract, so

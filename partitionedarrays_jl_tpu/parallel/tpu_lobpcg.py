@@ -57,7 +57,12 @@ def make_lobpcg_fn(
     ops = _matrix_operands(dA)
     specs = jax.tree.map(lambda _: spec, ops)
     sgn = -1.0 if largest else 1.0
-    if gmg_h is not None:
+    # the closures below must reference only this BOOL, never gmg_h
+    # itself: the returned fn lives in a cache evicted by a weakref
+    # finalizer on the hierarchy, which can only fire if the fn does not
+    # hold the hierarchy alive (its staged operands ride `dh`/`vcycle`)
+    has_gmg = gmg_h is not None
+    if has_gmg:
         from .tpu_gmg import (
             _device_hierarchy, _gmg_operands, _shard_ops, _vcycle_shard_body,
         )
@@ -75,7 +80,7 @@ def make_lobpcg_fn(
             mats = {k: v[0] for k, v in ms.items()}
             mvv = mvs[0]
             dt = X.dtype
-            if gmg_h is not None:
+            if has_gmg:
                 gmat = _shard_ops(jax, gs[0])
                 cinv_r = gs[1]
 
@@ -129,7 +134,7 @@ def make_lobpcg_fn(
             def step(st):
                 X, AX, P, AP, lam, _res, it, hist = st
                 R = AX - lam[:, None] * X
-                if gmg_h is not None:
+                if has_gmg:
                     # one full V-cycle per residual block row, inlined
                     def prec_one(r_owned):
                         rv = jnp.zeros(L.W, dtype=dt).at[sl].set(r_owned)
@@ -190,7 +195,7 @@ def make_lobpcg_fn(
             return X[order][None], lam[order], res[order], it, hist
 
         in_specs = (spec, spec, specs)
-        if gmg_h is not None:
+        if has_gmg:
             in_specs = in_specs + (gspecs, none_spec)
         return shard_map(
             shard_fn,
@@ -201,7 +206,7 @@ def make_lobpcg_fn(
         )(X0, mv, mats_in, *g)
 
     def run(X0, mv):
-        if gmg_h is not None:
+        if has_gmg:
             return fn(X0, X0 if mv is None else mv, ops, gops, cinv_host)
         return fn(X0, X0 if mv is None else mv, ops)
 
@@ -243,15 +248,33 @@ def tpu_lobpcg(
             "tpu_lobpcg: the hierarchy's level-0 frame differs from A's — "
             "build the hierarchy from the operator being solved",
         )
-        cache = getattr(gmg_h, "_fn_cache", None)
-        if cache is None:
-            cache = gmg_h._fn_cache = {}
-        key = ("lobpcg", backend._token, m, float(tol), int(maxiter), bool(largest))
-        if key not in cache:
-            cache[key] = make_lobpcg_fn(
+        import weakref
+
+        from .tpu_gmg import _gmg_env_key
+
+        # cached ON the matrix's device lowering (the tpu.py rule: a
+        # fn's lifetime is tied to the operator whose staged operands
+        # its closure holds), keyed by the hierarchy's id plus the env
+        # modes. Of those, only PA_TPU_GMG_BOX does real keying work
+        # here — the DeviceMatrix lowering modes are already baked into
+        # dA's identity via device_matrix's own key, and ride along as
+        # defense-in-depth against future cache restructuring. The id is
+        # safe (no strong ref -> no pinning) because a finalizer evicts
+        # the entry when the hierarchy dies — before its id can be
+        # reused — which also frees the fn's staged level operands for
+        # callers that rebuild hierarchies in a loop; the fn itself
+        # references only `dh`/`vcycle`, never gmg_h (see
+        # make_lobpcg_fn's has_gmg note).
+        key = (
+            "lobpcg-gmg", id(gmg_h), m, float(tol), int(maxiter),
+            bool(largest),
+        ) + _gmg_env_key(backend)
+        if key not in dA._cg_cache:
+            dA._cg_cache[key] = make_lobpcg_fn(
                 dA, m, tol, maxiter, largest, False, gmg_h=gmg_h
             )
-        solve = cache[key]
+            weakref.finalize(gmg_h, dA._cg_cache.pop, key, None)
+        solve = dA._cg_cache[key]
     else:
         key = (
             "lobpcg", m, float(tol), int(maxiter), bool(largest),
