@@ -77,7 +77,16 @@ def marginal_chain_time(run_chain, k1: int, k2: int, nreps: int = 5) -> float:
 
 
 def bench_halo(n: int, backend, pa) -> dict:
-    """Per-chip halo-exchange payload bandwidth (see module docstring)."""
+    """Per-chip halo-exchange payload bandwidth (see module docstring).
+
+    Uses whatever plan `device_exchange_plan` selects for the 8-part
+    Cartesian PRange — the slice-based box plan (tpu_box.py) on the fast
+    path, or the generic gather plan if detection declines — so the
+    metric always measures the shipping halo path. Part 0's program runs
+    with self-loop `ppermute`s on the single reachable chip; for the box
+    plan each send-direction's packed slab lands in the opposite
+    direction's ghost segment (equal boxes make the shapes match), which
+    is exactly one part's per-exchange pack+unpack work."""
     import statistics
     from functools import partial
 
@@ -86,8 +95,9 @@ def bench_halo(n: int, backend, pa) -> dict:
 
     from partitionedarrays_jl_tpu.parallel.sequential import SequentialBackend
     from partitionedarrays_jl_tpu.parallel.tpu import (
-        DeviceExchangePlan, _stage, device_layout,
+        _stage, device_exchange_plan,
     )
+    from partitionedarrays_jl_tpu.parallel.tpu_box import BoxExchangePlan
 
     dtype = np.float32
     # the real 8-part plan, built host-side exactly as a 2x2x2 run would
@@ -96,49 +106,104 @@ def bench_halo(n: int, backend, pa) -> dict:
         lambda parts: pa.prange(parts, (n, n, n), pa.with_ghost),
         seq, (2, 2, 2),
     )
-    layout = device_layout(rows, False)
-    plan = DeviceExchangePlan(rows.exchanger, layout)
+    plan = device_exchange_plan(rows, False)
+    layout = plan.layout
     p0 = 0
     # payload: each ghost entry of part 0 lands once per exchange
     hids = rows.partition.part_values()[p0].num_hids
     payload_bytes = hids * np.dtype(dtype).itemsize
-    si = _stage(backend, plan.snd_idx[p0][None], 1)
-    sm = _stage(backend, plan.snd_mask[p0][None], 1)
-    ri = _stage(backend, plan.rcv_idx[p0][None], 1)
     mesh = backend.mesh(1)
     spec = backend.parts_spec()
-    R, trash = plan.R, layout.trash
     x0 = np.zeros((1, layout.W), dtype=dtype)
     x0[0, layout.o0 : layout.o0 + layout.no_max] = 1.0
-    x = jax.device_put(
-        x0, jax.sharding.NamedSharding(mesh, spec)
-    )
+    x = jax.device_put(x0, jax.sharding.NamedSharding(mesh, spec))
 
-    @partial(jax.jit, static_argnums=4)
-    def chain(x, si, sm, ri, k):
-        def shard_fn(xs, sis, sms, ris):
-            xv, siv, smv, riv = xs[0], sis[0], sms[0], ris[0]
+    if isinstance(plan, BoxExchangePlan):
+        info = plan.info
+        o0, g0 = layout.o0, layout.g0
+        no = int(np.prod(info.box_shape))
+        bs = info.box_shape
+        by_dir = {d.dir: d for d in info.dirs}
+        # part 0's send directions, each paired with the segment it
+        # would fill on the receiving side (the opposite direction)
+        legs = []
+        for d in info.dirs:
+            if any(p == p0 for p, _ in d.perm):
+                opp = by_dir[tuple(-c for c in d.dir)]
+                assert opp.size == d.size, "asymmetric halo shapes"
+                legs.append((d, opp))
 
-            def step(_, xv):
-                # part 0's rounds of the 8-part plan; the ppermute hop is
-                # a self-loop on the 1-device mesh (see module docstring)
-                for r in range(R):
-                    buf = jnp.where(smv[r], xv[siv[r]], 0)
-                    buf = jax.lax.ppermute(buf, "parts", perm=((0, 0),))
-                    xv = xv.at[riv[r]].set(buf)
-                    xv = xv.at[trash].set(0)
-                return xv
+        def step_body(xv):
+            own = jax.lax.slice(xv, (o0,), (o0 + no,)).reshape(bs)
+            for d, opp in legs:
+                sl = tuple(
+                    slice(a, a + s) for a, s in zip(d.start, d.shape)
+                )
+                buf = own[sl].reshape(-1)
+                buf = jax.lax.ppermute(buf, "parts", perm=((0, 0),))
+                xv = jax.lax.dynamic_update_slice(
+                    xv, buf, (g0 + opp.off,)
+                )
+            # one-element ghost->owned feedback: the owned region must
+            # EVOLVE across iterations (as it does in a real solver), or
+            # the compiler may hoist the loop-invariant packs and the
+            # chain would measure permute+unpack only. The fed-back cell
+            # is the HI corner (o0+no-1): part 0 of the non-periodic
+            # 2x2x2 split sends only positive-direction slabs, and the
+            # hi corner lies in every one of them — the lo corner lies
+            # in none and would leave the packs loop-invariant.
+            return xv.at[o0 + no - 1].add(
+                xv[g0] * jnp.asarray(1e-30, xv.dtype)
+            )
 
-            return jax.lax.fori_loop(0, k, step, xv)[None]
+        @partial(jax.jit, static_argnums=1)
+        def chain(x, k):
+            def shard_fn(xs):
+                return jax.lax.fori_loop(
+                    0, k, lambda _, xv: step_body(xv), xs[0]
+                )[None]
 
-        from jax import shard_map
+            from jax import shard_map
 
-        return shard_map(
-            shard_fn, mesh=mesh, in_specs=(spec,) * 4, out_specs=spec,
-            check_vma=False,
-        )(x, si, sm, ri).sum()
+            return shard_map(
+                shard_fn, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                check_vma=False,
+            )(x).sum()
 
-    dt = marginal_chain_time(lambda k: float(chain(x, si, sm, ri, k)), 50, 850)
+        run_chain = lambda k: float(chain(x, k))
+    else:
+        si = _stage(backend, plan.snd_idx[p0][None], 1)
+        sm = _stage(backend, plan.snd_mask[p0][None], 1)
+        ri = _stage(backend, plan.rcv_idx[p0][None], 1)
+        R, trash = plan.R, layout.trash
+
+        @partial(jax.jit, static_argnums=4)
+        def chain(x, si, sm, ri, k):
+            def shard_fn(xs, sis, sms, ris):
+                xv, siv, smv, riv = xs[0], sis[0], sms[0], ris[0]
+
+                def step(_, xv):
+                    for r in range(R):
+                        buf = jnp.where(smv[r], xv[siv[r]], 0)
+                        buf = jax.lax.ppermute(
+                            buf, "parts", perm=((0, 0),)
+                        )
+                        xv = xv.at[riv[r]].set(buf)
+                        xv = xv.at[trash].set(0)
+                    return xv
+
+                return jax.lax.fori_loop(0, k, step, xv)[None]
+
+            from jax import shard_map
+
+            return shard_map(
+                shard_fn, mesh=mesh, in_specs=(spec,) * 4, out_specs=spec,
+                check_vma=False,
+            )(x, si, sm, ri).sum()
+
+        run_chain = lambda k: float(chain(x, si, sm, ri, k))
+
+    dt = marginal_chain_time(run_chain, 50, 850)
     bw = payload_bytes / dt
 
     # sequential-oracle comparand: the eager 8-part exchange (numpy

@@ -225,15 +225,19 @@ class DeviceLayout:
       blocks (ops/pallas_dia.py:PAD_BLOCK_ROWS). The padded form IS the
       coded SpMV kernel's operand/result frame, so the hot loop runs with
       zero layout copies; the pads are the shifted-read halo (invariant:
-      every non-owned, non-ghost slot is exactly 0).
+      every non-owned, non-ghost slot OUTSIDE the ghost segment region is
+      exactly 0 — under a box layout, orphan slab slots INSIDE the ghost
+      region hold sender values after a forward exchange and are real
+      only where `box_info.seg_mask` is True; never read the ghost
+      region except through slot maps or the mask).
     """
 
     __slots__ = (
         "P", "W", "no_max", "nh_max", "noids", "nhids", "lid_slots",
-        "o0", "g0", "padded",
+        "hid_slots", "o0", "g0", "padded", "box_info",
     )
 
-    def __init__(self, rows: PRange, padded: bool = False):
+    def __init__(self, rows: PRange, padded: bool = False, box_info=None):
         isets = rows.partition.part_values()
         self.P = len(isets)
         self.noids = np.array([i.num_oids for i in isets], dtype=np.int64)
@@ -241,6 +245,11 @@ class DeviceLayout:
         self.no_max = int(self.noids.max())
         self.nh_max = int(self.nhids.max()) if self.P else 0
         self.padded = bool(padded)
+        self.box_info = box_info
+        # the box layout reorders the ghost region into per-direction
+        # segments (slot maps only — see tpu_box.py); the segment frame
+        # can be wider than nh_max (missing-neighbor segments stay zero)
+        nh_span = box_info.nh_total if box_info is not None else self.nh_max
         if padded:
             from ..ops.pallas_dia import LANES, PAD_BLOCK_ROWS
 
@@ -248,21 +257,36 @@ class DeviceLayout:
             n_blocks = -(-self.no_max // blk)
             self.o0 = blk
             self.g0 = (n_blocks + 2) * blk
-            self.W = -(-(self.g0 + self.nh_max + 1) // blk) * blk
+            self.W = -(-(self.g0 + nh_span + 1) // blk) * blk
         else:
             self.o0 = 0
             self.g0 = self.no_max
-            self.W = self.no_max + self.nh_max + 1
+            self.W = self.no_max + nh_span + 1
         # lid -> slot per part, from the signed lid_to_ohid map — any lid
         # order is supported (owned-first layouts, the common case, just
         # produce the identity-prefix mapping)
         self.lid_slots = []
-        for i in isets:
+        self.hid_slots = []  # ghost slots in hid order (staging + A_oh)
+        for p, i in enumerate(isets):
             ohid = np.asarray(i.lid_to_ohid)
-            slots = np.where(
-                ohid >= 0, self.o0 + ohid, self.g0 + (-ohid - 1)
-            ).astype(INDEX_DTYPE)
+            if box_info is not None:
+                rel = box_info.ghost_rel_slots[p]
+                if rel.size:
+                    gslot = self.g0 + rel[
+                        np.clip(-ohid - 1, 0, rel.size - 1)
+                    ]
+                else:
+                    gslot = np.zeros_like(ohid) + self.g0
+            else:
+                gslot = self.g0 + (-ohid - 1)
+            slots = np.where(ohid >= 0, self.o0 + ohid, gslot).astype(
+                INDEX_DTYPE
+            )
             self.lid_slots.append(slots)
+            h = ohid < 0
+            hs = np.empty(int(self.nhids[p]), dtype=INDEX_DTYPE)
+            hs[-ohid[h] - 1] = slots[h]
+            self.hid_slots.append(hs)
 
     @property
     def trash(self) -> int:
@@ -333,14 +357,23 @@ class DeviceExchangePlan:
         self.perms = tuple(self.perms)
 
 
-def _shard_exchange(plan: DeviceExchangePlan, combine: str):
+def _shard_exchange(plan, combine: str):
     """Per-shard halo exchange body (used inside shard_map): R static
     `ppermute` rounds. `combine='set'` for owner->ghost halo updates,
     `'add'` for ghost->owner assembly scatter-accumulation (which, like the
     host `assemble`, zeroes the ghost region afterwards —
-    reference: src/Interfaces.jl:2078-2106)."""
+    reference: src/Interfaces.jl:2078-2106).
+
+    Dispatch: a BoxExchangePlan (Cartesian partitions, tpu_box.py) gets
+    the gather-free slice body; the generic plan keeps the index-vector
+    form below. Both bodies share the (xv, si, sm, ri) signature."""
     import jax
     import jax.numpy as jnp
+
+    from .tpu_box import BoxExchangePlan, shard_box_exchange
+
+    if isinstance(plan, BoxExchangePlan):
+        return shard_box_exchange(plan, combine)
 
     R = plan.R
     perms = plan.perms
@@ -384,7 +417,9 @@ class DeviceVector:
         ):
             vals = np.asarray(vals)
             stacked[p, o0 : o0 + iset.num_oids] = _owned(iset, vals)
-            stacked[p, g0 : g0 + iset.num_hids] = _ghost(iset, vals)
+            # hid_slots, not g0+hid: the box layout reorders the ghost
+            # region into direction segments
+            stacked[p, layout.hid_slots[p]] = _ghost(iset, vals)
         data = _stage(backend, stacked, layout.P)
         return cls(data, v.rows, layout, backend)
 
@@ -396,7 +431,7 @@ class DeviceVector:
         vals = []
         for p, iset in enumerate(self.rows.partition.part_values()):
             owned = host[p, o0 : o0 + iset.num_oids]
-            ghost = host[p, g0 : g0 + iset.num_hids]
+            ghost = host[p, self.layout.hid_slots[p]]
             if iset.owned_first:
                 v = np.concatenate([owned, ghost])
             else:
@@ -414,24 +449,42 @@ def _padded_for(backend: TPUBackend) -> bool:
     return backend.devices()[0].platform == "tpu"
 
 
+def _box_exchange_enabled() -> bool:
+    """The slice-based box exchange (tpu_box.py), default ON. Strict-bits
+    keeps the generic plan: the box 'add' path accumulates ghost
+    contributions in direction order, not the host assemble's edge
+    order, so its bits can differ on multiply-received cells."""
+    return os.environ.get("PA_TPU_BOX", "1") != "0" and not strict_bits()
+
+
 def device_layout(rows: PRange, padded: bool = False) -> DeviceLayout:
+    from .tpu_box import box_structure
+
     cache = getattr(rows, "_device_layout", None)
     if cache is None:
         cache = rows._device_layout = {}
-    if padded not in cache:
-        cache[padded] = DeviceLayout(rows, padded)
-    return cache[padded]
+    box = _box_exchange_enabled()
+    key = (padded, box)
+    if key not in cache:
+        info = box_structure(rows) if box else None
+        cache[key] = DeviceLayout(rows, padded, box_info=info)
+    return cache[key]
 
 
-def device_exchange_plan(rows: PRange, padded: bool = False) -> DeviceExchangePlan:
+def device_exchange_plan(rows: PRange, padded: bool = False):
+    from .tpu_box import BoxExchangePlan
+
     cache = getattr(rows, "_device_plan", None)
     if cache is None:
         cache = rows._device_plan = {}
-    if padded not in cache:
-        cache[padded] = DeviceExchangePlan(
-            rows.exchanger, device_layout(rows, padded)
-        )
-    return cache[padded]
+    layout = device_layout(rows, padded)
+    key = (padded, layout.box_info is not None)
+    if key not in cache:
+        if layout.box_info is not None:
+            cache[key] = BoxExchangePlan(layout, layout.box_info)
+        else:
+            cache[key] = DeviceExchangePlan(rows.exchanger, layout)
+    return cache[key]
 
 
 class DeviceMatrix:
@@ -560,7 +613,12 @@ class DeviceMatrix:
                 Eoh = ELLMatrix.from_csr(oh[p], row_width=L_oh)
                 oh_rows[p, : len(br)] = row_layout.o0 + br
                 oh_vals[p, : len(br)] = Eoh.vals[br]
-                oh_cols[p, : len(br)] = col_layout.g0 + Eoh.cols[br]
+                # hid -> slot through the layout map (the box layout
+                # reorders ghosts into direction segments); ELL pad cols
+                # are hid 0 with value 0 — a real slot, safe either way
+                oh_cols[p, : len(br)] = col_layout.hid_slots[p][
+                    Eoh.cols[br]
+                ]
         self._cg_cache = {}
         self._ops_cache = None
         self.oh_vals = _stage(backend, oh_vals.astype(dt), P)
@@ -874,6 +932,7 @@ def _lowering_env_key() -> tuple:
         strict_bits(),
         os.environ.get("PA_TPU_BSR", "1") != "0",
         os.environ.get("PA_TPU_CLASS_ACC", "1") != "0",
+        _box_exchange_enabled(),
     )
 
 
@@ -960,11 +1019,15 @@ def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") ->
     import jax
     from jax import shard_map
 
+    from .tpu_box import BoxExchangePlan
+
     plan = device_exchange_plan(rows, _padded_for(backend))
     if combine == "add":
-        rev = plan.layout  # reverse plan: swap pack/unpack roles
-        rplan = DeviceExchangePlan(rows.exchanger.reverse(), rev)
-        plan = rplan
+        if isinstance(plan, BoxExchangePlan):
+            plan = plan.reverse()
+        else:
+            # reverse plan: swap pack/unpack roles
+            plan = DeviceExchangePlan(rows.exchanger.reverse(), plan.layout)
     mesh = backend.mesh(plan.layout.P)
     spec = backend.parts_spec()
     body = _shard_exchange(plan, combine)
@@ -982,25 +1045,57 @@ def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") ->
             check_vma=False,
         )(x, si, sm, ri)
 
-    sh = backend.sharding(plan.layout.P)
-    si = _stage(backend, plan.snd_idx, plan.layout.P)
-    sm = _stage(backend, plan.snd_mask, plan.layout.P)
-    ri = _stage(backend, plan.rcv_idx, plan.layout.P)
+    if isinstance(plan, BoxExchangePlan):
+        # everything is compiled in; tiny dummies keep the fn signature —
+        # except the reverse path's sm slot, which carries the real
+        # segment mask (orphan slab slots must not accumulate into owners)
+        si, sm, ri = _box_dummy_operands(
+            backend,
+            plan.layout.P,
+            plan.info.seg_mask if plan.reverse_mode else None,
+        )
+    else:
+        si = _stage(backend, plan.snd_idx, plan.layout.P)
+        sm = _stage(backend, plan.snd_mask, plan.layout.P)
+        ri = _stage(backend, plan.rcv_idx, plan.layout.P)
     return lambda x: fn(x, si, sm, ri)
+
+
+def _box_dummy_operands(backend: TPUBackend, P: int, seg_mask=None):
+    """(si, sm, ri) operands for box-plan programs. The slice bodies
+    ignore si/ri (tiny dummies keep the operand pytree uniform so every
+    caller passes m['si']/m['sm']/m['ri'] unconditionally); sm is the
+    staged real segment mask when the caller holds a reverse plan, a
+    dummy otherwise."""
+    z = np.zeros((P, 1), dtype=INDEX_DTYPE)
+    sm = seg_mask if seg_mask is not None else np.zeros((P, 1), dtype=bool)
+    return (
+        _stage(backend, z, P),
+        _stage(backend, sm, P),
+        _stage(backend, z, P),
+    )
 
 
 def _matrix_operands(dA: DeviceMatrix) -> dict:
     """The sharded operand pytree fed to compiled programs — only what the
     selected A_oo path actually reads (coded mode drops the O(D*N) values
     stream entirely: codebook + int8 codes instead)."""
+    from .tpu_box import BoxExchangePlan
+
     if dA._ops_cache is not None:
         return dA._ops_cache
     plan = dA.col_plan
     P = plan.layout.P
+    if isinstance(plan, BoxExchangePlan):
+        si, sm, ri = _box_dummy_operands(dA.backend, P)
+    else:
+        si = _stage(dA.backend, plan.snd_idx, P)
+        sm = _stage(dA.backend, plan.snd_mask, P)
+        ri = _stage(dA.backend, plan.rcv_idx, P)
     ops = {
-        "si": _stage(dA.backend, plan.snd_idx, P),
-        "sm": _stage(dA.backend, plan.snd_mask, P),
-        "ri": _stage(dA.backend, plan.rcv_idx, P),
+        "si": si,
+        "sm": sm,
+        "ri": ri,
         "oh_v": dA.oh_vals,
         "oh_c": dA.oh_cols,
         "oh_r": dA.oh_rows,

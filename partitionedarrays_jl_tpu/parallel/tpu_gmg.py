@@ -152,15 +152,31 @@ def _stage_structured_transfer(h, li: int, backend: TPUBackend):
         if (lids < 0).any():
             return None  # embedded point beyond this part's fine halo
         emb[p, : len(kg)] = LS.lid_slots[p][lids]
-    rev = DeviceExchangePlan(S.cols.exchanger.reverse(), LS)
+    from .tpu import _box_dummy_operands
+    from .tpu_box import BoxExchangePlan
+
+    cp = dS.col_plan
+    if isinstance(cp, BoxExchangePlan):
+        # slice-based ghost->owner assembly: reverse of the same box
+        # plan; rsm carries the segment mask (orphan slab slots must not
+        # accumulate into owners), rsi/rri are ignored dummies
+        rev = cp.reverse()
+        rsi, rsm, rri = _box_dummy_operands(
+            backend, LS.P, cp.info.seg_mask
+        )
+    else:
+        rev = DeviceExchangePlan(S.cols.exchanger.reverse(), LS)
+        rsi = _stage(backend, rev.snd_idx, LS.P)
+        rsm = _stage(backend, rev.snd_mask, LS.P)
+        rri = _stage(backend, rev.rcv_idx, LS.P)
     out = {
         "dS": dS,
         "rev_plan": rev,
         "emb_host": emb,
         "emb": _stage(backend, emb, LS.P),
-        "rsi": _stage(backend, rev.snd_idx, LS.P),
-        "rsm": _stage(backend, rev.snd_mask, LS.P),
-        "rri": _stage(backend, rev.rcv_idx, LS.P),
+        "rsi": rsi,
+        "rsm": rsm,
+        "rri": rri,
     }
     # The strided-box embedding measured SLOWER on the real chip than the
     # element gathers it replaces (A/B at 192³ f32: 11.31 vs 7.91 ms per
