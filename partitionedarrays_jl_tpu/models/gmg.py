@@ -143,7 +143,62 @@ def galerkin_cartesian(
         "galerkin_cartesian: coarse grid does not match coarse_rows",
     )
 
+    def _local_box(ri, ci, M):
+        """Native stencil-collapse path (planning.cpp:galerkin3_impl):
+        direct scatter of w_i·A_ij·w_j into the 3^d-diagonal coarse
+        accumulator over the part's extended coarse box — no sparse
+        matmats, no index sorts. Returns the COO contribution or None
+        when the part lacks box metadata / the operator leaves the
+        closure (periodic wrap, wide stencils), in which case the
+        generic sparse-product path below runs instead."""
+        from .. import native
+
+        if not (hasattr(ri, "box_lo") and ri.grid_shape == nfs):
+            return None
+        flo, fhi = ri.box_lo, ri.box_hi
+        dim = len(nfs)
+        elo = [max(0, (flo[d] - 1) // 2) for d in range(dim)]
+        ehi = [min(ncs[d], fhi[d] // 2 + 1) for d in range(dim)]
+        out = native.galerkin3(
+            M.indptr, M.indices, M.data, ri.num_oids,
+            np.asarray(ci.lid_to_gid, dtype=np.int64),
+            nfs, flo, fhi, ncs, elo, ehi,
+        )
+        if out is None:
+            return None
+        ebox = tuple(h - l for l, h in zip(elo, ehi))
+        I_out, J_out, V_out = [], [], []
+        for e in range(3**dim):
+            v = out[e]
+            nz = np.nonzero(v)[0]
+            if not len(nz):
+                continue
+            cc = np.unravel_index(nz, ebox)
+            de, m = [], e
+            for _ in range(dim):
+                de.append(m % 3 - 1)
+                m //= 3
+            de.reverse()  # e was accumulated most-significant-first
+            c1 = [c + l for c, l in zip(cc, elo)]
+            c2 = [c + d for c, d in zip(c1, de)]
+            I_out.append(np.ravel_multi_index(tuple(c1), ncs))
+            J_out.append(np.ravel_multi_index(tuple(c2), ncs))
+            V_out.append(v[nz])
+        if not I_out:
+            z = np.empty(0, dtype=np.int64)
+            return z, z.copy(), np.empty(0, dtype=M.data.dtype)
+        return (
+            np.concatenate(I_out),
+            np.concatenate(J_out),
+            # keep the fine operator's dtype (the generic path casts the
+            # same way; the f64 accumulator is internal)
+            np.concatenate(V_out).astype(M.data.dtype, copy=False),
+        )
+
     def _local(ri, ci, M):
+        fast = _local_box(ri, ci, M)
+        if fast is not None:
+            return fast
         # P extended to all fine lids of A's cols; columns in global
         # coarse ids compressed to a local index set
         fg = np.asarray(ci.lid_to_gid, dtype=np.int64)
@@ -155,7 +210,9 @@ def galerkin_cartesian(
         Q = A_loc @ P_ext  # owned fine rows x local coarse
         no = ri.num_oids
         T = (P_ext[:no].T @ Q).tocoo()  # local coarse x local coarse
-        return cg[T.row], cg[T.col], T.data
+        # same dtype as the fast path: per-part dtype mixing (fast path
+        # on some parts, this fallback on others) must not happen
+        return cg[T.row], cg[T.col], T.data.astype(M.data.dtype, copy=False)
 
     coo = map_parts(_local, A.rows.partition, A.cols.partition, A.values)
     I = map_parts(lambda c: np.asarray(c[0], dtype=np.int64), coo)
@@ -239,22 +296,46 @@ class GMGLevel:
     (coarser) level, the grid dims, and the inverse diagonal for Jacobi
     smoothing."""
 
-    __slots__ = ("A", "P", "R", "dinv", "nfs", "ncs")
+    __slots__ = ("A", "_P", "_R", "_mk_transfers", "dinv", "nfs", "ncs")
 
     def __init__(
         self,
         A: PSparseMatrix,
-        P: PSparseMatrix,
-        R: PSparseMatrix,
+        P: PSparseMatrix = None,
+        R: PSparseMatrix = None,
         nfs: Sequence[int] = None,
         ncs: Sequence[int] = None,
+        mk_transfers=None,
     ):
         self.A = A
-        self.P = P
-        self.R = R
+        self._P = P
+        self._R = R
+        #: deferred builder () -> (P, R): the assembled rectangular
+        #: transfers serve the host V-cycle and the device FALLBACK path
+        #: only — the structured S·E device transfers never read them, so
+        #: building them eagerly wasted ~1/3 of hierarchy setup at scale
+        self._mk_transfers = mk_transfers
         self.nfs = tuple(int(n) for n in nfs) if nfs is not None else None
         self.ncs = tuple(int(n) for n in ncs) if ncs is not None else None
         self.dinv = jacobi_preconditioner(A)
+
+    def _build_transfers(self):
+        if self._P is None:
+            check(
+                self._mk_transfers is not None,
+                "GMGLevel: no transfers and no builder",
+            )
+            self._P, self._R = self._mk_transfers()
+
+    @property
+    def P(self) -> PSparseMatrix:
+        self._build_transfers()
+        return self._P
+
+    @property
+    def R(self) -> PSparseMatrix:
+        self._build_transfers()
+        return self._R
 
 
 class GMGHierarchy:
@@ -342,6 +423,7 @@ def gmg_hierarchy(
     pre: int = 1,
     post: int = 1,
     cycle: str = "v",
+    agg_threshold: int = 0,
 ) -> GMGHierarchy:
     """Build the variational hierarchy for a Cartesian-grid operator
     ``A`` over ``dims`` (A.rows must be the ghost-free Cartesian
@@ -349,7 +431,14 @@ def gmg_hierarchy(
     d-linear interpolation P, R = Pᵀ, and the exact Galerkin coarse
     operator — all distributed. Coarsening stops once the grid has at
     most ``coarse_threshold`` points (solved dense on MAIN) or no
-    dimension can halve."""
+    dimension can halve.
+
+    ``agg_threshold`` > 0 enables coarse-level AGGLOMERATION: once a
+    level's cells-per-active-part drop below the threshold, the next
+    coarse partition lives on a 2x-strided sub-grid of parts (repeated
+    per level as needed, down to one part), so coarse sweeps stop paying
+    full-mesh halo latency. Iteration counts are unchanged — only the
+    data placement moves (validated in tests/test_gmg.py)."""
     dims = tuple(int(n) for n in dims)
     check(
         A.rows.ngids == int(np.prod(dims)),
@@ -357,17 +446,37 @@ def gmg_hierarchy(
     )
     levels: List[GMGLevel] = []
     A_l, nfs = A, dims
+    pshape = parts.shape
+    stride = tuple(1 for _ in pshape)
     for _ in range(max_levels):
         if int(np.prod(nfs)) <= coarse_threshold:
             break
         ncs = tuple((n + 1) // 2 for n in nfs)
         if ncs == nfs or min(ncs) < 3:
             break
-        coarse_rows = cartesian_partition(parts, ncs, no_ghost)
-        P = interpolation_cartesian(nfs, ncs, A_l.rows, coarse_rows)
-        R = restriction_from(P, coarse_rows)
+        if agg_threshold > 0:
+            active = tuple(
+                -(-k // s) for k, s in zip(pshape, stride)
+            )
+            per_part = int(np.prod(ncs)) / max(int(np.prod(active)), 1)
+            if per_part < agg_threshold and max(active) > 1:
+                # double while >1 ACTIVE part remains in the dim (k > s,
+                # not k // s > 1: odd part counts would stall at 2)
+                stride = tuple(
+                    min(s * 2, k) if k > s else s
+                    for s, k in zip(stride, pshape)
+                )
+        coarse_rows = cartesian_partition(
+            parts, ncs, no_ghost,
+            part_stride=stride if max(stride) > 1 else None,
+        )
         A_c = galerkin_cartesian(A_l, nfs, ncs, coarse_rows)
-        levels.append(GMGLevel(A_l, P, R, nfs=nfs, ncs=ncs))
+
+        def _mk(nfs=nfs, ncs=ncs, fine_rows=A_l.rows, coarse_rows=coarse_rows):
+            P = interpolation_cartesian(nfs, ncs, fine_rows, coarse_rows)
+            return P, restriction_from(P, coarse_rows)
+
+        levels.append(GMGLevel(A_l, nfs=nfs, ncs=ncs, mk_transfers=_mk))
         A_l, nfs = A_c, ncs
     check(
         len(levels) >= 1,

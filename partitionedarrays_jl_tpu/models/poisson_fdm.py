@@ -72,11 +72,14 @@ def assemble_cartesian_stencil(
         # copying (2*dim+2 growing temporaries of up to nnz elements)
         gb = gid[~interior]
         gi = gid[interior]
-        icoords = [c[interior] for c in coords]
         nb_, ni = len(gb), len(gi)
         total = nb_ + ni * (2 * dim + 1)
-        I = np.empty(total, dtype=np.int64)
-        J = np.empty(total, dtype=np.int64)
+        # int32 triplets whenever the grid fits: halves COO memory and
+        # lets every planning kernel (box lookup, dedup, compresscoo)
+        # run conversion-copy-free at 1e8 DOFs
+        idt = np.int32 if math.prod(ns) < 2**31 else np.int64
+        I = np.empty(total, dtype=idt)
+        J = np.empty(total, dtype=idt)
         V = np.empty(total, dtype=np.float64)
         # boundary: identity rows (Dirichlet)
         I[:nb_] = gb
@@ -87,11 +90,12 @@ def assemble_cartesian_stencil(
         J[pos : pos + ni] = gi
         V[pos : pos + ni] = center
         pos += ni
+        # interior rows never wrap, so the ±1 neighbor in dim d is a flat
+        # C-order stride add — no per-arm ravel_multi_index pass
+        strides = [int(np.prod(ns[d + 1 :], dtype=np.int64)) for d in range(dim)]
         for d in range(dim):
             for off, coef in zip((-1, 1), arm_coefs[d]):
-                nb = list(icoords)
-                nb[d] = nb[d] + off
-                J[pos : pos + ni] = np.ravel_multi_index(nb, ns)
+                np.add(gi, off * strides[d], out=J[pos : pos + ni])
                 V[pos : pos + ni] = coef
                 pos += ni
         return I, J, V

@@ -579,10 +579,14 @@ def jacobi_preconditioner(A: PSparseMatrix) -> PVector:
     minv = PVector.full(0.0, A.cols, dtype=A.dtype)
 
     def per_part(iset, M, mv):
-        d = np.ones(iset.num_oids, dtype=M.data.dtype)
-        r = M.row_of_nz()
-        hits = np.nonzero(M.indices == r)[0]
-        d[r[hits]] = M.data[hits]
+        from .. import native
+
+        d = native.csr_diag(M.indptr, M.indices, M.data, iset.num_oids)
+        if d is None:
+            d = np.zeros(iset.num_oids, dtype=M.data.dtype)
+            r = M.row_of_nz()
+            hits = np.nonzero(M.indices == r)[0]
+            d[r[hits]] = M.data[hits]
         d = np.where(d == 0, 1.0, d)
         _write_owned(iset, mv, 1.0 / d)
 

@@ -59,16 +59,33 @@ def _load() -> Optional[ctypes.CDLL]:
             i64p, ctypes.c_int64, i64p, i64p, i64p, ctypes.c_int32, i32p,
         ]
         lib.pa_box_gids_to_lids.restype = None
+        lib.pa_box_gids_to_lids_i32.argtypes = [
+            i32p, ctypes.c_int64, i64p, i64p, i64p, ctypes.c_int32, i32p,
+        ]
+        lib.pa_box_gids_to_lids_i32.restype = None
         lib.pa_lookup_sorted.argtypes = [
             i64p, ctypes.c_int64, i64p, i32p, ctypes.c_int64, i32p,
         ]
         lib.pa_lookup_sorted.restype = ctypes.c_int64
+        lib.pa_lookup_sorted_i32.argtypes = [
+            i32p, ctypes.c_int64, i64p, i32p, ctypes.c_int64, i32p,
+        ]
+        lib.pa_lookup_sorted_i32.restype = ctypes.c_int64
         f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
         f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
         for name, fp in (("pa_coo_to_csr_f64", f64p), ("pa_coo_to_csr_f32", f32p)):
             fn = getattr(lib, name)
             fn.argtypes = [
                 i32p, i32p, fp, ctypes.c_int64, ctypes.c_int64,
+                i32p, i32p, fp, i32p,
+            ]
+            fn.restype = ctypes.c_int64
+        for name, fp in (
+            ("pa_coo_to_csr_i64_f64", f64p), ("pa_coo_to_csr_i64_f32", f32p),
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                i64p, i64p, fp, ctypes.c_int64, ctypes.c_int64,
                 i32p, i32p, fp, i32p,
             ]
             fn.restype = ctypes.c_int64
@@ -91,6 +108,28 @@ def _load() -> Optional[ctypes.CDLL]:
                 i32p, i32p, fp, i32p, i32p, fp,
             ]
             fn.restype = None
+        for name, fp in (("pa_csr_spmv_f64", f64p), ("pa_csr_spmv_f32", f32p)):
+            fn = getattr(lib, name)
+            fn.argtypes = [i32p, i32p, fp, ctypes.c_int64, fp, fp]
+            fn.restype = None
+        for name, fp in (("pa_dia_fill_f64", f64p), ("pa_dia_fill_f32", f32p)):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                i32p, i32p, fp, ctypes.c_int64, i64p, ctypes.c_int64,
+                ctypes.c_int64, f64p,
+            ]
+            fn.restype = ctypes.c_int64
+        for name, fp in (("pa_csr_diag_f64", f64p), ("pa_csr_diag_f32", f32p)):
+            fn = getattr(lib, name)
+            fn.argtypes = [i32p, i32p, fp, ctypes.c_int64, fp]
+            fn.restype = None
+        for name, fp in (("pa_galerkin3_f64", f64p), ("pa_galerkin3_f32", f32p)):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                i32p, i32p, fp, ctypes.c_int64, i64p, i64p, i64p, i64p,
+                i64p, i64p, i64p, ctypes.c_int32, f64p,
+            ]
+            fn.restype = ctypes.c_int64
         _lib = lib
     except Exception:
         _lib = None
@@ -109,8 +148,14 @@ def box_gids_to_lids(
     lib = _load()
     if lib is None or len(grid) > 8:
         return False
-    g = np.ascontiguousarray(gids, dtype=np.int64)
-    lib.pa_box_gids_to_lids(
+    if np.asarray(gids).dtype == np.int32:
+        # int32 COO batches skip the n-sized int64 conversion copy
+        g = np.ascontiguousarray(gids, dtype=np.int32)
+        fn = lib.pa_box_gids_to_lids_i32
+    else:
+        g = np.ascontiguousarray(gids, dtype=np.int64)
+        fn = lib.pa_box_gids_to_lids
+    fn(
         g,
         len(g),
         np.asarray(grid, dtype=np.int64),
@@ -129,8 +174,13 @@ def lookup_sorted(
     lib = _load()
     if lib is None:
         return False
-    g = np.ascontiguousarray(gids, dtype=np.int64)
-    lib.pa_lookup_sorted(
+    if np.asarray(gids).dtype == np.int32:
+        g = np.ascontiguousarray(gids, dtype=np.int32)
+        fn = lib.pa_lookup_sorted_i32
+    else:
+        g = np.ascontiguousarray(gids, dtype=np.int64)
+        fn = lib.pa_lookup_sorted
+    fn(
         g,
         len(g),
         np.ascontiguousarray(sorted_gids, dtype=np.int64),
@@ -147,7 +197,9 @@ _FLOAT_FN = {"float64": "f64", "float32": "f32"}
 def coo_to_csr(I, J, V, m: int, n: int):
     """COO -> (indptr, cols, vals) CSR with column-sorted rows and
     +-accumulated duplicates. None when native is absent or the inputs are
-    out of the int32/float32-64 envelope."""
+    out of the int32/float32-64 envelope. int64 and int32 I/J are both
+    consumed in place (no conversion copy) when already matching and
+    contiguous."""
     lib = _load()
     dt = np.dtype(np.asarray(V).dtype).name
     if (
@@ -159,14 +211,19 @@ def coo_to_csr(I, J, V, m: int, n: int):
     ):
         return None
     nnz = len(I)
-    Ic = np.ascontiguousarray(I, dtype=np.int32)
-    Jc = np.ascontiguousarray(J, dtype=np.int32)
+    if np.asarray(I).dtype == np.int64 and np.asarray(J).dtype == np.int64:
+        Ic = np.ascontiguousarray(I, dtype=np.int64)
+        Jc = np.ascontiguousarray(J, dtype=np.int64)
+        fn = getattr(lib, f"pa_coo_to_csr_i64_{_FLOAT_FN[dt]}")
+    else:
+        Ic = np.ascontiguousarray(I, dtype=np.int32)
+        Jc = np.ascontiguousarray(J, dtype=np.int32)
+        fn = getattr(lib, f"pa_coo_to_csr_{_FLOAT_FN[dt]}")
     Vc = np.ascontiguousarray(V)
     indptr = np.empty(m + 1, dtype=np.int32)
     cols = np.empty(nnz, dtype=np.int32)
     vals = np.empty(nnz, dtype=Vc.dtype)
     cursor = np.empty(max(m, 1), dtype=np.int32)
-    fn = getattr(lib, f"pa_coo_to_csr_{_FLOAT_FN[dt]}")
     w = fn(Ic, Jc, Vc, nnz, m, indptr, cols, vals, cursor)
     if w < (nnz * 3) // 4:  # compaction shrank a lot: don't pin dead memory
         return indptr, cols[:w].copy(), vals[:w].copy()
@@ -195,6 +252,119 @@ def csr_split_by_col(indptr, cols, vals, m: int, thr: int):
     fn = getattr(lib, f"pa_csr_split_{_FLOAT_FN[dt]}")
     fn(ip, c, v, m, thr, ip_lo, c_lo, v_lo, ip_hi, c_hi, v_hi)
     return (ip_lo, c_lo, v_lo), (ip_hi, c_hi, v_hi)
+
+
+def csr_spmv(indptr, cols, vals, x, y) -> bool:
+    """Fused y = A @ x over a CSR (one pass, no nnz-sized temporary; see
+    csr_spmv_impl). Returns False untouched when native is absent or the
+    dtypes/widths are out of envelope; `y` must be preallocated with the
+    result dtype of (vals, x)."""
+    lib = _load()
+    dt = np.dtype(np.asarray(vals).dtype).name
+    if (
+        lib is None
+        or dt not in _FLOAT_FN
+        or np.asarray(x).dtype != np.asarray(vals).dtype
+        or y.dtype != np.asarray(vals).dtype
+        or len(cols) >= 2**31
+    ):
+        return False
+    fn = getattr(lib, f"pa_csr_spmv_{_FLOAT_FN[dt]}")
+    fn(
+        np.ascontiguousarray(indptr, dtype=np.int32),
+        np.ascontiguousarray(cols, dtype=np.int32),
+        np.ascontiguousarray(vals),
+        len(y),
+        np.ascontiguousarray(x),
+        y,
+    )
+    return True
+
+
+def dia_fill(indptr, cols, vals, m: int, offsets, dia: np.ndarray) -> bool:
+    """Scatter CSR entries into dense per-diagonal rows:
+    dia[d, i] = A[i, i + offsets[d]] (dia is (D, stride) float64,
+    pre-zeroed). Returns False untouched when native is absent, and
+    raises ValueError when an entry's offset is not in `offsets` (the
+    caller's offset set must be the union it just computed)."""
+    lib = _load()
+    dt = np.dtype(np.asarray(vals).dtype).name
+    if lib is None or dt not in _FLOAT_FN or len(cols) >= 2**31:
+        return False
+    off = np.ascontiguousarray(offsets, dtype=np.int64)
+    fn = getattr(lib, f"pa_dia_fill_{_FLOAT_FN[dt]}")
+    rc = fn(
+        np.ascontiguousarray(indptr, dtype=np.int32),
+        np.ascontiguousarray(cols, dtype=np.int32),
+        np.ascontiguousarray(vals),
+        m,
+        off,
+        len(off),
+        dia.shape[1],
+        dia,
+    )
+    if rc != 0:
+        raise ValueError("dia_fill: entry offset outside the offset set")
+    return True
+
+
+def csr_diag(indptr, cols, vals, m: int):
+    """Diagonal of a column-sorted CSR block (missing entries 0), or
+    None when the native layer is absent / dtype out of envelope."""
+    lib = _load()
+    dt = np.dtype(np.asarray(vals).dtype).name
+    if lib is None or dt not in _FLOAT_FN or len(cols) >= 2**31:
+        return None
+    d = np.empty(m, dtype=np.asarray(vals).dtype)
+    fn = getattr(lib, f"pa_csr_diag_{_FLOAT_FN[dt]}")
+    fn(
+        np.ascontiguousarray(indptr, dtype=np.int32),
+        np.ascontiguousarray(cols, dtype=np.int32),
+        np.ascontiguousarray(vals),
+        m,
+        d,
+    )
+    return d
+
+
+def galerkin3(
+    indptr, cols, vals, no: int, lid_gid, fdims, flo, fhi, cdims, elo, ehi
+):
+    """Per-part Galerkin stencil collapse A_c = P^T A P over an owned
+    fine box (d-linear P, d <= 3): returns the (3^dim, prod(ehi-elo))
+    float64 diagonal accumulator, or None when native is absent, dim > 3,
+    or some fine entry's coordinate offset leaves the +-1 cube (the
+    caller falls back to the generic sparse product)."""
+    lib = _load()
+    dim = len(fdims)
+    if lib is None or dim > 3 or len(cols) >= 2**31:
+        return None
+    dt = np.dtype(np.asarray(vals).dtype).name
+    if dt not in _FLOAT_FN:
+        return None
+    ebox = [int(h - l) for l, h in zip(elo, ehi)]
+    out = np.zeros((3**dim, int(np.prod(ebox))), dtype=np.float64)
+    fn = getattr(lib, f"pa_galerkin3_{_FLOAT_FN[dt]}")
+    rc = fn(
+        np.ascontiguousarray(indptr, dtype=np.int32),
+        np.ascontiguousarray(cols, dtype=np.int32),
+        np.ascontiguousarray(vals),
+        no,
+        np.ascontiguousarray(lid_gid, dtype=np.int64),
+        np.asarray(fdims, dtype=np.int64),
+        np.asarray(flo, dtype=np.int64),
+        np.asarray(fhi, dtype=np.int64),
+        np.asarray(cdims, dtype=np.int64),
+        np.asarray(elo, dtype=np.int64),
+        np.asarray(ehi, dtype=np.int64),
+        dim,
+        out,
+    )
+    if rc == -1:
+        return None  # operator outside the 3^d closure: generic path
+    if rc != 0:
+        raise ValueError(f"galerkin3: internal bounds violation rc={rc}")
+    return out
 
 
 def unique_small(vals: np.ndarray, K: int):

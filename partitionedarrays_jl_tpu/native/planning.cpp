@@ -25,8 +25,8 @@
 // int32). Returns the compacted nnz. Stability: the scatter preserves
 // arrival order per row; the per-row sort is stable; duplicate groups
 // accumulate left-to-right — bit-identical to the reduceat fallback.
-template <typename T>
-static int64_t coo_to_csr_impl(const int32_t* I, const int32_t* J,
+template <typename TI, typename T>
+static int64_t coo_to_csr_impl(const TI* I, const TI* J,
                                const T* V, int64_t nnz, int64_t m,
                                int32_t* indptr, int32_t* cols_out,
                                T* vals_out, int32_t* cursor) {
@@ -36,7 +36,7 @@ static int64_t coo_to_csr_impl(const int32_t* I, const int32_t* J,
     for (int64_t r = 0; r < m; ++r) cursor[r] = indptr[r];
     for (int64_t k = 0; k < nnz; ++k) {
         int32_t p = cursor[I[k]]++;
-        cols_out[p] = J[k];
+        cols_out[p] = (int32_t)J[k];  // caller guarantees n < 2^31
         vals_out[p] = V[k];
     }
     int64_t w = 0;
@@ -114,14 +114,15 @@ static void csr_split_impl(const int32_t* indptr, const int32_t* cols,
 }
 
 
-extern "C" {
-
 // Fused N-D "box" gid -> lid: decompose gid in the global grid, test the
 // owned box [lo, hi), emit the C-order local id or -1 — one pass, no
-// temporaries. ndim <= 8.
-void pa_box_gids_to_lids(const int64_t* gids, int64_t n,
-                         const int64_t* grid, const int64_t* lo,
-                         const int64_t* hi, int32_t ndim, int32_t* out) {
+// temporaries. ndim <= 8. Templated on the gid width so int32 COO
+// batches (any grid < 2^31 cells) avoid an n-sized conversion copy.
+template <typename TG>
+static void box_gids_to_lids_impl(const TG* gids, int64_t n,
+                                  const int64_t* grid, const int64_t* lo,
+                                  const int64_t* hi, int32_t ndim,
+                                  int32_t* out) {
     int64_t stride[8];   // global-grid C-order strides
     int64_t bstride[8];  // box C-order strides
     int64_t total = 1;
@@ -135,7 +136,7 @@ void pa_box_gids_to_lids(const int64_t* gids, int64_t n,
         btotal *= hi[d] - lo[d];
     }
     for (int64_t i = 0; i < n; ++i) {
-        int64_t g = gids[i];
+        int64_t g = (int64_t)gids[i];
         if (g < 0 || g >= total) {
             out[i] = -1;
             continue;
@@ -155,24 +156,56 @@ void pa_box_gids_to_lids(const int64_t* gids, int64_t n,
     }
 }
 
-// Binary-search gid -> lid over a sorted ghost table, writing lid_of[pos]
-// on hit; entries already >= 0 in `out` (resolved by a cheaper path) are
-// left untouched. Returns the number of misses remaining.
-int64_t pa_lookup_sorted(const int64_t* gids, int64_t n,
-                         const int64_t* sorted_gids, const int32_t* lid_of,
-                         int64_t m, int32_t* out) {
+// Binary-search gid -> lid over a sorted ghost table (see the extern
+// wrapper below), templated like the box kernel.
+template <typename TG>
+static int64_t lookup_sorted_impl(const TG* gids, int64_t n,
+                                  const int64_t* sorted_gids,
+                                  const int32_t* lid_of, int64_t m,
+                                  int32_t* out) {
     int64_t misses = 0;
     for (int64_t i = 0; i < n; ++i) {
         if (out[i] >= 0) continue;
-        const int64_t* p =
-            std::lower_bound(sorted_gids, sorted_gids + m, gids[i]);
-        if (p != sorted_gids + m && *p == gids[i]) {
+        const int64_t g = (int64_t)gids[i];
+        const int64_t* p = std::lower_bound(sorted_gids, sorted_gids + m, g);
+        if (p != sorted_gids + m && *p == g) {
             out[i] = lid_of[p - sorted_gids];
         } else {
             ++misses;
         }
     }
     return misses;
+}
+
+extern "C" {
+
+void pa_box_gids_to_lids(const int64_t* gids, int64_t n,
+                         const int64_t* grid, const int64_t* lo,
+                         const int64_t* hi, int32_t ndim, int32_t* out) {
+    box_gids_to_lids_impl<int64_t>(gids, n, grid, lo, hi, ndim, out);
+}
+
+void pa_box_gids_to_lids_i32(const int32_t* gids, int64_t n,
+                             const int64_t* grid, const int64_t* lo,
+                             const int64_t* hi, int32_t ndim,
+                             int32_t* out) {
+    box_gids_to_lids_impl<int32_t>(gids, n, grid, lo, hi, ndim, out);
+}
+
+// Binary-search gid -> lid over a sorted ghost table, writing lid_of[pos]
+// on hit; entries already >= 0 in `out` (resolved by a cheaper path) are
+// left untouched. Returns the number of misses remaining.
+int64_t pa_lookup_sorted(const int64_t* gids, int64_t n,
+                         const int64_t* sorted_gids, const int32_t* lid_of,
+                         int64_t m, int32_t* out) {
+    return lookup_sorted_impl<int64_t>(gids, n, sorted_gids, lid_of, m, out);
+}
+
+int64_t pa_lookup_sorted_i32(const int32_t* gids, int64_t n,
+                             const int64_t* sorted_gids,
+                             const int32_t* lid_of, int64_t m,
+                             int32_t* out) {
+    return lookup_sorted_impl<int32_t>(gids, n, sorted_gids, lid_of, m, out);
 }
 
 int64_t pa_coo_to_csr_f64(const int32_t* I, const int32_t* J,
@@ -187,6 +220,22 @@ int64_t pa_coo_to_csr_f32(const int32_t* I, const int32_t* J,
                           const float* V, int64_t nnz, int64_t m,
                           int32_t* indptr, int32_t* cols_out,
                           float* vals_out, int32_t* cursor) {
+    return coo_to_csr_impl(I, J, V, nnz, m, indptr, cols_out, vals_out,
+                           cursor);
+}
+
+int64_t pa_coo_to_csr_i64_f64(const int64_t* I, const int64_t* J,
+                              const double* V, int64_t nnz, int64_t m,
+                              int32_t* indptr, int32_t* cols_out,
+                              double* vals_out, int32_t* cursor) {
+    return coo_to_csr_impl(I, J, V, nnz, m, indptr, cols_out, vals_out,
+                           cursor);
+}
+
+int64_t pa_coo_to_csr_i64_f32(const int64_t* I, const int64_t* J,
+                              const float* V, int64_t nnz, int64_t m,
+                              int32_t* indptr, int32_t* cols_out,
+                              float* vals_out, int32_t* cursor) {
     return coo_to_csr_impl(I, J, V, nnz, m, indptr, cols_out, vals_out,
                            cursor);
 }
@@ -317,6 +366,255 @@ int64_t pa_ic0_f64(const int32_t* indptr, const int32_t* cols,
         }
     }
     return 0;
+}
+
+}  // extern "C"
+
+// Fused host CSR SpMV y = A x: one pass over (cols, vals), no nnz-sized
+// product temporary (the NumPy form materializes x[cols], multiplies,
+// then reduceat-scans — three volume passes and ~2 nnz-sized
+// temporaries; at 7e8 nnz that is >10 GB of traffic this loop never
+// touches). Row accumulation is left-to-right in stored (column-sorted)
+// order — the same order reduceat contracts, to rounding.
+template <typename T>
+static void csr_spmv_impl(const int32_t* indptr, const int32_t* cols,
+                          const T* vals, int64_t m, const T* x, T* y) {
+    for (int64_t i = 0; i < m; ++i) {
+        T acc = 0;
+        for (int32_t k = indptr[i]; k < indptr[i + 1]; ++k)
+            acc += vals[k] * x[cols[k]];
+        y[i] = acc;
+    }
+}
+
+// Fused dense-diagonal fill for the DIA detection/staging pass: for each
+// stored entry (i, j, v) of a CSR block, dia[lookup(j - i) * stride + i]
+// = v. Offsets are few (<=64) and sorted; a branchless linear probe from
+// the previous hit beats binary search (stencil entries arrive in
+// ascending per-row column order). Returns 0, or -1 when some j - i is
+// not in `offsets` (caller falls back).
+template <typename T>
+static int64_t dia_fill_impl(const int32_t* indptr, const int32_t* cols,
+                             const T* vals, int64_t m,
+                             const int64_t* offsets, int64_t D,
+                             int64_t stride, double* dia) {
+    for (int64_t i = 0; i < m; ++i) {
+        int64_t d = 0;
+        for (int32_t k = indptr[i]; k < indptr[i + 1]; ++k) {
+            const int64_t off = (int64_t)cols[k] - i;
+            if (offsets[d] != off) {
+                // re-seek (rows visit offsets in ascending order, so
+                // this loop usually advances 0 or 1 step)
+                d = 0;
+                while (d < D && offsets[d] < off) ++d;
+                if (d >= D || offsets[d] != off) return -1;
+            }
+            dia[d * stride + i] = (double)vals[k];
+            if (d + 1 < D) ++d;
+        }
+    }
+    return 0;
+}
+
+// Per-part Galerkin triple product A_c = P^T A P for the d-linear
+// Cartesian interpolation (d <= 3), as a direct stencil collapse: for
+// every OWNED fine row i, for every stored entry A[i, j], scatter
+// w(i->c1) * A_ij * w(j->c2) into the dense 3^d-diagonal accumulator at
+// coarse point c1, diagonal e = c2 - c1. The 3^d closure is exact: the
+// d-linear P moves any fine offset with |o_d| <= 1 into |e_d| <= 1, and
+// Galerkin coarse operators stay within the 3^d cube forever. Weights
+// follow the same per-dimension rule as the Python _interp_1d (even
+// fine points coincide with coarse f/2; odd average their neighbors;
+// the trailing odd point of an even-sized dim DROPS the out-of-range
+// weight). Contributions to coarse rows outside [elo, ehi) cannot
+// happen by construction (ext box sized by the caller); entries whose
+// fine column offset leaves the +-1 cube return -1 (caller falls back
+// to the generic sparse product).
+template <typename T>
+static int64_t galerkin3_impl(const int32_t* indptr, const int32_t* cols,
+                              const T* vals, int64_t no,
+                              const int64_t* lid_gid, const int64_t* fdims,
+                              const int64_t* flo, const int64_t* fhi,
+                              const int64_t* cdims, const int64_t* elo,
+                              const int64_t* ehi, int32_t dim,
+                              double* out) {
+    int64_t fstride[3] = {1, 1, 1}, estride[3] = {1, 1, 1};
+    int64_t ebox[3] = {1, 1, 1};
+    for (int32_t d = 0; d < dim; ++d) ebox[d] = ehi[d] - elo[d];
+    for (int32_t d = dim - 2; d >= 0; --d) {
+        // strides within the global fine grid / the ext coarse box
+        fstride[d] = fstride[d + 1] * fdims[d + 1];
+        estride[d] = estride[d + 1] * ebox[d + 1];
+    }
+    int64_t esize = 1;
+    for (int32_t d = 0; d < dim; ++d) esize *= ebox[d];
+    // per-dim interpolation of a fine coord f: up to 2 (k, w) pairs
+    auto interp1 = [&](int64_t f, int64_t nc, int64_t* k, double* w) {
+        if ((f & 1) == 0) {
+            k[0] = f >> 1;
+            w[0] = 1.0;
+            return 1;
+        }
+        int n = 0;
+        k[n] = (f - 1) >> 1;
+        w[n++] = 0.5;
+        if (((f + 1) >> 1) <= nc - 1) {
+            k[n] = (f + 1) >> 1;
+            w[n++] = 0.5;
+        }
+        return n;
+    };
+    int64_t fbox[3] = {1, 1, 1};
+    for (int32_t d = 0; d < dim; ++d) fbox[d] = fhi[d] - flo[d];
+    for (int64_t r = 0; r < no; ++r) {
+        // owned fine coords from the C-order box scan
+        int64_t fc[3] = {0, 0, 0}, rem = r;
+        for (int32_t d = dim - 1; d >= 0; --d) {
+            fc[d] = flo[d] + rem % fbox[d];
+            rem /= fbox[d];
+        }
+        // P row of i: tensor product of per-dim pairs
+        int64_t ki[3][2];
+        double wi[3][2];
+        int ni[3] = {1, 1, 1};
+        for (int32_t d = 0; d < dim; ++d)
+            ni[d] = interp1(fc[d], cdims[d], ki[d], wi[d]);
+        for (int32_t a = indptr[r]; a < indptr[r + 1]; ++a) {
+            const double av = (double)vals[a];
+            int64_t g = lid_gid[cols[a]];
+            int64_t jc[3] = {0, 0, 0};
+            for (int32_t d = 0; d < dim; ++d) {
+                jc[d] = g / fstride[d];
+                g -= jc[d] * fstride[d];
+            }
+            for (int32_t d = 0; d < dim; ++d) {
+                const int64_t o = jc[d] - fc[d];
+                if (o < -1 || o > 1) return -1;  // outside the closure
+            }
+            int64_t kj[3][2];
+            double wj[3][2];
+            int nj[3] = {1, 1, 1};
+            for (int32_t d = 0; d < dim; ++d)
+                nj[d] = interp1(jc[d], cdims[d], kj[d], wj[d]);
+            // scatter the <=8 x <=8 tensor contributions
+            for (int ai = 0; ai < ni[0]; ++ai)
+                for (int bi = 0; bi < (dim > 1 ? ni[1] : 1); ++bi)
+                    for (int ci = 0; ci < (dim > 2 ? ni[2] : 1); ++ci) {
+                        const double w1 = wi[0][ai] *
+                                          (dim > 1 ? wi[1][bi] : 1.0) *
+                                          (dim > 2 ? wi[2][ci] : 1.0);
+                        int64_t pos = 0;
+                        const int64_t c1[3] = {
+                            ki[0][ai],
+                            dim > 1 ? ki[1][bi] : 0,
+                            dim > 2 ? ki[2][ci] : 0,
+                        };
+                        bool ok = true;
+                        for (int32_t d = 0; d < dim; ++d) {
+                            const int64_t p = c1[d] - elo[d];
+                            if (p < 0 || p >= ebox[d]) { ok = false; break; }
+                            pos += p * estride[d];
+                        }
+                        if (!ok) return -2;  // ext box undersized (bug)
+                        for (int aj = 0; aj < nj[0]; ++aj)
+                            for (int bj = 0; bj < (dim > 1 ? nj[1] : 1); ++bj)
+                                for (int cj = 0;
+                                     cj < (dim > 2 ? nj[2] : 1); ++cj) {
+                                    const double w2 =
+                                        wj[0][aj] *
+                                        (dim > 1 ? wj[1][bj] : 1.0) *
+                                        (dim > 2 ? wj[2][cj] : 1.0);
+                                    const int64_t c2[3] = {
+                                        kj[0][aj],
+                                        dim > 1 ? kj[1][bj] : 0,
+                                        dim > 2 ? kj[2][cj] : 0,
+                                    };
+                                    int64_t e = 0;  // diagonal id, base 3
+                                    for (int32_t d = 0; d < dim; ++d) {
+                                        const int64_t de = c2[d] - c1[d];
+                                        if (de < -1 || de > 1) return -3;
+                                        e = e * 3 + (de + 1);
+                                    }
+                                    out[e * esize + pos] += w1 * av * w2;
+                                }
+                    }
+        }
+    }
+    return 0;
+}
+
+// Diagonal of a CSR block: one pass, binary search per (column-sorted)
+// row — replaces a row_of_nz expansion + full-nnz compare + nonzero
+// triple pass.
+template <typename T>
+static void csr_diag_impl(const int32_t* indptr, const int32_t* cols,
+                          const T* vals, int64_t m, T* d) {
+    for (int64_t i = 0; i < m; ++i) {
+        const int32_t* b = cols + indptr[i];
+        const int32_t* e = cols + indptr[i + 1];
+        const int32_t* p = std::lower_bound(b, e, (int32_t)i);
+        d[i] = (p != e && *p == (int32_t)i) ? vals[p - cols] : (T)0;
+    }
+}
+
+extern "C" {
+
+void pa_csr_diag_f64(const int32_t* indptr, const int32_t* cols,
+                     const double* vals, int64_t m, double* d) {
+    csr_diag_impl<double>(indptr, cols, vals, m, d);
+}
+
+void pa_csr_diag_f32(const int32_t* indptr, const int32_t* cols,
+                     const float* vals, int64_t m, float* d) {
+    csr_diag_impl<float>(indptr, cols, vals, m, d);
+}
+
+int64_t pa_galerkin3_f64(const int32_t* indptr, const int32_t* cols,
+                         const double* vals, int64_t no,
+                         const int64_t* lid_gid, const int64_t* fdims,
+                         const int64_t* flo, const int64_t* fhi,
+                         const int64_t* cdims, const int64_t* elo,
+                         const int64_t* ehi, int32_t dim, double* out) {
+    return galerkin3_impl<double>(indptr, cols, vals, no, lid_gid, fdims,
+                                  flo, fhi, cdims, elo, ehi, dim, out);
+}
+
+int64_t pa_galerkin3_f32(const int32_t* indptr, const int32_t* cols,
+                         const float* vals, int64_t no,
+                         const int64_t* lid_gid, const int64_t* fdims,
+                         const int64_t* flo, const int64_t* fhi,
+                         const int64_t* cdims, const int64_t* elo,
+                         const int64_t* ehi, int32_t dim, double* out) {
+    return galerkin3_impl<float>(indptr, cols, vals, no, lid_gid, fdims,
+                                 flo, fhi, cdims, elo, ehi, dim, out);
+}
+
+void pa_csr_spmv_f64(const int32_t* indptr, const int32_t* cols,
+                     const double* vals, int64_t m, const double* x,
+                     double* y) {
+    csr_spmv_impl<double>(indptr, cols, vals, m, x, y);
+}
+
+void pa_csr_spmv_f32(const int32_t* indptr, const int32_t* cols,
+                     const float* vals, int64_t m, const float* x,
+                     float* y) {
+    csr_spmv_impl<float>(indptr, cols, vals, m, x, y);
+}
+
+int64_t pa_dia_fill_f64(const int32_t* indptr, const int32_t* cols,
+                        const double* vals, int64_t m,
+                        const int64_t* offsets, int64_t D, int64_t stride,
+                        double* dia) {
+    return dia_fill_impl<double>(indptr, cols, vals, m, offsets, D, stride,
+                                 dia);
+}
+
+int64_t pa_dia_fill_f32(const int32_t* indptr, const int32_t* cols,
+                        const float* vals, int64_t m,
+                        const int64_t* offsets, int64_t D, int64_t stride,
+                        double* dia) {
+    return dia_fill_impl<float>(indptr, cols, vals, m, offsets, D, stride,
+                                dia);
 }
 
 }  // extern "C"

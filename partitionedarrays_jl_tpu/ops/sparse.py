@@ -82,8 +82,13 @@ def compresscoo(
     (default +). Vectorized (lexsort + reduceat) rather than the
     reference's `sparse`/`sparsecsr` calls
     (reference: src/SparseUtils.jl:51-57, :80-88, :193-204)."""
-    I = np.asarray(I, dtype=np.int64)
-    J = np.asarray(J, dtype=np.int64)
+    # keep the caller's integer width: int32 lid batches (any local size
+    # < 2^31) flow through the native kernel with zero conversion copies
+    I = np.asarray(I)
+    J = np.asarray(J)
+    if I.dtype != np.int32 or J.dtype != np.int32:
+        I = np.asarray(I, dtype=np.int64)
+        J = np.asarray(J, dtype=np.int64)
     V = np.asarray(V)
     check(len(I) == len(J) == len(V), "COO arrays must have equal length")
     if len(I):
@@ -110,8 +115,10 @@ def compresscoo(
         # single fused key, sorted with NumPy's run-adaptive stable sort:
         # assembled COO batches arrive as concatenated pre-sorted stencil
         # arms, which merge in near-linear time (measured ~20x faster than
-        # a radix or quicksort pass at 1e8 triplets)
-        keys_full = I * n + J
+        # a radix or quicksort pass at 1e8 triplets). The key is widened
+        # to int64 FIRST: int32 triplets (the planning fast path) would
+        # wrap I*n+J at m*n > 2^31 and silently corrupt the merge groups
+        keys_full = I.astype(np.int64, copy=False) * n + J
         order = np.argsort(keys_full, kind="stable")
         keys = keys_full[order]
     else:
@@ -120,7 +127,7 @@ def compresscoo(
     I, J, V = I[order], J[order], V[order]
     if len(I):
         if keys is None:
-            keys = I * n + J
+            keys = I.astype(np.int64, copy=False) * n + J
         boundary = np.empty(len(keys), dtype=bool)
         boundary[0] = True
         np.not_equal(keys[1:], keys[:-1], out=boundary[1:])
@@ -209,7 +216,20 @@ def csr_spmv(A: CSRMatrix, x: np.ndarray, y: Optional[np.ndarray] = None,
         y *= beta
         y += alpha * rowsum
         return y
-    prod = A.data * np.asarray(x)[A.indices]
+    xv = np.asarray(x)
+    if A.data.dtype == xv.dtype:
+        # fused native pass (same per-row left-to-right accumulation);
+        # avoids the nnz-sized product temporary + reduceat scan below
+        from .. import native
+
+        rowsum = np.empty(A.shape[0], dtype=A.dtype)
+        if native.csr_spmv(A.indptr, A.indices, A.data, xv, rowsum):
+            if y is None:
+                return alpha * rowsum
+            y *= beta
+            y += alpha * rowsum
+            return y
+    prod = A.data * xv[A.indices]
     starts = A.indptr[:-1]
     rowsum = np.zeros(A.shape[0], dtype=prod.dtype if prod.size else A.dtype)
     nonempty = A.indptr[:-1] < A.indptr[1:]

@@ -405,7 +405,9 @@ class CartesianIndexSet(IndexSet):
     def gids_to_lids(self, gids, missing_to: int = -1) -> np.ndarray:
         from .. import native
 
-        gids = np.atleast_1d(_as_gids(gids))
+        gids = np.atleast_1d(np.asarray(gids))
+        if gids.dtype != np.int32:  # int32 batches pass through copy-free
+            gids = _as_gids(gids)
         shape = gids.shape
         gids = np.ascontiguousarray(gids).ravel()  # native kernels are 1-D
         out = np.full(gids.shape, -1, dtype=INDEX_DTYPE)

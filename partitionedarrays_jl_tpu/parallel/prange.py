@@ -107,6 +107,12 @@ class PRange:
 
     def invalidate_exchanger(self):
         self._exchanger = None
+        # everything derived from the ghost set dies with the exchanger:
+        # a stale device layout / box-structure map would silently route
+        # newly added ghosts nowhere
+        for attr in ("_device_layout", "_device_plan", "_box_info"):
+            if hasattr(self, attr):
+                delattr(self, attr)
 
     # --- per-part size queries ----------------------------------------
     def num_lids(self) -> AbstractPData:
@@ -251,11 +257,29 @@ def _extended_dim(
     return ext[keep], ext[keep]
 
 
+class _StridedGidToPart:
+    """gid -> owner for an agglomerated Cartesian partition: the reduced
+    grid's owner coordinate maps back to the full part grid at
+    ``coord * stride`` (only stride-aligned parts own cells)."""
+
+    def __init__(self, inner: "CartesianGidToPart", pshape, stride):
+        self.inner = inner
+        self.pshape = tuple(pshape)
+        self.stride = tuple(stride)
+
+    def __call__(self, gids):
+        sub = self.inner(gids)
+        sc = np.unravel_index(sub, self.inner.part_shape)
+        full = tuple(c * s for c, s in zip(sc, self.stride))
+        return np.ravel_multi_index(full, self.pshape).astype(INDEX_DTYPE)
+
+
 def cartesian_partition(
     parts: AbstractPData,
     ngids: Sequence[int],
     ghost=no_ghost,
     periodic: Optional[Sequence[bool]] = None,
+    part_stride: Optional[Sequence[int]] = None,
 ) -> PRange:
     """N-D Cartesian block partition (reference:
     src/Interfaces.jl:1114-1231): plain (`no_ghost`), with a 1-cell halo in
@@ -264,7 +288,12 @@ def cartesian_partition(
 
     The halo neighbor graph is symmetric, so the Exchanger reuses
     `parts_rcv` as `parts_snd` (reference: src/Interfaces.jl:1191).
-    """
+
+    ``part_stride`` AGGLOMERATES the partition onto the sub-grid of
+    parts whose coordinates are multiples of the stride; every other
+    part owns nothing. Coarse multigrid levels use this so tiny grids
+    stop paying full-mesh communication latency (the distributed analog
+    of gathering a coarse problem onto fewer ranks)."""
     ngids = tuple(int(n) for n in ngids)
     pshape = parts.shape
     check(
@@ -280,13 +309,35 @@ def cartesian_partition(
             per and k == 1,
             f"periodic dimension {d} with a single part is not supported",
         )
-    dim_firsts = tuple(_block_firsts(n, k) for n, k in zip(ngids, pshape))
+    if part_stride is not None:
+        stride = tuple(int(s) for s in part_stride)
+        check(len(stride) == len(pshape), "one stride per part-grid dim")
+        check(all(s >= 1 for s in stride), "part_stride must be >= 1")
+        pshape_eff = tuple(-(-k // s) for k, s in zip(pshape, stride))
+        notimplementedif(
+            isinstance(ghost, WithGhost),
+            "part_stride with ghost layers is not supported",
+        )
+    else:
+        stride = tuple(1 for _ in pshape)
+        pshape_eff = pshape
+    dim_firsts = tuple(
+        _block_firsts(n, k) for n, k in zip(ngids, pshape_eff)
+    )
     g2p = CartesianGidToPart(ngids, dim_firsts)
+    if part_stride is not None and stride != tuple(1 for _ in pshape):
+        g2p = _StridedGidToPart(g2p, pshape, stride)
     halo = isinstance(ghost, WithGhost)
 
     def _mk(p):
         coord = _part_coords(p, pshape)
-        lo, hi = _cartesian_box(coord, ngids, pshape)
+        if any(c % s for c, s in zip(coord, stride)):
+            # agglomerated away: this part owns an empty box
+            lo = [0] * len(ngids)
+            hi = [0] * len(ngids)
+        else:
+            sub = tuple(c // s for c, s in zip(coord, stride))
+            lo, hi = _cartesian_box(sub, ngids, pshape_eff)
         own_ranges = [np.arange(l, h, dtype=GID_DTYPE) for l, h in zip(lo, hi)]
         own_grid = np.meshgrid(*own_ranges, indexing="ij")
         own_gids = np.ravel_multi_index(own_grid, ngids).ravel()
@@ -432,12 +483,24 @@ def add_gids_inplace(
         np.not_equal(gs[1:], gs[:-1], out=head[1:])
         return g[np.sort(order[head])]
 
+    def _missing_first_touch(iset, g):
+        # pre-filter to ids the part does NOT already hold before the
+        # dedup sort: a stencil COO batch is volume-sized but its ghost
+        # set is surface-sized, so filtering first (O(n) box arithmetic /
+        # binary search in gids_to_lids) shrinks the sort from ~n·log n
+        # over the whole batch to the tiny miss set. First-touch order of
+        # the misses — and hence ghost append order — is unchanged.
+        g = np.asarray(g).ravel()
+        if len(g) == 0:
+            return g
+        return _dedup_first_touch(g[iset.gids_to_lids(g) < 0])
+
     if owners is None:
         check(
             r.gid_to_part is not None,
             "add_gids: PRange has no global gid->part map; pass owners explicitly",
         )
-        gids = map_parts(_dedup_first_touch, gids)
+        gids = map_parts(_missing_first_touch, r.partition, gids)
         owners = map_parts(lambda g: r.gid_to_part(np.asarray(g)), gids)
 
     map_parts(

@@ -50,11 +50,15 @@ def _box_enabled(backend: TPUBackend) -> bool:
 def _gmg_env_key(backend: TPUBackend):
     """Every env mode that changes the staged lowering must key the
     caches: the resolved PA_TPU_GMG_BOX value (it selects the emb_fast
-    descriptor) plus the shared DeviceMatrix lowering modes — ONE
-    helper, so the two key sites can never drift apart."""
+    descriptor), PA_TPU_GMG_STENCIL (it selects the matrix-free
+    transfers), plus the shared DeviceMatrix lowering modes — ONE
+    helper, so the key sites can never drift apart."""
+    import os
+
     from .tpu import _lowering_env_key
 
-    return (_box_enabled(backend),) + _lowering_env_key()
+    stencil = os.environ.get("PA_TPU_GMG_STENCIL", "1") != "0"
+    return (_box_enabled(backend), stencil) + _lowering_env_key()
 
 
 def _device_hierarchy(h, backend: TPUBackend):
@@ -76,7 +80,9 @@ def _device_hierarchy(h, backend: TPUBackend):
         dA = device_matrix(lvl.A, backend)
         dinv = DeviceVector.from_pvector(lvl.dinv, backend, dA.col_layout).data
         entry = {"dA": dA, "dinv": dinv}
-        st = _stage_structured_transfer(h, li, backend)
+        st = _stage_stencil_transfer(h, li, dA)
+        if st is None:
+            st = _stage_structured_transfer(h, li, backend)
         if st is not None:
             entry.update(st)
         else:
@@ -106,6 +112,143 @@ def _device_hierarchy(h, backend: TPUBackend):
     }
     cache[key] = staged
     return staged
+
+
+def _stage_stencil_transfer(h, li: int, dA):
+    """MATRIX-FREE factored transfer P = S·E: when the level's partition
+    is the equal-box Cartesian case and its halo covers the FULL in-grid
+    shell, the interpolation stencil S (w(δ) = 0.5^|δ|₀ truncated at the
+    global boundary) is applied as 3^d shifted slice-reads of the
+    part's extended box — assembled from the owned box plus the box
+    exchange's ghost SEGMENTS — instead of through an assembled S
+    operator. Kills the O(3^d · N) S staging entirely (43 GB of COO at
+    464³, the round-3 OOM) and replaces its gathers with pure slices.
+
+    Returns the descriptor dict or None (fall back to the matrix S /
+    assembled transfers):
+    * ``stencil``: (fb, cb, st) — the embedding boxes, as in emb_fast,
+    * ``shell``: per-direction (ext_slice, seg_off, seg_shape) placements
+      of the ghost segments into the (b+2)^d extended array."""
+    import os
+
+    from .tpu_box import BoxExchangePlan
+
+    if os.environ.get("PA_TPU_GMG_STENCIL", "1") == "0":
+        return None
+    lvl = h.levels[li]
+    if lvl.nfs is None or lvl.ncs is None:
+        return None
+    dim = len(lvl.nfs)
+    if dim > 3:
+        return None
+    plan = dA.col_plan
+    if not isinstance(plan, BoxExchangePlan):
+        return None
+    info = plan.info
+    coarse_rows = (
+        h.levels[li + 1].A.rows if li + 1 < len(h.levels) else h.coarse_A.rows
+    )
+    # the COLS partition carries the ghosts the stencil apply reads (rows
+    # are ghost-free); its owned boxes coincide with the rows'
+    fsets = lvl.A.cols.partition.part_values()
+    csets = coarse_rows.partition.part_values()
+    fb = info.box_shape
+    descr = None
+    for fi, ci in zip(fsets, csets):
+        if getattr(fi, "box_shape", None) is None:
+            return None
+        if getattr(ci, "box_shape", None) is None:
+            return None
+        if fi.box_shape != fb:
+            return None
+        cb = ci.box_shape
+        if any(s == 0 for s in cb):
+            return None  # agglomerated coarse level: matrix path
+        st = tuple(
+            2 * cl - fl for cl, fl in zip(ci.box_lo, fi.box_lo)
+        )
+        if any(s < 0 or s > 1 for s in st):
+            return None
+        if any(st[d] + 2 * (cb[d] - 1) >= fb[d] for d in range(dim)):
+            return None
+        cand = (fb, tuple(cb), st)
+        if descr is None:
+            descr = cand
+        elif cand != descr:
+            return None  # shards differ: SPMD uniformity broken
+        # FULL-shell coverage: every in-grid shell cell owned by another
+        # part must be a ghost, or the shifted reads would see zeros
+        # where S needs neighbor values
+        gdims = fi.grid_shape
+        shell = []
+        for d in range(dim):
+            shell.append(
+                np.arange(
+                    max(fi.box_lo[d] - 1, 0),
+                    min(fi.box_hi[d] + 1, gdims[d]),
+                )
+            )
+        grid = np.meshgrid(*shell, indexing="ij")
+        inside = np.ones(grid[0].shape, dtype=bool)
+        for d in range(dim):
+            inside &= (grid[d] >= fi.box_lo[d]) & (grid[d] < fi.box_hi[d])
+        sg = np.ravel_multi_index(
+            [g[~inside] for g in grid], gdims
+        )
+        if (fi.gids_to_lids(sg) < 0).any():
+            return None
+        # the ghost set must be EXACTLY the in-grid foreign shell: a
+        # periodic partition carries wrapped ghosts beyond it, and the
+        # zero-padded stencil apply would drop boundary weights where
+        # the assembled S (and the host oracle) wraps them
+        if fi.num_hids != len(sg):
+            return None
+    fb, cb, st = descr
+    # segment placements into the (b+2)^d extended array: each direction
+    # δ maps to the shell slice [0,1) / [1,1+b) / [1+b,2+b) per dim; the
+    # slab must be exactly the full face/edge/corner extent (guaranteed
+    # by the full-shell check for interior parts — verify anyway)
+    shell_put = []
+    for d_ in info.dirs:
+        exp_shape = tuple(
+            1 if c != 0 else fb[k] for k, c in enumerate(d_.dir)
+        )
+        if d_.shape != exp_shape:
+            return None
+        sl = tuple(
+            slice(0, 1) if c == -1
+            else (slice(1 + fb[k], 2 + fb[k]) if c == 1
+                  else slice(1, 1 + fb[k]))
+            for k, c in enumerate(d_.dir)
+        )
+        shell_put.append((sl, d_.off, d_.shape))
+    return {"stencil": (fb, cb, st), "shell": tuple(shell_put)}
+
+
+def _stencil_apply(jnp, layout, shell_put, xv, fb):
+    """S·x over one part: embed the owned box and the ghost segments into
+    the zero-padded (b+2)^d extended array, then sum the 3^d shifted
+    slices with weights 0.5^|δ|₀. Reads beyond the global boundary see
+    the zero pad — exactly S's dropped-weight truncation."""
+    dim = len(fb)
+    o0, g0 = layout.o0, layout.g0
+    no = 1
+    for b in fb:
+        no *= b
+    ext = jnp.zeros(tuple(b + 2 for b in fb), dtype=xv.dtype)
+    core = tuple(slice(1, 1 + b) for b in fb)
+    ext = ext.at[core].set(xv[o0 : o0 + no].reshape(fb))
+    for sl, off, shape in shell_put:
+        seg = xv[g0 + off : g0 + off + int(np.prod(shape))]
+        ext = ext.at[sl].set(seg.reshape(shape))
+    acc = None
+    for delta in np.ndindex(*(3,) * dim):
+        d = tuple(c - 1 for c in delta)
+        w = 0.5 ** sum(1 for c in d if c != 0)
+        sl = tuple(slice(1 + c, 1 + c + b) for c, b in zip(d, fb))
+        term = ext[sl] if w == 1.0 else w * ext[sl]
+        acc = term if acc is None else acc + term
+    return acc.reshape(-1)
 
 
 def _stage_structured_transfer(h, li: int, backend: TPUBackend):
@@ -296,7 +439,9 @@ def _gmg_operands(dh):
     lv = []
     for l in dh["levels"]:
         entry = {"A": _matrix_operands(l["dA"]), "dinv": l["dinv"]}
-        if "dS" in l:
+        if "stencil" in l:
+            pass  # matrix-free transfers: everything is compiled in
+        elif "dS" in l:
             entry.update(
                 S=_matrix_operands(l["dS"]),
                 emb=l["emb"], rsi=l["rsi"], rsm=l["rsm"], rri=l["rri"],
@@ -321,7 +466,11 @@ def _vcycle_shard_body(h, dh):
     bodies = []
     for l in dh["levels"]:
         b = {"A": _spmv_body(l["dA"])}
-        if "dS" in l:
+        if "stencil" in l:
+            # matrix-free transfers refresh ghosts through the level's
+            # own box exchange before each stencil apply
+            b["exch_A"] = _shard_exchange(l["dA"].col_plan, "set")
+        elif "dS" in l:
             b["S"] = _spmv_body(l["dS"])
             b["exch_add"] = _shard_exchange(l["rev_plan"], "add")
             b["exch_set"] = _shard_exchange(l["dS"].col_plan, "set")
@@ -377,7 +526,19 @@ def _vcycle_shard_body(h, dh):
                 q = spmv_A(x)
                 x = x.at[sl].add(omega * dinv[sl] * (b_l[sl] - q[sl]))
             q = spmv_A(x)
-            if structured:
+            if "stencil" in lv:
+                # MATRIX-FREE factored restriction R = Eᵀ·S: refresh the
+                # residual's ghosts through the level's box exchange,
+                # apply S as 3^d shifted slices of the extended box,
+                # extract the even points — no operators staged at all
+                fbx, cbx, stx = lv["stencil"]
+                rv = jnp.zeros_like(b_l).at[sl].set(b_l[sl] - q[sl])
+                rv = bodies[level]["exch_A"](
+                    rv, m["A"]["si"], m["A"]["sm"], m["A"]["ri"]
+                )
+                w_own = _stencil_apply(jnp, LA, lv["shell"], rv, fbx)
+                rc_own = _box_extract(jnp, w_own, fbx, cbx, stx)
+            elif structured:
                 # factored restriction R = Eᵀ·S: stencil-apply the fine
                 # residual (coded-DIA speed), refresh ghosts so embedded
                 # points owned elsewhere are readable, extract the
@@ -444,7 +605,19 @@ def _vcycle_shard_body(h, dh):
                     # second coarse pass, warm-started (W-cycle γ = 2)
                     ec = solve_level(level + 1, bc, ec)
                 ec_own = ec[nxt.o0 : nxt.o0 + nxt.no_max]
-            if structured:
+            if "stencil" in lv:
+                # matrix-free prolongation P = S·E: interleave the
+                # coarse correction onto the even fine points, refresh
+                # ghosts (neighbor parts' interleaved values), stencil
+                fbx, cbx, stx = lv["stencil"]
+                t = _box_interleave(jnp, ec_own, fbx, cbx, stx)
+                z = jnp.zeros_like(b_l).at[sl].set(t)
+                z = bodies[level]["exch_A"](
+                    z, m["A"]["si"], m["A"]["sm"], m["A"]["ri"]
+                )
+                ef_own = _stencil_apply(jnp, LA, lv["shell"], z, fbx)
+                x = x.at[sl].add(ef_own)
+            elif structured:
                 # factored prolongation P = S·E: scatter the coarse
                 # correction onto the even fine points (N/8 elements),
                 # assemble embedded-into-ghost values to their owners,
@@ -664,6 +837,204 @@ def make_gmg_pcg_fn(h, backend: TPUBackend, tol: float, maxiter: int):
         return fn(b, x0, dh["cinv"], ops)
 
     return run
+
+
+def make_fgmres_gmg_fn(
+    h, backend: TPUBackend, tol: float, maxiter: int, restart: int = 30
+):
+    """FLEXIBLE restarted GMRES with the ENTIRE multigrid V-cycle inlined
+    as the right preconditioner — one compiled program (the device form
+    of models.solvers.fgmres(A, b, minv=hierarchy)). The Arnoldi loop
+    follows the host algorithm step for step (modified Gram-Schmidt in
+    fixed order, sequential Givens rotations, true-residual restart
+    test), with fixed shapes: the V/Z bases are dense (m+1, W)/(m, W)
+    carries and inactive steps are masked rather than skipped, so one
+    `lax.while_loop` over restart cycles serves any trip count."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+
+    dh = _device_hierarchy(h, backend)
+    dA0 = dh["levels"][0]["dA"]
+    mesh = backend.mesh(dA0.row_layout.P)
+    spec = backend.parts_spec()
+    none_spec = jax.sharding.PartitionSpec()
+    L0 = dA0.col_plan.layout
+    pdot = _pdot_factory(L0.o0, L0.no_max)
+    body_A0 = _spmv_body(dA0)
+    vcycle = _vcycle_shard_body(h, dh)
+    ops = _gmg_operands(dh)
+    specs = jax.tree.map(lambda _: spec, ops)
+    m = int(restart)
+    H_cap = int(min(maxiter + 1, 4096))
+
+    @jax.jit
+    def fn(b, x0, cinv, mats_in):
+        def shard_fn(bs, x0s, cinv_r, ms):
+            bv, xv = bs[0], x0s[0]
+            mats = _shard_ops(jax, ms)
+            no = L0.no_max
+            sl = slice(L0.o0, L0.o0 + no)
+            Lr = dA0.row_layout
+            dt = bv.dtype
+
+            def spmv(z):
+                y, _ = body_A0(z, mats["lv"][0]["A"])
+                return jnp.zeros_like(z).at[sl].set(y[Lr.o0 : Lr.o0 + no])
+
+            def residual(x):
+                y = spmv(x)
+                return jnp.zeros_like(x).at[sl].set(bv[sl] - y[sl])
+
+            r0 = residual(xv)
+            beta0 = jnp.sqrt(pdot(r0, r0))
+            rs0 = jnp.maximum(1.0, beta0)
+            hist = jnp.full(H_cap, jnp.nan, dtype=dt).at[0].set(beta0)
+            W = xv.shape[0]
+
+            def cycle(st):
+                x, beta, it, hist, _conv = st
+                r = residual(x)
+                b2 = jnp.sqrt(pdot(r, r))
+                safe = jnp.where(b2 > 0, b2, 1.0)
+                V = jnp.zeros((m + 1, W), dt).at[0].set(r / safe)
+                Z = jnp.zeros((m, W), dt)
+                Hm = jnp.zeros((m + 1, m), dt)
+                cs = jnp.zeros(m, dt)
+                sn = jnp.zeros(m, dt)
+                g = jnp.zeros(m + 1, dt).at[0].set(b2)
+                active0 = b2 > tol * rs0
+
+                def arnoldi(j, car):
+                    V, Z, Hm, cs, sn, g, it, hist, active, j_used = car
+                    active = active & (it < maxiter)
+                    vj = jax.lax.dynamic_slice(V, (j, 0), (1, W))[0]
+                    z = vcycle(vj, mats, cinv_r)
+                    w = spmv(z)
+                    # modified Gram-Schmidt, fixed order (i <= j live)
+                    hcol = jnp.zeros(m + 1, dt)
+                    for i in range(m):
+                        live = i <= j
+                        hij = jnp.where(live, pdot(w, V[i]), 0.0)
+                        w = w - hij * V[i]
+                        hcol = hcol.at[i].set(hij)
+                    hj1 = jnp.sqrt(pdot(w, w))
+                    hcol = hcol.at[j + 1].set(hj1)
+                    # apply the accumulated Givens rotations (i < j)
+                    for i in range(m):
+                        live = i < j
+                        t = cs[i] * hcol[i] + sn[i] * hcol[i + 1]
+                        u = -sn[i] * hcol[i] + cs[i] * hcol[i + 1]
+                        hcol = hcol.at[i].set(jnp.where(live, t, hcol[i]))
+                        hcol = hcol.at[i + 1].set(
+                            jnp.where(live, u, hcol[i + 1])
+                        )
+                    hjj = jax.lax.dynamic_slice(hcol, (j,), (1,))[0]
+                    rho = jnp.hypot(hjj, hj1)
+                    csj = jnp.where(rho == 0, 1.0, hjj / rho)
+                    snj = jnp.where(rho == 0, 0.0, hj1 / rho)
+                    hcol = jax.lax.dynamic_update_slice(
+                        hcol, jnp.stack([rho, jnp.zeros((), dt)]), (j,)
+                    )
+                    gj = jax.lax.dynamic_slice(g, (j,), (1,))[0]
+                    g_new = jax.lax.dynamic_update_slice(
+                        g, jnp.stack([csj * gj, -snj * gj]), (j,)
+                    )
+                    res = jnp.abs(-snj * gj)
+                    # masked commits
+                    Z = jnp.where(active, Z.at[j].set(z), Z)
+                    Hm = jnp.where(active, Hm.at[:, j].set(hcol), Hm)
+                    cs = jnp.where(active, cs.at[j].set(csj), cs)
+                    sn = jnp.where(active, sn.at[j].set(snj), sn)
+                    g = jnp.where(active, g_new, g)
+                    safe_w = jnp.where(hj1 > 0, hj1, 1.0)
+                    V = jnp.where(active, V.at[j + 1].set(w / safe_w), V)
+                    it = it + active.astype(it.dtype)
+                    hist = jnp.where(
+                        active,
+                        hist.at[jnp.minimum(it, H_cap - 1)].set(res),
+                        hist,
+                    )
+                    j_used = jnp.where(active, j + 1, j_used)
+                    # the host breaks AFTER committing step j on
+                    # convergence or lucky breakdown
+                    active = active & (res > tol * rs0) & (hj1 > 0)
+                    return (V, Z, Hm, cs, sn, g, it, hist, active, j_used)
+
+                V, Z, Hm, cs, sn, g, it, hist, _a, j_used = jax.lax.fori_loop(
+                    0,
+                    m,
+                    arnoldi,
+                    (V, Z, Hm, cs, sn, g, it, hist, active0,
+                     jnp.int32(0)),
+                )
+                # back-substitute the j_used x j_used triangular system
+                y = jnp.zeros(m, dt)
+                for i in range(m - 1, -1, -1):
+                    live = i < j_used
+                    s = g[i] - jnp.sum(Hm[i, :] * y)
+                    d = jnp.where(Hm[i, i] != 0, Hm[i, i], 1.0)
+                    y = y.at[i].set(jnp.where(live, s / d, 0.0))
+                # flexible update: x rides the PRECONDITIONED basis Z,
+                # applied in host order (sequential axpys) over the OWNED
+                # slice only — Z rows are raw V-cycle outputs whose ghost
+                # slots carry transfer-internal values
+                for i in range(m):
+                    x = x.at[sl].add(y[i] * Z[i][sl])
+                r = residual(x)
+                beta = jnp.sqrt(pdot(r, r))
+                conv = beta <= tol * rs0
+                return (x, beta, it, hist, conv)
+
+            def cond(st):
+                _x, _beta, it, _h, conv = st
+                return (~conv) & (it < maxiter)
+
+            x, beta, it, hist, _conv = jax.lax.while_loop(
+                cond,
+                cycle,
+                (xv, beta0, jnp.int32(0), hist, beta0 <= tol * rs0),
+            )
+            return x[None], beta * beta, beta0 * beta0, it, hist
+
+        return shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec, spec, none_spec, specs),
+            out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
+            check_vma=False,
+        )(b, x0, cinv, mats_in)
+
+    def run(b, x0):
+        return fn(b, x0, dh["cinv"], ops)
+
+    return run
+
+
+def tpu_fgmres_gmg(
+    h,
+    b: PVector,
+    x0: Optional[PVector] = None,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    restart: int = 30,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """Compiled flexible GMRES with the V-cycle preconditioner inlined
+    (device form of fgmres(A, b, minv=hierarchy))."""
+    backend = b.values.backend
+    check(
+        isinstance(backend, TPUBackend), "tpu_fgmres_gmg needs the TPU backend"
+    )
+    if maxiter is None:
+        maxiter = 4 * int(h.levels[0].A.rows.ngids)
+    return _run_gmg(
+        h, b, x0, tol, maxiter, verbose,
+        lambda: make_fgmres_gmg_fn(
+            h, backend, tol, maxiter, restart=restart
+        ),
+        f"fgmres+gmg(m={restart})",
+    )
 
 
 def _run_gmg(h, b, x0, tol, maxiter, verbose, make_fn, name):

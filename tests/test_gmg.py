@@ -355,3 +355,93 @@ def test_gmg_variable_coefficient_operator():
     it_s = pa.prun(driver, pa.sequential, (2, 2))
     it_t = pa.prun(driver, pa.tpu, (2, 2))
     assert it_s == it_t, (it_s, it_t)
+
+
+def test_fgmres_gmg_compiled_matches_host():
+    """Compiled flexible GMRES with the inlined V-cycle preconditioner
+    (parallel/tpu_gmg.py:make_fgmres_gmg_fn) vs the host
+    fgmres(minv=hierarchy): same Arnoldi/Givens/restart algorithm, so
+    the gate is iteration parity (+-1 for FP reassociation in the basis
+    updates) and solution accuracy."""
+
+    def driver(parts):
+        ns = (12, 12, 12)
+        A, b, x_exact, _ = _poisson(parts, ns)
+        Ah, bh = pa.decouple_dirichlet(A, b)
+        h = pa.gmg_hierarchy(parts, Ah, ns, coarse_threshold=100)
+        xh, ih = pa.fgmres(Ah, bh, minv=h, tol=1e-9, restart=10)
+        assert ih["converged"], ih
+        xt, it_ = pa.tpu_fgmres_gmg(h, bh, tol=1e-9, restart=10)
+        assert it_["converged"], it_
+        errh = np.abs(pa.gather_pvector(xh) - pa.gather_pvector(x_exact)).max()
+        errt = np.abs(pa.gather_pvector(xt) - pa.gather_pvector(x_exact)).max()
+        assert errh < 1e-7 and errt < 1e-7, (errh, errt)
+        assert abs(ih["iterations"] - it_["iterations"]) <= 1, (
+            ih["iterations"], it_["iterations"],
+        )
+        return True
+
+    assert pa.prun(driver, pa.tpu, (2, 2, 2))
+
+
+def test_fgmres_gmg_restart_cycles():
+    """A restart smaller than the iteration count forces multiple outer
+    cycles through the compiled while_loop; convergence must survive."""
+
+    def driver(parts):
+        ns = (12, 12)
+        A, b, x_exact, _ = _poisson(parts, ns)
+        Ah, bh = pa.decouple_dirichlet(A, b)
+        h = pa.gmg_hierarchy(parts, Ah, ns, coarse_threshold=30)
+        xt, info = pa.tpu_fgmres_gmg(h, bh, tol=1e-10, restart=3)
+        assert info["converged"], info
+        err = np.abs(pa.gather_pvector(xt) - pa.gather_pvector(x_exact)).max()
+        assert err < 1e-7, err
+        return True
+
+    assert pa.prun(driver, pa.tpu, (2, 2))
+
+
+def test_gmg_coarse_agglomeration_iteration_parity():
+    """agg_threshold moves coarse levels onto a 2x-strided sub-grid of
+    parts (empty boxes elsewhere). Placement must not change the math:
+    same iteration counts and solution as the full-mesh hierarchy, on
+    the host loop AND the compiled program."""
+
+    def driver(parts, agg):
+        ns = (24, 24, 24)
+        A, b, x_exact, _ = _poisson(parts, ns)
+        Ah, bh = pa.decouple_dirichlet(A, b)
+        h = pa.gmg_hierarchy(
+            parts, Ah, ns, coarse_threshold=100,
+            agg_threshold=agg,
+        )
+        if agg:
+            # some level must actually be agglomerated: a coarse
+            # partition with empty parts while cells >= parts
+            assert any(
+                min(
+                    i.num_oids
+                    for i in lvl.A.rows.partition.part_values()
+                ) == 0
+                and lvl.A.rows.ngids >= lvl.A.rows.num_parts
+                for lvl in h.levels[1:]
+            ) or min(
+                i.num_oids
+                for i in h.coarse_A.rows.partition.part_values()
+            ) == 0
+        x, info = pa.gmg_solve(h, bh, tol=1e-9)
+        assert info["converged"]
+        err = np.abs(pa.gather_pvector(x) - pa.gather_pvector(x_exact)).max()
+        assert err < 1e-6, err
+        xp, infop = pa.tpu_gmg_pcg(h, bh, tol=1e-9)
+        assert infop["converged"]
+        errp = np.abs(
+            pa.gather_pvector(xp) - pa.gather_pvector(x_exact)
+        ).max()
+        assert errp < 1e-6, errp
+        return info["iterations"], infop["iterations"]
+
+    it_full = pa.prun(driver, pa.tpu, (2, 2, 2), 0)
+    it_agg = pa.prun(driver, pa.tpu, (2, 2, 2), 2000)
+    assert it_full == it_agg, (it_full, it_agg)
