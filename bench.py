@@ -271,8 +271,8 @@ def bench_cg_vs_cpu(n: int, backend, pa, dA) -> dict:
 
     host_it_s = pa.prun(host_driver, SequentialBackend(), (1, 1, 1))
 
-    b = pa.PVector.full(np.float32(1.0), A.cols, dtype=dtype)
-    x0 = pa.PVector.full(np.float32(0.0), A.cols, dtype=dtype)
+    b = pa.PVector.full(np.float32(1.0), dA.cols, dtype=dtype)
+    x0 = pa.PVector.full(np.float32(0.0), dA.cols, dtype=dtype)
 
     # device leg: two fixed-trip compiled solves, marginal cost per it
     db = DeviceVector.from_pvector(b, backend, dA.col_layout)

@@ -47,18 +47,24 @@ def _box_enabled(backend: TPUBackend) -> bool:
     return os.environ.get("PA_TPU_GMG_BOX", "0" if on_tpu else "1") != "0"
 
 
+def _stencil_enabled() -> bool:
+    """The ONE resolution of PA_TPU_GMG_STENCIL (matrix-free transfers),
+    used by both the staging site and the cache key — they must never
+    disagree, or a stale lowering is served."""
+    import os
+
+    return os.environ.get("PA_TPU_GMG_STENCIL", "1") != "0"
+
+
 def _gmg_env_key(backend: TPUBackend):
     """Every env mode that changes the staged lowering must key the
     caches: the resolved PA_TPU_GMG_BOX value (it selects the emb_fast
     descriptor), PA_TPU_GMG_STENCIL (it selects the matrix-free
     transfers), plus the shared DeviceMatrix lowering modes — ONE
-    helper, so the key sites can never drift apart."""
-    import os
-
+    helper per mode, so the key sites can never drift apart."""
     from .tpu import _lowering_env_key
 
-    stencil = os.environ.get("PA_TPU_GMG_STENCIL", "1") != "0"
-    return (_box_enabled(backend), stencil) + _lowering_env_key()
+    return (_box_enabled(backend), _stencil_enabled()) + _lowering_env_key()
 
 
 def _device_hierarchy(h, backend: TPUBackend):
@@ -129,11 +135,9 @@ def _stage_stencil_transfer(h, li: int, dA):
     * ``stencil``: (fb, cb, st) — the embedding boxes, as in emb_fast,
     * ``shell``: per-direction (ext_slice, seg_off, seg_shape) placements
       of the ghost segments into the (b+2)^d extended array."""
-    import os
-
     from .tpu_box import BoxExchangePlan
 
-    if os.environ.get("PA_TPU_GMG_STENCIL", "1") == "0":
+    if not _stencil_enabled():
         return None
     lvl = h.levels[li]
     if lvl.nfs is None or lvl.ncs is None:

@@ -140,11 +140,14 @@ def main():
             out = None
             for attempt in range(3):
                 # the relay's remote_compile endpoint drops large compile
-                # responses occasionally; the request is idempotent
+                # responses occasionally; the request is idempotent. `out`
+                # commits only after the forcing fetch succeeds, so a
+                # flake in EITHER step leaves a clean retry state.
                 try:
                     t0 = time.perf_counter()
-                    out = gfn(dbg.data, dx0g.data)
-                    git = int(out[3])
+                    attempt_out = gfn(dbg.data, dx0g.data)
+                    git = int(attempt_out[3])
+                    out = attempt_out
                     break
                 except Exception as e:
                     print(
