@@ -167,6 +167,9 @@ def galerkin_cartesian(
         if out is None:
             return None
         ebox = tuple(h - l for l, h in zip(elo, ehi))
+        # int32 coarse gids whenever they fit: the whole COO assembly
+        # pipeline (dedup, to_lids, compresscoo) then runs copy-free
+        gdt = np.int32 if int(np.prod(ncs)) < 2**31 else np.int64
         I_out, J_out, V_out = [], [], []
         for e in range(3**dim):
             v = out[e]
@@ -181,8 +184,8 @@ def galerkin_cartesian(
             de.reverse()  # e was accumulated most-significant-first
             c1 = [c + l for c, l in zip(cc, elo)]
             c2 = [c + d for c, d in zip(c1, de)]
-            I_out.append(np.ravel_multi_index(tuple(c1), ncs))
-            J_out.append(np.ravel_multi_index(tuple(c2), ncs))
+            I_out.append(np.ravel_multi_index(tuple(c1), ncs).astype(gdt))
+            J_out.append(np.ravel_multi_index(tuple(c2), ncs).astype(gdt))
             V_out.append(v[nz])
         if not I_out:
             z = np.empty(0, dtype=np.int64)
@@ -215,8 +218,11 @@ def galerkin_cartesian(
         return cg[T.row], cg[T.col], T.data.astype(M.data.dtype, copy=False)
 
     coo = map_parts(_local, A.rows.partition, A.cols.partition, A.values)
-    I = map_parts(lambda c: np.asarray(c[0], dtype=np.int64), coo)
-    J = map_parts(lambda c: np.asarray(c[1], dtype=np.int64), coo)
+    # keep each part's gid dtype as produced (int32 from the fast path
+    # flows copy-free through dedup/to_lids/compresscoo; forcing int64
+    # here would silently undo that)
+    I = map_parts(lambda c: np.asarray(c[0]), coo)
+    J = map_parts(lambda c: np.asarray(c[1]), coo)
     V = map_parts(lambda c: c[2], coo)
     return assemble_matrix_from_coo(I, J, V, coarse_rows)
 

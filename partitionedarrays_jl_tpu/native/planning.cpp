@@ -430,25 +430,27 @@ static int64_t dia_fill_impl(const int32_t* indptr, const int32_t* cols,
 // happen by construction (ext box sized by the caller); entries whose
 // fine column offset leaves the +-1 cube return -1 (caller falls back
 // to the generic sparse product).
-template <typename T>
-static int64_t galerkin3_impl(const int32_t* indptr, const int32_t* cols,
-                              const T* vals, int64_t no,
-                              const int64_t* lid_gid, const int64_t* fdims,
-                              const int64_t* flo, const int64_t* fhi,
-                              const int64_t* cdims, const int64_t* elo,
-                              const int64_t* ehi, int32_t dim,
-                              double* out) {
-    int64_t fstride[3] = {1, 1, 1}, estride[3] = {1, 1, 1};
-    int64_t ebox[3] = {1, 1, 1};
-    for (int32_t d = 0; d < dim; ++d) ebox[d] = ehi[d] - elo[d];
-    for (int32_t d = dim - 2; d >= 0; --d) {
-        // strides within the global fine grid / the ext coarse box
+// DIM is a compile-time parameter so the per-entry loops fully unroll
+// (the runtime-dim version measured ~8.6 ns per weight pair; the
+// specialized one removes the dim>k ternaries and bounds the loops).
+template <typename T, int DIM>
+static int64_t galerkin3_dim(const int32_t* indptr, const int32_t* cols,
+                             const T* vals, int64_t no,
+                             const int64_t* lid_gid, const int64_t* fdims,
+                             const int64_t* flo, const int64_t* fhi,
+                             const int64_t* cdims, const int64_t* elo,
+                             const int64_t* ehi, double* out) {
+    int64_t fstride[DIM], estride[DIM], ebox[DIM], fbox[DIM];
+    for (int d = 0; d < DIM; ++d) ebox[d] = ehi[d] - elo[d];
+    fstride[DIM - 1] = 1;
+    estride[DIM - 1] = 1;
+    for (int d = DIM - 2; d >= 0; --d) {
         fstride[d] = fstride[d + 1] * fdims[d + 1];
         estride[d] = estride[d + 1] * ebox[d + 1];
     }
     int64_t esize = 1;
-    for (int32_t d = 0; d < dim; ++d) esize *= ebox[d];
-    // per-dim interpolation of a fine coord f: up to 2 (k, w) pairs
+    for (int d = 0; d < DIM; ++d) esize *= ebox[d];
+    for (int d = 0; d < DIM; ++d) fbox[d] = fhi[d] - flo[d];
     auto interp1 = [&](int64_t f, int64_t nc, int64_t* k, double* w) {
         if ((f & 1) == 0) {
             k[0] = f >> 1;
@@ -464,83 +466,113 @@ static int64_t galerkin3_impl(const int32_t* indptr, const int32_t* cols,
         }
         return n;
     };
-    int64_t fbox[3] = {1, 1, 1};
-    for (int32_t d = 0; d < dim; ++d) fbox[d] = fhi[d] - flo[d];
+    // per-row P entries, hoisted: flat ext position + coords + weight
+    int64_t rpos[1 << DIM];
+    int64_t rc[1 << DIM][DIM];
+    double rw[1 << DIM];
     for (int64_t r = 0; r < no; ++r) {
-        // owned fine coords from the C-order box scan
-        int64_t fc[3] = {0, 0, 0}, rem = r;
-        for (int32_t d = dim - 1; d >= 0; --d) {
+        int64_t fc[DIM], rem = r;
+        for (int d = DIM - 1; d >= 0; --d) {
             fc[d] = flo[d] + rem % fbox[d];
             rem /= fbox[d];
         }
-        // P row of i: tensor product of per-dim pairs
-        int64_t ki[3][2];
-        double wi[3][2];
-        int ni[3] = {1, 1, 1};
-        for (int32_t d = 0; d < dim; ++d)
+        int64_t ki[DIM][2];
+        double wi[DIM][2];
+        int ni[DIM];
+        for (int d = 0; d < DIM; ++d)
             ni[d] = interp1(fc[d], cdims[d], ki[d], wi[d]);
+        int nr = 0;
+        int idx[DIM] = {0};
+        for (;;) {
+            int64_t pos = 0;
+            double w = 1.0;
+            bool ok = true;
+            for (int d = 0; d < DIM; ++d) {
+                const int64_t c = ki[d][idx[d]];
+                const int64_t p = c - elo[d];
+                if (p < 0 || p >= ebox[d]) { ok = false; break; }
+                pos += p * estride[d];
+                w *= wi[d][idx[d]];
+                rc[nr][d] = c;
+            }
+            if (!ok) return -2;
+            rpos[nr] = pos;
+            rw[nr] = w;
+            ++nr;
+            int d = DIM - 1;
+            while (d >= 0 && ++idx[d] >= ni[d]) idx[d--] = 0;
+            if (d < 0) break;
+        }
         for (int32_t a = indptr[r]; a < indptr[r + 1]; ++a) {
             const double av = (double)vals[a];
             int64_t g = lid_gid[cols[a]];
-            int64_t jc[3] = {0, 0, 0};
-            for (int32_t d = 0; d < dim; ++d) {
+            int64_t jc[DIM];
+            for (int d = 0; d < DIM; ++d) {
                 jc[d] = g / fstride[d];
                 g -= jc[d] * fstride[d];
             }
-            for (int32_t d = 0; d < dim; ++d) {
+            for (int d = 0; d < DIM; ++d) {
                 const int64_t o = jc[d] - fc[d];
-                if (o < -1 || o > 1) return -1;  // outside the closure
+                if (o < -1 || o > 1) return -1;
             }
-            int64_t kj[3][2];
-            double wj[3][2];
-            int nj[3] = {1, 1, 1};
-            for (int32_t d = 0; d < dim; ++d)
+            int64_t kj[DIM][2];
+            double wj[DIM][2];
+            int nj[DIM];
+            for (int d = 0; d < DIM; ++d)
                 nj[d] = interp1(jc[d], cdims[d], kj[d], wj[d]);
-            // scatter the <=8 x <=8 tensor contributions
-            for (int ai = 0; ai < ni[0]; ++ai)
-                for (int bi = 0; bi < (dim > 1 ? ni[1] : 1); ++bi)
-                    for (int ci = 0; ci < (dim > 2 ? ni[2] : 1); ++ci) {
-                        const double w1 = wi[0][ai] *
-                                          (dim > 1 ? wi[1][bi] : 1.0) *
-                                          (dim > 2 ? wi[2][ci] : 1.0);
-                        int64_t pos = 0;
-                        const int64_t c1[3] = {
-                            ki[0][ai],
-                            dim > 1 ? ki[1][bi] : 0,
-                            dim > 2 ? ki[2][ci] : 0,
-                        };
-                        bool ok = true;
-                        for (int32_t d = 0; d < dim; ++d) {
-                            const int64_t p = c1[d] - elo[d];
-                            if (p < 0 || p >= ebox[d]) { ok = false; break; }
-                            pos += p * estride[d];
-                        }
-                        if (!ok) return -2;  // ext box undersized (bug)
-                        for (int aj = 0; aj < nj[0]; ++aj)
-                            for (int bj = 0; bj < (dim > 1 ? nj[1] : 1); ++bj)
-                                for (int cj = 0;
-                                     cj < (dim > 2 ? nj[2] : 1); ++cj) {
-                                    const double w2 =
-                                        wj[0][aj] *
-                                        (dim > 1 ? wj[1][bj] : 1.0) *
-                                        (dim > 2 ? wj[2][cj] : 1.0);
-                                    const int64_t c2[3] = {
-                                        kj[0][aj],
-                                        dim > 1 ? kj[1][bj] : 0,
-                                        dim > 2 ? kj[2][cj] : 0,
-                                    };
-                                    int64_t e = 0;  // diagonal id, base 3
-                                    for (int32_t d = 0; d < dim; ++d) {
-                                        const int64_t de = c2[d] - c1[d];
-                                        if (de < -1 || de > 1) return -3;
-                                        e = e * 3 + (de + 1);
-                                    }
-                                    out[e * esize + pos] += w1 * av * w2;
-                                }
+            // enumerate the col's P entries once, then scatter against
+            // the hoisted row list
+            int64_t cc2[1 << DIM][DIM];
+            double w2s[1 << DIM];
+            int nc2 = 0;
+            int jdx[DIM] = {0};
+            for (;;) {
+                double w = av;
+                for (int d = 0; d < DIM; ++d) {
+                    cc2[nc2][d] = kj[d][jdx[d]];
+                    w *= wj[d][jdx[d]];
+                }
+                w2s[nc2++] = w;
+                int d = DIM - 1;
+                while (d >= 0 && ++jdx[d] >= nj[d]) jdx[d--] = 0;
+                if (d < 0) break;
+            }
+            for (int i1 = 0; i1 < nr; ++i1) {
+                const double w1 = rw[i1];
+                double* base = out;  // out[e * esize + rpos]
+                for (int i2 = 0; i2 < nc2; ++i2) {
+                    int64_t e = 0;
+                    for (int d = 0; d < DIM; ++d) {
+                        const int64_t de = cc2[i2][d] - rc[i1][d];
+                        if (de < -1 || de > 1) return -3;
+                        e = e * 3 + (de + 1);
                     }
+                    base[e * esize + rpos[i1]] += w1 * w2s[i2];
+                }
+            }
         }
     }
     return 0;
+}
+
+template <typename T>
+static int64_t galerkin3_impl(const int32_t* indptr, const int32_t* cols,
+                              const T* vals, int64_t no,
+                              const int64_t* lid_gid, const int64_t* fdims,
+                              const int64_t* flo, const int64_t* fhi,
+                              const int64_t* cdims, const int64_t* elo,
+                              const int64_t* ehi, int32_t dim,
+                              double* out) {
+    if (dim == 3)
+        return galerkin3_dim<T, 3>(indptr, cols, vals, no, lid_gid, fdims,
+                                   flo, fhi, cdims, elo, ehi, out);
+    if (dim == 2)
+        return galerkin3_dim<T, 2>(indptr, cols, vals, no, lid_gid, fdims,
+                                   flo, fhi, cdims, elo, ehi, out);
+    if (dim == 1)
+        return galerkin3_dim<T, 1>(indptr, cols, vals, no, lid_gid, fdims,
+                                   flo, fhi, cdims, elo, ehi, out);
+    return -1;  // unsupported dim: the Python wrapper guards dim <= 3
 }
 
 // Diagonal of a CSR block: one pass, binary search per (column-sorted)
