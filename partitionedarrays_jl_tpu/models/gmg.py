@@ -121,6 +121,164 @@ def _scipy_csr(M):
     return csr_matrix((M.data, M.indices, M.indptr), shape=M.shape)
 
 
+def _decode_offset(e: int, dim: int):
+    """Base-3 decode of a 3^d diagonal index into per-dim offsets in
+    {-1, 0, 1}, most-significant dim first (the accumulation order of
+    planning.cpp:galerkin3_dim)."""
+    de, m = [], e
+    for _ in range(dim):
+        de.append(m % 3 - 1)
+        m //= 3
+    de.reverse()
+    return de
+
+
+def _galerkin_fused(accs, ncs, coarse_rows: PRange) -> PSparseMatrix:
+    """COO-free Galerkin assembly from per-part accumulators (round-4
+    directive 1): only the O(surface) SHELL of each part's extended-box
+    accumulator — contributions to coarse rows owned elsewhere — rides
+    the classic COO migration (`assemble_coo`); received triplets are
+    scattered back into the accumulator, and the owned interior is then
+    emitted straight to column-sorted per-part CSR with local column
+    ids by planning.cpp:galerkin_emit_dim. The O(volume) extraction /
+    dedup / add_gids / to_lids / compresscoo passes of the generic path
+    never run. Cross-part sums happen at the accumulator's f64
+    precision (the generic path sums after the cast to the operator
+    dtype; both round to the same values to operator-dtype accuracy).
+    Reference anchor: the assembly migration this specializes,
+    src/Interfaces.jl:2406-2492."""
+    from .. import native
+    from ..ops.sparse import CSRMatrix
+    from ..parallel.collectives import gather_all
+    from ..parallel.psparse import assemble_coo
+
+    ncs = tuple(int(n) for n in ncs)
+    dim = len(ncs)
+
+    def _empty_coo():
+        z = np.empty(0, dtype=np.int64)
+        return z, z.copy(), np.empty(0, dtype=np.float64)
+
+    # ---- 1) shell COO: rows of the extended box outside the owned box
+    def _shell(ci, a):
+        out, elo, ehi, _dt = a
+        clo, chi = ci.box_lo, ci.box_hi
+        ebox = tuple(h - l for l, h in zip(elo, ehi))
+        if int(np.prod(ebox)) == 0:
+            return _empty_coo()
+        mask = np.zeros(ebox, dtype=bool)
+        mask[
+            tuple(
+                slice(cl - el, ch - el)
+                for cl, ch, el in zip(clo, chi, elo)
+            )
+        ] = True
+        shell = np.nonzero(~mask.ravel())[0]
+        if not len(shell):
+            return _empty_coo()
+        cc = np.unravel_index(shell, ebox)
+        I_out, J_out, V_out = [], [], []
+        for e in range(3**dim):
+            v = out[e][shell]
+            nz = np.nonzero(v)[0]
+            if not len(nz):
+                continue
+            de = _decode_offset(e, dim)
+            c1 = [c[nz] + l for c, l in zip(cc, elo)]
+            c2 = [c + d for c, d in zip(c1, de)]
+            I_out.append(np.ravel_multi_index(tuple(c1), ncs))
+            J_out.append(np.ravel_multi_index(tuple(c2), ncs))
+            V_out.append(v[nz])
+        if not I_out:
+            return _empty_coo()
+        return (
+            np.concatenate(I_out),
+            np.concatenate(J_out),
+            np.concatenate(V_out),
+        )
+
+    shell = map_parts(_shell, coarse_rows.partition, accs)
+    sizes = gather_all(map_parts(lambda s: len(s[0]), shell))
+    if int(np.sum(np.asarray(sizes.part_values()[0]))) > 0:
+        I = map_parts(lambda s: s[0], shell)
+        J = map_parts(lambda s: s[1], shell)
+        V = map_parts(lambda s: s[2], shell)
+        rows_g = add_gids(coarse_rows, I)
+        I2, J2, V2 = assemble_coo(I, J, V, rows_g)
+
+        def _scatter(ci, a, i, j, v):
+            out, elo, ehi, _dt = a
+            i = np.asarray(i)
+            j = np.asarray(j)
+            v = np.asarray(v)
+            # our zeroed sent copies target rows owned elsewhere; what
+            # remains nonzero on owned rows is neighbor contributions
+            keep = (ci.gids_to_lids(i) >= 0) & (v != 0)
+            if not keep.any():
+                return None
+            i, j, v = i[keep], j[keep], v[keep]
+            ebox = tuple(h - l for l, h in zip(elo, ehi))
+            c1 = np.unravel_index(i, ncs)
+            c2 = np.unravel_index(j, ncs)
+            pos = np.ravel_multi_index(
+                tuple(c - l for c, l in zip(c1, elo)), ebox
+            )
+            e = np.zeros(len(v), dtype=np.int64)
+            for d in range(dim):
+                de_d = c2[d].astype(np.int64) - c1[d]
+                check(
+                    bool(((de_d >= -1) & (de_d <= 1)).all()),
+                    "galerkin shell triplet outside the 3^d closure",
+                )
+                e = e * 3 + (de_d + 1)
+            np.add.at(out, (e, pos), v)
+            return None
+
+        map_parts(_scatter, coarse_rows.partition, accs, I2, J2, V2)
+
+    # ---- 2) geometric-shell column ghosts (sorted gids: add_gids then
+    # appends them in exactly the rank order the emission kernel uses)
+    def _ghosts(ci):
+        clo, chi = ci.box_lo, ci.box_hi
+        xlo = [max(0, c - 1) for c in clo]
+        xhi = [min(n, c + 1) for c, n in zip(chi, ncs)]
+        slabs = []
+        for d in range(dim):
+            for lo_d, hi_d in ((xlo[d], clo[d]), (chi[d], xhi[d])):
+                if lo_d >= hi_d:
+                    continue
+                ranges = [np.arange(xlo[k], xhi[k]) for k in range(dim)]
+                ranges[d] = np.arange(lo_d, hi_d)
+                mg = np.meshgrid(*ranges, indexing="ij")
+                slabs.append(
+                    np.ravel_multi_index(
+                        tuple(m.ravel() for m in mg), ncs
+                    )
+                )
+        if not slabs:
+            return np.empty(0, dtype=np.int64)
+        return np.unique(np.concatenate(slabs))
+
+    ghosts = map_parts(_ghosts, coarse_rows.partition)
+    cols = add_gids(coarse_rows, ghosts)
+
+    # ---- 3) fused CSR emission over the owned box
+    def _emit(ci, a, gg):
+        out, elo, ehi, dt = a
+        clo, chi = ci.box_lo, ci.box_hi
+        res = native.galerkin_emit(out, ncs, elo, ehi, clo, chi, gg, dt)
+        check(
+            res is not None,
+            "galerkin_emit declined after the eligibility check",
+        )
+        indptr, cols_l, vals = res
+        no = int(np.prod([h - l for l, h in zip(clo, chi)]))
+        return CSRMatrix(indptr, cols_l, vals, (no, no + len(gg)))
+
+    values = map_parts(_emit, coarse_rows.partition, accs, ghosts)
+    return PSparseMatrix(values, coarse_rows, cols)
+
+
 def galerkin_cartesian(
     A: PSparseMatrix,
     nfs: Sequence[int],
@@ -133,30 +291,37 @@ def galerkin_cartesian(
     P-row exchange. The per-part contribution
     Σ_{i ∈ owned fine rows} P[i,:]ᵀ (A P)[i,:] sums to the exact triple
     product because fine rows are disjointly owned; the coarse triplets
-    then migrate to their row owners along the FE-assembly path."""
+    then migrate to their row owners along the FE-assembly path.
+
+    Round-4 fast path: when every part has box metadata and the native
+    stencil-collapse succeeds everywhere, the result is built WITHOUT
+    materializing a COO at all — only the O(surface) shell of each
+    part's extended-box accumulator rides the assembly exchange; the
+    owned-box interior is emitted straight to per-part CSR by
+    planning.cpp:galerkin_emit_dim (`_galerkin_fused`). This removed
+    the extraction+migration+compression passes that were 98% of the
+    398 s hierarchy setup at 1e8 DOFs (SCALE_BENCH r3)."""
     from scipy.sparse import csr_matrix
+
+    from .. import native
+    from ..parallel.collectives import gather_all
 
     nfs = tuple(int(n) for n in nfs)
     ncs = tuple(int(n) for n in ncs)
+    dim = len(nfs)
     check(
         int(np.prod(ncs)) == coarse_rows.ngids,
         "galerkin_cartesian: coarse grid does not match coarse_rows",
     )
 
-    def _local_box(ri, ci, M):
-        """Native stencil-collapse path (planning.cpp:galerkin3_impl):
-        direct scatter of w_i·A_ij·w_j into the 3^d-diagonal coarse
-        accumulator over the part's extended coarse box — no sparse
-        matmats, no index sorts. Returns the COO contribution or None
-        when the part lacks box metadata / the operator leaves the
-        closure (periodic wrap, wide stencils), in which case the
-        generic sparse-product path below runs instead."""
-        from .. import native
-
+    def _acc_part(ri, ci, M):
+        """Native stencil-collapse accumulator (planning.cpp:
+        galerkin3_impl) over the part's extended coarse box, or None
+        when the part lacks box metadata / the operator leaves the 3^d
+        closure (periodic wrap, wide stencils)."""
         if not (hasattr(ri, "box_lo") and ri.grid_shape == nfs):
             return None
         flo, fhi = ri.box_lo, ri.box_hi
-        dim = len(nfs)
         elo = [max(0, (flo[d] - 1) // 2) for d in range(dim)]
         ehi = [min(ncs[d], fhi[d] // 2 + 1) for d in range(dim)]
         out = native.galerkin3(
@@ -166,6 +331,45 @@ def galerkin_cartesian(
         )
         if out is None:
             return None
+        return out, tuple(elo), tuple(ehi), M.data.dtype
+
+    accs = map_parts(
+        _acc_part, A.rows.partition, A.cols.partition, A.values
+    )
+
+    def _fusable(a, ci):
+        # the fused path needs the coarse partition to be a box too,
+        # with the owned box inside this part's extended box (emission
+        # walks owned rows; shell rows migrate)
+        if a is None:
+            return 0
+        if not (hasattr(ci, "box_lo") and ci.grid_shape == ncs):
+            return 0
+        _, elo, ehi, _ = a
+        no = int(
+            np.prod([h - l for l, h in zip(ci.box_lo, ci.box_hi)])
+        )
+        if no * 3**dim >= 2**31:  # the emission kernel's int32 capacity
+            return 0
+        return int(
+            all(
+                el <= cl and ch <= eh
+                for el, eh, cl, ch in zip(elo, ehi, ci.box_lo, ci.box_hi)
+            )
+        )
+
+    flags = map_parts(_fusable, accs, coarse_rows.partition)
+    if bool(np.all(np.asarray(gather_all(flags).part_values()[0]))):
+        return _galerkin_fused(accs, ncs, coarse_rows)
+
+    def _local_box(ri, ci, M, a):
+        """COO extraction from a precomputed accumulator — the pre-r4
+        native path, kept for parts the fused path declines (mixed
+        eligibility, agglomerated coarse partitions without box
+        metadata)."""
+        if a is None:
+            return None
+        out, elo, ehi, _dt = a
         ebox = tuple(h - l for l, h in zip(elo, ehi))
         # int32 coarse gids whenever they fit: the whole COO assembly
         # pipeline (dedup, to_lids, compresscoo) then runs copy-free
@@ -177,18 +381,16 @@ def galerkin_cartesian(
             if not len(nz):
                 continue
             cc = np.unravel_index(nz, ebox)
-            de, m = [], e
-            for _ in range(dim):
-                de.append(m % 3 - 1)
-                m //= 3
-            de.reverse()  # e was accumulated most-significant-first
+            de = _decode_offset(e, dim)
             c1 = [c + l for c, l in zip(cc, elo)]
             c2 = [c + d for c, d in zip(c1, de)]
             I_out.append(np.ravel_multi_index(tuple(c1), ncs).astype(gdt))
             J_out.append(np.ravel_multi_index(tuple(c2), ncs).astype(gdt))
             V_out.append(v[nz])
         if not I_out:
-            z = np.empty(0, dtype=np.int64)
+            # same gdt as the nonempty path: per-part index dtypes must
+            # not mix (advisor r3)
+            z = np.empty(0, dtype=gdt)
             return z, z.copy(), np.empty(0, dtype=M.data.dtype)
         return (
             np.concatenate(I_out),
@@ -198,8 +400,8 @@ def galerkin_cartesian(
             np.concatenate(V_out).astype(M.data.dtype, copy=False),
         )
 
-    def _local(ri, ci, M):
-        fast = _local_box(ri, ci, M)
+    def _local(ri, ci, M, a):
+        fast = _local_box(ri, ci, M, a)
         if fast is not None:
             return fast
         # P extended to all fine lids of A's cols; columns in global
@@ -217,7 +419,9 @@ def galerkin_cartesian(
         # on some parts, this fallback on others) must not happen
         return cg[T.row], cg[T.col], T.data.astype(M.data.dtype, copy=False)
 
-    coo = map_parts(_local, A.rows.partition, A.cols.partition, A.values)
+    coo = map_parts(
+        _local, A.rows.partition, A.cols.partition, A.values, accs
+    )
     # keep each part's gid dtype as produced (int32 from the fast path
     # flows copy-free through dedup/to_lids/compresscoo; forcing int64
     # here would silently undo that)

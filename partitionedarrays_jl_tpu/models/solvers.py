@@ -18,11 +18,28 @@ from typing import Optional, Tuple
 import numpy as np
 
 from ..ops.sparse import CSRMatrix, compresscoo
-from ..utils.helpers import check
+from ..utils.helpers import check, krylov_info, warn_tol_below_floor
 from ..parallel.backends import map_parts
 from ..parallel.prange import PRange
 from ..parallel.psparse import PSparseMatrix, psparse_global_triplets
 from ..parallel.pvector import PVector, _assign_full, _owned, _write_owned
+
+
+def _final_true_rel(A, x, b, rel_est, rs0_norm, tol, force=False):
+    """TRUE final relative residual for status classification: the
+    solver's own value when it already passes (converged runs pay no
+    extra work), else recomputed from b - A@x — recurrence estimates
+    (CG's rs, the Lanczos residual) drift below the true residual on
+    ill-conditioned problems and would misreport a genuine failure as a
+    benign floor-stall. ``force`` recomputes even on apparent success
+    (set when tol sits below the dtype floor, where the recurrence can
+    underflow past a test the true residual never meets)."""
+    if rel_est <= tol and not force:
+        return rel_est
+    r = b.copy()
+    q = A @ x
+    _owned_update(r, lambda rv, qv: rv - qv, q)
+    return float(r.norm()) / max(1.0, rs0_norm)
 
 
 def cg(
@@ -63,6 +80,7 @@ def cg(
 
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    floor_warned = warn_tol_below_floor(tol, b.dtype, name="cg")
 
     r = b.copy()  # rows-range residual
     q = A @ x
@@ -88,7 +106,14 @@ def cg(
         it += 1
         if verbose:
             print(f"cg it={it} residual={np.sqrt(rs):.3e}")
-    return x, {"iterations": it, "residuals": np.array(history), "converged": np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0))}
+    return x, krylov_info(
+        it, history, np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
+        tol, b.dtype, floor_warned,
+        final_rel=_final_true_rel(
+            A, x, b, np.sqrt(rs) / max(1.0, np.sqrt(rs0)), np.sqrt(rs0),
+            tol, force=floor_warned,
+        ),
+    )
 
 
 def gershgorin_bounds(A: PSparseMatrix) -> Tuple[float, float]:
@@ -435,6 +460,7 @@ def chebyshev_solve(
 
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     maxiter = maxiter if maxiter is not None else 10 * A.rows.ngids
+    floor_warned = warn_tol_below_floor(tol, b.dtype, name="chebyshev")
     theta = (lmax + lmin) / 2.0
     delta = (lmax - lmin) / 2.0
     sigma1 = theta / delta
@@ -463,11 +489,14 @@ def chebyshev_solve(
         it += 1
         if verbose:
             print(f"chebyshev it={it} residual={np.sqrt(rs):.3e}")
-    return x, {
-        "iterations": it,
-        "residuals": np.array(history),
-        "converged": np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
-    }
+    return x, krylov_info(
+        it, history, np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
+        tol, b.dtype, floor_warned,
+        final_rel=_final_true_rel(
+            A, x, b, np.sqrt(rs) / max(1.0, np.sqrt(rs0)), np.sqrt(rs0),
+            tol, force=floor_warned,
+        ),
+    )
 
 
 def _owned_update(dest: PVector, f, src: PVector):
@@ -1008,6 +1037,7 @@ def pcg(
 
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    floor_warned = warn_tol_below_floor(tol, b.dtype, name="pcg")
 
     r = b.copy()
     q = A @ x
@@ -1045,11 +1075,14 @@ def pcg(
         it += 1
         if verbose:
             print(f"pcg it={it} residual={np.sqrt(rs):.3e}")
-    return x, {
-        "iterations": it,
-        "residuals": np.array(history),
-        "converged": np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
-    }
+    return x, krylov_info(
+        it, history, np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
+        tol, b.dtype, floor_warned,
+        final_rel=_final_true_rel(
+            A, x, b, np.sqrt(rs) / max(1.0, np.sqrt(rs0)), np.sqrt(rs0),
+            tol, force=floor_warned,
+        ),
+    )
 
 
 def gmres(
@@ -1089,6 +1122,7 @@ def gmres(
 
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    floor_warned = warn_tol_below_floor(tol, b.dtype, name="gmres")
     m = restart
 
     def precond(v):
@@ -1174,11 +1208,10 @@ def gmres(
         r = residual_vec()
         beta = r.norm()
         converged = beta <= tol * max(1.0, rs0)
-    return x, {
-        "iterations": it,
-        "residuals": np.array(history),
-        "converged": bool(converged),
-    }
+    return x, krylov_info(
+        it, history, converged, tol, b.dtype, floor_warned,
+        final_rel=beta / max(1.0, rs0),
+    )
 
 
 def fgmres(
@@ -1210,6 +1243,7 @@ def fgmres(
 
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    floor_warned = warn_tol_below_floor(tol, b.dtype, name="fgmres")
     m = restart
 
     def precond(v):
@@ -1297,11 +1331,10 @@ def fgmres(
         r = residual_vec()
         beta = r.norm()
         converged = beta <= tol * max(1.0, rs0)
-    return x, {
-        "iterations": it,
-        "residuals": np.array(history),
-        "converged": bool(converged),
-    }
+    return x, krylov_info(
+        it, history, converged, tol, b.dtype, floor_warned,
+        final_rel=beta / max(1.0, rs0),
+    )
 
 
 def minres(
@@ -1327,6 +1360,7 @@ def minres(
 
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    floor_warned = warn_tol_below_floor(tol, b.dtype, name="minres")
 
     r = PVector.full(0.0, A.cols, dtype=b.dtype)
     q0 = A @ x
@@ -1335,7 +1369,9 @@ def minres(
     rs0 = beta
     history = [beta]
     if beta == 0.0:
-        return x, {"iterations": 0, "residuals": np.array(history), "converged": True}
+        return x, krylov_info(
+            0, history, True, tol, b.dtype, floor_warned, final_rel=0.0
+        )
 
     v = r / beta  # Lanczos vector v_1
     v_old = PVector.full(0.0, A.cols, dtype=b.dtype)
@@ -1398,11 +1434,12 @@ def minres(
             print(f"minres it={it} residual={res:.3e}")
         if beta_new == 0.0:  # invariant subspace: exact solve reached
             break
-    return x, {
-        "iterations": it,
-        "residuals": np.array(history),
-        "converged": res <= tol * max(1.0, rs0),
-    }
+    return x, krylov_info(
+        it, history, res <= tol * max(1.0, rs0), tol, b.dtype, floor_warned,
+        final_rel=_final_true_rel(
+            A, x, b, res / max(1.0, rs0), rs0, tol, force=floor_warned
+        ),
+    )
 
 
 def bicgstab(
@@ -1449,6 +1486,7 @@ def bicgstab(
 
     x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    floor_warned = warn_tol_below_floor(tol, b.dtype, name="bicgstab")
 
     r = b.copy()
     q = A @ x
@@ -1497,8 +1535,11 @@ def bicgstab(
         it += 1
         if verbose:
             print(f"bicgstab it={it} residual={np.sqrt(rs):.3e}")
-    return x, {
-        "iterations": it,
-        "residuals": np.array(history),
-        "converged": np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
-    }
+    return x, krylov_info(
+        it, history, np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
+        tol, b.dtype, floor_warned,
+        final_rel=_final_true_rel(
+            A, x, b, np.sqrt(rs) / max(1.0, np.sqrt(rs0)), np.sqrt(rs0),
+            tol, force=floor_warned,
+        ),
+    )

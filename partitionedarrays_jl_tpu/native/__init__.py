@@ -28,7 +28,14 @@ def _build() -> bool:
     # first imports (multi-process launches) must never dlopen a
     # half-written file
     tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    # -ffp-contract=off: the CSR SpMV's left-to-right accumulation claim
+    # (ops/sparse.py csr_spmv_impl) must hold bit-exactly on FMA-baseline
+    # targets too — contraction would make default-mode host bits differ
+    # between the native and NumPy fallback paths
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-ffp-contract=off",
+        "-shared", "-fPIC", _SRC, "-o", tmp,
+    ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
@@ -128,6 +135,15 @@ def _load() -> Optional[ctypes.CDLL]:
             fn.argtypes = [
                 i32p, i32p, fp, ctypes.c_int64, i64p, i64p, i64p, i64p,
                 i64p, i64p, i64p, ctypes.c_int32, f64p,
+            ]
+            fn.restype = ctypes.c_int64
+        for name, fp in (
+            ("pa_galerkin_emit_f64", f64p), ("pa_galerkin_emit_f32", f32p),
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                f64p, i64p, i64p, i64p, i64p, i64p, i64p,
+                ctypes.c_int64, ctypes.c_int32, i32p, i32p, fp,
             ]
             fn.restype = ctypes.c_int64
         _lib = lib
@@ -360,11 +376,64 @@ def galerkin3(
         dim,
         out,
     )
-    if rc == -1:
-        return None  # operator outside the 3^d closure: generic path
-    if rc != 0:
-        raise ValueError(f"galerkin3: internal bounds violation rc={rc}")
+    if rc < 0:
+        # -1: operator outside the 3^d closure. Other negative codes are
+        # unreachable with the current elo/ehi formulas, but any kernel
+        # decline must stay recoverable — the generic sparse-product
+        # fallback always covers it (advisor r3: a hard raise here turned
+        # a box-metadata inconsistency into a crash).
+        return None
     return out
+
+
+def galerkin_emit(
+    acc, cdims, elo, ehi, clo, chi, ghost_gids, dtype
+):
+    """Fused CSR emission from the galerkin3 accumulator (see
+    planning.cpp:galerkin_emit_dim): returns (indptr, cols, vals) over
+    the part's owned coarse box with LOCAL column lids (owned-box
+    C-order, then `ghost_gids` ranks offset by n_owned), column-sorted
+    rows, structural zeros dropped — or None when the native layer is
+    absent / dim > 3 / a nonzero column is missing from `ghost_gids`
+    (callers fall back to the COO assembly path)."""
+    lib = _load()
+    dim = len(cdims)
+    dt = np.dtype(dtype).name
+    if lib is None or dim > 3 or dt not in _FLOAT_FN:
+        return None
+    no = 1
+    for l, h in zip(clo, chi):
+        no *= int(h - l)
+    cap = no * 3**dim
+    if cap >= 2**31:
+        return None
+    indptr = np.empty(no + 1, dtype=np.int32)
+    cols = np.empty(cap, dtype=np.int32)
+    vals = np.empty(cap, dtype=dtype)
+    if no == 0:
+        indptr[:] = 0
+        return indptr, cols[:0], vals[:0]
+    gg = np.ascontiguousarray(ghost_gids, dtype=np.int64)
+    fn = getattr(lib, f"pa_galerkin_emit_{_FLOAT_FN[dt]}")
+    w = fn(
+        np.ascontiguousarray(acc, dtype=np.float64),
+        np.asarray(cdims, dtype=np.int64),
+        np.asarray(elo, dtype=np.int64),
+        np.asarray(ehi, dtype=np.int64),
+        np.asarray(clo, dtype=np.int64),
+        np.asarray(chi, dtype=np.int64),
+        gg,
+        len(gg),
+        dim,
+        indptr,
+        cols,
+        vals,
+    )
+    if w < 0:
+        return None
+    if w < (cap * 3) // 4:  # don't pin dead capacity
+        return indptr, cols[:w].copy(), vals[:w].copy()
+    return indptr, cols[:w], vals[:w]
 
 
 def unique_small(vals: np.ndarray, K: int):

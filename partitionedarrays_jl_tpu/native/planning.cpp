@@ -575,6 +575,142 @@ static int64_t galerkin3_impl(const int32_t* indptr, const int32_t* cols,
     return -1;  // unsupported dim: the Python wrapper guards dim <= 3
 }
 
+// Emit the owned-rows CSR of a collapsed coarse operator DIRECTLY from
+// the galerkin3 accumulator — the round-4 fusion that kills the COO
+// round trip (extract 3^d*n_c triplets -> migrate -> dedup -> add_gids
+// -> to_lids -> compresscoo) that dominated hierarchy setup at 1e8 DOFs
+// (SCALE_BENCH r3: 398 s, kernel itself ~8 s). The accumulator stores
+// A_c[c1, c1+de] at acc[e * esize + pos(c1)] (e = base-3 encoding of
+// de+1, most-significant dim first), so one pass over the OWNED coarse
+// box emits column-sorted CSR rows with LOCAL column ids:
+//   * owned columns first (owned-box C-order lids are monotone in gid:
+//     both orders are lexicographic in the coords), in ascending global
+//     gid-delta order of the 3^d offsets,
+//   * then ghost columns via binary search over the caller's SORTED
+//     geometric-shell gid table (lid = n_owned + rank — matching
+//     add_gids's append order for a sorted input list).
+// Structural zeros are dropped (same convention as the COO path's
+// nonzero() extraction). Returns nnz, or -1 when a nonzero entry's
+// column is missing from the ghost table (caller falls back).
+template <typename T, int DIM>
+static int64_t galerkin_emit_dim(const double* acc, const int64_t* cdims,
+                                 const int64_t* elo, const int64_t* ehi,
+                                 const int64_t* clo, const int64_t* chi,
+                                 const int64_t* ghost_gids, int64_t n_ghost,
+                                 int32_t* indptr, int32_t* cols, T* vals) {
+    int64_t ebox[DIM], obox[DIM], estride[DIM], ostride[DIM], cstride[DIM];
+    for (int d = 0; d < DIM; ++d) {
+        ebox[d] = ehi[d] - elo[d];
+        obox[d] = chi[d] - clo[d];
+    }
+    estride[DIM - 1] = ostride[DIM - 1] = cstride[DIM - 1] = 1;
+    for (int d = DIM - 2; d >= 0; --d) {
+        estride[d] = estride[d + 1] * ebox[d + 1];
+        ostride[d] = ostride[d + 1] * obox[d + 1];
+        cstride[d] = cstride[d + 1] * cdims[d + 1];
+    }
+    int64_t esize = 1, no = 1;
+    for (int d = 0; d < DIM; ++d) {
+        esize *= ebox[d];
+        no *= obox[d];
+    }
+    int ne = 1;
+    for (int d = 0; d < DIM; ++d) ne *= 3;
+    // offsets sorted by global gid delta (ties impossible: strides differ)
+    int64_t de[81][DIM];  // ne <= 27 for DIM <= 3; 81 headroom
+    int64_t gdelta[81];
+    int ord[81];
+    for (int e = 0; e < ne; ++e) {
+        int m = e;
+        for (int d = DIM - 1; d >= 0; --d) {
+            de[e][d] = m % 3 - 1;
+            m /= 3;
+        }
+        int64_t gd = 0;
+        for (int d = 0; d < DIM; ++d) gd += de[e][d] * cstride[d];
+        gdelta[e] = gd;
+        ord[e] = e;
+    }
+    std::sort(ord, ord + ne, [&](int a, int b) {
+        return gdelta[a] < gdelta[b];
+    });
+    int64_t w = 0;
+    indptr[0] = 0;
+    int64_t c1[DIM];
+    for (int d = 0; d < DIM; ++d) c1[d] = clo[d];
+    for (int64_t r = 0; r < no; ++r) {
+        // pos of c1 in the extended box (owned box is inside it)
+        int64_t pos1 = 0;
+        for (int d = 0; d < DIM; ++d) pos1 += (c1[d] - elo[d]) * estride[d];
+        // pass 1: owned columns (ascending gid => ascending owned lid)
+        for (int k = 0; k < ne; ++k) {
+            const int e = ord[k];
+            const double v = acc[(int64_t)e * esize + pos1];
+            if (v == 0.0) continue;
+            int64_t lid = 0;
+            bool owned = true, ingrid = true;
+            for (int d = 0; d < DIM; ++d) {
+                const int64_t c2 = c1[d] + de[e][d];
+                if (c2 < 0 || c2 >= cdims[d]) { ingrid = false; break; }
+                if (c2 < clo[d] || c2 >= chi[d]) { owned = false; break; }
+                lid += (c2 - clo[d]) * ostride[d];
+            }
+            if (!ingrid || !owned) continue;
+            cols[w] = (int32_t)lid;
+            vals[w++] = (T)v;
+        }
+        // pass 2: ghost columns (ascending gid => ascending table rank)
+        for (int k = 0; k < ne; ++k) {
+            const int e = ord[k];
+            const double v = acc[(int64_t)e * esize + pos1];
+            if (v == 0.0) continue;
+            int64_t gid2 = 0;
+            bool owned = true, ingrid = true;
+            for (int d = 0; d < DIM; ++d) {
+                const int64_t c2 = c1[d] + de[e][d];
+                if (c2 < 0 || c2 >= cdims[d]) { ingrid = false; break; }
+                if (c2 < clo[d] || c2 >= chi[d]) owned = false;
+                gid2 += c2 * cstride[d];
+            }
+            if (!ingrid || owned) continue;
+            const int64_t* p =
+                std::lower_bound(ghost_gids, ghost_gids + n_ghost, gid2);
+            if (p == ghost_gids + n_ghost || *p != gid2) return -1;
+            cols[w] = (int32_t)(no + (p - ghost_gids));
+            vals[w++] = (T)v;
+        }
+        indptr[r + 1] = (int32_t)w;
+        // advance c1 in C-order over the owned box
+        for (int d = DIM - 1; d >= 0; --d) {
+            if (++c1[d] < chi[d]) break;
+            c1[d] = clo[d];
+        }
+    }
+    return w;
+}
+
+template <typename T>
+static int64_t galerkin_emit_impl(const double* acc, const int64_t* cdims,
+                                  const int64_t* elo, const int64_t* ehi,
+                                  const int64_t* clo, const int64_t* chi,
+                                  const int64_t* ghost_gids, int64_t n_ghost,
+                                  int32_t dim, int32_t* indptr,
+                                  int32_t* cols, T* vals) {
+    if (dim == 3)
+        return galerkin_emit_dim<T, 3>(acc, cdims, elo, ehi, clo, chi,
+                                       ghost_gids, n_ghost, indptr, cols,
+                                       vals);
+    if (dim == 2)
+        return galerkin_emit_dim<T, 2>(acc, cdims, elo, ehi, clo, chi,
+                                       ghost_gids, n_ghost, indptr, cols,
+                                       vals);
+    if (dim == 1)
+        return galerkin_emit_dim<T, 1>(acc, cdims, elo, ehi, clo, chi,
+                                       ghost_gids, n_ghost, indptr, cols,
+                                       vals);
+    return -2;  // unsupported dim: the Python wrapper guards dim <= 3
+}
+
 // Diagonal of a CSR block: one pass, binary search per (column-sorted)
 // row — replaces a row_of_nz expansion + full-nnz compare + nonzero
 // triple pass.
@@ -619,6 +755,28 @@ int64_t pa_galerkin3_f32(const int32_t* indptr, const int32_t* cols,
                          const int64_t* ehi, int32_t dim, double* out) {
     return galerkin3_impl<float>(indptr, cols, vals, no, lid_gid, fdims,
                                  flo, fhi, cdims, elo, ehi, dim, out);
+}
+
+int64_t pa_galerkin_emit_f64(const double* acc, const int64_t* cdims,
+                             const int64_t* elo, const int64_t* ehi,
+                             const int64_t* clo, const int64_t* chi,
+                             const int64_t* ghost_gids, int64_t n_ghost,
+                             int32_t dim, int32_t* indptr, int32_t* cols,
+                             double* vals) {
+    return galerkin_emit_impl<double>(acc, cdims, elo, ehi, clo, chi,
+                                      ghost_gids, n_ghost, dim, indptr,
+                                      cols, vals);
+}
+
+int64_t pa_galerkin_emit_f32(const double* acc, const int64_t* cdims,
+                             const int64_t* elo, const int64_t* ehi,
+                             const int64_t* clo, const int64_t* chi,
+                             const int64_t* ghost_gids, int64_t n_ghost,
+                             int32_t dim, int32_t* indptr, int32_t* cols,
+                             float* vals) {
+    return galerkin_emit_impl<float>(acc, cdims, elo, ehi, clo, chi,
+                                     ghost_gids, n_ghost, dim, indptr,
+                                     cols, vals);
 }
 
 void pa_csr_spmv_f64(const int32_t* indptr, const int32_t* cols,

@@ -2257,7 +2257,10 @@ def tpu_chebyshev(
 ):
     """Compiled Chebyshev solve (see make_chebyshev_fn). The residual
     history is per-leg (one entry per 16 iterations), not per-iteration."""
+    from ..utils.helpers import warn_tol_below_floor
+
     backend = b.values.backend
+    floor_warned = warn_tol_below_floor(tol, b.dtype, name="chebyshev")
     dA = device_matrix(A, backend)
     if maxiter is None:
         maxiter = 10 * int(A.rows.ngids)
@@ -2279,12 +2282,17 @@ def tpu_chebyshev(
     if verbose:
         for i, r in enumerate(residuals[1:], start=1):
             print(f"chebyshev leg={i} (it={16 * i}) residual={r:.3e}")
-    return x, {
-        "iterations": it,
-        "residuals": residuals,
-        "residuals_every": 16,
-        "converged": bool(np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0))),
-    }
+    from ..utils.helpers import krylov_info
+
+    converged = bool(np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)))
+    return x, krylov_info(
+        it, residuals, converged, tol, b.dtype, floor_warned,
+        final_rel=_final_true_rel(
+            A, x, b, np.sqrt(rs) / max(1.0, np.sqrt(rs0)), np.sqrt(rs0),
+            tol, force=floor_warned,
+        ),
+        residuals_every=16,
+    )
 
 
 def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg"):
@@ -2293,7 +2301,10 @@ def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg"):
     host PVector. The info dict matches the host solvers' contract:
     `residuals` has iterations+1 entries (capped at the compiled history
     length)."""
+    from ..utils.helpers import krylov_info, warn_tol_below_floor
+
     backend = b.values.backend
+    floor_warned = warn_tol_below_floor(tol, b.dtype, name=name)
     dA = device_matrix(A, backend)
     x0 = x0 if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     db = _b_on_cols_layout(b, dA)
@@ -2309,11 +2320,20 @@ def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg"):
     if verbose:
         for i, r in enumerate(residuals[1:], start=1):
             print(f"{name} it={i} residual={r:.3e}")
-    return x, {
-        "iterations": it,
-        "residuals": residuals,
-        "converged": bool(np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0))),
-    }
+    converged = bool(np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)))
+    return x, krylov_info(
+        it, residuals, converged, tol, b.dtype, floor_warned,
+        final_rel=_final_true_rel(
+            A, x, b, np.sqrt(rs) / max(1.0, np.sqrt(rs0)), np.sqrt(rs0),
+            tol, force=floor_warned,
+        ),
+    )
+
+
+def _final_true_rel(A, x, b, rel_est, rs0_norm, tol, force=False):
+    from ..models.solvers import _final_true_rel as impl
+
+    return impl(A, x, b, rel_est, rs0_norm, tol, force=force)
 
 
 def tpu_cg(

@@ -74,6 +74,134 @@ def pairwise_sum(v):
     return v[0]
 
 
+#: the resolution floor multiplier for relative-residual tolerances: a
+#: Krylov residual estimate in dtype d cannot reliably resolve below
+#: ~TOL_FLOOR_EPS_MULTIPLE x eps(d) x problem scale (round-3 finding:
+#: an f32 FGMRES with tol=1e-8 oscillates at the floor with an accurate
+#: solution and converged=False — docs/roadmap.md §5, now implemented)
+TOL_FLOOR_EPS_MULTIPLE = 50.0
+
+
+def tolerance_floor(dtype) -> float:
+    """The smallest relative-residual tolerance `dtype` can resolve."""
+    import numpy as np
+
+    return TOL_FLOOR_EPS_MULTIPLE * float(np.finfo(np.dtype(dtype)).eps)
+
+
+def warn_tol_below_floor(tol: float, dtype, name: str = "solver") -> bool:
+    """Warn (RuntimeWarning) when a relative tolerance sits below the
+    dtype's resolution floor — the round-3 f32 footgun made
+    self-describing: the solver may then report converged=False with an
+    accurate solution because its residual estimate flatlines near
+    eps-scale. Returns whether the warning fired (recorded in info)."""
+    import warnings
+
+    import numpy as np
+
+    if not (tol > 0):  # tol=0 fixed-trip benchmark runs are deliberate
+        return False
+    dt = np.dtype(dtype)
+    if dt.kind != "f":
+        return False
+    floor = tolerance_floor(dt)
+    if tol >= floor:
+        return False
+    warnings.warn(
+        f"{name}: tol={tol:g} is below the {dt.name} resolution floor "
+        f"(~{TOL_FLOOR_EPS_MULTIPLE:g}x eps = {floor:g}). A relative "
+        "residual this small is generally unreachable in this dtype; the "
+        "run may stall at the dtype floor with converged=False despite an "
+        "accurate solution. Solve in float64 or loosen tol.",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+    return True
+
+
+def krylov_status(
+    residuals, converged: bool, tol: float, dtype, final_rel=None
+) -> str:
+    """Classify a finished Krylov run for the info dict:
+
+    * ``"converged"`` — the residual test passed.
+    * ``"stalled"`` — no convergence, but the TRUE relative residual sits
+      at the dtype resolution floor (tol is unreachable in this dtype —
+      the r3 f32 symptom: restart cycles oscillate, the within-cycle
+      Givens estimate keeps shrinking spuriously, the solution is
+      accurate), or the best residual stopped improving over the tail
+      of the history (a genuine stagnation above the floor).
+    * ``"diverged"`` — the final residual grew well past the initial one.
+    * ``"maxiter"`` — still improving when the iteration budget ran out.
+
+    ``final_rel`` is the final TRUE relative residual when the solver has
+    one (restarted methods recompute it at cycle boundaries; estimate
+    histories alone cannot witness a floor-stall because the estimate
+    dives below the true residual).
+    """
+    import numpy as np
+
+    if converged:
+        return "converged"
+    r = np.asarray(residuals, dtype=np.float64)
+    r = r[np.isfinite(r)]
+    if len(r) >= 2 and r[-1] > 10.0 * max(r[0], 1e-300):
+        return "diverged"
+    dt = np.dtype(dtype)
+    if (
+        final_rel is not None
+        and dt.kind == "f"
+        and tol < float(final_rel) <= 10.0 * tolerance_floor(dt)
+    ):
+        return "stalled"
+    if len(r) >= 8:
+        w = max(4, len(r) // 4)  # tail window: last quarter, >= 4 entries
+        best_before = float(np.min(r[:-w]))
+        best_tail = float(np.min(r[-w:]))
+        if best_tail > 0.9 * best_before:  # <10% improvement in the tail
+            return "stalled"
+    return "maxiter"
+
+
+def krylov_info(
+    it, history, converged, tol, dtype, floor_warned, final_rel=None, **extra
+):
+    """The ONE Krylov info-dict builder (host loops, compiled drivers,
+    early returns alike): iterations/residuals/converged plus the
+    `status` classification and the tolerance-floor flag when it fired.
+    ``final_rel`` must be a TRUE relative residual or None — recurrence
+    estimates (CG's rs, Lanczos) drift below the true residual on
+    ill-conditioned problems and would misclassify a genuine failure as
+    a floor-stall."""
+    import numpy as np
+
+    residuals = np.array(history)
+    converged = bool(converged)
+    if (
+        converged
+        and floor_warned
+        and final_rel is not None
+        and final_rel > tol
+    ):
+        # the RECURRENCE residual underflowed past a below-floor tol
+        # while the TRUE residual still sits above it (f32 CG's version
+        # of the footgun: rs keeps shrinking on paper after b - Ax has
+        # floored) — converged would be a lie here
+        converged = False
+    info = {
+        "iterations": int(it),
+        "residuals": residuals,
+        "converged": converged,
+        "status": krylov_status(
+            residuals, converged, tol, dtype, final_rel=final_rel
+        ),
+        **extra,
+    }
+    if floor_warned:
+        info["tol_below_dtype_floor"] = True
+    return info
+
+
 def check(condition, msg: str = "check failed") -> None:
     """Cheap contract assertion, strippable via PA_TPU_CHECKS=0.
 

@@ -850,3 +850,109 @@ def test_lobpcg_gmg_preconditioned_compiled():
         return True
 
     assert pa.prun(driver, pa.tpu, (2, 2))
+
+
+def test_tolerance_floor_warning_and_stall_status():
+    """VERDICT r3 directive 4: a float32 run with a tolerance below the
+    dtype resolution floor (~50x eps) must surface a RuntimeWarning at
+    solver entry, and the info dict must say "stalled" — the honest name
+    for restart cycles oscillating at the f32 floor with an accurate
+    solution — instead of a silent converged=False. A reachable
+    tolerance on the same operator reports "converged"."""
+
+    def _f32(A, b):
+        A.values = pa.map_parts(
+            lambda M: pa.CSRMatrix(
+                M.indptr, M.indices, M.data.astype(np.float32), M.shape
+            ),
+            A.values,
+        )
+        A.invalidate_blocks()
+        b.values = pa.map_parts(lambda v: np.asarray(v, np.float32), b.values)
+        return A, b
+
+    def driver(parts):
+        A, b, x_exact, _ = pa.assemble_poisson(parts, (12, 12, 12))
+        Ah, bh = pa.decouple_dirichlet(A, b)
+        Ah, bh = _f32(Ah, bh)
+        with pytest.warns(RuntimeWarning, match="resolution floor"):
+            x, info = pa.fgmres(Ah, bh, tol=1e-12, restart=10, maxiter=100)
+        assert not info["converged"]
+        assert info["status"] == "stalled", info
+        assert info.get("tol_below_dtype_floor") is True
+        # ... while the SOLUTION is accurate — the classic footgun shape
+        err = np.abs(gather_pvector(x) - gather_pvector(x_exact)).max()
+        assert err < 1e-4, err
+        x2, info2 = pa.fgmres(Ah, bh, tol=1e-4, restart=10, maxiter=200)
+        assert info2["converged"] and info2["status"] == "converged"
+        assert "tol_below_dtype_floor" not in info2
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2, 2))
+
+
+def test_tolerance_floor_compiled_fgmres_gmg():
+    """The compiled FGMRES+GMG path (the r3 probe's config, f32 this
+    time ON PURPOSE): entry warning fires and status distinguishes the
+    stall from a genuine non-convergence."""
+
+    def driver(parts):
+        ns = (16, 16, 16)
+        A, b, x_exact, _ = pa.assemble_poisson(parts, ns)
+        Ah, bh = pa.decouple_dirichlet(A, b)
+        Ah.values = pa.map_parts(
+            lambda M: pa.CSRMatrix(
+                M.indptr, M.indices, M.data.astype(np.float32), M.shape
+            ),
+            Ah.values,
+        )
+        Ah.invalidate_blocks()
+        bh.values = pa.map_parts(
+            lambda v: np.asarray(v, np.float32), bh.values
+        )
+        h = pa.gmg_hierarchy(parts, Ah, ns, coarse_threshold=100)
+        with pytest.warns(RuntimeWarning, match="resolution floor"):
+            xt, info = pa.tpu_fgmres_gmg(
+                h, bh, tol=1e-12, restart=12, maxiter=60
+            )
+        assert not info["converged"]
+        assert info["status"] == "stalled", info
+        err = np.abs(
+            pa.gather_pvector(xt) - pa.gather_pvector(x_exact)
+        ).max()
+        assert err < 1e-3, err
+        return True
+
+    assert pa.prun(driver, pa.tpu, (2, 2, 2))
+
+
+def test_recurrence_underflow_below_floor_reports_stalled():
+    """The CG-family version of the floor footgun: with tol below the
+    f32 floor the RECURRENCE residual can underflow past the test while
+    the true b - Ax residual floors above it. The info contract must
+    recompute the true residual in exactly this regime and report
+    stalled, not a converged=True lie."""
+
+    def driver(parts):
+        A, b, x_exact, _ = pa.assemble_poisson(parts, (10, 10, 10))
+        Ah, bh = pa.decouple_dirichlet(A, b)
+        Ah.values = pa.map_parts(
+            lambda M: pa.CSRMatrix(
+                M.indptr, M.indices, M.data.astype(np.float32), M.shape
+            ),
+            Ah.values,
+        )
+        Ah.invalidate_blocks()
+        bh.values = pa.map_parts(lambda v: np.asarray(v, np.float32), bh.values)
+        mv = pa.jacobi_preconditioner(Ah)
+        with pytest.warns(RuntimeWarning, match="resolution floor"):
+            x, info = pa.pcg(Ah, bh, minv=mv, tol=1e-12, maxiter=300)
+        assert not info["converged"]
+        assert info["status"] == "stalled", info
+        err = np.abs(gather_pvector(x) - gather_pvector(x_exact)).max()
+        assert err < 1e-3, err  # the solution itself is fine
+        x2, info2 = pa.pcg(Ah, bh, minv=mv, tol=1e-4, maxiter=300)
+        assert info2["converged"] and info2["status"] == "converged"
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2, 2))
