@@ -62,6 +62,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_SO)
         i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
         lib.pa_box_gids_to_lids.argtypes = [
             i64p, ctypes.c_int64, i64p, i64p, i64p, ctypes.c_int32, i32p,
         ]
@@ -100,7 +101,6 @@ def _load() -> Optional[ctypes.CDLL]:
             f64p, ctypes.c_int64, ctypes.c_int64, f64p,
         ]
         lib.pa_unique_small_f64.restype = ctypes.c_int64
-        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
         lib.pa_row_classes_f64.argtypes = [
             f64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, f64p, u8p,
@@ -144,6 +144,28 @@ def _load() -> Optional[ctypes.CDLL]:
             fn.argtypes = [
                 f64p, i64p, i64p, i64p, i64p, i64p, i64p,
                 ctypes.c_int64, ctypes.c_int32, i32p, i32p, fp,
+            ]
+            fn.restype = ctypes.c_int64
+        for name, fp in (
+            ("pa_stencil_emit_f64", f64p), ("pa_stencil_emit_f32", f32p),
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                i64p, i64p, i64p, ctypes.c_int32, ctypes.c_double, f64p,
+                i64p, ctypes.c_int64, ctypes.c_int32, i32p, i32p, fp,
+            ]
+            fn.restype = ctypes.c_int64
+        lib.pa_band_offsets.argtypes = [
+            i32p, i32p, ctypes.c_int64, ctypes.c_int64, i64p,
+        ]
+        lib.pa_band_offsets.restype = ctypes.c_int64
+        for name, fp in (
+            ("pa_dia_classify_f64", f64p), ("pa_dia_classify_f32", f32p),
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                i32p, i32p, fp, ctypes.c_int64, i64p, ctypes.c_int64,
+                ctypes.c_int64, f64p, u8p,
             ]
             fn.restype = ctypes.c_int64
         _lib = lib
@@ -434,6 +456,117 @@ def galerkin_emit(
     if w < (cap * 3) // 4:  # don't pin dead capacity
         return indptr, cols[:w].copy(), vals[:w].copy()
     return indptr, cols[:w], vals[:w]
+
+
+def stencil_emit(
+    dims, lo, hi, center, arm_vals, ghost_gids, dtype, decouple=False
+):
+    """Fused Dirichlet-identity Cartesian-stencil assembly straight to
+    column-sorted per-part CSR with local column ids (owned-box C-order,
+    then SORTED `ghost_gids` ranks offset by n_owned — add_gids's append
+    order for a sorted input). See planning.cpp:stencil_emit_dim.
+    ``decouple`` zeroes interior->boundary coupling VALUES in place
+    (pattern preserved), emitting the `decouple_dirichlet`'d operator
+    directly. Returns (indptr, cols, vals) or None when the native layer
+    is absent / dim > 3 / the int32 envelope is exceeded (callers fall
+    back to the COO assembly path)."""
+    lib = _load()
+    dim = len(dims)
+    dt = np.dtype(dtype).name
+    if lib is None or dim > 3 or dt not in _FLOAT_FN:
+        return None
+    no = 1
+    for l, h in zip(lo, hi):
+        no *= int(h - l)
+    cap = no * (2 * dim + 1)
+    if cap >= 2**31 or no + len(ghost_gids) >= 2**31:
+        return None
+    indptr = np.empty(no + 1, dtype=np.int32)
+    cols = np.empty(cap, dtype=np.int32)
+    vals = np.empty(cap, dtype=dtype)
+    if no == 0:
+        indptr[:] = 0
+        return indptr, cols[:0], vals[:0]
+    gg = np.ascontiguousarray(ghost_gids, dtype=np.int64)
+    fn = getattr(lib, f"pa_stencil_emit_{_FLOAT_FN[dt]}")
+    w = fn(
+        np.asarray(dims, dtype=np.int64),
+        np.asarray(lo, dtype=np.int64),
+        np.asarray(hi, dtype=np.int64),
+        dim,
+        float(center),
+        np.ascontiguousarray(arm_vals, dtype=np.float64),
+        gg,
+        len(gg),
+        1 if decouple else 0,
+        indptr,
+        cols,
+        vals,
+    )
+    if w < 0:
+        return None
+    if w < (cap * 3) // 4:  # boundary-heavy part: don't pin dead capacity
+        return indptr, cols[:w].copy(), vals[:w].copy()
+    return indptr, cols[:w], vals[:w]
+
+
+def band_offsets(indptr, cols, m: int, K: int):
+    """Sorted distinct band offsets (j - i) of a column-sorted CSR,
+    capped at K. Returns ``(offsets, ok)``: ok=False means MORE than K
+    distinct offsets exist (offsets=None, scan stopped early). Falls
+    back to the NumPy unique (full result, ok judged by length) when the
+    native layer is absent."""
+    lib = _load()
+    if lib is None or len(cols) >= 2**31:
+        ip = np.asarray(indptr)
+        r = np.repeat(
+            np.arange(m, dtype=np.int64), np.diff(ip[: m + 1])
+        )
+        u = np.unique(np.asarray(cols, dtype=np.int64) - r)
+        return (u, True) if len(u) <= K else (None, False)
+    out = np.empty(K, dtype=np.int64)
+    cnt = lib.pa_band_offsets(
+        np.ascontiguousarray(indptr, dtype=np.int32),
+        np.ascontiguousarray(cols, dtype=np.int32),
+        m,
+        K,
+        out,
+    )
+    if cnt < 0:
+        return None, False
+    return out[:cnt].copy(), True
+
+
+def dia_classify(indptr, cols, vals, m: int, offsets, K: int):
+    """Row classes (distinct per-row diagonal-value tuples, absent
+    diagonals 0) of a banded CSR in one fused pass — the dense-DIA-free
+    form of `dia_fill` + `row_classes` (planning.cpp:dia_classify_impl,
+    identical classes in identical first-touch order). Returns
+    ``(class_table, codes, ok)``; ok=False when the native layer is
+    absent, a (K+1)-th class appears, or an entry's offset is missing
+    from `offsets` — callers then run the dense-DIA path."""
+    lib = _load()
+    dt = np.dtype(np.asarray(vals).dtype).name
+    D = len(offsets)
+    if lib is None or dt not in _FLOAT_FN or D > 64 or len(cols) >= 2**31:
+        return None, None, False
+    table = np.empty((K, D), dtype=np.float64)
+    codes = np.empty(max(m, 1), dtype=np.uint8)
+    fn = getattr(lib, f"pa_dia_classify_{_FLOAT_FN[dt]}")
+    cnt = fn(
+        np.ascontiguousarray(indptr, dtype=np.int32),
+        np.ascontiguousarray(cols, dtype=np.int32),
+        np.ascontiguousarray(vals),
+        m,
+        np.ascontiguousarray(offsets, dtype=np.int64),
+        D,
+        K,
+        table,
+        codes,
+    )
+    if cnt < 0:
+        return None, None, False
+    return table[:cnt].copy(), codes[:m], True
 
 
 def unique_small(vals: np.ndarray, K: int):

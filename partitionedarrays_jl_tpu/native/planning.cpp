@@ -416,6 +416,108 @@ static int64_t dia_fill_impl(const int32_t* indptr, const int32_t* cols,
     return 0;
 }
 
+// Distinct band offsets (j - i) of a column-sorted CSR in one pass —
+// replaces the astype + row_of_nz repeat + np.unique sort over nnz
+// entries that dominated the band-detection phase of device lowering.
+// The tiny sorted table is probed from the previous hit first (rows of
+// a stencil operator visit offsets in the same ascending order, so
+// steady state is a sequential hit per entry); misses binary-search +
+// insert. Returns the count, or -1 as soon as a (K+1)-th distinct
+// offset appears.
+static int64_t band_offsets_impl(const int32_t* indptr, const int32_t* cols,
+                                 int64_t m, int64_t K, int64_t* out) {
+    int64_t cnt = 0;
+    for (int64_t i = 0; i < m; ++i) {
+        int64_t d = 0;
+        for (int32_t k = indptr[i]; k < indptr[i + 1]; ++k) {
+            const int64_t off = (int64_t)cols[k] - i;
+            if (d < cnt && out[d] == off) {
+                ++d;
+                continue;
+            }
+            int64_t lo = 0, hi = cnt;
+            while (lo < hi) {
+                const int64_t mid = (lo + hi) >> 1;
+                if (out[mid] < off) lo = mid + 1; else hi = mid;
+            }
+            if (lo < cnt && out[lo] == off) {
+                d = lo + 1;
+                continue;
+            }
+            if (cnt == K) return -1;
+            for (int64_t t = cnt; t > lo; --t) out[t] = out[t - 1];
+            out[lo] = off;
+            ++cnt;
+            d = lo + 1;
+        }
+    }
+    return cnt;
+}
+
+// Fused row-class detection for the coded-DIA lowering, WITHOUT the
+// dense (D, n) diagonal matrix: one pass over the CSR builds each row's
+// D-tuple of diagonal values (absent diagonals 0) in a stack buffer and
+// matches it against a first-touch class table — the same classes, in
+// the same first-touch order, as dia_fill + pa_row_classes_f64, minus
+// the O(D * n) materialization + refill traffic (5.6 GB at 1e8 DOFs).
+// Returns the class count; -1 when an entry's offset is missing from
+// `offsets` (caller's offset set must be the union it just computed);
+// -2 when a (K+1)-th class appears (caller falls back to the dense
+// path, which also serves the streaming-DIA staging).
+template <typename T>
+static int64_t dia_classify_impl(const int32_t* indptr, const int32_t* cols,
+                                 const T* vals, int64_t m,
+                                 const int64_t* offsets, int64_t D,
+                                 int64_t K, double* class_table,
+                                 uint8_t* codes) {
+    double row[64];  // D <= DIA_MAX_OFFSETS = 64
+    if (D > 64) return -1;
+    int64_t cnt = 0, last = 0;
+    auto match = [&](int64_t c) {
+        const double* t = &class_table[c * D];
+        for (int64_t q = 0; q < D; ++q)
+            if (t[q] != row[q]) return false;
+        return true;
+    };
+    for (int64_t i = 0; i < m; ++i) {
+        for (int64_t d = 0; d < D; ++d) row[d] = 0.0;
+        int64_t d = 0;
+        for (int32_t k = indptr[i]; k < indptr[i + 1]; ++k) {
+            const int64_t off = (int64_t)cols[k] - i;
+            if (!(d < D && offsets[d] == off)) {
+                d = 0;
+                while (d < D && offsets[d] < off) ++d;
+                if (d >= D || offsets[d] != off) return -1;
+            }
+            row[d] = (double)vals[k];
+            if (d + 1 < D) ++d;
+        }
+        // consecutive rows usually share a class (C-order runs), so the
+        // previous hit is probed first; the table scan only runs on
+        // class-change rows, keeping the pass O(n) even near the cap
+        int64_t hit = -1;
+        if (last < cnt && match(last)) {
+            hit = last;
+        } else {
+            for (int64_t c = 0; c < cnt; ++c) {
+                if (c != last && match(c)) {
+                    hit = c;
+                    break;
+                }
+            }
+        }
+        if (hit < 0) {
+            if (cnt == K) return -2;
+            for (int64_t q = 0; q < D; ++q)
+                class_table[cnt * D + q] = row[q];
+            hit = cnt++;
+        }
+        codes[i] = (uint8_t)hit;
+        last = hit;
+    }
+    return cnt;
+}
+
 // Per-part Galerkin triple product A_c = P^T A P for the d-linear
 // Cartesian interpolation (d <= 3), as a direct stencil collapse: for
 // every OWNED fine row i, for every stored entry A[i, j], scatter
@@ -711,6 +813,134 @@ static int64_t galerkin_emit_impl(const double* acc, const int64_t* cdims,
     return -2;  // unsupported dim: the Python wrapper guards dim <= 3
 }
 
+// Emit the owned-rows CSR of a Dirichlet-identity Cartesian stencil
+// operator DIRECTLY from box geometry — the round-4 fusion that removes
+// the whole COO pipeline from structured assembly (generate 2d+1
+// volume-sized triplet arrays -> add_gids -> to_lids -> compresscoo:
+// ~70% of the 276 s assembly_s at 1e8 DOFs, SCALE_BENCH r3). Rows are
+// the owned box in C-order; grid-boundary cells are identity rows;
+// interior cells carry `center` on the diagonal and arm_vals[2d + s]
+// on the -+1 neighbor in dim d. Columns are LOCAL ids: owned-box
+// C-order first, then `ghost_gids` (the caller's SORTED geometric face
+// slabs) at n_owned + rank — matching add_gids's append order for a
+// sorted input, exactly like galerkin_emit_dim. Rows come out
+// column-sorted by the same two-pass trick: owned columns in ascending
+// gid-delta order (box C-order lids are monotone in gid), then ghost
+// columns (sorted table ranks are monotone in gid).
+// `decouple` = 1 zeroes the VALUE of interior->boundary couplings
+// (pattern preserved), emitting the decouple_dirichlet'd operator in
+// place — for identity-row systems the decoupled RHS is then exactly
+// b^ = A^ @ x^, so the separate np.add.at classification passes never
+// run. Returns nnz, or -1 when an out-of-box neighbor is missing from
+// the ghost table (caller falls back to the COO path).
+template <typename T, int DIM>
+static int64_t stencil_emit_dim(const int64_t* dims, const int64_t* lo,
+                                const int64_t* hi, double center,
+                                const double* arm_vals,
+                                const int64_t* ghost_gids, int64_t n_ghost,
+                                int32_t decouple, int32_t* indptr,
+                                int32_t* cols, T* vals) {
+    int64_t gstride[DIM], bstride[DIM], box[DIM];
+    gstride[DIM - 1] = bstride[DIM - 1] = 1;
+    for (int d = 0; d < DIM; ++d) box[d] = hi[d] - lo[d];
+    for (int d = DIM - 2; d >= 0; --d) {
+        gstride[d] = gstride[d + 1] * dims[d + 1];
+        bstride[d] = bstride[d + 1] * box[d + 1];
+    }
+    int64_t no = 1;
+    for (int d = 0; d < DIM; ++d) no *= box[d];
+    // arms in ascending global gid-delta order:
+    // -s0, -s1, ..., -s_{DIM-1}, center, +s_{DIM-1}, ..., +s0
+    struct Arm {
+        int d;        // dimension of the offset (-1 = center)
+        int64_t off;  // -1 / +1 coordinate offset
+        int64_t ld;   // owned-box lid delta
+        double coef;
+    };
+    Arm arms[2 * DIM + 1];
+    for (int d = 0; d < DIM; ++d) {
+        arms[d] = {d, -1, -bstride[d], arm_vals[2 * d]};
+        arms[2 * DIM - d] = {d, +1, bstride[d], arm_vals[2 * d + 1]};
+    }
+    arms[DIM] = {-1, 0, 0, center};
+    int64_t w = 0;
+    indptr[0] = 0;
+    int64_t c[DIM];
+    for (int d = 0; d < DIM; ++d) c[d] = lo[d];
+    for (int64_t r = 0; r < no; ++r) {
+        bool bnd = false;
+        for (int d = 0; d < DIM; ++d)
+            bnd |= (c[d] == 0) | (c[d] == dims[d] - 1);
+        if (bnd) {  // Dirichlet identity row
+            cols[w] = (int32_t)r;
+            vals[w++] = (T)1.0;
+        } else {
+            // pass 1: in-box columns (ascending lid == ascending gid)
+            for (int k = 0; k < 2 * DIM + 1; ++k) {
+                const Arm& a = arms[k];
+                if (a.d < 0) {
+                    cols[w] = (int32_t)r;
+                    vals[w++] = (T)a.coef;
+                    continue;
+                }
+                const int64_t c2 = c[a.d] + a.off;
+                if (c2 < lo[a.d] || c2 >= hi[a.d]) continue;
+                // the neighbor differs from an interior cell only in dim
+                // a.d, so it is a boundary cell iff c2 hits that dim's edge
+                double v = a.coef;
+                if (decouple && (c2 == 0 || c2 == dims[a.d] - 1)) v = 0.0;
+                cols[w] = (int32_t)(r + a.ld);
+                vals[w++] = (T)v;
+            }
+            // pass 2: ghost columns (sorted-table ranks ascend with gid)
+            int64_t gid = 0;
+            for (int d = 0; d < DIM; ++d) gid += c[d] * gstride[d];
+            for (int k = 0; k < 2 * DIM + 1; ++k) {
+                const Arm& a = arms[k];
+                if (a.d < 0) continue;
+                const int64_t c2 = c[a.d] + a.off;
+                if (c2 >= lo[a.d] && c2 < hi[a.d]) continue;
+                const int64_t gid2 = gid + a.off * gstride[a.d];
+                const int64_t* p =
+                    std::lower_bound(ghost_gids, ghost_gids + n_ghost, gid2);
+                if (p == ghost_gids + n_ghost || *p != gid2) return -1;
+                double v = a.coef;
+                if (decouple && (c2 == 0 || c2 == dims[a.d] - 1)) v = 0.0;
+                cols[w] = (int32_t)(no + (p - ghost_gids));
+                vals[w++] = (T)v;
+            }
+        }
+        indptr[r + 1] = (int32_t)w;
+        for (int d = DIM - 1; d >= 0; --d) {  // advance c in C-order
+            if (++c[d] < hi[d]) break;
+            c[d] = lo[d];
+        }
+    }
+    return w;
+}
+
+template <typename T>
+static int64_t stencil_emit_impl(const int64_t* dims, const int64_t* lo,
+                                 const int64_t* hi, int32_t dim,
+                                 double center, const double* arm_vals,
+                                 const int64_t* ghost_gids, int64_t n_ghost,
+                                 int32_t decouple, int32_t* indptr,
+                                 int32_t* cols, T* vals) {
+    if (dim == 3)
+        return stencil_emit_dim<T, 3>(dims, lo, hi, center, arm_vals,
+                                      ghost_gids, n_ghost, decouple, indptr,
+                                      cols, vals);
+    if (dim == 2)
+        return stencil_emit_dim<T, 2>(dims, lo, hi, center, arm_vals,
+                                      ghost_gids, n_ghost, decouple, indptr,
+                                      cols, vals);
+    if (dim == 1)
+        return stencil_emit_dim<T, 1>(dims, lo, hi, center, arm_vals,
+                                      ghost_gids, n_ghost, decouple, indptr,
+                                      cols, vals);
+    return -2;  // unsupported dim: the Python wrapper guards dim <= 3
+}
+
 // Diagonal of a CSR block: one pass, binary search per (column-sorted)
 // row — replaces a row_of_nz expansion + full-nnz compare + nonzero
 // triple pass.
@@ -777,6 +1007,49 @@ int64_t pa_galerkin_emit_f32(const double* acc, const int64_t* cdims,
     return galerkin_emit_impl<float>(acc, cdims, elo, ehi, clo, chi,
                                      ghost_gids, n_ghost, dim, indptr,
                                      cols, vals);
+}
+
+int64_t pa_band_offsets(const int32_t* indptr, const int32_t* cols,
+                        int64_t m, int64_t K, int64_t* out) {
+    return band_offsets_impl(indptr, cols, m, K, out);
+}
+
+int64_t pa_dia_classify_f64(const int32_t* indptr, const int32_t* cols,
+                            const double* vals, int64_t m,
+                            const int64_t* offsets, int64_t D, int64_t K,
+                            double* class_table, uint8_t* codes) {
+    return dia_classify_impl<double>(indptr, cols, vals, m, offsets, D, K,
+                                     class_table, codes);
+}
+
+int64_t pa_dia_classify_f32(const int32_t* indptr, const int32_t* cols,
+                            const float* vals, int64_t m,
+                            const int64_t* offsets, int64_t D, int64_t K,
+                            double* class_table, uint8_t* codes) {
+    return dia_classify_impl<float>(indptr, cols, vals, m, offsets, D, K,
+                                    class_table, codes);
+}
+
+int64_t pa_stencil_emit_f64(const int64_t* dims, const int64_t* lo,
+                            const int64_t* hi, int32_t dim, double center,
+                            const double* arm_vals,
+                            const int64_t* ghost_gids, int64_t n_ghost,
+                            int32_t decouple, int32_t* indptr,
+                            int32_t* cols, double* vals) {
+    return stencil_emit_impl<double>(dims, lo, hi, dim, center, arm_vals,
+                                     ghost_gids, n_ghost, decouple, indptr,
+                                     cols, vals);
+}
+
+int64_t pa_stencil_emit_f32(const int64_t* dims, const int64_t* lo,
+                            const int64_t* hi, int32_t dim, double center,
+                            const double* arm_vals,
+                            const int64_t* ghost_gids, int64_t n_ghost,
+                            int32_t decouple, int32_t* indptr,
+                            int32_t* cols, float* vals) {
+    return stencil_emit_impl<float>(dims, lo, hi, dim, center, arm_vals,
+                                    ghost_gids, n_ghost, decouple, indptr,
+                                    cols, vals);
 }
 
 void pa_csr_spmv_f64(const int32_t* indptr, const int32_t* cols,

@@ -522,6 +522,16 @@ class DeviceMatrix:
     #: operators exceed it and take the streaming path instead.
     CODE_MAX_VALUES = 8
 
+    #: Row-class cap of the fused (dense-DIA-free) band analysis. The
+    #: kernel probes the previous row's class first (C-order runs), so
+    #: the cap bounds only the rare class-change scan; 64 covers the
+    #: decoupled-Dirichlet stencil family (3^d interior adjacency
+    #: variants + identity) with headroom. Operators with more distinct
+    #: row tuples fall back to the dense-diagonal detection path. Note
+    #: this is an ANALYSIS cap only — the row-class COMPRESSION mode
+    #: still requires <= CODE_MAX_VALUES classes, as before.
+    _CLS_CAP = 64
+
     def __init__(self, A: PSparseMatrix, backend: TPUBackend, padded=None):
         from ..ops.sparse import ELLMatrix
 
@@ -677,6 +687,28 @@ class DeviceMatrix:
             codes = np.zeros((P, n_streams, nlen), dtype=np.uint8)
             if cls_uniq is not None:
                 codes[:, 0, :no_max] = cls_ids
+            elif dia is None:
+                # fused analysis: per-diagonal codes via the tiny
+                # class->code map composed with the per-row class ids
+                # (identical values to the dense searchsorted below —
+                # dia[p, d, r] IS cls_tables[p][cls_codes[p, r], d]).
+                # Rows past a part's noids stay code 0; they are masked
+                # by dia_no in the kernel either way.
+                for p in range(P):
+                    n_o = oo[p].shape[0]
+                    for j, d in enumerate(coded):
+                        u = uniq[p][d]
+                        if len(u):
+                            m_ = np.clip(
+                                np.searchsorted(
+                                    u, det["cls_tables"][p][:, d]
+                                ),
+                                0,
+                                len(u) - 1,
+                            ).astype(np.uint8)
+                            codes[p, j, :n_o] = m_[
+                                det["cls_codes"][p, :n_o]
+                            ]
             else:
                 for p in range(P):
                     for j, d in enumerate(coded):
@@ -716,6 +748,25 @@ class DeviceMatrix:
             self.dia_codes = _stage(backend, codes, P)
         else:
             self.dia_mode = "stream"
+            if dia is None:
+                # fused analysis skipped the dense diagonals, but this
+                # branch (explicit padded=True with no padded plan) needs
+                # them as the staging source — rebuild here (review r4)
+                from .. import native as _native
+
+                off_arr = np.array(offsets)
+                dia = np.zeros((P, D, no_max))
+                for p in range(P):
+                    M = oo[p]
+                    if M.nnz and not _native.dia_fill(
+                        M.indptr, M.indices, M.data, M.shape[0], off_arr,
+                        dia[p],
+                    ):
+                        r = M.row_of_nz()
+                        d_ = np.searchsorted(
+                            off_arr, M.indices.astype(np.int64) - r
+                        )
+                        dia[p, d_, r] = M.data
             on_tpu = backend.devices()[0].platform == "tpu"
             self.pallas_plan = (
                 plan_dia_pallas(offsets, no_max, itemsize=np.dtype(dt).itemsize)
@@ -797,6 +848,84 @@ class DeviceMatrix:
         return None
 
     @classmethod
+    def _analyze_dia_classes(
+        cls, oo, P, noids, no_max, offsets, off_arr, itemsize
+    ):
+        """Dense-DIA-free coded-diagonal analysis (round-4): one fused
+        pass per part classifies rows by their diagonal-value tuple
+        (planning.cpp:dia_classify_impl — identical classes, identical
+        first-touch order as dia_fill + row_classes); the per-diagonal
+        codebooks, the coded set, and the row-class compression all
+        derive from the tiny class tables, so the (P, D, no_max) float64
+        diagonal matrix (5.6 GB at 1e8 DOFs) is never materialized.
+        Returns the det dict with ``det["dia"] = None``, or None when
+        the fused analysis doesn't apply (native off, > _CLS_CAP
+        classes, a diagonal over CODE_MAX_VALUES) — the caller then
+        runs the dense-diagonal path, which also serves streaming."""
+        from .. import native
+        from ..ops.pallas_dia import plan_dia_padded
+
+        D = len(offsets)
+        KMAX = cls.CODE_MAX_VALUES
+        tables = []
+        codes_all = np.zeros((P, no_max), dtype=np.uint8)
+        for p in range(P):
+            M = oo[p]
+            n_o = int(noids[p])
+            if M.nnz:
+                t, c, ok = native.dia_classify(
+                    M.indptr, M.indices, M.data, M.shape[0], off_arr,
+                    cls._CLS_CAP,
+                )
+                if not ok:
+                    return None
+                tables.append(t)
+                codes_all[p, :n_o] = c
+            else:
+                tables.append(np.zeros((1, D)))
+        uniq = [
+            [np.unique(tables[p][:, d]) for d in range(D)] for p in range(P)
+        ]
+        kk = tuple(
+            max((len(uniq[p][d]) for p in range(P)), default=1) or 1
+            for d in range(D)
+        )
+        if max(kk) > KMAX:
+            return None  # streaming staging needs the dense diagonals
+        code_row, coded = [], []
+        for d in range(D):
+            if kk[d] > 1:
+                code_row.append(len(coded))
+                coded.append(d)
+            else:
+                code_row.append(-1)
+        cls_uniq = cls_ids = None
+        if len(coded) >= 3 and all(len(t) <= KMAX for t in tables):
+            cls_uniq = tables
+            cls_ids = codes_all
+            n_class = max((len(t) for t in tables), default=1) or 1
+            kk = tuple(n_class if kk[d] > 1 else 1 for d in range(D))
+            code_row = [0 if c >= 0 else -1 for c in code_row]
+        n_streams = 1 if cls_uniq is not None else -(-len(coded) // 2)
+        return {
+            "offsets": offsets,
+            "dia": None,
+            "uniq": uniq,
+            "kk": kk,
+            "code_row": code_row,
+            "coded": coded,
+            "Dc": len(coded),
+            "coded_ok": True,
+            "cls_uniq": cls_uniq,
+            "cls_ids": cls_ids,
+            "cls_tables": tables,
+            "cls_codes": codes_all,
+            "pplan": plan_dia_padded(
+                offsets, no_max, n_streams, itemsize=itemsize
+            ),
+        }
+
+    @classmethod
     def _detect_dia(cls, A, oo, P, noids, no_max, itemsize):
         """Band structure analysis of the A_oo block, run *before* the
         layout choice (the padded frame is only worth it when the coded
@@ -814,9 +943,24 @@ class DeviceMatrix:
         decoding returns the exact stored values and the ascending-offset
         accumulation order is unchanged."""
         from ..ops.pallas_dia import plan_dia_padded
+        from .. import native
+
+        def _oids_eq(ri, ci):
+            # box partitions answer the square check from metadata — the
+            # volume-sized oid_to_gid materialization + compare was ~10%
+            # of the 1e8-DOF lowering profile
+            if (
+                hasattr(ri, "box_lo")
+                and hasattr(ci, "box_lo")
+                and ri.grid_shape == ci.grid_shape
+                and ri.box_lo == ci.box_lo
+                and ri.box_hi == ci.box_hi
+            ):
+                return True
+            return np.array_equal(ri.oid_to_gid, ci.oid_to_gid)
 
         square = all(
-            np.array_equal(ri.oid_to_gid, ci.oid_to_gid)
+            _oids_eq(ri, ci)
             for ri, ci in zip(
                 A.rows.partition.part_values(), A.cols.partition.part_values()
             )
@@ -827,14 +971,26 @@ class DeviceMatrix:
         for p in range(P):
             M = oo[p]
             if M.nnz:
-                offs.update(
-                    np.unique(M.indices.astype(np.int64) - M.row_of_nz()).tolist()
+                # fused one-pass scan (planning.cpp:band_offsets_impl) —
+                # the nnz-sized astype + row repeat + unique sort it
+                # replaces dominated band detection at 1e8 DOFs
+                u, ok = native.band_offsets(
+                    M.indptr, M.indices, M.shape[0], cls.DIA_MAX_OFFSETS
                 )
+                if not ok:
+                    return None
+                offs.update(u.tolist())
         if not (0 < len(offs) <= cls.DIA_MAX_OFFSETS):
             return None
         offsets = tuple(sorted(offs))
         D = len(offsets)
         off_arr = np.array(offsets)
+
+        fused = cls._analyze_dia_classes(
+            oo, P, noids, no_max, offsets, off_arr, itemsize
+        )
+        if fused is not None:
+            return fused
         # dense per-diagonal values on host: detection + staging source.
         # Entry (r, r+o) of part p goes to diagonal o; ascending offsets ==
         # ascending column order per row, so the accumulation order (and

@@ -404,3 +404,39 @@ def test_pipelined_cg_matches_standard():
     r0 = float(is_["residuals"][0])
     for rr in (rd0, rd1):
         assert float(rr) <= tol * max(1.0, r0) * 1.5, (float(rr), r0)
+
+
+def test_stream_staging_after_fused_analysis_padded():
+    """Regression (r4 review): an explicit padded=True lowering of a
+    banded operator whose offsets exceed the padded plan's reserve takes
+    the STREAMING staging branch; when the fused (dense-DIA-free) band
+    analysis supplied the det dict, the dense diagonals must be rebuilt
+    there — not staged from None as NaN."""
+    import jax
+
+    from partitionedarrays_jl_tpu.parallel.tpu import DeviceMatrix, TPUBackend
+
+    backend = TPUBackend(devices=jax.devices()[:1])
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (3, 300000))
+        dA = DeviceMatrix(A, backend, padded=True)
+        assert dA.dia_mode == "stream"
+        vals = np.asarray(dA.dia_vals)
+        assert not np.isnan(vals).any()
+        return True
+
+    pa.prun(driver, backend, (1, 1))
+
+
+def test_stencil_fast_declines_unsupported_dtype():
+    """Regression (r4 review): dtypes outside the native f32/f64
+    envelope must fall back to the generic COO path, not crash the
+    fused emitter's post-eligibility check."""
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8), dtype=np.float16)
+        assert A.dtype == np.float16
+        return True
+
+    pa.prun(driver, pa.sequential, (2, 1))
