@@ -293,18 +293,10 @@ def bench_cg_vs_cpu(n: int, backend, pa, dA) -> dict:
     # host leg: K iterations of the sequential backend's eager CG on an
     # identically-built operator (the TPU-backend A would dispatch to the
     # compiled path — the comparand must be the host execution model)
-    from partitionedarrays_jl_tpu.models import assemble_poisson
     from partitionedarrays_jl_tpu.parallel.sequential import SequentialBackend
 
     def host_driver(parts):
-        Ah, bh, _, x0h = assemble_poisson(parts, (n, n, n))
-        Ah.values = pa.map_parts(
-            lambda M: pa.CSRMatrix(
-                M.indptr, M.indices, (M.data / 16).astype(dtype), M.shape
-            ),
-            Ah.values,
-        )
-        Ah.invalidate_blocks()
+        Ah, _, _, _ = assemble_poisson_scaled(parts, (n, n, n), pa, dtype)
         bh = pa.PVector.full(np.float32(1.0), Ah.cols, dtype=dtype)
         x0h = pa.PVector.full(np.float32(0.0), Ah.cols, dtype=dtype)
         K = 25
@@ -364,6 +356,136 @@ def bench_cg_vs_cpu(n: int, backend, pa, dA) -> dict:
     return rec
 
 
+def bench_ici(n: int, devices, pa, fabric: str):
+    """Multi-device halo + CG legs with TRUE neighbor `ppermute`s
+    (round-4 directive 8): the day a real TPU slice is reachable these
+    numbers are one command away; until then the same code runs on the
+    virtual CPU mesh via `tools/bench_ici.py` with the records labeled
+    ``fabric='virtual-cpu'`` (kernel-correctness only — virtual-mesh
+    bandwidth says nothing about ICI wires). Reference anchor: the
+    multi-node exchange these legs will measure,
+    /root/reference/src/MPIBackend.jl:213-309."""
+    import statistics
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from partitionedarrays_jl_tpu.parallel.sequential import SequentialBackend
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        DeviceVector, TPUBackend, device_matrix, make_cg_fn,
+        make_exchange_fn, _stage,
+    )
+
+    shapes = {8: (2, 2, 2), 4: (2, 2, 1), 2: (2, 1, 1)}
+    P = max(k for k in shapes if k <= len(devices))
+    pshape = shapes[P]
+    backend = TPUBackend(devices=devices[:P])
+    dtype = np.float32
+
+    # --- halo leg: the compiled multi-shard exchange, loop-carried ----
+    seq = SequentialBackend()
+    rows = pa.prun(
+        lambda parts: pa.prange(parts, (n, n, n), pa.with_ghost),
+        seq, pshape,
+    )
+    exch = make_exchange_fn(rows, backend)
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        _padded_for, device_exchange_plan,
+    )
+
+    # the SAME layout the exchange program was compiled against — on a
+    # real TPU _padded_for selects the padded frame with different
+    # o0/g0/W (review r4: a device_layout(rows, False) input here would
+    # shape-mismatch the compiled chain on the ici fabric)
+    layout = device_exchange_plan(rows, _padded_for(backend)).layout
+    payload = sum(
+        i.num_hids for i in rows.partition.part_values()
+    ) * np.dtype(dtype).itemsize
+    x0 = np.ones((P, layout.W), dtype=dtype)
+    x = _stage(backend, x0, P)
+    o_last = layout.o0 + layout.no_max - 1
+
+    @partial(jax.jit, static_argnums=1)
+    def chain(xv, k):
+        def step(_, v):
+            v = exch(v)
+            # loop-carried feedback: owned values must evolve or XLA
+            # hoists the packs (docs/performance.md methodology)
+            return v.at[:, o_last].add(
+                v[:, layout.g0] * jnp.asarray(1e-30, v.dtype)
+            )
+
+        return jax.lax.fori_loop(0, k, step, xv).sum()
+
+    run_chain = lambda k: float(chain(x, k))
+    dt = marginal_chain_time(run_chain, 50, 650)
+    halo_rec = {
+        "metric": f"ici_halo_bytes_per_s_aggregate_{n}cube_{P}dev_f32",
+        "value": round(payload / dt, 1),
+        "unit": "B/s",
+        "vs_baseline": 0.0,
+        "fabric": fabric,
+        "devices": P,
+        "payload_bytes_per_exchange": payload,
+        "methodology": METHODOLOGY,
+    }
+
+    # --- CG leg: fixed-trip marginal per iteration over the mesh ------
+    def driver(parts):
+        A, b, xe, x0v = assemble_poisson_scaled(parts, (n, n, n), pa, dtype)
+        return A
+
+    A = pa.prun(driver, backend, pshape)
+    dA = device_matrix(A, backend)
+    b = pa.PVector.full(np.float32(1.0), dA.cols, dtype=dtype)
+    z = pa.PVector.full(np.float32(0.0), dA.cols, dtype=dtype)
+    db = DeviceVector.from_pvector(b, backend, dA.col_layout)
+    dz = DeviceVector.from_pvector(z, backend, dA.col_layout)
+
+    def run_k(k):
+        fn = make_cg_fn(dA, tol=0.0, maxiter=k)
+        fn(db.data, dz.data, None)
+
+        def once():
+            t0 = time.perf_counter()
+            out = fn(db.data, dz.data, None)
+            float(out[1])
+            return time.perf_counter() - t0
+
+        once()
+        return statistics.median(once() for _ in range(5))
+
+    t1, t2 = run_k(40), run_k(440)
+    cg_rec = {
+        "metric": f"ici_cg_s_per_iteration_{n}cube_{P}dev_f32",
+        "value": round(max((t2 - t1) / 400, 1e-9), 6),
+        "unit": "s/iteration",
+        "vs_baseline": 0.0,
+        "fabric": fabric,
+        "devices": P,
+        "methodology": METHODOLOGY,
+    }
+    return [halo_rec, cg_rec]
+
+
+def assemble_poisson_scaled(parts, ns, pa, dtype):
+    """The bench operator: 1/16-scaled Poisson in `dtype` (bounded under
+    repeated application), shared by the single-chip and ICI legs."""
+    from partitionedarrays_jl_tpu.models import assemble_poisson
+
+    A, b, xe, x0 = assemble_poisson(parts, ns)
+    A.values = pa.map_parts(
+        lambda M: pa.CSRMatrix(
+            M.indptr, M.indices, (M.data / 16).astype(dtype), M.shape
+        ),
+        A.values,
+    )
+    A.invalidate_blocks()
+    xe.values = pa.map_parts(lambda v: np.asarray(v, dtype=dtype), xe.values)
+    return A, b, xe, x0
+
+
 def spmv_chain(n: int, backend, pa):
     """Build the SHIPPED SpMV timing chain: the 1/16-scaled n^3 Poisson
     operator lowered to the device, a jitted k-step `fori_loop` of
@@ -374,7 +496,6 @@ def spmv_chain(n: int, backend, pa):
     import jax
     from functools import partial
 
-    from partitionedarrays_jl_tpu.models import assemble_poisson
     from partitionedarrays_jl_tpu.parallel.tpu import (
         DeviceVector, device_matrix, make_spmv_fn,
     )
@@ -382,19 +503,9 @@ def spmv_chain(n: int, backend, pa):
     dtype = np.float32
 
     def driver(parts):
-        A, b, x_exact, x0 = assemble_poisson(parts, (n, n, n))
-        # scale by 1/16 so the timing chain (repeated application) stays
+        # 1/16-scaled so the timing chain (repeated application) stays
         # bounded: the raw 7-point operator amplifies ~12x per step
-        A.values = pa.map_parts(
-            lambda M: pa.CSRMatrix(
-                M.indptr, M.indices, (M.data / 16).astype(dtype), M.shape
-            ),
-            A.values,
-        )
-        A.invalidate_blocks()
-        x_exact.values = pa.map_parts(
-            lambda v: np.asarray(v, dtype=dtype), x_exact.values
-        )
+        A, b, x_exact, x0 = assemble_poisson_scaled(parts, (n, n, n), pa, dtype)
         return A, x_exact
 
     A, x = pa.prun(driver, backend, (1, 1, 1))
@@ -476,6 +587,19 @@ def main():
         print(json.dumps(bench_cg_vs_cpu(n, backend, pa, dA)), flush=True)
     except Exception as e:
         print(f"cg-vs-cpu bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+
+    # ICI legs: only when MORE than one real device is reachable (the
+    # virtual-mesh form runs via tools/bench_ici.py) — true neighbor
+    # ppermutes, recorded per fabric so multi-chip day needs no new code
+    if len(jax.devices()) > 1:
+        try:
+            for r in bench_ici(
+                n, jax.devices(), pa,
+                "ici" if jax.devices()[0].platform == "tpu" else "virtual-cpu",
+            ):
+                print(json.dumps(r), flush=True)
+        except Exception as e:
+            print(f"ici bench failed: {type(e).__name__}: {e}", file=sys.stderr)
 
     rec = {
         "metric": f"spmv_gflops_per_chip_poisson3d_{n}cube_f32",

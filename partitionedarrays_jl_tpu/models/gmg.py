@@ -179,7 +179,7 @@ def _galerkin_fused(accs, ncs, coarse_rows: PRange) -> PSparseMatrix:
         cc = np.unravel_index(shell, ebox)
         I_out, J_out, V_out = [], [], []
         for e in range(3**dim):
-            v = out[e][shell]
+            v = out[shell, e]
             nz = np.nonzero(v)[0]
             if not len(nz):
                 continue
@@ -231,7 +231,7 @@ def _galerkin_fused(accs, ncs, coarse_rows: PRange) -> PSparseMatrix:
                     "galerkin shell triplet outside the 3^d closure",
                 )
                 e = e * 3 + (de_d + 1)
-            np.add.at(out, (e, pos), v)
+            np.add.at(out, (pos, e), v)
             return None
 
         map_parts(_scatter, coarse_rows.partition, accs, I2, J2, V2)
@@ -279,6 +279,119 @@ def _galerkin_fused(accs, ncs, coarse_rows: PRange) -> PSparseMatrix:
     return PSparseMatrix(values, coarse_rows, cols)
 
 
+#: Boundary-distance margin of the classed collapse: rows/coarse points
+#: further than this from every grid edge are treated as one zone. The
+#: induction bound for the d-linear Galerkin family is ~ceil(M/2)+3,
+#: whose fixed point is 6 — 8 adds safety without changing the rep
+#: count meaningfully.
+_CLASSED_MARGIN = 8
+
+
+def _classed_collapse(ri, ci, M, nfs, ncs, flo, fhi, elo, ehi):
+    """O(reps + volume-copy) Galerkin collapse for boundary-classed
+    operators (round-4 directive 1). Precondition, VERIFIED exactly per
+    part: every owned fine row's 3^d grid-offset value signature is a
+    function of its per-dim boundary-distance zones
+    (planning.cpp:galerkin_classify_dim + the rep-gather compare below).
+    Given that, the accumulator row at coarse point c is determined by
+    the per-dim tuple (distance to grid lo/hi capped at _CLASSED_MARGIN,
+    distance to the part's ext-box lo/hi capped at 2): all fine rows a
+    coarse point draws on (support [2c-2, 2c+2]) then sit in identical
+    zones with identical interpolation parity/clamp and identical
+    part-ownership partiality. So only one REPRESENTATIVE coarse row per
+    zone tuple is collapsed (planning.cpp row-subset mode, rows in
+    ascending order — bit-identical partial sums to the full pass) and
+    the rest of the accumulator is a broadcast gather. Returns the
+    (esize, 3^d) accumulator or None (caller runs the full collapse)."""
+    from .. import native
+
+    dim = len(nfs)
+    fbox = [fhi[d] - flo[d] for d in range(dim)]
+    no = int(np.prod(fbox))
+    ebox = [ehi[d] - elo[d] for d in range(dim)]
+    esize = int(np.prod(ebox))
+    if no < 4096 or esize == 0:
+        return None  # rep machinery wouldn't beat the direct pass
+    if not (
+        hasattr(ci, "gids_to_lids")
+        and ci.num_oids == ri.num_oids
+    ):
+        return None
+    Mf = _CLASSED_MARGIN
+
+    # --- 1) per-row grid-offset classes + zone-uniformity verification
+    nh = M.shape[1] - no
+    if nh:
+        gg = np.asarray(ci.lid_to_gid[no:], dtype=np.int64)
+        gcoords = np.unravel_index(gg, tuple(nfs))
+        ghost_rel = np.stack(
+            [c - l for c, l in zip(gcoords, flo)], axis=1
+        )
+    else:
+        ghost_rel = np.zeros((0, dim), dtype=np.int64)
+    table, codes, ok = native.galerkin_classify(
+        M.indptr, M.indices, M.data, no, fbox, ghost_rel, 64
+    )
+    if not ok:
+        return None
+
+    def _zone_reps(coords_lo, coords_hi, n_glob, part_margin_lo,
+                   part_margin_hi):
+        """Per-coordinate zone ids over [coords_lo, coords_hi) plus the
+        first coordinate of each distinct zone: (rep_index_per_coord,
+        rep_coords). Zones: global-edge distances capped at Mf, part
+        (box) distances capped at the given margins."""
+        x = np.arange(coords_lo, coords_hi, dtype=np.int64)
+        z = (
+            np.minimum(x, Mf) * (4 * (Mf + 1) * 4)
+            + np.minimum(n_glob - 1 - x, Mf) * 16
+            + np.minimum(x - coords_lo, part_margin_lo) * 4
+            + np.minimum(coords_hi - 1 - x, part_margin_hi)
+        )
+        _, first, inv = np.unique(z, return_index=True, return_inverse=True)
+        return first[inv], x[np.sort(first)], first
+
+    # fine zone maps (values depend on global distance only)
+    fmaps = []
+    for d in range(dim):
+        rep_idx_of, _, _ = _zone_reps(flo[d], fhi[d], nfs[d], 0, 0)
+        fmaps.append(rep_idx_of)
+    C = codes.reshape(fbox)
+    if not np.array_equal(C, C[np.ix_(*fmaps)]):
+        return None  # not boundary-classed (e.g. variable coefficients)
+
+    # --- 2) coarse reps (global margins + part-partiality margins)
+    cmaps, creps = [], []
+    for d in range(dim):
+        rep_idx_of, reps, _ = _zone_reps(elo[d], ehi[d], ncs[d], 2, 2)
+        cmaps.append(rep_idx_of)
+        creps.append(reps)
+    n_rep = int(np.prod([len(r) for r in creps]))
+    if n_rep * 4 > esize:
+        return None  # too few repeated rows to pay for the gather
+
+    # --- 3) collapse the rep support only, then expand
+    sups = []
+    for d in range(dim):
+        f = np.unique(
+            np.concatenate([2 * creps[d] - 1, 2 * creps[d], 2 * creps[d] + 1])
+        )
+        sups.append(f[(f >= flo[d]) & (f < fhi[d])])
+    acc = native.galerkin3(
+        M.indptr, M.indices, M.data, no,
+        np.asarray(ci.lid_to_gid, dtype=np.int64),
+        nfs, flo, fhi, ncs, elo, ehi, sub_coords=sups,
+    )
+    if acc is None:
+        return None
+    ne = 3**dim
+    A_full = acc.reshape(tuple(ebox) + (ne,))
+    # cmaps[d] already holds, per coarse coordinate, the ext-box
+    # POSITION of its zone's representative (first occurrence)
+    out = np.ascontiguousarray(A_full[np.ix_(*cmaps)])
+    return out.reshape(esize, ne)
+
+
 def galerkin_cartesian(
     A: PSparseMatrix,
     nfs: Sequence[int],
@@ -318,17 +431,26 @@ def galerkin_cartesian(
         """Native stencil-collapse accumulator (planning.cpp:
         galerkin3_impl) over the part's extended coarse box, or None
         when the part lacks box metadata / the operator leaves the 3^d
-        closure (periodic wrap, wide stencils)."""
+        closure (periodic wrap, wide stencils). Boundary-classed
+        operators (verified per part) take the O(reps) classed collapse
+        (`_classed_collapse`, PA_TPU_GMG_CLASSED=0 disables); its
+        accumulator is bit-identical to the full pass."""
+        import os
+
         if not (hasattr(ri, "box_lo") and ri.grid_shape == nfs):
             return None
         flo, fhi = ri.box_lo, ri.box_hi
         elo = [max(0, (flo[d] - 1) // 2) for d in range(dim)]
         ehi = [min(ncs[d], fhi[d] // 2 + 1) for d in range(dim)]
-        out = native.galerkin3(
-            M.indptr, M.indices, M.data, ri.num_oids,
-            np.asarray(ci.lid_to_gid, dtype=np.int64),
-            nfs, flo, fhi, ncs, elo, ehi,
-        )
+        out = None
+        if os.environ.get("PA_TPU_GMG_CLASSED", "1") != "0":
+            out = _classed_collapse(ri, ci, M, nfs, ncs, flo, fhi, elo, ehi)
+        if out is None:
+            out = native.galerkin3(
+                M.indptr, M.indices, M.data, ri.num_oids,
+                np.asarray(ci.lid_to_gid, dtype=np.int64),
+                nfs, flo, fhi, ncs, elo, ehi,
+            )
         if out is None:
             return None
         return out, tuple(elo), tuple(ehi), M.data.dtype
@@ -376,7 +498,7 @@ def galerkin_cartesian(
         gdt = np.int32 if int(np.prod(ncs)) < 2**31 else np.int64
         I_out, J_out, V_out = [], [], []
         for e in range(3**dim):
-            v = out[e]
+            v = out[:, e]
             nz = np.nonzero(v)[0]
             if not len(nz):
                 continue

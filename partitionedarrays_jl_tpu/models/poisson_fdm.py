@@ -112,16 +112,16 @@ def _boundary_mask_on_iset(iset, ns) -> np.ndarray:
     return np.concatenate([owned, gm])
 
 
-def _stencil_ghost_slabs(iset, ns) -> np.ndarray:
+def stencil_ghost_slabs(lo, hi, ns) -> np.ndarray:
     """SORTED gids of the column ghost layer a Dirichlet-identity +-1
-    stencil touches from an owned box: per dimension d, the face slab one
-    cell outside the box, restricted to coordinates where the adjacent
-    OWNED cell is grid-interior (boundary rows are identity — they reach
-    nobody). Slabs of different dims are disjoint by construction (each
-    lies outside the box in exactly its own dimension), so a plain sort
-    of the concatenation is the unique sorted ghost set."""
+    stencil touches from an owned box [lo, hi): per dimension d, the
+    face slab one cell outside the box, restricted to coordinates where
+    the adjacent OWNED cell is grid-interior (boundary rows are identity
+    — they reach nobody). Slabs of different dims are disjoint by
+    construction (each lies outside the box in exactly its own
+    dimension), so a plain sort of the concatenation is the unique
+    sorted ghost set."""
     dim = len(ns)
-    lo, hi = iset.box_lo, iset.box_hi
     inter = [(max(l, 1), min(h, n - 1)) for l, h, n in zip(lo, hi, ns)]
     slabs = []
     for d in range(dim):
@@ -176,7 +176,9 @@ def _try_stencil_fast(rows, ns, center, arm_coefs, dtype, decoupled):
     flags = gather_all(map_parts(_ok, rows.partition))
     if not bool(np.all(np.asarray(flags.part_values()[0]))):
         return None
-    ghosts = map_parts(lambda i: _stencil_ghost_slabs(i, ns), rows.partition)
+    ghosts = map_parts(
+        lambda i: stencil_ghost_slabs(i.box_lo, i.box_hi, ns), rows.partition
+    )
     cols = add_gids(rows, ghosts)
     arm_vals = np.array(
         [c for pair in arm_coefs for c in pair], dtype=np.float64

@@ -138,6 +138,25 @@ def _load() -> Optional[ctypes.CDLL]:
             ]
             fn.restype = ctypes.c_int64
         for name, fp in (
+            ("pa_galerkin3_sub_f64", f64p), ("pa_galerkin3_sub_f32", f32p),
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                i32p, i32p, fp, ctypes.c_int64, i64p, i64p, i64p, i64p,
+                i64p, i64p, i64p, ctypes.c_int32, f64p, i64p, i64p,
+            ]
+            fn.restype = ctypes.c_int64
+        for name, fp in (
+            ("pa_galerkin_classify_f64", f64p),
+            ("pa_galerkin_classify_f32", f32p),
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                i32p, i32p, fp, ctypes.c_int64, i64p, i64p,
+                ctypes.c_int32, ctypes.c_int64, f64p, u8p,
+            ]
+            fn.restype = ctypes.c_int64
+        for name, fp in (
             ("pa_galerkin_emit_f64", f64p), ("pa_galerkin_emit_f32", f32p),
         ):
             fn = getattr(lib, name)
@@ -366,13 +385,21 @@ def csr_diag(indptr, cols, vals, m: int):
 
 
 def galerkin3(
-    indptr, cols, vals, no: int, lid_gid, fdims, flo, fhi, cdims, elo, ehi
+    indptr, cols, vals, no: int, lid_gid, fdims, flo, fhi, cdims, elo, ehi,
+    sub_coords=None,
 ):
     """Per-part Galerkin stencil collapse A_c = P^T A P over an owned
-    fine box (d-linear P, d <= 3): returns the (3^dim, prod(ehi-elo))
-    float64 diagonal accumulator, or None when native is absent, dim > 3,
-    or some fine entry's coordinate offset leaves the +-1 cube (the
-    caller falls back to the generic sparse product)."""
+    fine box (d-linear P, d <= 3): returns the POS-MAJOR
+    (prod(ehi-elo), 3^dim) float64 diagonal accumulator, or None when
+    native is absent, dim > 3, or some fine entry's coordinate offset
+    leaves the +-1 cube (the caller falls back to the generic sparse
+    product).
+
+    ``sub_coords`` (per-dim sequences of GLOBAL fine coordinates, each
+    sorted, within [flo, fhi)) restricts the collapse to the product of
+    those fine rows — the rep-support mode of the classed collapse:
+    accumulator rows fully supported by the subset are exact, all others
+    are partial garbage the caller overwrites by expansion."""
     lib = _load()
     dim = len(fdims)
     if lib is None or dim > 3 or len(cols) >= 2**31:
@@ -381,9 +408,8 @@ def galerkin3(
     if dt not in _FLOAT_FN:
         return None
     ebox = [int(h - l) for l, h in zip(elo, ehi)]
-    out = np.zeros((3**dim, int(np.prod(ebox))), dtype=np.float64)
-    fn = getattr(lib, f"pa_galerkin3_{_FLOAT_FN[dt]}")
-    rc = fn(
+    out = np.zeros((int(np.prod(ebox)), 3**dim), dtype=np.float64)
+    args = [
         np.ascontiguousarray(indptr, dtype=np.int32),
         np.ascontiguousarray(cols, dtype=np.int32),
         np.ascontiguousarray(vals),
@@ -397,7 +423,19 @@ def galerkin3(
         np.asarray(ehi, dtype=np.int64),
         dim,
         out,
-    )
+    ]
+    if sub_coords is None:
+        fn = getattr(lib, f"pa_galerkin3_{_FLOAT_FN[dt]}")
+        rc = fn(*args)
+    else:
+        counts = np.array([len(c) for c in sub_coords], dtype=np.int64)
+        flat = (
+            np.concatenate([np.asarray(c, dtype=np.int64) for c in sub_coords])
+            if counts.sum()
+            else np.zeros(1, dtype=np.int64)
+        )
+        fn = getattr(lib, f"pa_galerkin3_sub_{_FLOAT_FN[dt]}")
+        rc = fn(*args, np.ascontiguousarray(flat), counts)
     if rc < 0:
         # -1: operator outside the 3^d closure. Other negative codes are
         # unreachable with the current elo/ehi formulas, but any kernel
@@ -406,6 +444,45 @@ def galerkin3(
         # a box-metadata inconsistency into a crash).
         return None
     return out
+
+
+def galerkin_classify(indptr, cols, vals, no: int, fbox, ghost_rel, K: int):
+    """Row classes of a part's fine operator keyed by its 3^d GRID-OFFSET
+    value signature (planning.cpp:galerkin_classify_dim) — the
+    precondition check of the classed Galerkin collapse. ``ghost_rel``
+    is the (nh, d) int64 table of ghost-lid coordinates relative to the
+    part's box lo. Returns ``(table, codes, ok)``; ok=False when native
+    is absent, dim > 3, an offset leaves the +-1 cube, or a (K+1)-th
+    class appears — callers then run the unclassed collapse."""
+    lib = _load()
+    dim = len(fbox)
+    dt = np.dtype(np.asarray(vals).dtype).name
+    if lib is None or dim > 3 or dt not in _FLOAT_FN or len(cols) >= 2**31:
+        return None, None, False
+    ne = 3**dim
+    table = np.empty((K, ne), dtype=np.float64)
+    codes = np.empty(max(no, 1), dtype=np.uint8)
+    gr = np.ascontiguousarray(
+        np.asarray(ghost_rel, dtype=np.int64).reshape(-1, dim)
+    )
+    if not len(gr):
+        gr = np.zeros((1, dim), dtype=np.int64)
+    fn = getattr(lib, f"pa_galerkin_classify_{_FLOAT_FN[dt]}")
+    cnt = fn(
+        np.ascontiguousarray(indptr, dtype=np.int32),
+        np.ascontiguousarray(cols, dtype=np.int32),
+        np.ascontiguousarray(vals),
+        no,
+        np.asarray(fbox, dtype=np.int64),
+        gr,
+        dim,
+        K,
+        table,
+        codes,
+    )
+    if cnt < 0:
+        return None, None, False
+    return table[:cnt].copy(), codes[:no], True
 
 
 def galerkin_emit(

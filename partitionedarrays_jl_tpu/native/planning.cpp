@@ -532,6 +532,11 @@ static int64_t dia_classify_impl(const int32_t* indptr, const int32_t* cols,
 // happen by construction (ext box sized by the caller); entries whose
 // fine column offset leaves the +-1 cube return -1 (caller falls back
 // to the generic sparse product).
+// The accumulator is POS-MAJOR — out[pos * 3^d + e] — so each fine
+// row's scatters land in <= 8 contiguous 3^d-double blocks and the
+// downstream emission reads each coarse row's diagonals as one
+// contiguous block (the e-major layout made both a 27-plane strided
+// scatter/gather, ~3x slower end-to-end at 1e8 DOFs).
 // DIM is a compile-time parameter so the per-entry loops fully unroll
 // (the runtime-dim version measured ~8.6 ns per weight pair; the
 // specialized one removes the dim>k ternaries and bounds the loops).
@@ -541,8 +546,10 @@ static int64_t galerkin3_dim(const int32_t* indptr, const int32_t* cols,
                              const int64_t* lid_gid, const int64_t* fdims,
                              const int64_t* flo, const int64_t* fhi,
                              const int64_t* cdims, const int64_t* elo,
-                             const int64_t* ehi, double* out) {
-    int64_t fstride[DIM], estride[DIM], ebox[DIM], fbox[DIM];
+                             const int64_t* ehi, double* out,
+                             const int64_t* sub_coords = nullptr,
+                             const int64_t* sub_counts = nullptr) {
+    int64_t fstride[DIM], estride[DIM], ebox[DIM], fbox[DIM], bstride[DIM];
     for (int d = 0; d < DIM; ++d) ebox[d] = ehi[d] - elo[d];
     fstride[DIM - 1] = 1;
     estride[DIM - 1] = 1;
@@ -552,7 +559,11 @@ static int64_t galerkin3_dim(const int32_t* indptr, const int32_t* cols,
     }
     int64_t esize = 1;
     for (int d = 0; d < DIM; ++d) esize *= ebox[d];
+    (void)esize;
     for (int d = 0; d < DIM; ++d) fbox[d] = fhi[d] - flo[d];
+    bstride[DIM - 1] = 1;
+    for (int d = DIM - 2; d >= 0; --d)
+        bstride[d] = bstride[d + 1] * fbox[d + 1];
     auto interp1 = [&](int64_t f, int64_t nc, int64_t* k, double* w) {
         if ((f & 1) == 0) {
             k[0] = f >> 1;
@@ -572,11 +583,41 @@ static int64_t galerkin3_dim(const int32_t* indptr, const int32_t* cols,
     int64_t rpos[1 << DIM];
     int64_t rc[1 << DIM][DIM];
     double rw[1 << DIM];
-    for (int64_t r = 0; r < no; ++r) {
-        int64_t fc[DIM], rem = r;
-        for (int d = DIM - 1; d >= 0; --d) {
-            fc[d] = flo[d] + rem % fbox[d];
-            rem /= fbox[d];
+    // row iteration: all owned rows (sub_counts null), or the product
+    // of per-dim fine-coordinate lists (the rep-support subset of the
+    // classed collapse — see pa_galerkin3_sub)
+    const int64_t* sub_list[DIM > 0 ? DIM : 1];
+    int64_t sub_idx[DIM] = {0};
+    int64_t n_sub = 0;
+    if (sub_counts) {
+        const int64_t* p = sub_coords;
+        n_sub = 1;
+        for (int d = 0; d < DIM; ++d) {
+            sub_list[d] = p;
+            p += sub_counts[d];
+            n_sub *= sub_counts[d];
+        }
+        if (n_sub == 0) return 0;
+    }
+    const int64_t n_iter = sub_counts ? n_sub : no;
+    for (int64_t it = 0; it < n_iter; ++it) {
+        int64_t fc[DIM], r;
+        if (sub_counts) {
+            r = 0;
+            for (int d = 0; d < DIM; ++d) {
+                fc[d] = sub_list[d][sub_idx[d]];
+                r += (fc[d] - flo[d]) * bstride[d];
+            }
+            int d = DIM - 1;
+            while (d >= 0 && ++sub_idx[d] >= sub_counts[d])
+                sub_idx[d--] = 0;
+        } else {
+            r = it;
+            int64_t rem = r;
+            for (int d = DIM - 1; d >= 0; --d) {
+                fc[d] = flo[d] + rem % fbox[d];
+                rem /= fbox[d];
+            }
         }
         int64_t ki[DIM][2];
         double wi[DIM][2];
@@ -639,9 +680,10 @@ static int64_t galerkin3_dim(const int32_t* indptr, const int32_t* cols,
                 while (d >= 0 && ++jdx[d] >= nj[d]) jdx[d--] = 0;
                 if (d < 0) break;
             }
+            constexpr int64_t NE = DIM == 1 ? 3 : (DIM == 2 ? 9 : 27);
             for (int i1 = 0; i1 < nr; ++i1) {
                 const double w1 = rw[i1];
-                double* base = out;  // out[e * esize + rpos]
+                double* base = out + rpos[i1] * NE;  // pos-major block
                 for (int i2 = 0; i2 < nc2; ++i2) {
                     int64_t e = 0;
                     for (int d = 0; d < DIM; ++d) {
@@ -649,7 +691,7 @@ static int64_t galerkin3_dim(const int32_t* indptr, const int32_t* cols,
                         if (de < -1 || de > 1) return -3;
                         e = e * 3 + (de + 1);
                     }
-                    base[e * esize + rpos[i1]] += w1 * w2s[i2];
+                    base[e] += w1 * w2s[i2];
                 }
             }
         }
@@ -664,17 +706,123 @@ static int64_t galerkin3_impl(const int32_t* indptr, const int32_t* cols,
                               const int64_t* flo, const int64_t* fhi,
                               const int64_t* cdims, const int64_t* elo,
                               const int64_t* ehi, int32_t dim,
-                              double* out) {
+                              double* out,
+                              const int64_t* sub_coords = nullptr,
+                              const int64_t* sub_counts = nullptr) {
     if (dim == 3)
         return galerkin3_dim<T, 3>(indptr, cols, vals, no, lid_gid, fdims,
-                                   flo, fhi, cdims, elo, ehi, out);
+                                   flo, fhi, cdims, elo, ehi, out,
+                                   sub_coords, sub_counts);
     if (dim == 2)
         return galerkin3_dim<T, 2>(indptr, cols, vals, no, lid_gid, fdims,
-                                   flo, fhi, cdims, elo, ehi, out);
+                                   flo, fhi, cdims, elo, ehi, out,
+                                   sub_coords, sub_counts);
     if (dim == 1)
         return galerkin3_dim<T, 1>(indptr, cols, vals, no, lid_gid, fdims,
-                                   flo, fhi, cdims, elo, ehi, out);
+                                   flo, fhi, cdims, elo, ehi, out,
+                                   sub_coords, sub_counts);
     return -1;  // unsupported dim: the Python wrapper guards dim <= 3
+}
+
+// Row classes of a part's fine operator keyed by its GRID-OFFSET value
+// signature: per owned row, the 3^d-tuple of stored values by coarse...
+// fine coordinate offset (absent offsets 0), matched against a
+// first-touch class table — the precondition check of the classed
+// Galerkin collapse (models/gmg.py). Unlike dia_classify (lid offsets),
+// the grid-offset signature is translation-invariant across part
+// boundaries: rows whose -x neighbor is a ghost lid get the same
+// signature as interior rows with equal values. Column coords: owned
+// lids decode arithmetically from the box; ghost lids read the caller's
+// (nh, d) box-relative coordinate table. Returns the class count; -1
+// when an offset leaves the +-1 cube (not 3^d-closed — the collapse
+// declines these anyway); -2 on table overflow.
+template <typename T, int DIM>
+static int64_t galerkin_classify_dim(const int32_t* indptr,
+                                     const int32_t* cols, const T* vals,
+                                     int64_t no, const int64_t* fbox,
+                                     const int64_t* ghost_rel, int64_t K,
+                                     double* table, uint8_t* codes) {
+    constexpr int64_t NE = DIM == 1 ? 3 : (DIM == 2 ? 9 : 27);
+    int64_t bstride[DIM];
+    bstride[DIM - 1] = 1;
+    for (int d = DIM - 2; d >= 0; --d)
+        bstride[d] = bstride[d + 1] * fbox[d + 1];
+    double sig[NE];
+    int64_t cnt = 0, last = 0;
+    auto match = [&](int64_t c) {
+        const double* t = &table[c * NE];
+        for (int64_t q = 0; q < NE; ++q)
+            if (t[q] != sig[q]) return false;
+        return true;
+    };
+    int64_t rc[DIM] = {0};
+    for (int64_t r = 0; r < no; ++r) {
+        for (int64_t q = 0; q < NE; ++q) sig[q] = 0.0;
+        for (int32_t k = indptr[r]; k < indptr[r + 1]; ++k) {
+            const int32_t j = cols[k];
+            int64_t e = 0;
+            if (j < no) {
+                int64_t rem = j;
+                for (int d = 0; d < DIM; ++d) {
+                    const int64_t jc = rem / bstride[d];
+                    rem -= jc * bstride[d];
+                    const int64_t off = jc - rc[d];
+                    if (off < -1 || off > 1) return -1;
+                    e = e * 3 + (off + 1);
+                }
+            } else {
+                const int64_t* gc = &ghost_rel[(int64_t)(j - no) * DIM];
+                for (int d = 0; d < DIM; ++d) {
+                    const int64_t off = gc[d] - rc[d];
+                    if (off < -1 || off > 1) return -1;
+                    e = e * 3 + (off + 1);
+                }
+            }
+            sig[e] = (double)vals[k];
+        }
+        int64_t hit = -1;
+        if (last < cnt && match(last)) {
+            hit = last;
+        } else {
+            for (int64_t c = 0; c < cnt; ++c) {
+                if (c != last && match(c)) {
+                    hit = c;
+                    break;
+                }
+            }
+        }
+        if (hit < 0) {
+            if (cnt == K) return -2;
+            for (int64_t q = 0; q < NE; ++q) table[cnt * NE + q] = sig[q];
+            hit = cnt++;
+        }
+        codes[r] = (uint8_t)hit;
+        last = hit;
+        for (int d = DIM - 1; d >= 0; --d) {  // advance box coords
+            if (++rc[d] < fbox[d]) break;
+            rc[d] = 0;
+        }
+    }
+    return cnt;
+}
+
+template <typename T>
+static int64_t galerkin_classify_impl(const int32_t* indptr,
+                                      const int32_t* cols, const T* vals,
+                                      int64_t no, const int64_t* fbox,
+                                      const int64_t* ghost_rel, int32_t dim,
+                                      int64_t K, double* table,
+                                      uint8_t* codes) {
+    if (dim == 3)
+        return galerkin_classify_dim<T, 3>(indptr, cols, vals, no, fbox,
+                                           ghost_rel, K, table, codes);
+    if (dim == 2)
+        return galerkin_classify_dim<T, 2>(indptr, cols, vals, no, fbox,
+                                           ghost_rel, K, table, codes);
+    if (dim == 1)
+        return galerkin_classify_dim<T, 1>(indptr, cols, vals, no, fbox,
+                                           ghost_rel, K, table, codes);
+    return -1;
 }
 
 // Emit the owned-rows CSR of a collapsed coarse operator DIRECTLY from
@@ -744,10 +892,11 @@ static int64_t galerkin_emit_dim(const double* acc, const int64_t* cdims,
         // pos of c1 in the extended box (owned box is inside it)
         int64_t pos1 = 0;
         for (int d = 0; d < DIM; ++d) pos1 += (c1[d] - elo[d]) * estride[d];
+        const double* arow = acc + pos1 * ne;  // pos-major: one block
         // pass 1: owned columns (ascending gid => ascending owned lid)
         for (int k = 0; k < ne; ++k) {
             const int e = ord[k];
-            const double v = acc[(int64_t)e * esize + pos1];
+            const double v = arow[e];
             if (v == 0.0) continue;
             int64_t lid = 0;
             bool owned = true, ingrid = true;
@@ -764,7 +913,7 @@ static int64_t galerkin_emit_dim(const double* acc, const int64_t* cdims,
         // pass 2: ghost columns (ascending gid => ascending table rank)
         for (int k = 0; k < ne; ++k) {
             const int e = ord[k];
-            const double v = acc[(int64_t)e * esize + pos1];
+            const double v = arow[e];
             if (v == 0.0) continue;
             int64_t gid2 = 0;
             bool owned = true, ingrid = true;
@@ -985,6 +1134,50 @@ int64_t pa_galerkin3_f32(const int32_t* indptr, const int32_t* cols,
                          const int64_t* ehi, int32_t dim, double* out) {
     return galerkin3_impl<float>(indptr, cols, vals, no, lid_gid, fdims,
                                  flo, fhi, cdims, elo, ehi, dim, out);
+}
+
+int64_t pa_galerkin3_sub_f64(const int32_t* indptr, const int32_t* cols,
+                             const double* vals, int64_t no,
+                             const int64_t* lid_gid, const int64_t* fdims,
+                             const int64_t* flo, const int64_t* fhi,
+                             const int64_t* cdims, const int64_t* elo,
+                             const int64_t* ehi, int32_t dim, double* out,
+                             const int64_t* sub_coords,
+                             const int64_t* sub_counts) {
+    return galerkin3_impl<double>(indptr, cols, vals, no, lid_gid, fdims,
+                                  flo, fhi, cdims, elo, ehi, dim, out,
+                                  sub_coords, sub_counts);
+}
+
+int64_t pa_galerkin3_sub_f32(const int32_t* indptr, const int32_t* cols,
+                             const float* vals, int64_t no,
+                             const int64_t* lid_gid, const int64_t* fdims,
+                             const int64_t* flo, const int64_t* fhi,
+                             const int64_t* cdims, const int64_t* elo,
+                             const int64_t* ehi, int32_t dim, double* out,
+                             const int64_t* sub_coords,
+                             const int64_t* sub_counts) {
+    return galerkin3_impl<float>(indptr, cols, vals, no, lid_gid, fdims,
+                                 flo, fhi, cdims, elo, ehi, dim, out,
+                                 sub_coords, sub_counts);
+}
+
+int64_t pa_galerkin_classify_f64(const int32_t* indptr, const int32_t* cols,
+                                 const double* vals, int64_t no,
+                                 const int64_t* fbox,
+                                 const int64_t* ghost_rel, int32_t dim,
+                                 int64_t K, double* table, uint8_t* codes) {
+    return galerkin_classify_impl<double>(indptr, cols, vals, no, fbox,
+                                          ghost_rel, dim, K, table, codes);
+}
+
+int64_t pa_galerkin_classify_f32(const int32_t* indptr, const int32_t* cols,
+                                 const float* vals, int64_t no,
+                                 const int64_t* fbox,
+                                 const int64_t* ghost_rel, int32_t dim,
+                                 int64_t K, double* table, uint8_t* codes) {
+    return galerkin_classify_impl<float>(indptr, cols, vals, no, fbox,
+                                         ghost_rel, dim, K, table, codes);
 }
 
 int64_t pa_galerkin_emit_f64(const double* acc, const int64_t* cdims,

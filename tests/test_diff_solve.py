@@ -99,3 +99,25 @@ def test_solution_matches_host_cg():
         return True
 
     assert pa.prun(driver, pa.tpu, 4)
+
+
+def test_diff_solve_on_node_block_lowering():
+    """Regression (r4 review): make_diff_solve_fn read dA.oh_vals.dtype,
+    which is None on the node-block A_oh path — differentiable solves
+    must work on multi-part SD/BSR lowerings."""
+    from partitionedarrays_jl_tpu.models.elasticity_tet import (
+        assemble_elasticity_tet,
+    )
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        device_matrix, make_diff_solve_fn,
+    )
+
+    def driver(parts):
+        A, b, xh, x0 = assemble_elasticity_tet(parts, (4, 4, 4))
+        dA = device_matrix(A, parts.backend)
+        assert dA.ohb_bs == 3 and dA.oh_vals is None
+        fn = make_diff_solve_fn(dA, tol=1e-8, maxiter=400)
+        assert fn is not None
+        return True
+
+    assert pa.prun(driver, pa.tpu, 4)

@@ -111,11 +111,12 @@ def test_elasticity_tpu_matches_sequential():
     np.testing.assert_allclose(xt, xs, rtol=0, atol=1e-10)
 
 
-def test_bsr_lowering_engages_and_matches_ell():
-    """The irregular-graph fast path: the tet-elasticity operator lowers
-    to 3x3 node-block BSR (one gather per block — measured ~24x over the
-    padded-ELL gathers, tools/bench_irregular.py); the product must match
-    both the forced-ELL lowering and the host oracle to rounding."""
+def test_irregular_lowerings_engage_and_match():
+    """The irregular-graph fast paths: the tet-elasticity operator
+    lowers to the supernode-dense (SD) MXU path by default (round 4),
+    to 3x3 node-block BSR with PA_TPU_SD=0, and to padded ELL with both
+    off; all three products must match each other and the host oracle
+    to rounding."""
     import os
 
     from partitionedarrays_jl_tpu.parallel.tpu import (
@@ -126,23 +127,72 @@ def test_bsr_lowering_engages_and_matches_ell():
         A, b, xh, x0 = assemble_elasticity_tet(parts, (4, 4, 4))
         backend = parts.backend
         dA = device_matrix(A, backend)
-        assert dA.bsr_bs == 3, dA.bsr_bs
+        assert dA.sd_bs == 3 and dA.bsr_bs is None, (dA.sd_bs, dA.bsr_bs)
         dx = DeviceVector.from_pvector(xh, backend, dA.col_layout)
-        y_bsr = np.asarray(make_spmv_fn(dA)(dx.data))
+        y_sd = np.asarray(make_spmv_fn(dA)(dx.data))
+        os.environ["PA_TPU_SD"] = "0"
+        try:
+            dA_bsr = DeviceMatrix(A, backend)
+            assert dA_bsr.bsr_bs == 3, dA_bsr.bsr_bs
+            dxb = DeviceVector.from_pvector(xh, backend, dA_bsr.col_layout)
+            y_bsr = np.asarray(make_spmv_fn(dA_bsr)(dxb.data))
+            os.environ["PA_TPU_BSR"] = "0"
+            try:
+                dA_ell = DeviceMatrix(A, backend)
+            finally:
+                del os.environ["PA_TPU_BSR"]
+        finally:
+            del os.environ["PA_TPU_SD"]
+        assert dA_ell.bsr_bs is None and dA_ell.sd_bs is None
+        dx2 = DeviceVector.from_pvector(xh, backend, dA_ell.col_layout)
+        y_ell = np.asarray(make_spmv_fn(dA_ell)(dx2.data))
+        np.testing.assert_allclose(y_bsr, y_ell, rtol=1e-12, atol=1e-12)
+        np.testing.assert_allclose(y_sd, y_ell, rtol=1e-10, atol=1e-10)
+        host = pa.gather_pvector(A @ xh)
+        got = np.zeros_like(host)
+        for p, iset in enumerate(A.rows.partition.part_values()):
+            got[np.asarray(iset.oid_to_gid)] = y_sd[p, : iset.num_oids]
+        np.testing.assert_allclose(got, host, rtol=1e-10, atol=1e-10)
+        return True
+
+    assert pa.prun(driver, pa.tpu, 4)
+
+
+def test_oh_node_block_path_engages_and_matches():
+    """Round-4 directive 7: on a multi-part irregular lowering the A_oh
+    boundary block must take the node-block gather path (one index per
+    ghost NODE), not per-element ELL — and match the ELL-forced product
+    and the host oracle."""
+    import os
+
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        DeviceMatrix, DeviceVector, device_matrix, make_spmv_fn,
+    )
+
+    def driver(parts):
+        A, b, xh, x0 = assemble_elasticity_tet(parts, (4, 4, 4))
+        backend = parts.backend
+        dA = device_matrix(A, backend)
+        assert dA.oh_nnz > 0, "multi-part run must have boundary coupling"
+        assert dA.ohb_bs == 3, "node-block A_oh did not engage"
+        assert dA.oh_vals is None, "ELL A_oh staged alongside node-block"
+        dx = DeviceVector.from_pvector(xh, backend, dA.col_layout)
+        y_blk = np.asarray(make_spmv_fn(dA)(dx.data))
+        os.environ["PA_TPU_SD"] = "0"
         os.environ["PA_TPU_BSR"] = "0"
         try:
             dA_ell = DeviceMatrix(A, backend)
         finally:
-            del os.environ["PA_TPU_BSR"]
-        assert dA_ell.bsr_bs is None
+            del os.environ["PA_TPU_SD"], os.environ["PA_TPU_BSR"]
+        assert dA_ell.ohb_bs is None and dA_ell.oh_vals is not None
         dx2 = DeviceVector.from_pvector(xh, backend, dA_ell.col_layout)
         y_ell = np.asarray(make_spmv_fn(dA_ell)(dx2.data))
-        np.testing.assert_allclose(y_bsr, y_ell, rtol=1e-12, atol=1e-12)
+        np.testing.assert_allclose(y_blk, y_ell, rtol=1e-10, atol=1e-10)
         host = pa.gather_pvector(A @ xh)
         got = np.zeros_like(host)
         for p, iset in enumerate(A.rows.partition.part_values()):
-            got[np.asarray(iset.oid_to_gid)] = y_bsr[p, : iset.num_oids]
-        np.testing.assert_allclose(got, host, rtol=1e-12, atol=1e-12)
+            got[np.asarray(iset.oid_to_gid)] = y_blk[p, : iset.num_oids]
+        np.testing.assert_allclose(got, host, rtol=1e-10, atol=1e-10)
         return True
 
     assert pa.prun(driver, pa.tpu, 4)

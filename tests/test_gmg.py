@@ -505,3 +505,84 @@ def test_galerkin_fused_asymmetric_dense_parity():
 
     assert pa.prun(driver, pa.sequential, (2, 2, 2))
     assert pa.prun(driver, pa.sequential, (3, 1, 2))
+
+
+@pytest.mark.parametrize(
+    "ns,pshape",
+    [
+        ((40, 38, 36), (1, 1, 1)),
+        ((37, 41, 39), (2, 2, 1)),
+        ((48, 50), (2, 2)),
+    ],
+)
+def test_classed_collapse_bit_identical(ns, pshape):
+    """Round-4 directive 1: the classed Galerkin collapse (rep-box +
+    broadcast expansion, default-on) must produce BIT-identical coarse
+    operators to the full native collapse — same kernel arithmetic, same
+    fine-row order per coarse row. Pins _zone_reps margins,
+    galerkin_classify_dim, and the sub_coords kernel path."""
+    import os
+
+    from partitionedarrays_jl_tpu.models import assemble_poisson
+    from partitionedarrays_jl_tpu.models.gmg import galerkin_cartesian
+    from partitionedarrays_jl_tpu.parallel.prange import (
+        cartesian_partition, no_ghost,
+    )
+    from partitionedarrays_jl_tpu.parallel.psparse import (
+        psparse_global_triplets,
+    )
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(
+            parts, ns, dtype=np.float32, decoupled=True
+        )
+        ncs = tuple((n + 1) // 2 for n in ns)
+        Ac1 = galerkin_cartesian(
+            A, ns, ncs, cartesian_partition(parts, ncs, no_ghost)
+        )
+        os.environ["PA_TPU_GMG_CLASSED"] = "0"
+        try:
+            Ac2 = galerkin_cartesian(
+                A, ns, ncs, cartesian_partition(parts, ncs, no_ghost)
+            )
+        finally:
+            del os.environ["PA_TPU_GMG_CLASSED"]
+        for (i1, j1, v1), (i2, j2, v2) in zip(
+            psparse_global_triplets(Ac1).part_values(),
+            psparse_global_triplets(Ac2).part_values(),
+        ):
+            o1, o2 = np.lexsort((j1, i1)), np.lexsort((j2, i2))
+            assert np.array_equal(i1[o1], i2[o2])
+            assert np.array_equal(j1[o1], j2[o2])
+            assert np.array_equal(v1[o1], v2[o2]), "values drifted"
+        return True
+
+    assert pa.prun(driver, pa.sequential, pshape)
+
+
+def test_classed_collapse_declines_variable_coefficients():
+    """The zone-uniformity proof must reject operators whose values are
+    not a function of boundary distance — the classed path silently
+    producing wrong coarse operators for variable coefficients would be
+    the worst possible failure mode."""
+    from partitionedarrays_jl_tpu.models.gmg import _classed_collapse
+
+    def driver(parts):
+        ns = (24, 22, 20)
+        A, b, xe, x0 = pa.assemble_poisson(parts, ns)
+        # perturb one interior value: no zone function can explain it
+        M = A.values.part_values()[0]
+        k = len(M.data) // 2
+        M.data[k] *= 1.5
+        ri = A.rows.partition.part_values()[0]
+        ci = A.cols.partition.part_values()[0]
+        ncs = tuple((n + 1) // 2 for n in ns)
+        dim = len(ns)
+        flo, fhi = ri.box_lo, ri.box_hi
+        elo = [max(0, (flo[d] - 1) // 2) for d in range(dim)]
+        ehi = [min(ncs[d], fhi[d] // 2 + 1) for d in range(dim)]
+        out = _classed_collapse(ri, ci, M, ns, ncs, flo, fhi, elo, ehi)
+        assert out is None, "classed collapse accepted a non-classed operator"
+        return True
+
+    assert pa.prun(driver, pa.sequential, (1, 1, 1))

@@ -1,0 +1,46 @@
+"""The multiprocess planning path (tools/plan_multiproc.py) must compute
+the SAME per-part matrices as the in-process assembly fast path — the
+testable form of the "planning is embarrassingly parallel per part"
+claim (round-4 directive 3; reference analog: per-rank local assembly,
+test/test_fdm.jl:52-81)."""
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu import native
+
+
+@pytest.mark.skipif(not native.available(), reason="native layer required")
+def test_multiproc_planning_matches_inprocess():
+    import sys, os
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+    )
+    from plan_multiproc import run
+
+    ns, pshape = (20, 18, 16), (2, 2, 1)
+    w1, f1 = run(ns, pshape, 1, dtype="float64", decoupled=False)
+    w2, f2 = run(ns, pshape, 2, dtype="float64", decoupled=False)
+    # process count cannot change the matrices (last slot is wall time)
+    assert [r[:5] for r in f1] == [r[:5] for r in f2]
+    assert len(f2) == 4 and sorted(r[0] for r in f2) == [0, 1, 2, 3]
+
+    # pin the checksums to the real API's per-part CSR blocks
+    def driver(parts):
+        A, b, xe, x0 = pa.assemble_poisson(parts, ns)
+        out = []
+        for p, M in enumerate(A.values.part_values()):
+            out.append(
+                (
+                    p,
+                    int(M.nnz),
+                    float(M.data.sum(dtype=np.float64)),
+                    int(M.indices.sum(dtype=np.int64)),
+                    int(M.indptr[-1]),
+                )
+            )
+        return out
+
+    api = pa.prun(driver, pa.sequential, pshape)
+    assert [r[:5] for r in f2] == api

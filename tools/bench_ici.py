@@ -1,0 +1,43 @@
+"""ICI-mode bench legs on the virtual 8-device CPU mesh (round-4
+directive 8): exercises `bench.bench_ici` — true neighbor-`ppermute`
+halo exchange + mesh CG — today, without real multi-chip hardware.
+Records are labeled ``fabric="virtual-cpu"``: they validate the kernels
+and the measurement path, NOT interconnect bandwidth. On a machine with
+a real TPU slice, `python bench.py` runs the same legs automatically
+with ``fabric="ici"``.
+
+    python tools/bench_ici.py          # 64^3, 8 virtual CPU devices
+    PA_ICI_N=96 python tools/bench_ici.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    import partitionedarrays_jl_tpu as pa
+    from bench import bench_ici
+
+    n = int(os.environ.get("PA_ICI_N", "64"))
+    devs = jax.devices()
+    assert len(devs) == 8 and devs[0].platform == "cpu", devs
+    for rec in bench_ici(n, devs, pa, "virtual-cpu"):
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

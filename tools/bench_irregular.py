@@ -71,23 +71,47 @@ def main():
     from functools import partial
 
     def measure(dA, label):
-        dx = DeviceVector.from_pvector(xe, backend, dA.col_layout)
-        spmv = make_spmv_fn(dA)
-        flops = dA.flops_per_spmv
+        from partitionedarrays_jl_tpu.parallel.tpu import (
+            _matrix_operands, _spmv_body,
+        )
 
-        @partial(jax.jit, static_argnums=1)
-        def chain(x, k):
-            return jax.lax.fori_loop(
-                0, k, lambda i, y: spmv(y) * np.float32(1e-3), x
-            ).sum()
+        dx = DeviceVector.from_pvector(xe, backend, dA.col_layout)
+        flops = dA.flops_per_spmv
+        # the timing chain must pass the staged matrix operands as
+        # ARGUMENTS: closing over them would inline hundreds of MB of
+        # constants into the relay's compile request (HTTP 413 on the
+        # SD lowering's densified blocks)
+        ops = _matrix_operands(dA)
+        body = _spmv_body(dA)
+        mesh = backend.mesh(dA.row_layout.P)
+        spec = backend.parts_spec()
+        specs = jax.tree.map(lambda _: spec, ops)
+
+        @partial(jax.jit, static_argnums=2)
+        def chain(x, m, k):
+            def shard_fn(xs, ms):
+                mm = {k2: v[0] for k2, v in ms.items()}
+
+                def step(_, y):
+                    y2, _x = body(y, mm)
+                    return y2 * np.float32(1e-3)
+
+                return jax.lax.fori_loop(0, k, step, xs[0])[None]
+
+            from jax import shard_map
+
+            return shard_map(
+                shard_fn, mesh=mesh, in_specs=(spec, specs),
+                out_specs=spec, check_vma=False,
+            )(x, m).sum()
 
         def chain_time(k, nreps=5):
-            float(chain(dx.data, k))
-            float(chain(dx.data, k))
+            float(chain(dx.data, ops, k))
+            float(chain(dx.data, ops, k))
             ts = []
             for _ in range(nreps):
                 t0 = time.perf_counter()
-                v = float(chain(dx.data, k))
+                v = float(chain(dx.data, ops, k))
                 ts.append(time.perf_counter() - t0)
             assert v == v
             return statistics.median(ts)
@@ -110,19 +134,28 @@ def main():
         )
         return dt
 
-    # integrated default: the BSR node-block path
+    # integrated default: the supernode-dense MXU path (round 4)
     dA = device_matrix(A, backend)
-    assert dA.bsr_bs == 3, f"expected 3x3 BSR lowering, got {dA.bsr_bs}"
-    dt_bsr = measure(dA, "BSR(3x3) SpMV (default lowering)")
+    assert dA.sd_bs == 3, f"expected 3x3 SD lowering, got {dA.sd_bs}"
+    dt_sd = measure(dA, "SD supernode-dense SpMV (default lowering)")
 
-    # forced generic ELL (the pre-round-2 lowering), same matrix
-    os.environ["PA_TPU_BSR"] = "0"
+    # forced BSR (the round-2/3 default), same matrix
+    os.environ["PA_TPU_SD"] = "0"
     try:
-        dA_ell = DeviceMatrix(A, backend)
+        dA_bsr = DeviceMatrix(A, backend)
+        assert dA_bsr.bsr_bs == 3, f"expected 3x3 BSR, got {dA_bsr.bsr_bs}"
+        dt_bsr = measure(dA_bsr, "BSR(3x3) SpMV (PA_TPU_SD=0)")
+
+        # forced generic ELL (the pre-round-2 lowering)
+        os.environ["PA_TPU_BSR"] = "0"
+        try:
+            dA_ell = DeviceMatrix(A, backend)
+        finally:
+            del os.environ["PA_TPU_BSR"]
     finally:
-        del os.environ["PA_TPU_BSR"]
+        del os.environ["PA_TPU_SD"]
     assert dA_ell.bsr_bs is None and dA_ell.dia_mode is None
-    dt_ell = measure(dA_ell, "padded-ELL SpMV (PA_TPU_BSR=0)")
+    dt_ell = measure(dA_ell, "padded-ELL SpMV (both fast paths off)")
 
     xv = np.asarray(xe.values.part_values()[0], dtype=np.float32)
     csr_spmv(M, xv)
@@ -132,11 +165,22 @@ def main():
         csr_spmv(M, xv)
         ts.append(time.perf_counter() - t0)
     host_dt = statistics.median(ts)
+    flops = dA.flops_per_spmv  # same dA as the SD leg above
     print(
-        f"host oracle: {host_dt*1e3:.1f} ms; BSR vs ELL {dt_ell/dt_bsr:.1f}x, "
-        f"BSR vs host {host_dt/dt_bsr:.1f}x",
+        f"host oracle: {host_dt*1e3:.1f} ms; SD vs BSR {dt_bsr/dt_sd:.1f}x, "
+        f"BSR vs ELL {dt_ell/dt_bsr:.1f}x, SD vs host {host_dt/dt_sd:.1f}x",
         flush=True,
     )
+    import json
+
+    print(json.dumps({
+        "metric": f"irregular_spmv_gflops_tet_elasticity_{n}cube_f32",
+        "value": round(flops / dt_sd / 1e9, 2),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(dt_bsr / dt_sd, 2),
+        "bsr_gflops": round(flops / dt_bsr / 1e9, 2),
+        "ell_gflops": round(flops / dt_ell / 1e9, 2),
+    }))
 
 
 if __name__ == "__main__":
