@@ -284,10 +284,6 @@ def bench_cg_vs_cpu(n: int, backend, pa, dA) -> dict:
     median over short runs of the same recurrence."""
     import statistics
 
-    from partitionedarrays_jl_tpu.parallel.tpu import (
-        DeviceVector, make_cg_fn,
-    )
-
     dtype = np.float32
 
     # host leg: K iterations of the sequential backend's eager CG on an
@@ -309,29 +305,9 @@ def bench_cg_vs_cpu(n: int, backend, pa, dA) -> dict:
 
     host_it_s = pa.prun(host_driver, SequentialBackend(), (1, 1, 1))
 
-    b = pa.PVector.full(np.float32(1.0), dA.cols, dtype=dtype)
-    x0 = pa.PVector.full(np.float32(0.0), dA.cols, dtype=dtype)
-
     # device leg: two fixed-trip compiled solves, marginal cost per it
-    db = DeviceVector.from_pvector(b, backend, dA.col_layout)
-    dx = DeviceVector.from_pvector(x0, backend, dA.col_layout)
-    k1, k2 = 60, 1000  # long enough that the marginal beats relay jitter
-
-    def run_k(k):
-        fn = make_cg_fn(dA, tol=0.0, maxiter=k)
-        fn(db.data, dx.data, None)  # compile + warm
-
-        def once():
-            t0 = time.perf_counter()
-            out = fn(db.data, dx.data, None)
-            float(out[1])  # force completion
-            return time.perf_counter() - t0
-
-        once()
-        return statistics.median(once() for _ in range(5))
-
-    t1, t2 = run_k(k1), run_k(k2)
-    dev_it_s = max((t2 - t1) / (k2 - k1), 1e-9)
+    # (k2 long enough that the marginal beats relay jitter)
+    dev_it_s = cg_marginal_s_per_it(pa, dA, 60, 1000)
     speedup = host_it_s / dev_it_s
     rec = {
             "metric": f"cg_iteration_speedup_vs_cpu_poisson3d_{n}cube_f32",
@@ -356,6 +332,38 @@ def bench_cg_vs_cpu(n: int, backend, pa, dA) -> dict:
     return rec
 
 
+def cg_marginal_s_per_it(pa, dA, k1: int, k2: int) -> float:
+    """Fixed-trip compiled-CG marginal cost per iteration: two solves at
+    maxiter k1/k2 (tol=0), each warmed then median-of-5 timed, so the
+    relay RTT and compile cancel in the difference. Shared by the
+    single-chip CG comparand and the ICI leg (one protocol, one place)."""
+    import statistics
+
+    from partitionedarrays_jl_tpu.parallel.tpu import DeviceVector, make_cg_fn
+
+    dtype = np.float32
+    b = pa.PVector.full(np.float32(1.0), dA.cols, dtype=dtype)
+    z = pa.PVector.full(np.float32(0.0), dA.cols, dtype=dtype)
+    db = DeviceVector.from_pvector(b, dA.backend, dA.col_layout)
+    dz = DeviceVector.from_pvector(z, dA.backend, dA.col_layout)
+
+    def run_k(k):
+        fn = make_cg_fn(dA, tol=0.0, maxiter=k)
+        fn(db.data, dz.data, None)
+
+        def once():
+            t0 = time.perf_counter()
+            out = fn(db.data, dz.data, None)
+            float(out[1])
+            return time.perf_counter() - t0
+
+        once()
+        return statistics.median(once() for _ in range(5))
+
+    t1, t2 = run_k(k1), run_k(k2)
+    return max((t2 - t1) / (k2 - k1), 1e-9)
+
+
 def bench_ici(n: int, devices, pa, fabric: str):
     """Multi-device halo + CG legs with TRUE neighbor `ppermute`s
     (round-4 directive 8): the day a real TPU slice is reachable these
@@ -365,7 +373,6 @@ def bench_ici(n: int, devices, pa, fabric: str):
     bandwidth says nothing about ICI wires). Reference anchor: the
     multi-node exchange these legs will measure,
     /root/reference/src/MPIBackend.jl:213-309."""
-    import statistics
     from functools import partial
 
     import jax
@@ -373,8 +380,7 @@ def bench_ici(n: int, devices, pa, fabric: str):
 
     from partitionedarrays_jl_tpu.parallel.sequential import SequentialBackend
     from partitionedarrays_jl_tpu.parallel.tpu import (
-        DeviceVector, TPUBackend, device_matrix, make_cg_fn,
-        make_exchange_fn, _stage,
+        TPUBackend, device_matrix, make_exchange_fn, _stage,
     )
 
     shapes = {8: (2, 2, 2), 4: (2, 2, 1), 2: (2, 1, 1)}
@@ -438,28 +444,9 @@ def bench_ici(n: int, devices, pa, fabric: str):
 
     A = pa.prun(driver, backend, pshape)
     dA = device_matrix(A, backend)
-    b = pa.PVector.full(np.float32(1.0), dA.cols, dtype=dtype)
-    z = pa.PVector.full(np.float32(0.0), dA.cols, dtype=dtype)
-    db = DeviceVector.from_pvector(b, backend, dA.col_layout)
-    dz = DeviceVector.from_pvector(z, backend, dA.col_layout)
-
-    def run_k(k):
-        fn = make_cg_fn(dA, tol=0.0, maxiter=k)
-        fn(db.data, dz.data, None)
-
-        def once():
-            t0 = time.perf_counter()
-            out = fn(db.data, dz.data, None)
-            float(out[1])
-            return time.perf_counter() - t0
-
-        once()
-        return statistics.median(once() for _ in range(5))
-
-    t1, t2 = run_k(40), run_k(440)
     cg_rec = {
         "metric": f"ici_cg_s_per_iteration_{n}cube_{P}dev_f32",
-        "value": round(max((t2 - t1) / 400, 1e-9), 6),
+        "value": round(cg_marginal_s_per_it(pa, dA, 40, 440), 6),
         "unit": "s/iteration",
         "vs_baseline": 0.0,
         "fabric": fabric,

@@ -627,12 +627,13 @@ class DeviceMatrix:
             # way as A_oo — ghost dofs arrive node-triple-contiguous
             ohb = self._detect_oh_blocks(
                 A, oh, P, self.sd_bs or self.bsr_bs, row_layout, col_layout,
+                dt,
             )
         if ohb is not None:
             self.ohb_bs = ohb["bs"]
             self.ohb_rows = _stage(backend, ohb["rows"], P)
             self.ohb_cols = _stage(backend, ohb["cols"], P)
-            self.ohb_vals = _stage(backend, ohb["vals"].astype(dt), P)
+            self.ohb_vals = _stage(backend, ohb["vals"], P)
         else:
             nb_max = max(
                 (int(np.count_nonzero(m.row_lengths())) for m in oh),
@@ -885,7 +886,9 @@ class DeviceMatrix:
             if (P * ngr_max * emax) * bs * bs > 0.7 * nnz:
                 return None
             idx = np.zeros((P, ngr_max, emax), dtype=INDEX_DTYPE)
-            vals = np.zeros((P, ngr_max, G * bs, width))
+            # allocate in the operator dtype directly: an f64 temp would
+            # double the peak against the SD_MAX_BYTES budget (review r4)
+            vals = np.zeros((P, ngr_max, G * bs, width), dtype=dt)
             for p in range(P):
                 m = oo[p]
                 for g, ext in enumerate(unions[p]):
@@ -908,16 +911,11 @@ class DeviceMatrix:
                     )
                     idx[p, g, : len(ext)] = ext
                     vals[p, g][rr, lc] = m.data[s:e]
-            return {
-                "bs": bs,
-                "G": G,
-                "idx": idx,
-                "vals": vals.astype(dt),
-            }
+            return {"bs": bs, "G": G, "idx": idx, "vals": vals}
         return None
 
     @staticmethod
-    def _detect_oh_blocks(A, oh, P, bs, row_layout, col_layout):
+    def _detect_oh_blocks(A, oh, P, bs, row_layout, col_layout, dt):
         """Node-block (bs x bs) staging of the A_oh boundary block
         (round-4 directive 7): when the ghost layer arrives as whole
         aligned node triples (vector-dof FE assembly touches all of a
@@ -966,7 +964,8 @@ class DeviceMatrix:
             (P, nb_max, bs), row_layout.trash, dtype=INDEX_DTYPE
         )
         colsb = np.zeros((P, nb_max, Lb_max), dtype=INDEX_DTYPE)
-        vals = np.zeros((P, nb_max, Lb_max, bs, bs))
+        # operator dtype directly: no f64 transient (review r4)
+        vals = np.zeros((P, nb_max, Lb_max, bs, bs), dtype=dt)
         for p, pl in enumerate(plans):
             if pl is None:
                 continue
@@ -1420,6 +1419,7 @@ def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") ->
             backend,
             plan.layout.P,
             plan.info.seg_mask if plan.reverse_mode else None,
+            variants=plan.info.variants,
         )
     else:
         si = _stage(backend, plan.snd_idx, plan.layout.P)
@@ -1428,16 +1428,23 @@ def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") ->
     return lambda x: fn(x, si, sm, ri)
 
 
-def _box_dummy_operands(backend: TPUBackend, P: int, seg_mask=None):
+def _box_dummy_operands(backend: TPUBackend, P: int, seg_mask=None,
+                        variants=None):
     """(si, sm, ri) operands for box-plan programs. The slice bodies
-    ignore si/ri (tiny dummies keep the operand pytree uniform so every
-    caller passes m['si']/m['sm']/m['ri'] unconditionally); sm is the
-    staged real segment mask when the caller holds a reverse plan, a
-    dummy otherwise."""
+    ignore ri (a tiny dummy keeps the operand pytree uniform so every
+    caller passes m['si']/m['sm']/m['ri'] unconditionally); si carries
+    each shard's box-shape VARIANT index (read only by multi-variant
+    plans — unequal Cartesian splits); sm is the staged real segment
+    mask when the caller holds a reverse plan, a dummy otherwise."""
     z = np.zeros((P, 1), dtype=INDEX_DTYPE)
+    si = (
+        np.asarray(variants, dtype=INDEX_DTYPE).reshape(P, 1)
+        if variants is not None
+        else z
+    )
     sm = seg_mask if seg_mask is not None else np.zeros((P, 1), dtype=bool)
     return (
-        _stage(backend, z, P),
+        _stage(backend, si, P),
         _stage(backend, sm, P),
         _stage(backend, z, P),
     )
@@ -1454,7 +1461,9 @@ def _matrix_operands(dA: DeviceMatrix) -> dict:
     plan = dA.col_plan
     P = plan.layout.P
     if isinstance(plan, BoxExchangePlan):
-        si, sm, ri = _box_dummy_operands(dA.backend, P)
+        si, sm, ri = _box_dummy_operands(
+            dA.backend, P, variants=plan.info.variants
+        )
     else:
         si = _stage(dA.backend, plan.snd_idx, P)
         sm = _stage(dA.backend, plan.snd_mask, P)

@@ -44,18 +44,36 @@ from .prange import PRange
 
 class BoxDir:
     """One geometric direction of the box exchange: a static sender
-    sub-box (start/shape, relative to the owned box), the receiver
-    segment offset into the ghost region, and the ppermute pairs."""
+    sub-box PER BOX-SHAPE VARIANT (start/shape relative to the owned
+    box — unequal Cartesian splits produce <= 2^d variants and each
+    shard packs with its own variant's static slice), the receiver
+    segment offset into the ghost region, and the ppermute pairs. The
+    segment is sized to the LARGEST variant's slab; smaller variants
+    pad (receiver-side slot maps, computed host-side from the SENDER's
+    geometry, only ever address real positions)."""
 
-    __slots__ = ("dir", "start", "shape", "off", "size", "perm")
+    __slots__ = ("dir", "geo", "off", "size", "perm")
 
-    def __init__(self, dir, start, shape, off, perm):
+    def __init__(self, dir, geo, off, perm):
         self.dir = tuple(dir)
-        self.start = tuple(int(s) for s in start)
-        self.shape = tuple(int(s) for s in shape)
+        #: per variant: (start, shape) of the pack slice, or a (0..,
+        #: 1..) degenerate slice for variants with no edge in this dir
+        self.geo = tuple(
+            (tuple(int(x) for x in s), tuple(int(x) for x in sh))
+            for s, sh in geo
+        )
         self.off = int(off)
-        self.size = int(math.prod(self.shape))
+        self.size = max(int(math.prod(sh)) for _, sh in self.geo)
         self.perm = tuple(perm)
+
+    # single-variant convenience (the equal-box fast consumers)
+    @property
+    def start(self):
+        return self.geo[0][0]
+
+    @property
+    def shape(self):
+        return self.geo[0][1]
 
 
 class BoxInfo:
@@ -63,11 +81,18 @@ class BoxInfo:
     and the exchange body need, all host-side."""
 
     __slots__ = (
-        "box_shape", "dirs", "nh_total", "ghost_rel_slots", "seg_mask", "P",
+        "box_shapes", "variants", "dirs", "nh_total", "ghost_rel_slots",
+        "seg_mask", "P",
     )
 
-    def __init__(self, box_shape, dirs, nh_total, ghost_rel_slots, seg_mask, P):
-        self.box_shape = tuple(box_shape)
+    def __init__(
+        self, box_shapes, variants, dirs, nh_total, ghost_rel_slots,
+        seg_mask, P,
+    ):
+        #: distinct per-part owned-box shapes (sorted; <= 2^d for
+        #: Cartesian splits) and each part's index into them
+        self.box_shapes = tuple(tuple(s) for s in box_shapes)
+        self.variants = np.asarray(variants, dtype=np.int32)
         self.dirs = tuple(dirs)
         self.nh_total = int(nh_total)
         #: per part: hid -> slot index relative to g0 (segment layout)
@@ -79,6 +104,14 @@ class BoxInfo:
         #: this mask so orphans never accumulate into owners.
         self.seg_mask = seg_mask
         self.P = int(P)
+
+    @property
+    def box_shape(self):
+        """The single box shape of an equal-box plan (the consumers that
+        read this — the stencil-transfer staging, the halo bench — only
+        operate on single-variant plans)."""
+        assert len(self.box_shapes) == 1, "multi-variant plan"
+        return self.box_shapes[0]
 
 
 def _logical_coords(gids, gdims, lo, hi):
@@ -121,11 +154,17 @@ def analyze_box_structure(rows: PRange) -> Optional[BoxInfo]:
             return None
         if not getattr(i, "owned_first", True):
             return None
-    box_shape = isets[0].box_shape
-    if any(i.box_shape != box_shape for i in isets):
-        return None  # unequal boxes: pack slices would differ per shard
-    if math.prod(box_shape) == 0:
+    # unequal Cartesian splits (floor/ceil interval lengths per dim)
+    # produce <= 2^d distinct box shapes: each becomes a pack-slice
+    # VARIANT selected per shard by a lax.switch in the exchange body
+    box_shapes = sorted({i.box_shape for i in isets})
+    if len(box_shapes) > (1 << dim):
+        return None  # not a tensor-product split
+    if any(math.prod(s) == 0 for s in box_shapes):
         return None
+    variants = np.array(
+        [box_shapes.index(i.box_shape) for i in isets], dtype=np.int32
+    )
     # owned ids must be the C-order box scan (slot = o0 + ohid relies on
     # it). CartesianIndexSet guarantees this by contract (the owned block
     # IS the box scan — index_sets.py), so an O(1) spot check suffices:
@@ -207,18 +246,40 @@ def analyze_box_structure(rows: PRange) -> Optional[BoxInfo]:
         if not covered[p].all():
             return None  # some ghost never receives (stale-slot hazard)
 
-    # per direction: the bounding SLAB over every edge's sub-box — one
-    # static pack slice serving every shard (boundary-trimmed shells,
-    # e.g. Dirichlet-decoupled stencils whose domain-boundary rows
-    # request no ghosts, simply leave orphan slab slots — see seg_mask)
+    # per direction: the bounding SLAB over every edge's sub-box, PER
+    # SENDER VARIANT — one static pack slice per (direction, box shape)
+    # serving every shard (boundary-trimmed shells, e.g. Dirichlet-
+    # decoupled stencils whose domain-boundary rows request no ghosts,
+    # simply leave orphan slab slots — see seg_mask). Each receiver's
+    # slot map is computed from its SENDER's slab geometry host-side, so
+    # the device-side unpack stays one contiguous segment store.
     dirs = []
     ghost_rel = [np.full(i.num_hids, -1, dtype=INDEX_DTYPE) for i in isets]
     off = 0
+    V = len(box_shapes)
     for k in sorted(groups):
         entries = groups[k]
-        slab_lo = np.min([e[2].min(axis=1) for e in entries], axis=0)
-        slab_hi = np.max([e[2].max(axis=1) for e in entries], axis=0) + 1
-        shape = tuple(int(x) for x in (slab_hi - slab_lo))
+        # bounding slab per sender variant
+        slab_lo = [None] * V
+        slab_hi = [None] * V
+        for p, q, rel, hids in entries:
+            v = int(variants[p])
+            lo_e, hi_e = rel.min(axis=1), rel.max(axis=1) + 1
+            slab_lo[v] = lo_e if slab_lo[v] is None else np.minimum(slab_lo[v], lo_e)
+            slab_hi[v] = hi_e if slab_hi[v] is None else np.maximum(slab_hi[v], hi_e)
+        geo = []
+        for v in range(V):
+            if slab_lo[v] is None:
+                # variant never sends in this direction: any in-bounds
+                # degenerate slice keeps the switch branch well-formed
+                geo.append(((0,) * dim, (1,) * dim))
+            else:
+                geo.append(
+                    (
+                        tuple(int(x) for x in slab_lo[v]),
+                        tuple(int(x) for x in (slab_hi[v] - slab_lo[v])),
+                    )
+                )
         senders, receivers = set(), set()
         perm = []
         for p, q, rel, hids in entries:
@@ -227,21 +288,26 @@ def analyze_box_structure(rows: PRange) -> Optional[BoxInfo]:
             senders.add(p)
             receivers.add(q)
             perm.append((p, q))
-            pos = np.ravel_multi_index(tuple(rel - slab_lo[:, None]), shape)
+            v = int(variants[p])
+            lo_v, shape_v = geo[v]
+            pos = np.ravel_multi_index(
+                tuple(rel - np.asarray(lo_v)[:, None]), shape_v
+            )
             if len(np.unique(pos)) != len(pos):
                 return None
             ghost_rel[q][hids] = off + pos
-        dirs.append(
-            BoxDir(k, tuple(int(x) for x in slab_lo), shape, off, sorted(perm))
-        )
-        off += int(math.prod(shape))
+        d = BoxDir(k, geo, off, sorted(perm))
+        dirs.append(d)
+        off += d.size
     nh_total = off
     seg_mask = np.zeros((P, max(nh_total, 1)), dtype=bool)
     for p in range(P):
         if (ghost_rel[p] < 0).any():
             return None
         seg_mask[p, ghost_rel[p]] = True
-    return BoxInfo(box_shape, dirs, nh_total, ghost_rel, seg_mask, P)
+    return BoxInfo(
+        box_shapes, variants, dirs, nh_total, ghost_rel, seg_mask, P
+    )
 
 
 def box_structure(rows: PRange) -> Optional[BoxInfo]:
@@ -301,19 +367,51 @@ def shard_box_exchange(plan: BoxExchangePlan, combine: str):
     layout = plan.layout
     info = plan.info
     o0, g0 = layout.o0, layout.g0
-    no = int(math.prod(info.box_shape))
-    bs = info.box_shape
+    shapes = info.box_shapes
+    V = len(shapes)
+
+    def _pack(xv, d, v):
+        """Variant v's static pack: slice the owned box, pad the slab to
+        the direction's segment size."""
+        bs_v = shapes[v]
+        no_v = int(math.prod(bs_v))
+        start, shape = d.geo[v]
+        own = jax.lax.slice(xv, (o0,), (o0 + no_v,)).reshape(bs_v)
+        sl = tuple(slice(a, a + s) for a, s in zip(start, shape))
+        buf = own[sl].reshape(-1)
+        pad = d.size - buf.shape[0]
+        return jnp.pad(buf, (0, pad)) if pad else buf
+
+    def _unpack_add(xv, buf, d, v):
+        """Variant v's static reverse unpack: accumulate the (sender-
+        geometry) slab back into the owned box."""
+        bs_v = shapes[v]
+        no_v = int(math.prod(bs_v))
+        start, shape = d.geo[v]
+        n_v = int(math.prod(shape))
+        own = jax.lax.slice(xv, (o0,), (o0 + no_v,)).reshape(bs_v)
+        sl = tuple(slice(a, a + s) for a, s in zip(start, shape))
+        own = own.at[sl].add(buf[:n_v].reshape(shape))
+        return jax.lax.dynamic_update_slice(xv, own.reshape(-1), (o0,))
 
     if not plan.reverse_mode:
 
         def body(xv, si, sm, ri):
-            del si, sm, ri
-            own = jax.lax.slice(xv, (o0,), (o0 + no,)).reshape(bs)
+            # `si` carries the shard's box-shape VARIANT index (a single
+            # int32; equal-box plans have V == 1 and never read it)
+            del sm, ri
             for d in info.dirs:
-                sl = tuple(
-                    slice(a, a + s) for a, s in zip(d.start, d.shape)
-                )
-                buf = own[sl].reshape(-1)
+                if V == 1:
+                    buf = _pack(xv, d, 0)
+                else:
+                    buf = jax.lax.switch(
+                        si[0].astype(jnp.int32),
+                        [
+                            (lambda x, d=d, v=v: _pack(x, d, v))
+                            for v in range(V)
+                        ],
+                        xv,
+                    )
                 buf = jax.lax.ppermute(buf, "parts", perm=d.perm)
                 xv = jax.lax.dynamic_update_slice(
                     xv, buf, (g0 + d.off,)
@@ -327,8 +425,7 @@ def shard_box_exchange(plan: BoxExchangePlan, combine: str):
         # info.seg_mask): slab packing leaves orphan slots holding
         # sender values after a forward exchange — they must not
         # accumulate into owners
-        del si, ri
-        own = jax.lax.slice(xv, (o0,), (o0 + no,)).reshape(bs)
+        del ri
         for d in info.dirs:
             buf = jax.lax.slice(xv, (g0 + d.off,), (g0 + d.off + d.size,))
             buf = jnp.where(
@@ -336,9 +433,18 @@ def shard_box_exchange(plan: BoxExchangePlan, combine: str):
             )
             rperm = tuple((q, p) for p, q in d.perm)
             buf = jax.lax.ppermute(buf, "parts", perm=rperm)
-            sl = tuple(slice(a, a + s) for a, s in zip(d.start, d.shape))
-            own = own.at[sl].add(buf.reshape(d.shape))
-        xv = jax.lax.dynamic_update_slice(xv, own.reshape(-1), (o0,))
+            if V == 1:
+                xv = _unpack_add(xv, buf, d, 0)
+            else:
+                xv = jax.lax.switch(
+                    si[0].astype(jnp.int32),
+                    [
+                        (lambda x, b, d=d, v=v: _unpack_add(x, b, d, v))
+                        for v in range(V)
+                    ],
+                    xv,
+                    buf,
+                )
         # ghost contributions now live on owners; region cleared like the
         # generic 'add' body (and the host assemble)
         xv = xv.at[g0:].set(0)

@@ -149,6 +149,8 @@ def _stage_stencil_transfer(h, li: int, dA):
     if not isinstance(plan, BoxExchangePlan):
         return None
     info = plan.info
+    if len(info.box_shapes) > 1:
+        return None  # unequal boxes: the S apply needs one static shape
     coarse_rows = (
         h.levels[li + 1].A.rows if li + 1 < len(h.levels) else h.coarse_A.rows
     )
@@ -309,7 +311,7 @@ def _stage_structured_transfer(h, li: int, backend: TPUBackend):
         # accumulate into owners), rsi/rri are ignored dummies
         rev = cp.reverse()
         rsi, rsm, rri = _box_dummy_operands(
-            backend, LS.P, cp.info.seg_mask
+            backend, LS.P, cp.info.seg_mask, variants=cp.info.variants
         )
     else:
         rev = DeviceExchangePlan(S.cols.exchanger.reverse(), LS)

@@ -67,13 +67,14 @@ def test_with_ghost_detection_and_parity(ns, grid):
     def driver(parts):
         rows = pa.prange(parts, ns, pa.with_ghost)
         info = analyze_box_structure(rows)
-        # equal-box splits must take the fast path; unequal fall back
+        # round-4: equal AND unequal Cartesian splits take the fast path
+        # (unequal boxes become pack-slice variants switched per shard)
         sets = rows.partition.part_values()
         shapes = {i.box_shape for i in sets}
-        if len(shapes) == 1:
-            assert info is not None, (ns, grid)
-            plan = device_exchange_plan(rows, False)
-            assert isinstance(plan, BoxExchangePlan)
+        assert info is not None, (ns, grid)
+        assert len(info.box_shapes) == len(shapes)
+        plan = device_exchange_plan(rows, False)
+        assert isinstance(plan, BoxExchangePlan)
         assert _exchange_device(parts, rows)
         assert _exchange_device(parts, rows, combine="add")
         return True
@@ -112,20 +113,47 @@ def test_stencil_discovery_cols_detection():
     assert pa.prun(driver, pa.tpu, (2, 2, 2))
 
 
-def test_unequal_boxes_fall_back():
-    """(7,) cells over (2,) parts -> box shapes (3,) and (4,): pack
-    slices would be shard-dependent, so detection declines and the
-    generic plan serves, with unchanged results."""
+def test_unequal_boxes_take_variant_fast_path():
+    """(7, 8) cells over (2, 2) parts -> box shapes (3, 4) and (4, 4):
+    round-4 directive 6 — unequal splits no longer fall back; the plan
+    carries per-shard pack-slice VARIANTS (lax.switch in the body) and
+    must match the host oracle in both directions."""
 
     def driver(parts):
         rows = pa.prange(parts, (7, 8), pa.with_ghost)
-        assert analyze_box_structure(rows) is None
+        info = analyze_box_structure(rows)
+        assert info is not None and len(info.box_shapes) == 2, info
         plan = device_exchange_plan(rows, False)
-        assert not isinstance(plan, BoxExchangePlan)
+        assert isinstance(plan, BoxExchangePlan)
         assert _exchange_device(parts, rows)
+        assert _exchange_device(parts, rows, combine="add")
         return True
 
     assert pa.prun(driver, pa.tpu, (2, 2))
+
+
+@pytest.mark.parametrize(
+    "ns,grid",
+    [
+        ((7, 9, 11), (2, 2, 2)),  # all dims unequal: 8 shape variants
+        ((31,), (4,)),
+        ((13, 8), (3, 2)),
+    ],
+)
+def test_unequal_boxes_variant_parity(ns, grid):
+    """Unequal-split parity sweep: forward and reverse exchanges through
+    the variant fast path must match the host oracle exactly."""
+
+    def driver(parts):
+        rows = pa.prange(parts, ns, pa.with_ghost)
+        assert isinstance(
+            device_exchange_plan(rows, False), BoxExchangePlan
+        )
+        assert _exchange_device(parts, rows)
+        assert _exchange_device(parts, rows, combine="add")
+        return True
+
+    assert pa.prun(driver, pa.tpu, grid)
 
 
 def test_irregular_partition_falls_back():
