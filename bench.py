@@ -138,10 +138,18 @@ def bench_halo(n: int, backend, pa) -> dict:
     from partitionedarrays_jl_tpu.parallel.tpu_box import BoxExchangePlan
 
     dtype = np.float32
-    # the real 8-part plan, built host-side exactly as a 2x2x2 run would
+    # the real 8-part plan, built host-side exactly as a 2x2x2 run would.
+    # PA_BENCH_HALO_PERIODIC=1 benches the TORUS halo instead: wrapped
+    # ghosts ride the same slice-based box plan (tpu_box.py handles the
+    # wrap), so the periodic fast path's bandwidth is measurable on the
+    # same protocol (round-4 directive 6)
+    periodic = os.environ.get("PA_BENCH_HALO_PERIODIC", "0") == "1"
     seq = SequentialBackend()
     rows = pa.prun(
-        lambda parts: pa.prange(parts, (n, n, n), pa.with_ghost),
+        lambda parts: pa.prange(
+            parts, (n, n, n), pa.with_ghost,
+            periodic=(True, True, True) if periodic else None,
+        ),
         seq, (2, 2, 2),
     )
     plan = device_exchange_plan(rows, False)
@@ -182,17 +190,17 @@ def bench_halo(n: int, backend, pa) -> dict:
                 xv = jax.lax.dynamic_update_slice(
                     xv, buf, (g0 + opp.off,)
                 )
-            # one-element ghost->owned feedback: the owned region must
-            # EVOLVE across iterations (as it does in a real solver), or
-            # the compiler may hoist the loop-invariant packs and the
-            # chain would measure permute+unpack only. The fed-back cell
-            # is the HI corner (o0+no-1): part 0 of the non-periodic
-            # 2x2x2 split sends only positive-direction slabs, and the
-            # hi corner lies in every one of them — the lo corner lies
-            # in none and would leave the packs loop-invariant.
-            return xv.at[o0 + no - 1].add(
-                xv[g0] * jnp.asarray(1e-30, xv.dtype)
-            )
+            # one-element ghost->owned feedback per corner: the owned
+            # region must EVOLVE across iterations (as it does in a real
+            # solver), or the compiler may hoist the loop-invariant packs
+            # and the chain would measure permute+unpack only. The HI
+            # corner (o0+no-1) lies in every positive-direction slab
+            # (all part 0 sends on the non-periodic 2x2x2 split); the LO
+            # corner covers the negative-direction slabs the PERIODIC
+            # torus adds.
+            eps = jnp.asarray(1e-30, xv.dtype)
+            xv = xv.at[o0 + no - 1].add(xv[g0] * eps)
+            return xv.at[o0].add(xv[g0 + 1] * eps)
 
         @partial(jax.jit, static_argnums=1)
         def chain(x, k):
@@ -263,14 +271,17 @@ def bench_halo(n: int, backend, pa) -> dict:
         host_ts.append(time.perf_counter() - t0)
     host_dt = statistics.median(host_ts) / 8
     host_bw = payload_bytes / host_dt
+    kind = "torus" if periodic else "poisson3d"
     rec = {
-        "metric": f"halo_exchange_bytes_per_s_per_chip_poisson3d_{n}cube_f32",
+        "metric": f"halo_exchange_bytes_per_s_per_chip_{kind}_{n}cube_f32",
         "value": round(bw, 1),
         "unit": "B/s",
         "vs_baseline": round(bw / host_bw, 3),
         "host_oracle_bytes_per_s": round(host_bw, 1),
+        "plan": type(plan).__name__,
     }
-    if n == 192:  # the bands are calibrated on the 192-cube problem only
+    if n == 192 and not periodic:
+        # the bands are calibrated on the 192-cube non-periodic problem
         band_annotate(rec, "halo_bytes_per_s", bw)
     return rec
 

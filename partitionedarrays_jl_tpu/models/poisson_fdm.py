@@ -144,14 +144,20 @@ def stencil_ghost_slabs(lo, hi, ns) -> np.ndarray:
     return np.sort(np.concatenate(slabs))
 
 
-def _try_stencil_fast(rows, ns, center, arm_coefs, dtype, decoupled):
+def _try_stencil_fast(rows, ns, center, arm_coefs, dtype, decoupled,
+                      want_b=False):
     """COO-free structured assembly (round-4 directive 3): when every
     part is a Cartesian box within the int32 envelope and the native
     layer is up, emit each part's owned-rows CSR (local column ids)
     straight from box geometry (planning.cpp:stencil_emit_dim) and build
     the column PRange from the geometric ghost slabs — no volume-sized
-    triplet arrays, no gid->lid passes, no compresscoo. Returns None
-    when ineligible (callers run the generic COO path)."""
+    triplet arrays, no gid->lid passes, no compresscoo. Returns
+    ``(A, b_owned)`` — with ``want_b`` the kernel also evaluates
+    b = A @ x̂ against the manufactured field's per-dim tables in the
+    same pass (bit-identical to the host's phased mul_into), so the
+    owned/ghost block split never materializes during assembly;
+    b_owned is None otherwise. Returns None when ineligible (callers
+    run the generic COO path)."""
     from .. import native
     from ..ops.sparse import CSRMatrix
     from ..parallel.collectives import gather_all
@@ -183,22 +189,40 @@ def _try_stencil_fast(rows, ns, center, arm_coefs, dtype, decoupled):
     arm_vals = np.array(
         [c for pair in arm_coefs for c in pair], dtype=np.float64
     )
+    xtab = (
+        np.concatenate(
+            [
+                np.sin(
+                    0.5
+                    + (d + 1.0)
+                    * np.arange(ns[d], dtype=np.int64)
+                    / (ns[d] + 1.0)
+                )
+                for d in range(dim)
+            ]
+        )
+        if want_b
+        else None
+    )
 
     def _emit(iset, gg):
         res = native.stencil_emit(
             ns, iset.box_lo, iset.box_hi, center, arm_vals, gg, dtype,
-            decouple=decoupled,
+            decouple=decoupled, xtab=xtab,
         )
         check(
             res is not None,
             "stencil_emit declined after the eligibility check",
         )
-        indptr, cols_l, vals = res
+        indptr, cols_l, vals = res[:3]
         no = int(np.prod(iset.box_shape))
-        return CSRMatrix(indptr, cols_l, vals, (no, no + len(gg)))
+        M = CSRMatrix(indptr, cols_l, vals, (no, no + len(gg)))
+        return (M, res[3]) if want_b else (M, None)
 
-    values = map_parts(_emit, rows.partition, ghosts)
-    return PSparseMatrix(values, rows, cols)
+    out = map_parts(_emit, rows.partition, ghosts)
+    values = map_parts(lambda o: o[0], out)
+    b_owned = map_parts(lambda o: o[1], out) if want_b else None
+    return PSparseMatrix(values, rows, cols), b_owned
 
 
 def assemble_cartesian_stencil(
@@ -232,10 +256,15 @@ def assemble_cartesian_stencil(
     dim = len(ns)
     check(len(arm_coefs) == dim, "one (minus, plus) coefficient pair per dim")
     rows = cartesian_partition(parts, ns, no_ghost)
-    A = _try_stencil_fast(rows, ns, center, arm_coefs, dtype, decoupled)
-    fused = A is not None  # the fused path already emitted Â when decoupled
-    if not fused:
+    fast = _try_stencil_fast(
+        rows, ns, center, arm_coefs, dtype, decoupled, want_b=True
+    )
+    fused = fast is not None  # the fused path already emitted Â + b̂
+    if fused:
+        A, b_owned = fast
+    else:
         A = _assemble_stencil_coo(parts, rows, ns, center, arm_coefs, dtype)
+        b_owned = None
     cols = A.cols
 
     xe_vals = map_parts(
@@ -243,7 +272,21 @@ def assemble_cartesian_stencil(
         cols.partition,
     )
     x_exact = PVector(xe_vals, cols)
-    b = A @ x_exact  # on the fused decoupled path this IS b̂ = Â @ x̂
+    if b_owned is not None:
+        # b̂ came out of the emission kernel (bit-identical to the
+        # phased mul_into below) — ghost slots zero, like mul's target
+        b = PVector(
+            map_parts(
+                lambda i, bo: np.concatenate(
+                    [bo, np.zeros(i.num_hids, dtype=dtype)]
+                ),
+                cols.partition,
+                b_owned,
+            ),
+            cols,
+        )
+    else:
+        b = A @ x_exact  # fused+decoupled: this IS b̂ = Â @ x̂
     if decoupled and not fused:
         from .solvers import decouple_dirichlet
 

@@ -614,13 +614,29 @@ def jacobi_preconditioner(A: PSparseMatrix) -> PVector:
         if d is None:
             d = np.zeros(iset.num_oids, dtype=M.data.dtype)
             r = M.row_of_nz()
-            hits = np.nonzero(M.indices == r)[0]
+            hits = np.nonzero(
+                (M.indices == r) & (r < iset.num_oids)
+            )[0]
             d[r[hits]] = M.data[hits]
         d = np.where(d == 0, 1.0, d)
         _write_owned(iset, mv, 1.0 / d)
 
+    # diagonal entries live at col == row < num_oids, so the FULL local
+    # CSR answers directly whenever it has no ghost rows — reading it
+    # avoids forcing the owned/ghost block split (a second full copy of
+    # the operator in fresh pages at 1e8 DOFs); pre-assembly matrices
+    # with ghost rows keep the block path
+    no_ghost_rows = all(
+        m.shape[0] == i.num_oids
+        for m, i in zip(
+            A.values.part_values(), A.rows.partition.part_values()
+        )
+    )
     map_parts(
-        per_part, A.cols.partition, A.owned_owned_values, minv.values
+        per_part,
+        A.cols.partition,
+        A.values if no_ghost_rows else A.owned_owned_values,
+        minv.values,
     )
     return minv
 

@@ -172,10 +172,12 @@ def _load() -> Optional[ctypes.CDLL]:
             fn.argtypes = [
                 i64p, i64p, i64p, ctypes.c_int32, ctypes.c_double, f64p,
                 i64p, ctypes.c_int64, ctypes.c_int32, i32p, i32p, fp,
+                f64p, fp, ctypes.c_int32,
             ]
             fn.restype = ctypes.c_int64
         lib.pa_band_offsets.argtypes = [
             i32p, i32p, ctypes.c_int64, ctypes.c_int64, i64p,
+            ctypes.c_int64,
         ]
         lib.pa_band_offsets.restype = ctypes.c_int64
         for name, fp in (
@@ -184,9 +186,20 @@ def _load() -> Optional[ctypes.CDLL]:
             fn = getattr(lib, name)
             fn.argtypes = [
                 i32p, i32p, fp, ctypes.c_int64, i64p, ctypes.c_int64,
-                ctypes.c_int64, f64p, u8p,
+                ctypes.c_int64, f64p, u8p, ctypes.c_int64,
             ]
             fn.restype = ctypes.c_int64
+        lib.pa_count_ge.argtypes = [i32p, ctypes.c_int64, ctypes.c_int32]
+        lib.pa_count_ge.restype = ctypes.c_int64
+        for name, fp in (
+            ("pa_csr_extract_hi_f64", f64p), ("pa_csr_extract_hi_f32", f32p),
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                i32p, i32p, fp, ctypes.c_int64, ctypes.c_int32,
+                i32p, i32p, fp,
+            ]
+            fn.restype = None
         _lib = lib
     except Exception:
         _lib = None
@@ -536,7 +549,8 @@ def galerkin_emit(
 
 
 def stencil_emit(
-    dims, lo, hi, center, arm_vals, ghost_gids, dtype, decouple=False
+    dims, lo, hi, center, arm_vals, ghost_gids, dtype, decouple=False,
+    xtab=None,
 ):
     """Fused Dirichlet-identity Cartesian-stencil assembly straight to
     column-sorted per-part CSR with local column ids (owned-box C-order,
@@ -544,9 +558,14 @@ def stencil_emit(
     order for a sorted input). See planning.cpp:stencil_emit_dim.
     ``decouple`` zeroes interior->boundary coupling VALUES in place
     (pattern preserved), emitting the `decouple_dirichlet`'d operator
-    directly. Returns (indptr, cols, vals) or None when the native layer
-    is absent / dim > 3 / the int32 envelope is exceeded (callers fall
-    back to the COO assembly path)."""
+    directly. ``xtab`` (a concatenated per-dim float64 table, one entry
+    per global coordinate) additionally computes b = A @ x^ in the same
+    pass, where x^ is the tables' left-to-right sum cast to `dtype` —
+    bit-identical to evaluating the manufactured field and running the
+    host's phased mul_into, WITHOUT materializing the owned/ghost block
+    split. Returns (indptr, cols, vals[, b]) or None when the native
+    layer is absent / dim > 3 / the int32 envelope is exceeded (callers
+    fall back to the COO assembly path)."""
     lib = _load()
     dim = len(dims)
     dt = np.dtype(dtype).name
@@ -561,9 +580,22 @@ def stencil_emit(
     indptr = np.empty(no + 1, dtype=np.int32)
     cols = np.empty(cap, dtype=np.int32)
     vals = np.empty(cap, dtype=dtype)
+    with_b = xtab is not None
+    if with_b:
+        xt = np.ascontiguousarray(xtab, dtype=np.float64)
+        if len(xt) != int(np.sum(dims)):
+            raise ValueError(
+                "stencil_emit: xtab must hold one entry per global "
+                "coordinate"
+            )
+        bout = np.empty(max(no, 1), dtype=dtype)
+    else:
+        xt = np.zeros(1, dtype=np.float64)
+        bout = np.empty(1, dtype=dtype)
     if no == 0:
         indptr[:] = 0
-        return indptr, cols[:0], vals[:0]
+        out = (indptr, cols[:0], vals[:0])
+        return out + (bout[:0],) if with_b else out
     gg = np.ascontiguousarray(ghost_gids, dtype=np.int64)
     fn = getattr(lib, f"pa_stencil_emit_{_FLOAT_FN[dt]}")
     w = fn(
@@ -579,27 +611,36 @@ def stencil_emit(
         indptr,
         cols,
         vals,
+        xt,
+        bout,
+        1 if with_b else 0,
     )
     if w < 0:
         return None
     if w < (cap * 3) // 4:  # boundary-heavy part: don't pin dead capacity
-        return indptr, cols[:w].copy(), vals[:w].copy()
-    return indptr, cols[:w], vals[:w]
+        out = (indptr, cols[:w].copy(), vals[:w].copy())
+    else:
+        out = (indptr, cols[:w], vals[:w])
+    return out + (bout,) if with_b else out
 
 
-def band_offsets(indptr, cols, m: int, K: int):
+def band_offsets(indptr, cols, m: int, K: int, col_limit: int = 2**31):
     """Sorted distinct band offsets (j - i) of a column-sorted CSR,
     capped at K. Returns ``(offsets, ok)``: ok=False means MORE than K
-    distinct offsets exist (offsets=None, scan stopped early). Falls
-    back to the NumPy unique (full result, ok judged by length) when the
-    native layer is absent."""
+    distinct offsets exist (offsets=None, scan stopped early).
+    ``col_limit`` skips columns >= it (the sorted ghost tail of a
+    FULL-row CSR — the no-split lowering analyzes A_oo without ever
+    materializing it). Falls back to the NumPy unique (full result, ok
+    judged by length) when the native layer is absent."""
     lib = _load()
     if lib is None or len(cols) >= 2**31:
         ip = np.asarray(indptr)
         r = np.repeat(
             np.arange(m, dtype=np.int64), np.diff(ip[: m + 1])
         )
-        u = np.unique(np.asarray(cols, dtype=np.int64) - r)
+        c = np.asarray(cols, dtype=np.int64)
+        keep = c < col_limit
+        u = np.unique(c[keep] - r[keep])
         return (u, True) if len(u) <= K else (None, False)
     out = np.empty(K, dtype=np.int64)
     cnt = lib.pa_band_offsets(
@@ -608,20 +649,61 @@ def band_offsets(indptr, cols, m: int, K: int):
         m,
         K,
         out,
+        col_limit,
     )
     if cnt < 0:
         return None, False
     return out[:cnt].copy(), True
 
 
-def dia_classify(indptr, cols, vals, m: int, offsets, K: int):
+def count_ge(cols, thr: int):
+    """Number of entries with column >= thr (no bool temporary), or None
+    when the native layer is absent."""
+    lib = _load()
+    if lib is None or len(cols) >= 2**31:
+        return None
+    return int(
+        lib.pa_count_ge(
+            np.ascontiguousarray(cols, dtype=np.int32), len(cols), thr
+        )
+    )
+
+
+def csr_extract_hi(indptr, cols, vals, m: int, thr: int):
+    """The (cols >= thr) side of a full-row CSR as its own CSR (columns
+    remapped by -thr) WITHOUT materializing the lo side — the A_oh
+    boundary block is surface-sized while the split's lo half would be a
+    second full copy of the operator. Returns (ip, cols, vals) or None
+    when the native layer is absent / dtype out of envelope."""
+    lib = _load()
+    dt = np.dtype(np.asarray(vals).dtype).name
+    if lib is None or dt not in _FLOAT_FN or len(cols) >= 2**31:
+        return None
+    n_hi = count_ge(cols, thr)
+    if n_hi is None:
+        return None
+    ip = np.ascontiguousarray(indptr, dtype=np.int32)
+    c = np.ascontiguousarray(cols, dtype=np.int32)
+    v = np.ascontiguousarray(vals)
+    ip_hi = np.empty(m + 1, dtype=np.int32)
+    c_hi = np.empty(n_hi, dtype=np.int32)
+    v_hi = np.empty(n_hi, dtype=v.dtype)
+    fn = getattr(lib, f"pa_csr_extract_hi_{_FLOAT_FN[dt]}")
+    fn(ip, c, v, m, thr, ip_hi, c_hi, v_hi)
+    return ip_hi, c_hi, v_hi
+
+
+def dia_classify(
+    indptr, cols, vals, m: int, offsets, K: int, col_limit: int = 2**31
+):
     """Row classes (distinct per-row diagonal-value tuples, absent
     diagonals 0) of a banded CSR in one fused pass — the dense-DIA-free
     form of `dia_fill` + `row_classes` (planning.cpp:dia_classify_impl,
     identical classes in identical first-touch order). Returns
     ``(class_table, codes, ok)``; ok=False when the native layer is
     absent, a (K+1)-th class appears, or an entry's offset is missing
-    from `offsets` — callers then run the dense-DIA path."""
+    from `offsets` — callers then run the dense-DIA path. ``col_limit``
+    skips the sorted ghost tail of full-row CSRs (see band_offsets)."""
     lib = _load()
     dt = np.dtype(np.asarray(vals).dtype).name
     D = len(offsets)
@@ -640,6 +722,7 @@ def dia_classify(indptr, cols, vals, m: int, offsets, K: int):
         K,
         table,
         codes,
+        col_limit,
     )
     if cnt < 0:
         return None, None, False

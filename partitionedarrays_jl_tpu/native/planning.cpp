@@ -370,6 +370,26 @@ int64_t pa_ic0_f64(const int32_t* indptr, const int32_t* cols,
 
 }  // extern "C"
 
+// Extract the (cols >= thr) side of a column-sorted full-row CSR as its
+// own CSR (columns remapped by -thr) WITHOUT materializing the lo side
+// — see pa_count_ge above for the sizing pass.
+template <typename T>
+static void csr_extract_hi_impl(const int32_t* indptr, const int32_t* cols,
+                                const T* vals, int64_t m, int32_t thr,
+                                int32_t* ip_hi, int32_t* c_hi, T* v_hi) {
+    int64_t w = 0;
+    ip_hi[0] = 0;
+    for (int64_t r = 0; r < m; ++r) {
+        for (int32_t a = indptr[r]; a < indptr[r + 1]; ++a) {
+            if (cols[a] >= thr) {
+                c_hi[w] = cols[a] - thr;
+                v_hi[w++] = vals[a];
+            }
+        }
+        ip_hi[r + 1] = (int32_t)w;
+    }
+}
+
 // Fused host CSR SpMV y = A x: one pass over (cols, vals), no nnz-sized
 // product temporary (the NumPy form materializes x[cols], multiplies,
 // then reduceat-scans — three volume passes and ~2 nnz-sized
@@ -425,11 +445,13 @@ static int64_t dia_fill_impl(const int32_t* indptr, const int32_t* cols,
 // insert. Returns the count, or -1 as soon as a (K+1)-th distinct
 // offset appears.
 static int64_t band_offsets_impl(const int32_t* indptr, const int32_t* cols,
-                                 int64_t m, int64_t K, int64_t* out) {
+                                 int64_t m, int64_t K, int64_t* out,
+                                 int64_t col_limit) {
     int64_t cnt = 0;
     for (int64_t i = 0; i < m; ++i) {
         int64_t d = 0;
         for (int32_t k = indptr[i]; k < indptr[i + 1]; ++k) {
+            if (cols[k] >= col_limit) break;  // sorted: ghost tail starts
             const int64_t off = (int64_t)cols[k] - i;
             if (d < cnt && out[d] == off) {
                 ++d;
@@ -469,7 +491,7 @@ static int64_t dia_classify_impl(const int32_t* indptr, const int32_t* cols,
                                  const T* vals, int64_t m,
                                  const int64_t* offsets, int64_t D,
                                  int64_t K, double* class_table,
-                                 uint8_t* codes) {
+                                 uint8_t* codes, int64_t col_limit) {
     double row[64];  // D <= DIA_MAX_OFFSETS = 64
     if (D > 64) return -1;
     int64_t cnt = 0, last = 0;
@@ -483,6 +505,7 @@ static int64_t dia_classify_impl(const int32_t* indptr, const int32_t* cols,
         for (int64_t d = 0; d < D; ++d) row[d] = 0.0;
         int64_t d = 0;
         for (int32_t k = indptr[i]; k < indptr[i + 1]; ++k) {
+            if (cols[k] >= col_limit) break;  // sorted: ghost tail starts
             const int64_t off = (int64_t)cols[k] - i;
             if (!(d < D && offsets[d] == off)) {
                 d = 0;
@@ -982,13 +1005,24 @@ static int64_t galerkin_emit_impl(const double* acc, const int64_t* cdims,
 // b^ = A^ @ x^, so the separate np.add.at classification passes never
 // run. Returns nnz, or -1 when an out-of-box neighbor is missing from
 // the ghost table (caller falls back to the COO path).
+// With `bout` non-null the kernel ALSO computes b = A @ x^ in the same
+// pass, where x^(c) = (T)(xtab_0[c_0] + ... + xtab_{d-1}[c_{d-1}])
+// (per-dim f64 tables summed left-to-right then cast — exactly the
+// manufactured-solution evaluation). The accumulation replicates the
+// host mul_into phases bit-for-bit: owned-column products summed
+// left-to-right in emitted (column) order, ghost-column products in a
+// SEPARATE accumulator added once at the end — and only when the part
+// has any ghosts at all (phase 2 is skipped part-wide otherwise, which
+// matters for -0.0). This removes the only consumer that forced the
+// owned/ghost block split during assembly.
 template <typename T, int DIM>
 static int64_t stencil_emit_dim(const int64_t* dims, const int64_t* lo,
                                 const int64_t* hi, double center,
                                 const double* arm_vals,
                                 const int64_t* ghost_gids, int64_t n_ghost,
                                 int32_t decouple, int32_t* indptr,
-                                int32_t* cols, T* vals) {
+                                int32_t* cols, T* vals,
+                                const double* xtab, T* bout) {
     int64_t gstride[DIM], bstride[DIM], box[DIM];
     gstride[DIM - 1] = bstride[DIM - 1] = 1;
     for (int d = 0; d < DIM; ++d) box[d] = hi[d] - lo[d];
@@ -1012,6 +1046,25 @@ static int64_t stencil_emit_dim(const int64_t* dims, const int64_t* lo,
         arms[2 * DIM - d] = {d, +1, bstride[d], arm_vals[2 * d + 1]};
     }
     arms[DIM] = {-1, 0, 0, center};
+    // per-dim table base offsets into the concatenated xtab
+    const double* tab[DIM];
+    if (xtab) {
+        const double* p = xtab;
+        for (int d = 0; d < DIM; ++d) {
+            tab[d] = p;
+            p += dims[d];
+        }
+    }
+    const bool has_ghosts = n_ghost > 0;
+    auto xhat = [&](const int64_t* cc, int d_off, int64_t off) -> T {
+        // x^ at cc with coordinate d_off shifted by off: per-dim table
+        // values summed left-to-right in f64, then cast — the exact
+        // evaluation order of the manufactured-solution tables
+        double s = 0.0;
+        for (int d = 0; d < DIM; ++d)
+            s += tab[d][cc[d] + (d == d_off ? off : 0)];
+        return (T)s;
+    };
     int64_t w = 0;
     indptr[0] = 0;
     int64_t c[DIM];
@@ -1020,9 +1073,11 @@ static int64_t stencil_emit_dim(const int64_t* dims, const int64_t* lo,
         bool bnd = false;
         for (int d = 0; d < DIM; ++d)
             bnd |= (c[d] == 0) | (c[d] == dims[d] - 1);
+        T acc_o = 0, acc_h = 0;
         if (bnd) {  // Dirichlet identity row
             cols[w] = (int32_t)r;
             vals[w++] = (T)1.0;
+            if (bout) acc_o = (T)1.0 * xhat(c, -1, 0);
         } else {
             // pass 1: in-box columns (ascending lid == ascending gid)
             for (int k = 0; k < 2 * DIM + 1; ++k) {
@@ -1030,6 +1085,7 @@ static int64_t stencil_emit_dim(const int64_t* dims, const int64_t* lo,
                 if (a.d < 0) {
                     cols[w] = (int32_t)r;
                     vals[w++] = (T)a.coef;
+                    if (bout) acc_o += (T)a.coef * xhat(c, -1, 0);
                     continue;
                 }
                 const int64_t c2 = c[a.d] + a.off;
@@ -1040,6 +1096,7 @@ static int64_t stencil_emit_dim(const int64_t* dims, const int64_t* lo,
                 if (decouple && (c2 == 0 || c2 == dims[a.d] - 1)) v = 0.0;
                 cols[w] = (int32_t)(r + a.ld);
                 vals[w++] = (T)v;
+                if (bout) acc_o += (T)v * xhat(c, a.d, a.off);
             }
             // pass 2: ghost columns (sorted-table ranks ascend with gid)
             int64_t gid = 0;
@@ -1057,7 +1114,14 @@ static int64_t stencil_emit_dim(const int64_t* dims, const int64_t* lo,
                 if (decouple && (c2 == 0 || c2 == dims[a.d] - 1)) v = 0.0;
                 cols[w] = (int32_t)(no + (p - ghost_gids));
                 vals[w++] = (T)v;
+                if (bout) acc_h += (T)v * xhat(c, a.d, a.off);
             }
+        }
+        if (bout) {
+            // phase-1 writes into a zeroed c (0 + acc: flips any -0.0
+            // partial to +0.0, as the host does), phase 2 adds
+            const T b0 = (T)0 + acc_o;
+            bout[r] = has_ghosts ? b0 + acc_h : b0;
         }
         indptr[r + 1] = (int32_t)w;
         for (int d = DIM - 1; d >= 0; --d) {  // advance c in C-order
@@ -1074,19 +1138,20 @@ static int64_t stencil_emit_impl(const int64_t* dims, const int64_t* lo,
                                  double center, const double* arm_vals,
                                  const int64_t* ghost_gids, int64_t n_ghost,
                                  int32_t decouple, int32_t* indptr,
-                                 int32_t* cols, T* vals) {
+                                 int32_t* cols, T* vals,
+                                 const double* xtab, T* bout) {
     if (dim == 3)
         return stencil_emit_dim<T, 3>(dims, lo, hi, center, arm_vals,
                                       ghost_gids, n_ghost, decouple, indptr,
-                                      cols, vals);
+                                      cols, vals, xtab, bout);
     if (dim == 2)
         return stencil_emit_dim<T, 2>(dims, lo, hi, center, arm_vals,
                                       ghost_gids, n_ghost, decouple, indptr,
-                                      cols, vals);
+                                      cols, vals, xtab, bout);
     if (dim == 1)
         return stencil_emit_dim<T, 1>(dims, lo, hi, center, arm_vals,
                                       ghost_gids, n_ghost, decouple, indptr,
-                                      cols, vals);
+                                      cols, vals, xtab, bout);
     return -2;  // unsupported dim: the Python wrapper guards dim <= 3
 }
 
@@ -1203,35 +1268,57 @@ int64_t pa_galerkin_emit_f32(const double* acc, const int64_t* cdims,
 }
 
 int64_t pa_band_offsets(const int32_t* indptr, const int32_t* cols,
-                        int64_t m, int64_t K, int64_t* out) {
-    return band_offsets_impl(indptr, cols, m, K, out);
+                        int64_t m, int64_t K, int64_t* out,
+                        int64_t col_limit) {
+    return band_offsets_impl(indptr, cols, m, K, out, col_limit);
 }
 
 int64_t pa_dia_classify_f64(const int32_t* indptr, const int32_t* cols,
                             const double* vals, int64_t m,
                             const int64_t* offsets, int64_t D, int64_t K,
-                            double* class_table, uint8_t* codes) {
+                            double* class_table, uint8_t* codes,
+                            int64_t col_limit) {
     return dia_classify_impl<double>(indptr, cols, vals, m, offsets, D, K,
-                                     class_table, codes);
+                                     class_table, codes, col_limit);
 }
 
 int64_t pa_dia_classify_f32(const int32_t* indptr, const int32_t* cols,
                             const float* vals, int64_t m,
                             const int64_t* offsets, int64_t D, int64_t K,
-                            double* class_table, uint8_t* codes) {
+                            double* class_table, uint8_t* codes,
+                            int64_t col_limit) {
     return dia_classify_impl<float>(indptr, cols, vals, m, offsets, D, K,
-                                    class_table, codes);
+                                    class_table, codes, col_limit);
 }
+
+// Count entries with column >= thr (the A_oh side of a column-sorted
+// full-row CSR) without a bool temp, then extract ONLY that side —
+// the no-split lowering's surface-sized boundary block (the full+halves
+// materialization it replaces cost ~2x the operator in fresh pages).
+int64_t pa_count_ge(const int32_t* cols, int64_t nnz, int32_t thr) {
+    int64_t c = 0;
+    for (int64_t k = 0; k < nnz; ++k) c += cols[k] >= thr;
+    return c;
+}
+
+void pa_csr_extract_hi_f64(const int32_t* indptr, const int32_t* cols,
+                           const double* vals, int64_t m, int32_t thr,
+                           int32_t* ip_hi, int32_t* c_hi, double* v_hi);
+void pa_csr_extract_hi_f32(const int32_t* indptr, const int32_t* cols,
+                           const float* vals, int64_t m, int32_t thr,
+                           int32_t* ip_hi, int32_t* c_hi, float* v_hi);
 
 int64_t pa_stencil_emit_f64(const int64_t* dims, const int64_t* lo,
                             const int64_t* hi, int32_t dim, double center,
                             const double* arm_vals,
                             const int64_t* ghost_gids, int64_t n_ghost,
                             int32_t decouple, int32_t* indptr,
-                            int32_t* cols, double* vals) {
+                            int32_t* cols, double* vals, const double* xtab,
+                            double* bout, int32_t with_b) {
     return stencil_emit_impl<double>(dims, lo, hi, dim, center, arm_vals,
                                      ghost_gids, n_ghost, decouple, indptr,
-                                     cols, vals);
+                                     cols, vals, with_b ? xtab : nullptr,
+                                     with_b ? bout : nullptr);
 }
 
 int64_t pa_stencil_emit_f32(const int64_t* dims, const int64_t* lo,
@@ -1239,10 +1326,26 @@ int64_t pa_stencil_emit_f32(const int64_t* dims, const int64_t* lo,
                             const double* arm_vals,
                             const int64_t* ghost_gids, int64_t n_ghost,
                             int32_t decouple, int32_t* indptr,
-                            int32_t* cols, float* vals) {
+                            int32_t* cols, float* vals, const double* xtab,
+                            float* bout, int32_t with_b) {
     return stencil_emit_impl<float>(dims, lo, hi, dim, center, arm_vals,
                                     ghost_gids, n_ghost, decouple, indptr,
-                                    cols, vals);
+                                    cols, vals, with_b ? xtab : nullptr,
+                                    with_b ? bout : nullptr);
+}
+
+void pa_csr_extract_hi_f64(const int32_t* indptr, const int32_t* cols,
+                           const double* vals, int64_t m, int32_t thr,
+                           int32_t* ip_hi, int32_t* c_hi, double* v_hi) {
+    csr_extract_hi_impl<double>(indptr, cols, vals, m, thr, ip_hi, c_hi,
+                                v_hi);
+}
+
+void pa_csr_extract_hi_f32(const int32_t* indptr, const int32_t* cols,
+                           const float* vals, int64_t m, int32_t thr,
+                           int32_t* ip_hi, int32_t* c_hi, float* v_hi) {
+    csr_extract_hi_impl<float>(indptr, cols, vals, m, thr, ip_hi, c_hi,
+                               v_hi);
 }
 
 void pa_csr_spmv_f64(const int32_t* indptr, const int32_t* cols,

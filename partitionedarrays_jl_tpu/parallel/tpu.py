@@ -535,11 +535,10 @@ class DeviceMatrix:
     _CLS_CAP = 64
 
     def __init__(self, A: PSparseMatrix, backend: TPUBackend, padded=None):
-        from ..ops.sparse import ELLMatrix
+        from ..ops.sparse import CSRMatrix, ELLMatrix
+        from .. import native
 
         jax = _jax()
-        oo = A.owned_owned_values.part_values()
-        oh = A.owned_ghost_values.part_values()
         isets = A.rows.partition.part_values()
         P = len(isets)
         noids = np.array([i.num_oids for i in isets], dtype=np.int64)
@@ -550,11 +549,54 @@ class DeviceMatrix:
         # the exact order of the host csr_spmv + mul_into pair, whereas
         # the DIA kernels sum in frame-offset order, which interleaves
         # ghost terms on boundary rows (equal only to rounding)
-        det = (
-            None
-            if strict_bits()
-            else self._detect_dia(A, oo, P, noids, no_max, np.dtype(dt).itemsize)
-        )
+        det = None
+        oo = oh = None
+        full = A.values.part_values()
+        if (
+            not strict_bits()
+            and A._blocks is None
+            and all(
+                full[p].shape[0] == int(noids[p]) for p in range(P)
+            )
+        ):
+            # NO-SPLIT fast path (round 4): analyze the band structure
+            # straight off the full (column-sorted, owned-first) local
+            # CSRs — each part's sorted ghost tail is skipped by column
+            # limit — and extract only the surface-sized A_oh side. The
+            # owned/ghost block split it avoids materializes a second
+            # full copy of the operator in fresh pages (~65 s of the
+            # 1e8-DOF assembly+lowering on the slow-fault bench host).
+            det = self._detect_dia(
+                A, full, P, noids, no_max, np.dtype(dt).itemsize,
+                col_limits=noids, fused_only=True,
+            )
+            if det is not None:
+                oh = []
+                for p in range(P):
+                    M = full[p]
+                    res = native.csr_extract_hi(
+                        M.indptr, M.indices, M.data, M.shape[0],
+                        int(noids[p]),
+                    )
+                    if res is None:
+                        oh = None
+                        break
+                    ip_hi, c_hi, v_hi = res
+                    oh.append(
+                        CSRMatrix(
+                            ip_hi, c_hi, v_hi,
+                            (M.shape[0], M.shape[1] - int(noids[p])),
+                        )
+                    )
+                if oh is None:
+                    det = None
+        if det is None:
+            oo = A.owned_owned_values.part_values()
+            oh = A.owned_ghost_values.part_values()
+            if not strict_bits():
+                det = self._detect_dia(
+                    A, oo, P, noids, no_max, np.dtype(dt).itemsize
+                )
         if padded is None:
             # the padded vector frame only pays off when the in-frame coded
             # kernel can actually run; otherwise stay compact even on TPU
@@ -569,8 +611,10 @@ class DeviceMatrix:
         self.backend = backend
         L_oh = max((int(m.row_lengths().max()) if m.nnz else 0 for m in oh), default=0)
         L_oh = max(L_oh, 1)
-        self.flops_per_spmv = 2 * sum(
-            oo[p].nnz + oh[p].nnz for p in range(P)
+        self.flops_per_spmv = 2 * (
+            sum(m.nnz for m in full)
+            if oo is None
+            else sum(oo[p].nnz + oh[p].nnz for p in range(P))
         )
         self.bsr_cols = self.bsr_vals = self.bsr_bs = None
         self.sd_idx = self.sd_vals = self.sd_g = self.sd_bs = None
@@ -726,7 +770,7 @@ class DeviceMatrix:
                 # Rows past a part's noids stay code 0; they are masked
                 # by dia_no in the kernel either way.
                 for p in range(P):
-                    n_o = oo[p].shape[0]
+                    n_o = int(noids[p])
                     for j, d in enumerate(coded):
                         u = uniq[p][d]
                         if len(u):
@@ -782,9 +826,13 @@ class DeviceMatrix:
             if dia is None:
                 # fused analysis skipped the dense diagonals, but this
                 # branch (explicit padded=True with no padded plan) needs
-                # them as the staging source — rebuild here (review r4)
+                # them as the staging source — rebuild here (review r4).
+                # The no-split path also skipped the block split; this
+                # rare branch materializes it (correctness over speed)
                 from .. import native as _native
 
+                if oo is None:
+                    oo = A.owned_owned_values.part_values()
                 off_arr = np.array(offsets)
                 dia = np.zeros((P, D, no_max))
                 for p in range(P):
@@ -1048,7 +1096,8 @@ class DeviceMatrix:
 
     @classmethod
     def _analyze_dia_classes(
-        cls, oo, P, noids, no_max, offsets, off_arr, itemsize
+        cls, oo, P, noids, no_max, offsets, off_arr, itemsize,
+        col_limits=None,
     ):
         """Dense-DIA-free coded-diagonal analysis (round-4): one fused
         pass per part classifies rows by their diagonal-value tuple
@@ -1075,6 +1124,10 @@ class DeviceMatrix:
                 t, c, ok = native.dia_classify(
                     M.indptr, M.indices, M.data, M.shape[0], off_arr,
                     cls._CLS_CAP,
+                    col_limit=(
+                        int(col_limits[p]) if col_limits is not None
+                        else 2**31
+                    ),
                 )
                 if not ok:
                     return None
@@ -1125,7 +1178,10 @@ class DeviceMatrix:
         }
 
     @classmethod
-    def _detect_dia(cls, A, oo, P, noids, no_max, itemsize):
+    def _detect_dia(
+        cls, A, oo, P, noids, no_max, itemsize, col_limits=None,
+        fused_only=False,
+    ):
         """Band structure analysis of the A_oo block, run *before* the
         layout choice (the padded frame is only worth it when the coded
         kernel applies). Returns None when A_oo is not a (square, narrow)
@@ -1172,9 +1228,17 @@ class DeviceMatrix:
             if M.nnz:
                 # fused one-pass scan (planning.cpp:band_offsets_impl) —
                 # the nnz-sized astype + row repeat + unique sort it
-                # replaces dominated band detection at 1e8 DOFs
+                # replaces dominated band detection at 1e8 DOFs.
+                # col_limits: `oo` is then the FULL local CSR per part
+                # and the sorted ghost tail is skipped per row (the
+                # no-split lowering; `fused_only` declines instead of
+                # running the dense path, which needs real blocks)
                 u, ok = native.band_offsets(
-                    M.indptr, M.indices, M.shape[0], cls.DIA_MAX_OFFSETS
+                    M.indptr, M.indices, M.shape[0], cls.DIA_MAX_OFFSETS,
+                    col_limit=(
+                        int(col_limits[p]) if col_limits is not None
+                        else 2**31
+                    ),
                 )
                 if not ok:
                     return None
@@ -1186,10 +1250,13 @@ class DeviceMatrix:
         off_arr = np.array(offsets)
 
         fused = cls._analyze_dia_classes(
-            oo, P, noids, no_max, offsets, off_arr, itemsize
+            oo, P, noids, no_max, offsets, off_arr, itemsize,
+            col_limits=col_limits,
         )
         if fused is not None:
             return fused
+        if fused_only:
+            return None  # dense detection needs the real A_oo blocks
         # dense per-diagonal values on host: detection + staging source.
         # Entry (r, r+o) of part p goes to diagonal o; ascending offsets ==
         # ascending column order per row, so the accumulation order (and
