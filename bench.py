@@ -166,6 +166,16 @@ def bench_halo(n: int, backend, pa) -> dict:
 
     if isinstance(plan, BoxExchangePlan):
         info = plan.info
+        if len(info.box_shapes) > 1:
+            # the manual-slab leg below reads single-variant geometry
+            # (info.box_shape, d.start/d.shape); an n not divisible by
+            # the 2x2x2 split yields a multi-variant plan that this
+            # protocol cannot replay part-0-only — fail loudly instead
+            # of asserting deep in BoxInfo.box_shape (advisor r4)
+            raise NotImplementedError(
+                "bench_halo's manual-slab protocol needs equal per-part "
+                f"boxes; n={n} is not divisible by the 2x2x2 split"
+            )
         o0, g0 = layout.o0, layout.g0
         no = int(np.prod(info.box_shape))
         bs = info.box_shape

@@ -14,6 +14,7 @@ the whole CG loop is one compiled program.
 from __future__ import annotations
 
 import math
+import os
 from typing import Sequence, Tuple
 
 import numpy as np
@@ -164,7 +165,8 @@ def _try_stencil_fast(rows, ns, center, arm_coefs, dtype, decoupled,
 
     dim = len(ns)
     if (
-        not native.available()
+        os.environ.get("PA_TPU_STENCIL_FAST", "1") == "0"
+        or not native.available()
         or dim > 3
         or np.dtype(dtype).name not in ("float64", "float32")
     ):

@@ -614,6 +614,10 @@ def jacobi_preconditioner(A: PSparseMatrix) -> PVector:
         if d is None:
             d = np.zeros(iset.num_oids, dtype=M.data.dtype)
             r = M.row_of_nz()
+            # defensive only: both dispatch arms below pass matrices
+            # whose rows are all < num_oids (the full CSR is only read
+            # when it has no ghost rows; A_oo has owned rows by
+            # construction) — the bound guards d against future callers
             hits = np.nonzero(
                 (M.indices == r) & (r < iset.num_oids)
             )[0]

@@ -928,11 +928,11 @@ class DeviceMatrix:
                 P * ngr_max * (G * bs) * width * np.dtype(dt).itemsize
             )
             if sd_bytes > cls.SD_MAX_BYTES:
-                return None
+                continue  # a smaller bs may still fit the budget
             # padding must not reintroduce the gathers it saves: require
             # the padded external gather count to beat BSR's block count
             if (P * ngr_max * emax) * bs * bs > 0.7 * nnz:
-                return None
+                continue
             idx = np.zeros((P, ngr_max, emax), dtype=INDEX_DTYPE)
             # allocate in the operator dtype directly: an f64 temp would
             # double the peak against the SD_MAX_BYTES budget (review r4)

@@ -43,6 +43,41 @@ def main():
     backend = TPUBackend(devices=jax.devices()[:1])
     rec = {"n": n, "dofs": n**3, "dtype": "float32", "tol": tol}
 
+    # persistent compilation cache (round-5 directive 1): cold = compile
+    # into a FRESH cache dir (so the recorded cold number is honest even
+    # when the bench reruns); warm = clear the in-process executable
+    # caches, rebuild the same program, and let XLA load from disk. A
+    # production run points PA_TPU_COMPILE_CACHE at a persistent dir and
+    # pays the warm number on every process after the first.
+    cache_on = os.environ.get("PA_SCALE_CACHE", "1") != "0"
+    if cache_on:
+        import tempfile
+
+        cache_dir = os.environ.get("PA_SCALE_CACHE_DIR") or tempfile.mkdtemp(
+            prefix="pa_scale_xla_"
+        )
+        pa.enable_compilation_cache(cache_dir)
+        rec["compile_cache_dir"] = cache_dir
+        # a reused PA_SCALE_CACHE_DIR serves the FIRST solve from disk
+        # too — record it so a "cold ~= warm" artifact is explainable
+        rec["cold_cache_prepopulated"] = bool(os.listdir(cache_dir))
+
+    def _warm_compile(build_fn, *call_args):
+        """Clear in-process executable caches, rebuild the compiled
+        program, run one call (served from the persistent cache), and
+        return (seconds, out) — (None, None) if the relay flakes (the
+        steady-state numbers recorded before this call must survive)."""
+        try:
+            jax.clear_caches()
+            t0 = time.perf_counter()
+            fn = build_fn()
+            out = fn(*call_args)
+            jax.block_until_ready(out)
+            return round(time.perf_counter() - t0, 2), out
+        except Exception as e:  # relay remote_compile drops responses
+            print(f"warm compile failed (non-fatal): {e}", flush=True)
+            return None, None
+
     def driver(parts):
         # round-4 fused pipeline: assemble DIRECTLY in f32 with the
         # Dirichlet decoupling applied in-kernel (b̂ = Â @ x̂ exactly for
@@ -80,6 +115,7 @@ def main():
         out = solve(db.data, dx0.data, None)
         it = int(out[3])
         rec["first_solve_s"] = round(time.perf_counter() - t0, 2)
+        rec["first_solve_cold_s"] = rec["first_solve_s"]
         t0 = time.perf_counter()
         out = solve(db.data, dx0.data, None)
         rs, rs0, it = float(out[1]), float(out[2]), int(out[3])
@@ -103,7 +139,26 @@ def main():
             flush=True,
         )
         assert rec["converged"], rec
+        # warm-compile measurement LAST in the leg: it clears the
+        # in-process executable caches, which would otherwise pollute
+        # the steady solve_s above with a retrace
         _flush()  # the CG leg's numbers survive any GMG-leg failure
+        if cache_on:
+            warm_s, wout = _warm_compile(
+                lambda: make_cg_fn(dA, tol=tol, maxiter=20000),
+                db.data, dx0.data, None,
+            )
+            if warm_s is not None:
+                rec["first_solve_warm_s"] = warm_s
+                # the disk-cached executable must be the SAME program:
+                # the warm solve's iterate count must match the cold one
+                assert int(wout[3]) == it, (int(wout[3]), it)
+                print(
+                    f"first solve: cold {rec['first_solve_cold_s']}s, "
+                    f"warm {warm_s}s (persistent cache)",
+                    flush=True,
+                )
+                _flush()
 
         # --- GMG-PCG leg: the headline capability at the headline scale
         # (CG iteration counts grow ~O(n); multigrid's stay flat) -------
@@ -156,6 +211,7 @@ def main():
                 return True
             g.pop("compile_error", None)
             g["first_solve_s"] = round(time.perf_counter() - t0, 2)
+            g["first_solve_cold_s"] = g["first_solve_s"]
             g["iterations"] = git
             _flush()  # survive flakes in the remaining legs
             t0 = time.perf_counter()
@@ -184,6 +240,27 @@ def main():
                 flush=True,
             )
             assert g["converged"], g
+            _flush()  # steady GMG numbers survive a warm-compile flake
+            if cache_on:
+                warm_s, wout = _warm_compile(
+                    lambda: make_gmg_pcg_fn(h, backend, tol, 200),
+                    dbg.data, dx0g.data,
+                )
+                if warm_s is not None:
+                    g["first_solve_warm_s"] = warm_s
+                    assert int(wout[3]) == git, (int(wout[3]), git)
+                    # the headline: what a second process pays before its
+                    # first 1e8-DOF GMG solve with the cache populated
+                    rec["warm_setup_total_s"] = round(
+                        rec["assembly_s"] + rec["lowering_s"]
+                        + rec["staging_s"] + g["hierarchy_s"] + warm_s, 2
+                    )
+                    print(
+                        f"gmg first solve: cold {g['first_solve_cold_s']}s"
+                        f", warm {warm_s}s (persistent cache); total warm"
+                        f" setup {rec['warm_setup_total_s']}s",
+                        flush=True,
+                    )
         return True
 
     def _flush():
