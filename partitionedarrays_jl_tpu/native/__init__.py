@@ -175,6 +175,17 @@ def _load() -> Optional[ctypes.CDLL]:
                 f64p, fp, ctypes.c_int32,
             ]
             fn.restype = ctypes.c_int64
+        for name, fp in (
+            ("pa_stencil_emit_range_f64", f64p),
+            ("pa_stencil_emit_range_f32", f32p),
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                i64p, i64p, i64p, ctypes.c_int32, ctypes.c_double, f64p,
+                i64p, ctypes.c_int64, ctypes.c_int32, i32p, i32p, fp,
+                f64p, fp, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
+            ]
+            fn.restype = ctypes.c_int64
         lib.pa_band_offsets.argtypes = [
             i32p, i32p, ctypes.c_int64, ctypes.c_int64, i64p,
             ctypes.c_int64,
@@ -622,6 +633,58 @@ def stencil_emit(
     else:
         out = (indptr, cols[:w], vals[:w])
     return out + (bout,) if with_b else out
+
+
+def stencil_emit_range(
+    dims, lo, hi, center, arm_vals, ghost_gids, dtype, row0, row1,
+    indptr_out, cols_out, vals_out, b_out=None, decouple=False, xtab=None,
+):
+    """Row-range form of `stencil_emit` (round-5 directive 6): emit rows
+    [row0, row1) of the box DIRECTLY into caller-provided buffers —
+    `indptr_out` (row1-row0+1 int32, written relative: [0]=0), `cols_out`
+    / `vals_out` (at least the range's nnz), `b_out` (row1-row0, only
+    read when `xtab` is given). Column ids stay in the FULL part's
+    numbering, so K workers over disjoint ranges fill disjoint slices of
+    the one-shot emission's arrays byte-identically. Returns the range's
+    nnz, or None when the native layer is absent/ineligible."""
+    lib = _load()
+    dim = len(dims)
+    dt = np.dtype(dtype).name
+    if lib is None or dim > 3 or dt not in _FLOAT_FN:
+        return None
+    with_b = xtab is not None
+    if with_b:
+        xt = np.ascontiguousarray(xtab, dtype=np.float64)
+        if len(xt) != int(np.sum(dims)):
+            raise ValueError(
+                "stencil_emit_range: xtab must hold one entry per global "
+                "coordinate"
+            )
+    else:
+        xt = np.zeros(1, dtype=np.float64)
+        b_out = np.empty(1, dtype=dtype)
+    gg = np.ascontiguousarray(ghost_gids, dtype=np.int64)
+    fn = getattr(lib, f"pa_stencil_emit_range_{_FLOAT_FN[dt]}")
+    w = fn(
+        np.asarray(dims, dtype=np.int64),
+        np.asarray(lo, dtype=np.int64),
+        np.asarray(hi, dtype=np.int64),
+        dim,
+        float(center),
+        np.ascontiguousarray(arm_vals, dtype=np.float64),
+        gg,
+        len(gg),
+        1 if decouple else 0,
+        indptr_out,
+        cols_out,
+        vals_out,
+        xt,
+        b_out,
+        1 if with_b else 0,
+        int(row0),
+        int(row1),
+    )
+    return None if w < 0 else int(w)
 
 
 def band_offsets(indptr, cols, m: int, K: int, col_limit: int = 2**31):

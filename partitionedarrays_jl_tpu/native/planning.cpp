@@ -1022,7 +1022,8 @@ static int64_t stencil_emit_dim(const int64_t* dims, const int64_t* lo,
                                 const int64_t* ghost_gids, int64_t n_ghost,
                                 int32_t decouple, int32_t* indptr,
                                 int32_t* cols, T* vals,
-                                const double* xtab, T* bout) {
+                                const double* xtab, T* bout,
+                                int64_t row0, int64_t row1) {
     int64_t gstride[DIM], bstride[DIM], box[DIM];
     gstride[DIM - 1] = bstride[DIM - 1] = 1;
     for (int d = 0; d < DIM; ++d) box[d] = hi[d] - lo[d];
@@ -1065,11 +1066,24 @@ static int64_t stencil_emit_dim(const int64_t* dims, const int64_t* lo,
             s += tab[d][cc[d] + (d == d_off ? off : 0)];
         return (T)s;
     };
+    // row-range form (round-5 directive 6): emit rows [row0, row1) of
+    // the SAME box — column ids, ghost ranks and gids all stay in the
+    // FULL part's numbering, so K workers over disjoint ranges write
+    // byte-identical slices of the one-shot emission. Outputs are
+    // RELATIVE to row0 (indptr[0]=0, cols/vals from slot 0, bout[0] is
+    // row row0); owned column ids remain absolute box lids.
+    if (row1 < 0) row1 = no;  // full range
     int64_t w = 0;
     indptr[0] = 0;
     int64_t c[DIM];
-    for (int d = 0; d < DIM; ++d) c[d] = lo[d];
-    for (int64_t r = 0; r < no; ++r) {
+    {  // decompose row0 into box coords (C-order)
+        int64_t rr = row0;
+        for (int d = 0; d < DIM; ++d) {
+            c[d] = lo[d] + (bstride[d] ? rr / bstride[d] : 0);
+            rr = bstride[d] ? rr % bstride[d] : rr;
+        }
+    }
+    for (int64_t r = row0; r < row1; ++r) {
         bool bnd = false;
         for (int d = 0; d < DIM; ++d)
             bnd |= (c[d] == 0) | (c[d] == dims[d] - 1);
@@ -1121,9 +1135,9 @@ static int64_t stencil_emit_dim(const int64_t* dims, const int64_t* lo,
             // phase-1 writes into a zeroed c (0 + acc: flips any -0.0
             // partial to +0.0, as the host does), phase 2 adds
             const T b0 = (T)0 + acc_o;
-            bout[r] = has_ghosts ? b0 + acc_h : b0;
+            bout[r - row0] = has_ghosts ? b0 + acc_h : b0;
         }
-        indptr[r + 1] = (int32_t)w;
+        indptr[r - row0 + 1] = (int32_t)w;
         for (int d = DIM - 1; d >= 0; --d) {  // advance c in C-order
             if (++c[d] < hi[d]) break;
             c[d] = lo[d];
@@ -1139,19 +1153,20 @@ static int64_t stencil_emit_impl(const int64_t* dims, const int64_t* lo,
                                  const int64_t* ghost_gids, int64_t n_ghost,
                                  int32_t decouple, int32_t* indptr,
                                  int32_t* cols, T* vals,
-                                 const double* xtab, T* bout) {
+                                 const double* xtab, T* bout,
+                                 int64_t row0 = 0, int64_t row1 = -1) {
     if (dim == 3)
         return stencil_emit_dim<T, 3>(dims, lo, hi, center, arm_vals,
                                       ghost_gids, n_ghost, decouple, indptr,
-                                      cols, vals, xtab, bout);
+                                      cols, vals, xtab, bout, row0, row1);
     if (dim == 2)
         return stencil_emit_dim<T, 2>(dims, lo, hi, center, arm_vals,
                                       ghost_gids, n_ghost, decouple, indptr,
-                                      cols, vals, xtab, bout);
+                                      cols, vals, xtab, bout, row0, row1);
     if (dim == 1)
         return stencil_emit_dim<T, 1>(dims, lo, hi, center, arm_vals,
                                       ghost_gids, n_ghost, decouple, indptr,
-                                      cols, vals, xtab, bout);
+                                      cols, vals, xtab, bout, row0, row1);
     return -2;  // unsupported dim: the Python wrapper guards dim <= 3
 }
 
@@ -1332,6 +1347,33 @@ int64_t pa_stencil_emit_f32(const int64_t* dims, const int64_t* lo,
                                     ghost_gids, n_ghost, decouple, indptr,
                                     cols, vals, with_b ? xtab : nullptr,
                                     with_b ? bout : nullptr);
+}
+
+// Row-range variants (round-5 directive 6): emit rows [row0, row1) of
+// the box with outputs relative to row0 and column ids in the FULL
+// part's numbering — the K-worker parallel-emission building block.
+int64_t pa_stencil_emit_range_f64(
+    const int64_t* dims, const int64_t* lo, const int64_t* hi, int32_t dim,
+    double center, const double* arm_vals, const int64_t* ghost_gids,
+    int64_t n_ghost, int32_t decouple, int32_t* indptr, int32_t* cols,
+    double* vals, const double* xtab, double* bout, int32_t with_b,
+    int64_t row0, int64_t row1) {
+    return stencil_emit_impl<double>(dims, lo, hi, dim, center, arm_vals,
+                                     ghost_gids, n_ghost, decouple, indptr,
+                                     cols, vals, with_b ? xtab : nullptr,
+                                     with_b ? bout : nullptr, row0, row1);
+}
+
+int64_t pa_stencil_emit_range_f32(
+    const int64_t* dims, const int64_t* lo, const int64_t* hi, int32_t dim,
+    double center, const double* arm_vals, const int64_t* ghost_gids,
+    int64_t n_ghost, int32_t decouple, int32_t* indptr, int32_t* cols,
+    float* vals, const double* xtab, float* bout, int32_t with_b,
+    int64_t row0, int64_t row1) {
+    return stencil_emit_impl<float>(dims, lo, hi, dim, center, arm_vals,
+                                    ghost_gids, n_ghost, decouple, indptr,
+                                    cols, vals, with_b ? xtab : nullptr,
+                                    with_b ? bout : nullptr, row0, row1);
 }
 
 void pa_csr_extract_hi_f64(const int32_t* indptr, const int32_t* cols,

@@ -8,6 +8,11 @@ records every phase in ``SCALE_BENCH.json`` (repo root) plus a final
 JSON line on stdout. Shrink with PA_SCALE_N for smoke runs.
 
     python tools/bench_scale.py            # 464^3, writes SCALE_BENCH.json
+
+``PA_TPU_PLAN_PROCS=K`` (K>1) routes the assembly emission through K
+spawned workers over row slabs (native/parallel_emit.py) — byte-
+identical operator; ~1x or slower on a 1-core host (spawn overhead, the
+documented no-op), scales assembly_s on multi-core planning hosts.
 """
 from __future__ import annotations
 
@@ -53,9 +58,15 @@ def main():
     if cache_on:
         import tempfile
 
-        cache_dir = os.environ.get("PA_SCALE_CACHE_DIR") or tempfile.mkdtemp(
-            prefix="pa_scale_xla_"
-        )
+        user_dir = os.environ.get("PA_SCALE_CACHE_DIR")
+        cache_dir = user_dir or tempfile.mkdtemp(prefix="pa_scale_xla_")
+        if not user_dir:
+            # bench-created dirs hold hundreds of MB of serialized
+            # executables; don't leak them into /tmp on every run
+            import atexit
+            import shutil
+
+            atexit.register(shutil.rmtree, cache_dir, ignore_errors=True)
         pa.enable_compilation_cache(cache_dir)
         rec["compile_cache_dir"] = cache_dir
         # a reused PA_SCALE_CACHE_DIR serves the FIRST solve from disk
@@ -274,5 +285,104 @@ def main():
                       "vs_baseline": rec["per_iteration_ms"]}))
 
 
+def curve():
+    """Scaling curve (round-5 directive 2): kernel-only SpMV, CG
+    iteration, and pure vector-op (stream) marginal costs at several
+    problem sizes on the SAME marginal-chain protocol the 192^3 bands
+    use — so the 464^3 per-DOF cliff is measured, not inferred from the
+    full-solve wall/iters number. Writes SCALE_CURVE.json.
+
+        python tools/bench_scale.py curve
+        PA_CURVE_SIZES=96,192 python tools/bench_scale.py curve
+    """
+    from functools import partial
+
+    import jax
+
+    import bench as benchmod
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu.parallel.tpu import TPUBackend
+
+    sizes = [
+        int(s)
+        for s in os.environ.get("PA_CURVE_SIZES", "96,192,296,464").split(",")
+    ]
+    backend = TPUBackend(devices=jax.devices()[:1])
+    out_path = os.environ.get(
+        "PA_CURVE_OUT",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "SCALE_CURVE.json",
+        ),
+    )
+    rows = []
+    rec = {
+        "methodology": benchmod.METHODOLOGY,
+        "protocol": "marginal-chain (bench.py) at EVERY size: kernel-only "
+        "SpMV fori_loop chain; fixed-trip compiled-CG marginal; 3-pass "
+        "stream chain y = c*y + x on the (1, W) vector layout",
+        "sizes": rows,
+    }
+
+    def _flush():
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1, sort_keys=True)
+
+    for n in sizes:
+        dofs = n**3
+        r = {"n": n, "dofs": dofs}
+        rows.append(r)
+        run_chain, A, x, dA, flops = benchmod.spmv_chain(n, backend, pa)
+        r["dia_mode"] = dA.dia_mode
+        # chain lengths scaled so the marginal signal stays ~0.5-5 s at
+        # every size (the 192^3 default would run 9+ s chains at 464^3)
+        kspan = max(100, min(450, int(3.5e9 / dofs)))
+        dt = benchmod.marginal_chain_time(run_chain, 50, 50 + kspan)
+        r["spmv_s"] = dt
+        r["spmv_gflops"] = round(flops / dt / 1e9, 1)
+        r["spmv_ps_per_dof"] = round(dt / dofs * 1e12, 1)
+        print(json.dumps(r), flush=True)
+
+        # CG marginal on the same operator (the band's protocol)
+        k1, k2 = (60, 1000) if dofs < 2e7 else (40, 440)
+        it_s = benchmod.cg_marginal_s_per_it(pa, dA, k1, k2)
+        r["cg_s_per_it"] = round(it_s, 7)
+        r["cg_ps_per_dof"] = round(it_s / dofs * 1e12, 1)
+        r["cg_over_spmv"] = round(it_s / dt, 2)
+
+        # stream leg: 3-access elementwise chain on the live vector
+        # layout -> effective HBM GB/s for the CG's axpy-shaped traffic
+        W = dA.col_layout.W
+        y0 = np.ones((1, W), dtype=np.float32)
+        yv = jax.device_put(y0)
+        c = np.float32(0.999)
+
+        @partial(jax.jit, static_argnums=1)
+        def stream_chain(y, k):
+            def step(i, v):
+                return c * v + y  # read v, read y, write v
+            return jax.lax.fori_loop(0, k, step, y).sum()
+
+        ks = max(100, min(1000, int(2.0e10 / W)))
+        sdt = benchmod.marginal_chain_time(
+            lambda k: float(stream_chain(yv, k)), 50, 50 + ks
+        )
+        r["stream_s"] = sdt
+        r["stream_gb_per_s"] = round(3 * W * 4 / sdt / 1e9, 1)
+        r["vector_slots_W"] = W
+        print(json.dumps(r), flush=True)
+        _flush()
+        # free staged operator before the next (bigger) size
+        del run_chain, A, x, dA
+        jax.clear_caches()
+
+    _flush()
+    print(json.dumps({"metric": "scale_curve_sizes", "value": len(rows),
+                      "unit": "sizes", "vs_baseline": 0.0}))
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "curve":
+        curve()
+    else:
+        main()

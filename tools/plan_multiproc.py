@@ -81,7 +81,12 @@ def run(ns, pshape, procs, dtype="float32", decoupled=True):
     if procs == 1:
         results = [plan_parts(args[0])]
     else:
-        with mp.get_context("fork").Pool(len(args)) as pool:
+        # spawn, not fork: the parent has live JAX threads (the image's
+        # sitecustomize pre-imports jax), and forking a multithreaded
+        # process is deadlock-prone (round-4 advisor). Workers import
+        # fresh interpreters and never initialize a JAX backend —
+        # planning is NumPy/C++ only.
+        with mp.get_context("spawn").Pool(len(args)) as pool:
             results = pool.map(plan_parts, args)
     wall = time.perf_counter() - t0
     flat = sorted(r for rs in results for r in rs)
