@@ -780,6 +780,17 @@ def gmg_hierarchy(
     A_l, nfs = A, dims
     pshape = parts.shape
     stride = tuple(1 for _ in pshape)
+    # per-dim block cuts of the CURRENT level's partition: coarse cuts
+    # are ceil(fine_cut / 2), so every coarse point's even fine position
+    # (2k) lies inside its own part's fine box — the alignment the
+    # matrix-free stencil transfers need (st ∈ {0, 1}; the default
+    # remainder-last split of an odd coarse extent puts st at -1 and
+    # forces the assembled-matrix path on deep levels)
+    from ..parallel.prange import _block_firsts
+
+    firsts = [
+        _block_firsts(n, k).tolist() for n, k in zip(dims, pshape)
+    ]
     for _ in range(max_levels):
         if int(np.prod(nfs)) <= coarse_threshold:
             break
@@ -798,9 +809,11 @@ def gmg_hierarchy(
                     min(s * 2, k) if k > s else s
                     for s, k in zip(stride, pshape)
                 )
+        firsts = [[(f + 1) // 2 for f in fd] for fd in firsts]
         coarse_rows = cartesian_partition(
             parts, ncs, no_ghost,
             part_stride=stride if max(stride) > 1 else None,
+            dim_firsts=None if max(stride) > 1 else firsts,
         )
         A_c = galerkin_cartesian(A_l, nfs, ncs, coarse_rows)
 

@@ -189,7 +189,11 @@ def stencil_emit_parallel(
             )
             nnz0 += nz
         assert nnz0 == nnz_total, (nnz0, nnz_total)
-        results = _pool(len(tasks)).map(_worker, tasks)
+        # one pool keyed by the REQUESTED worker count: parts whose dim-0
+        # extent caps K below procs would otherwise spawn a second pool
+        # per distinct task count (review r5) — submitting fewer tasks to
+        # a procs-wide pool is free
+        results = _pool(procs).map(_worker, tasks)
         if any(w < 0 or w != t[13] for (_, w), t in zip(results, tasks)):
             return None
         indptr = np.ndarray(

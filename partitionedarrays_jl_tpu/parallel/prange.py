@@ -280,6 +280,7 @@ def cartesian_partition(
     ghost=no_ghost,
     periodic: Optional[Sequence[bool]] = None,
     part_stride: Optional[Sequence[int]] = None,
+    dim_firsts: Optional[Sequence[Sequence[int]]] = None,
 ) -> PRange:
     """N-D Cartesian block partition (reference:
     src/Interfaces.jl:1114-1231): plain (`no_ghost`), with a 1-cell halo in
@@ -293,7 +294,15 @@ def cartesian_partition(
     parts whose coordinates are multiples of the stride; every other
     part owns nothing. Coarse multigrid levels use this so tiny grids
     stop paying full-mesh communication latency (the distributed analog
-    of gathering a coarse problem onto fewer ranks)."""
+    of gathering a coarse problem onto fewer ranks).
+
+    ``dim_firsts`` overrides the balanced per-dim block cuts: one
+    ascending int sequence per dimension, ``firsts[0] == 0``, one entry
+    per part along that dim (zero-size blocks allowed). The GMG
+    hierarchy passes the ALIGNED coarse cuts ``ceil(fine_cut / 2)`` so
+    every coarse point's even fine position stays inside its own part's
+    fine box (round-5 directive 4); mutually exclusive with
+    ``part_stride``."""
     ngids = tuple(int(n) for n in ngids)
     pshape = parts.shape
     check(
@@ -321,9 +330,26 @@ def cartesian_partition(
     else:
         stride = tuple(1 for _ in pshape)
         pshape_eff = pshape
-    dim_firsts = tuple(
-        _block_firsts(n, k) for n, k in zip(ngids, pshape_eff)
-    )
+    if dim_firsts is not None:
+        check(part_stride is None, "dim_firsts with part_stride unsupported")
+        dim_firsts = tuple(
+            np.asarray(f, dtype=GID_DTYPE) for f in dim_firsts
+        )
+        check(
+            len(dim_firsts) == len(ngids),
+            "one dim_firsts sequence per dimension",
+        )
+        for f, n, k in zip(dim_firsts, ngids, pshape_eff):
+            check(
+                len(f) == k and (len(f) == 0 or f[0] == 0)
+                and bool(np.all(np.diff(f) >= 0))
+                and (len(f) == 0 or f[-1] <= n),
+                "dim_firsts must be ascending cuts starting at 0",
+            )
+    else:
+        dim_firsts = tuple(
+            _block_firsts(n, k) for n, k in zip(ngids, pshape_eff)
+        )
     g2p = CartesianGidToPart(ngids, dim_firsts)
     if part_stride is not None and stride != tuple(1 for _ in pshape):
         g2p = _StridedGidToPart(g2p, pshape, stride)
@@ -337,7 +363,13 @@ def cartesian_partition(
             hi = [0] * len(ngids)
         else:
             sub = tuple(c // s for c, s in zip(coord, stride))
-            lo, hi = _cartesian_box(sub, ngids, pshape_eff)
+            lo = [int(dim_firsts[d][sub[d]]) for d in range(len(ngids))]
+            hi = [
+                int(dim_firsts[d][sub[d] + 1])
+                if sub[d] + 1 < len(dim_firsts[d])
+                else ngids[d]
+                for d in range(len(ngids))
+            ]
         own_ranges = [np.arange(l, h, dtype=GID_DTYPE) for l, h in zip(lo, hi)]
         own_grid = np.meshgrid(*own_ranges, indexing="ij")
         own_gids = np.ravel_multi_index(own_grid, ngids).ravel()

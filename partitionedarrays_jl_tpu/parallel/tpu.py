@@ -623,8 +623,13 @@ class DeviceMatrix:
             if sd is not None:
                 self.sd_bs = sd["bs"]
                 self.sd_g = sd["G"]
-                self.sd_idx = _stage(backend, sd["idx"], P)
-                self.sd_vals = _stage(backend, sd["vals"], P)
+                # one staged (idx, vals) pair per width bucket
+                self.sd_idx = tuple(
+                    _stage(backend, c["idx"], P) for c in sd["chunks"]
+                )
+                self.sd_vals = tuple(
+                    _stage(backend, c["vals"], P) for c in sd["chunks"]
+                )
             else:
                 bsr = self._detect_bsr(oo, P, noids, no_max, dt)
                 if bsr is not None:
@@ -870,6 +875,11 @@ class DeviceMatrix:
     #: HBM budget for the densified group blocks, summed over parts.
     SD_MAX_BYTES = int(2.5e9)
 
+    #: Width buckets for the SD lowering: contiguous group ranges padded
+    #: to their own union maximum (one einsum per bucket) instead of one
+    #: global width — see _detect_sd (round-5 directive 3).
+    SD_BUCKETS = 8
+
     @classmethod
     def _detect_sd(cls, oo, P, noids, no_max, dt):
         """Supernode-dense lowering for irregular node-block operators
@@ -907,7 +917,7 @@ class DeviceMatrix:
                 continue
             # per-part group unions (self excluded: those columns arrive
             # as a reshape of the owned region, gather-free)
-            unions, emax, ngr_max = [], 1, 1
+            unions, ngr_max = [], 1
             for p in range(P):
                 m = oo[p]
                 nn = m.shape[0] // bs
@@ -921,25 +931,62 @@ class DeviceMatrix:
                     )
                     ext = bc[(bc < g * G) | (bc >= g * G + G)]
                     us.append(ext)
-                    emax = max(emax, len(ext))
                 unions.append(us)
-            width = (G + emax) * bs
-            sd_bytes = (
-                P * ngr_max * (G * bs) * width * np.dtype(dt).itemsize
-            )
+            # BUCKETED group widths (round-5 directive 3): pad each
+            # CONTIGUOUS chunk of groups to its own union maximum
+            # instead of the global one — Morton order keeps neighboring
+            # groups' unions similar, so equal-range chunks recover most
+            # of the padding the global width wasted (the reason bigger
+            # meshes kept tripping SD_MAX_BYTES / the gather-count guard)
+            B = int(min(cls.SD_BUCKETS, ngr_max))
+            bounds = [round(i * ngr_max / B) for i in range(B + 1)]
+            chunks = []  # (r0, r1, emax_c)
+            sd_bytes = 0
+            pad_ext = 0
+            for c in range(B):
+                r0c, r1c = bounds[c], bounds[c + 1]
+                if r0c == r1c:
+                    continue
+                emax_c = 1
+                for p in range(P):
+                    for g in range(r0c, min(r1c, len(unions[p]))):
+                        emax_c = max(emax_c, len(unions[p][g]))
+                width = (G + emax_c) * bs
+                sd_bytes += (
+                    P * (r1c - r0c) * (G * bs) * width
+                    * np.dtype(dt).itemsize
+                )
+                pad_ext += P * (r1c - r0c) * emax_c
+                chunks.append((r0c, r1c, emax_c))
             if sd_bytes > cls.SD_MAX_BYTES:
                 continue  # a smaller bs may still fit the budget
             # padding must not reintroduce the gathers it saves: require
             # the padded external gather count to beat BSR's block count
-            if (P * ngr_max * emax) * bs * bs > 0.7 * nnz:
+            if pad_ext * bs * bs > 0.7 * nnz:
                 continue
-            idx = np.zeros((P, ngr_max, emax), dtype=INDEX_DTYPE)
-            # allocate in the operator dtype directly: an f64 temp would
-            # double the peak against the SD_MAX_BYTES budget (review r4)
-            vals = np.zeros((P, ngr_max, G * bs, width), dtype=dt)
+            out_chunks = []
+            for r0c, r1c, emax_c in chunks:
+                out_chunks.append(
+                    {
+                        "idx": np.zeros(
+                            (P, r1c - r0c, emax_c), dtype=INDEX_DTYPE
+                        ),
+                        # operator dtype directly: an f64 temp would
+                        # double the peak against SD_MAX_BYTES (review r4)
+                        "vals": np.zeros(
+                            (P, r1c - r0c, G * bs, (G + emax_c) * bs),
+                            dtype=dt,
+                        ),
+                        "r0": r0c,
+                    }
+                )
+            import bisect
+
+            starts = [c["r0"] for c in out_chunks]
             for p in range(P):
                 m = oo[p]
                 for g, ext in enumerate(unions[p]):
+                    ch = out_chunks[bisect.bisect_right(starts, g) - 1]
                     r0, r1 = g * G * bs, min((g + 1) * G * bs, m.shape[0])
                     s, e = m.indptr[r0], m.indptr[r1]
                     rr = (
@@ -957,9 +1004,10 @@ class DeviceMatrix:
                         cc - g * G * bs,
                         (np.searchsorted(ext, bc) + G) * bs + cc % bs,
                     )
-                    idx[p, g, : len(ext)] = ext
-                    vals[p, g][rr, lc] = m.data[s:e]
-            return {"bs": bs, "G": G, "idx": idx, "vals": vals}
+                    gl = g - ch["r0"]
+                    ch["idx"][p, gl, : len(ext)] = ext
+                    ch["vals"][p, gl][rr, lc] = m.data[s:e]
+            return {"bs": bs, "G": G, "chunks": out_chunks}
         return None
 
     @staticmethod
@@ -1711,25 +1759,37 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False):
             # owned region (no gather), only the per-group external
             # unions are gathered (~4x fewer element-at-a-time gather
             # steps than BSR), and the products run as one batched MXU
-            # einsum over the densified group blocks
+            # einsum per WIDTH BUCKET over the densified group blocks
+            # (each contiguous chunk of groups padded to its own union
+            # maximum — round-5 directive 3)
             bs, G = dA.sd_bs, dA.sd_g
             cl = dA.col_plan.layout
             yn = xv[cl.o0 : cl.o0 + cl.no_max].reshape(-1, bs)
-            ngr, emax = m["sd_i"].shape
+            ngr = sum(i.shape[0] for i in m["sd_i"])
             nn = yn.shape[0]
             yp = (
                 jnp.pad(yn, ((0, ngr * G - nn), (0, 0)))
                 if ngr * G > nn
                 else yn
             )
-            xs = yp[: ngr * G].reshape(ngr, G * bs)
-            xe = yn[m["sd_i"]].reshape(ngr, emax * bs)
-            xg = jnp.concatenate([xs, xe], axis=1)
-            partial_ = jnp.einsum(
-                "grc,gc->gr", m["sd_v"], xg,
-                preferred_element_type=xv.dtype,
-                precision=jax.lax.Precision.HIGHEST,
-            ).reshape(-1)[:no_max]
+            outs = []
+            g0_ = 0
+            for idx_c, val_c in zip(m["sd_i"], m["sd_v"]):
+                len_c, emax_c = idx_c.shape
+                xs = yp[g0_ * G : (g0_ + len_c) * G].reshape(
+                    len_c, G * bs
+                )
+                xe = yn[idx_c].reshape(len_c, emax_c * bs)
+                xg = jnp.concatenate([xs, xe], axis=1)
+                outs.append(
+                    jnp.einsum(
+                        "grc,gc->gr", val_c, xg,
+                        preferred_element_type=xv.dtype,
+                        precision=jax.lax.Precision.HIGHEST,
+                    )
+                )
+                g0_ += len_c
+            partial_ = jnp.concatenate(outs, axis=0).reshape(-1)[:no_max]
         elif dA.bsr_bs is not None:
             # node-block gather: one index per bs×bs block (~bs²× fewer
             # element-at-a-time gathers than ELL), block products as one
@@ -1795,6 +1855,12 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False):
     return body
 
 
+def _shard_ops(jax, ms):
+    """Strip the leading (length-1) shard axis from every operand leaf
+    (dicts of arrays, and the SD lowering's per-bucket tuples)."""
+    return jax.tree.map(lambda v: v[0], ms)
+
+
 def make_spmv_fn(dA: DeviceMatrix) -> Callable:
     """Compiled y = A @ x over the mesh: returns a function mapping the
     (P, Wc) column-range vector to the (P, Wr) row-range product (ghost
@@ -1812,7 +1878,7 @@ def make_spmv_fn(dA: DeviceMatrix) -> Callable:
     @jax.jit
     def fn(x, m):
         def shard_fn(xs, ms):
-            y, _ = body(xs[0], {k: v[0] for k, v in ms.items()})
+            y, _ = body(xs[0], _shard_ops(jax, ms))
             return y[None]
 
         return shard_map(
@@ -1893,7 +1959,7 @@ def make_cg_fn(
     def fn(b, x0, mv, m):
         def shard_fn(bs, x0s, mvs, ms):
             bv, xv = bs[0], x0s[0]
-            mats = {k: v[0] for k, v in ms.items()}
+            mats = _shard_ops(jax, ms)
             mvv = mvs[0]
 
             def spmv(z):
@@ -2060,8 +2126,9 @@ def make_diff_solve_fn(
     op_dt = next(
         a.dtype
         for a in (
-            dA.oh_vals, dA.ohb_vals, dA.sd_vals, dA.bsr_vals, dA.dia_cb,
-            dA.dia_vals, dA.oo_vals,
+            dA.oh_vals, dA.ohb_vals,
+            dA.sd_vals[0] if dA.sd_vals else None,  # per-bucket tuple
+            dA.bsr_vals, dA.dia_cb, dA.dia_vals, dA.oo_vals,
         )
         if a is not None
     )
@@ -2126,7 +2193,7 @@ def make_bicgstab_fn(
     def fn(b, x0, mv, m):
         def shard_fn(bs, x0s, mvs, ms):
             bv, xv = bs[0], x0s[0]
-            mats = {k: v[0] for k, v in ms.items()}
+            mats = _shard_ops(jax, ms)
             mvv = mvs[0]
             sl = slice(o0, o0 + no_max)
 
@@ -2282,7 +2349,7 @@ def make_gmres_fn(
     def fn(b, x0, mv, mats_in):
         def shard_fn(bs, x0s, mvs, ms):
             bv, xv = bs[0], x0s[0]
-            mats = {k: v[0] for k, v in ms.items()}
+            mats = _shard_ops(jax, ms)
             mvv = mvs[0]
             sl = slice(o0, o0 + no_max)
             dt = bv.dtype
@@ -2466,7 +2533,7 @@ def make_minres_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
     def fn(b, x0, m):
         def shard_fn(bs, x0s, ms):
             bv, xv = bs[0], x0s[0]
-            mats = {k: v[0] for k, v in ms.items()}
+            mats = _shard_ops(jax, ms)
             sl = slice(o0, o0 + no_max)
             one = jnp.asarray(1.0, dtype=bv.dtype)
 
@@ -2657,7 +2724,7 @@ def make_chebyshev_fn(
     def fn(b, x0, m):
         def shard_fn(bs, x0s, ms):
             bv, xv = bs[0], x0s[0]
-            mats = {k: v[0] for k, v in ms.items()}
+            mats = _shard_ops(jax, ms)
 
             def spmv(z):
                 y, _ = body_spmv(z, mats)

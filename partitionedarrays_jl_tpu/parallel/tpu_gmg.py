@@ -26,6 +26,7 @@ from ..utils.helpers import check
 from .pvector import PVector
 from .tpu import (
     DeviceVector,
+    _shard_ops,
     TPUBackend,
     _matrix_operands,
     _pdot_factory,
@@ -90,6 +91,19 @@ def _device_hierarchy(h, backend: TPUBackend):
         if st is None:
             st = _stage_structured_transfer(h, li, backend)
         if st is not None:
+            sm_host = st.pop("shmask_host", None)
+            if sm_host is not None:
+                st["shmask"] = _stage(
+                    backend, np.asarray(sm_host, dtype=dinv.dtype),
+                    sm_host.shape[0],
+                )
+            dsel_host = st.pop("dsel_host", None)
+            if dsel_host is not None and len(st["stencil"]) > 1:
+                st["dsel"] = _stage(
+                    backend,
+                    np.asarray(dsel_host, dtype=np.int32).reshape(-1, 1),
+                    len(dsel_host),
+                )
             entry.update(st)
         else:
             # fallback: the assembled rectangular transfers (gather-bound
@@ -122,7 +136,7 @@ def _device_hierarchy(h, backend: TPUBackend):
 
 def _stage_stencil_transfer(h, li: int, dA):
     """MATRIX-FREE factored transfer P = S·E: when the level's partition
-    is the equal-box Cartesian case and its halo covers the FULL in-grid
+    is the box Cartesian case and its halo covers the full in-grid
     shell, the interpolation stencil S (w(δ) = 0.5^|δ|₀ truncated at the
     global boundary) is applied as 3^d shifted slice-reads of the
     part's extended box — assembled from the owned box plus the box
@@ -130,11 +144,21 @@ def _stage_stencil_transfer(h, li: int, dA):
     operator. Kills the O(3^d · N) S staging entirely (43 GB of COO at
     464³, the round-3 OOM) and replaces its gathers with pure slices.
 
+    Round-5 directive 4 closes the two declines round 3 left: UNEQUAL
+    Cartesian splits stage one descriptor per box-shape variant (≤ 2^d,
+    the exchange's own variant machinery) and the apply switches on the
+    shard's variant index; PERIODIC partitions place their wrapped
+    segments through a per-(shard, direction) in-grid mask — wrapped
+    values are zeroed so the apply reproduces S's boundary truncation
+    (the assembled-S oracle truncates; it does not wrap weights).
+
     Returns the descriptor dict or None (fall back to the matrix S /
     assembled transfers):
-    * ``stencil``: (fb, cb, st) — the embedding boxes, as in emb_fast,
-    * ``shell``: per-direction (ext_slice, seg_off, seg_shape) placements
-      of the ghost segments into the (b+2)^d extended array."""
+    * ``stencil``: per-variant (fb, cb, st) embedding boxes,
+    * ``shell``: per-variant tuple of (ext_slice, seg_off, seg_shape)
+      placements of the ghost segments into the (b+2)^d extended array,
+    * ``shmask_host``: (P, ndirs) float mask, present only when some
+      shard receives a wrapped (out-of-grid) segment."""
     from .tpu_box import BoxExchangePlan
 
     if not _stencil_enabled():
@@ -149,8 +173,7 @@ def _stage_stencil_transfer(h, li: int, dA):
     if not isinstance(plan, BoxExchangePlan):
         return None
     info = plan.info
-    if len(info.box_shapes) > 1:
-        return None  # unequal boxes: the S apply needs one static shape
+    V = len(info.box_shapes)
     coarse_rows = (
         h.levels[li + 1].A.rows if li + 1 < len(h.levels) else h.coarse_A.rows
     )
@@ -158,13 +181,32 @@ def _stage_stencil_transfer(h, li: int, dA):
     # are ghost-free); its owned boxes coincide with the rows'
     fsets = lvl.A.cols.partition.part_values()
     csets = coarse_rows.partition.part_values()
-    fb = info.box_shape
-    descr = None
-    for fi, ci in zip(fsets, csets):
+    P = len(fsets)
+    variants = np.asarray(info.variants)
+    dir_index = {d_.dir: k for k, d_ in enumerate(info.dirs)}
+    # receiver -> sender per direction (partial permutation: at most one)
+    senders = [
+        {q: s for s, q in d_.perm} for d_ in info.dirs
+    ]
+    # descriptor variants are keyed by the FULL embedding (fb, cb, st),
+    # not by the exchange's fine-box variant: equal fine boxes over an
+    # odd coarse grid still split into floor/ceil coarse boxes, and each
+    # distinct embedding needs its own static branch
+    descs = []
+    dsel = np.zeros(P, dtype=np.int32)
+    ndirs = len(info.dirs)
+    shmask = np.ones((P, ndirs), dtype=np.float64)
+    any_wrapped = False
+    all_dirs = [
+        d_ for d_ in np.ndindex(*(3,) * dim)
+        if any(c != 1 for c in d_)
+    ]
+    for p, (fi, ci) in enumerate(zip(fsets, csets)):
         if getattr(fi, "box_shape", None) is None:
             return None
         if getattr(ci, "box_shape", None) is None:
             return None
+        fb = info.box_shapes[int(variants[p])]
         if fi.box_shape != fb:
             return None
         cb = ci.box_shape
@@ -178,64 +220,82 @@ def _stage_stencil_transfer(h, li: int, dA):
         if any(st[d] + 2 * (cb[d] - 1) >= fb[d] for d in range(dim)):
             return None
         cand = (fb, tuple(cb), st)
-        if descr is None:
-            descr = cand
-        elif cand != descr:
-            return None  # shards differ: SPMD uniformity broken
-        # FULL-shell coverage: every in-grid shell cell owned by another
-        # part must be a ghost, or the shifted reads would see zeros
-        # where S needs neighbor values
+        if cand in descs:
+            dsel[p] = descs.index(cand)
+        else:
+            if len(descs) >= 16:
+                return None  # implausible split: keep the matrix path
+            dsel[p] = len(descs)
+            descs.append(cand)
+        # FULL-shell coverage, direction by direction: every IN-GRID
+        # shell piece must arrive as a segment of the exact
+        # face/edge/corner extent (else the shifted reads would see
+        # zeros where S needs neighbor values); a WRAPPED segment
+        # (periodic) is allowed but masked to zero — S truncates at the
+        # global boundary, it does not wrap. Directions ABSENT from the
+        # plan entirely (e.g. a 7-point level whose halo has no corner
+        # slabs) decline here — the old sg-based check, per direction.
         gdims = fi.grid_shape
-        shell = []
-        for d in range(dim):
-            shell.append(
-                np.arange(
-                    max(fi.box_lo[d] - 1, 0),
-                    min(fi.box_hi[d] + 1, gdims[d]),
-                )
+        for delta in all_dirs:
+            dvec = tuple(c - 1 for c in delta)
+            in_grid = all(
+                (c != -1 or fi.box_lo[j] > 0)
+                and (c != 1 or fi.box_hi[j] < gdims[j])
+                for j, c in enumerate(dvec)
             )
-        grid = np.meshgrid(*shell, indexing="ij")
-        inside = np.ones(grid[0].shape, dtype=bool)
-        for d in range(dim):
-            inside &= (grid[d] >= fi.box_lo[d]) & (grid[d] < fi.box_hi[d])
-        sg = np.ravel_multi_index(
-            [g[~inside] for g in grid], gdims
-        )
-        if (fi.gids_to_lids(sg) < 0).any():
-            return None
-        # the ghost set must be EXACTLY the in-grid foreign shell: a
-        # periodic partition carries wrapped ghosts beyond it, and the
-        # zero-padded stencil apply would drop boundary weights where
-        # the assembled S (and the host oracle) wraps them
-        if fi.num_hids != len(sg):
-            return None
-    fb, cb, st = descr
-    # segment placements into the (b+2)^d extended array: each direction
-    # δ maps to the shell slice [0,1) / [1,1+b) / [1+b,2+b) per dim; the
-    # slab must be exactly the full face/edge/corner extent (guaranteed
-    # by the full-shell check for interior parts — verify anyway)
-    shell_put = []
-    for d_ in info.dirs:
-        exp_shape = tuple(
-            1 if c != 0 else fb[k] for k, c in enumerate(d_.dir)
-        )
-        if d_.shape != exp_shape:
-            return None
-        sl = tuple(
-            slice(0, 1) if c == -1
-            else (slice(1 + fb[k], 2 + fb[k]) if c == 1
-                  else slice(1, 1 + fb[k]))
-            for k, c in enumerate(d_.dir)
-        )
-        shell_put.append((sl, d_.off, d_.shape))
-    return {"stencil": (fb, cb, st), "shell": tuple(shell_put)}
+            k = dir_index.get(dvec)
+            s = senders[k].get(p) if k is not None else None
+            if s is None:
+                if in_grid:
+                    return None  # shell piece exists but never arrives
+                continue  # no segment: ppermute zero-fills — matches S
+            d_ = info.dirs[k]
+            exp_shape = tuple(
+                1 if c != 0 else fb[j] for j, c in enumerate(dvec)
+            )
+            if d_.geo[int(variants[s])][1] != exp_shape:
+                return None  # sender slab is not the exact face extent
+            n_seg = int(np.prod(exp_shape))
+            if not info.seg_mask[p, d_.off : d_.off + n_seg].all():
+                return None  # orphan slots inside the face: stale values
+            if not in_grid:
+                shmask[p, k] = 0.0
+                any_wrapped = True
+    # per-descriptor segment placements into the (b+2)^d extended array:
+    # each direction δ maps to the shell slice [0,1) / [1,1+b) /
+    # [1+b,2+b) per dim
+    shells = []
+    for fb, _cb, _st in descs:
+        shell_put = []
+        for d_ in info.dirs:
+            exp_shape = tuple(
+                1 if c != 0 else fb[k] for k, c in enumerate(d_.dir)
+            )
+            sl = tuple(
+                slice(0, 1) if c == -1
+                else (slice(1 + fb[k], 2 + fb[k]) if c == 1
+                      else slice(1, 1 + fb[k]))
+                for k, c in enumerate(d_.dir)
+            )
+            shell_put.append((sl, d_.off, exp_shape))
+        shells.append(tuple(shell_put))
+    out = {
+        "stencil": tuple(descs),
+        "shell": tuple(shells),
+        "dsel_host": dsel,
+    }
+    if any_wrapped:
+        out["shmask_host"] = shmask
+    return out
 
 
-def _stencil_apply(jnp, layout, shell_put, xv, fb):
+def _stencil_apply(jnp, layout, shell_put, xv, fb, dirmask=None):
     """S·x over one part: embed the owned box and the ghost segments into
     the zero-padded (b+2)^d extended array, then sum the 3^d shifted
     slices with weights 0.5^|δ|₀. Reads beyond the global boundary see
-    the zero pad — exactly S's dropped-weight truncation."""
+    the zero pad — exactly S's dropped-weight truncation. ``dirmask``
+    (ndirs,) zeroes WRAPPED segments on periodic partitions: the values
+    arrive (the exchange wraps) but S's truncation must not read them."""
     dim = len(fb)
     o0, g0 = layout.o0, layout.g0
     no = 1
@@ -244,8 +304,10 @@ def _stencil_apply(jnp, layout, shell_put, xv, fb):
     ext = jnp.zeros(tuple(b + 2 for b in fb), dtype=xv.dtype)
     core = tuple(slice(1, 1 + b) for b in fb)
     ext = ext.at[core].set(xv[o0 : o0 + no].reshape(fb))
-    for sl, off, shape in shell_put:
+    for k, (sl, off, shape) in enumerate(shell_put):
         seg = xv[g0 + off : g0 + off + int(np.prod(shape))]
+        if dirmask is not None:
+            seg = seg * dirmask[k]
         ext = ext.at[sl].set(seg.reshape(shape))
     acc = None
     for delta in np.ndindex(*(3,) * dim):
@@ -446,7 +508,13 @@ def _gmg_operands(dh):
     for l in dh["levels"]:
         entry = {"A": _matrix_operands(l["dA"]), "dinv": l["dinv"]}
         if "stencil" in l:
-            pass  # matrix-free transfers: everything is compiled in
+            # matrix-free transfers: everything is compiled in except
+            # the periodic wrapped-segment mask and the multi-variant
+            # descriptor selector (per-shard data)
+            if "shmask" in l:
+                entry["shmask"] = l["shmask"]
+            if "dsel" in l:
+                entry["dsel"] = l["dsel"]
         elif "dS" in l:
             entry.update(
                 S=_matrix_operands(l["dS"]),
@@ -536,14 +604,43 @@ def _vcycle_shard_body(h, dh):
                 # MATRIX-FREE factored restriction R = Eᵀ·S: refresh the
                 # residual's ghosts through the level's box exchange,
                 # apply S as 3^d shifted slices of the extended box,
-                # extract the even points — no operators staged at all
-                fbx, cbx, stx = lv["stencil"]
+                # extract the even points — no operators staged at all.
+                # Multi-variant plans (unequal boxes) switch on the
+                # shard's variant index (m["A"]["si"], the exchange's own
+                # selector); every branch pads to the coarse frame width
+                descs, shells = lv["stencil"], lv["shell"]
+                shmask = m.get("shmask")
                 rv = jnp.zeros_like(b_l).at[sl].set(b_l[sl] - q[sl])
                 rv = bodies[level]["exch_A"](
                     rv, m["A"]["si"], m["A"]["sm"], m["A"]["ri"]
                 )
-                w_own = _stencil_apply(jnp, LA, lv["shell"], rv, fbx)
-                rc_own = _box_extract(jnp, w_own, fbx, cbx, stx)
+                if level + 1 == L:
+                    nc_pad = mats["gmap"].shape[-1]
+                else:
+                    nc_pad = dh["levels"][level + 1][
+                        "dA"
+                    ].col_plan.layout.no_max
+
+                def _restrict(v, x_, nc_pad=nc_pad):
+                    fbx, cbx, stx = descs[v]
+                    w = _stencil_apply(
+                        jnp, LA, shells[v], x_, fbx, shmask
+                    )
+                    rc = _box_extract(jnp, w, fbx, cbx, stx)
+                    pad = nc_pad - rc.shape[0]
+                    return jnp.pad(rc, (0, pad)) if pad else rc
+
+                if len(descs) == 1:
+                    rc_own = _restrict(0, rv)
+                else:
+                    rc_own = jax.lax.switch(
+                        m["dsel"][0].astype(jnp.int32),
+                        [
+                            (lambda x_, v=v: _restrict(v, x_))
+                            for v in range(len(descs))
+                        ],
+                        rv,
+                    )
             elif structured:
                 # factored restriction R = Eᵀ·S: stencil-apply the fine
                 # residual (coded-DIA speed), refresh ghosts so embedded
@@ -615,13 +712,50 @@ def _vcycle_shard_body(h, dh):
                 # matrix-free prolongation P = S·E: interleave the
                 # coarse correction onto the even fine points, refresh
                 # ghosts (neighbor parts' interleaved values), stencil
-                fbx, cbx, stx = lv["stencil"]
-                t = _box_interleave(jnp, ec_own, fbx, cbx, stx)
+                descs, shells = lv["stencil"], lv["shell"]
+                shmask = m.get("shmask")
+
+                def _interleave(v, e_):
+                    fbx, cbx, stx = descs[v]
+                    t_ = _box_interleave(
+                        jnp, e_[: int(np.prod(cbx))], fbx, cbx, stx
+                    )
+                    pad = no - t_.shape[0]
+                    return jnp.pad(t_, (0, pad)) if pad else t_
+
+                def _apply_S(v, z_):
+                    ef_ = _stencil_apply(
+                        jnp, LA, shells[v], z_, descs[v][0], shmask
+                    )
+                    pad = no - ef_.shape[0]
+                    return jnp.pad(ef_, (0, pad)) if pad else ef_
+
+                if len(descs) == 1:
+                    t = _interleave(0, ec_own)
+                else:
+                    t = jax.lax.switch(
+                        m["dsel"][0].astype(jnp.int32),
+                        [
+                            (lambda e_, v=v: _interleave(v, e_))
+                            for v in range(len(descs))
+                        ],
+                        ec_own,
+                    )
                 z = jnp.zeros_like(b_l).at[sl].set(t)
                 z = bodies[level]["exch_A"](
                     z, m["A"]["si"], m["A"]["sm"], m["A"]["ri"]
                 )
-                ef_own = _stencil_apply(jnp, LA, lv["shell"], z, fbx)
+                if len(descs) == 1:
+                    ef_own = _apply_S(0, z)
+                else:
+                    ef_own = jax.lax.switch(
+                        m["dsel"][0].astype(jnp.int32),
+                        [
+                            (lambda z_, v=v: _apply_S(v, z_))
+                            for v in range(len(descs))
+                        ],
+                        z,
+                    )
                 x = x.at[sl].add(ef_own)
             elif structured:
                 # factored prolongation P = S·E: scatter the coarse
@@ -665,11 +799,6 @@ def _vcycle_shard_body(h, dh):
         return solve_level(0, b_vec)
 
     return vcycle
-
-
-def _shard_ops(jax, ms):
-    """Strip the leading (length-1) shard axis from every leaf."""
-    return jax.tree.map(lambda v: v[0], ms)
 
 
 def make_gmg_solve_fn(h, backend: TPUBackend, tol: float, maxiter: int):
@@ -794,40 +923,48 @@ def make_gmg_pcg_fn(h, backend: TPUBackend, tol: float, maxiter: int):
 
             q = spmv(xv)
             r = jnp.zeros_like(xv).at[sl].set(bv[sl] - q[sl])
-            z = apply_minv(r)
-            p = jnp.zeros_like(xv).at[sl].set(z[sl])
+            p = jnp.zeros_like(xv)
             rs0 = pdot(r, r)
-            rz0 = pdot(r, z)
             hist = jnp.full(H, jnp.nan, dtype=bv.dtype).at[0].set(
                 jnp.sqrt(rs0)
             )
 
+            # z = Minv(r) computed at the TOP of the body (beta = 0 on
+            # the first pass), not once outside the loop and once inside:
+            # the iterates are the textbook PCG sequence either way, but
+            # this form instantiates the ENTIRE V-cycle ONCE in the
+            # program. TPU codegen emits size-dependent code for the
+            # transfer slices, so the doubled V-cycle literally doubled
+            # the executable (111 MB at 464³, ~1.5 MB/s to ship through
+            # the axon relay on every warm start — round-5 directive 1).
             def cond(st):
-                _x, _r, _p, rz, rs, it, _h = st
+                _x, _r, _p, rz_prev, rs, it, _h = st
                 go = (
                     jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0))
                 ) & (it < maxiter)
-                return go & (rz != 0)
+                return go & (rz_prev != 0)
 
             def step(st):
-                x, r, p, rz, rs, it, hist = st
+                x, r, p, rz_prev, rs, it, hist = st
+                z = apply_minv(r)
+                rz = pdot(r, z)
+                beta = jnp.where(it == 0, 0.0, rz / rz_prev)
+                p = p.at[sl].set(z[sl] + beta * p[sl])
                 q = spmv(p)
                 pq = pdot(p, q)
                 alpha = rz / pq
                 x = x.at[sl].add(alpha * p[sl])
                 r = r.at[sl].add(-alpha * q[sl])
-                z = apply_minv(r)
-                rz_new = pdot(r, z)
                 rs_new = pdot(r, r)
-                beta = rz_new / rz
-                p = p.at[sl].set(z[sl] + beta * p[sl])
                 hist = hist.at[jnp.minimum(it + 1, H - 1)].set(
                     jnp.sqrt(rs_new)
                 )
-                return (x, r, p, rz_new, rs_new, it + 1, hist)
+                return (x, r, p, rz, rs_new, it + 1, hist)
 
             x, r, p, rz, rs, it, hist = jax.lax.while_loop(
-                cond, step, (xv, r, p, rz0, rs0, jnp.int32(0), hist)
+                cond, step,
+                (xv, r, p, jnp.asarray(1.0, bv.dtype), rs0,
+                 jnp.int32(0), hist),
             )
             return x[None], rs, rs0, it, hist
 

@@ -25,6 +25,7 @@ import numpy as np
 from ..utils.helpers import check
 from .pvector import PVector
 from .tpu import (
+    _shard_ops,
     DeviceVector,
     TPUBackend,
     _matrix_operands,
@@ -64,7 +65,7 @@ def make_lobpcg_fn(
     has_gmg = gmg_h is not None
     if has_gmg:
         from .tpu_gmg import (
-            _device_hierarchy, _gmg_operands, _shard_ops, _vcycle_shard_body,
+            _device_hierarchy, _gmg_operands, _vcycle_shard_body,
         )
 
         dh = _device_hierarchy(gmg_h, dA.backend)
@@ -77,7 +78,7 @@ def make_lobpcg_fn(
     def fn(X0, mv, mats_in, *g):
         def shard_fn(X0s, mvs, ms, *gs):
             X = X0s[0]  # (m, no) owned block
-            mats = {k: v[0] for k, v in ms.items()}
+            mats = _shard_ops(jax, ms)
             mvv = mvs[0]
             dt = X.dtype
             if has_gmg:

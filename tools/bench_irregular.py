@@ -72,7 +72,7 @@ def main():
 
     def measure(dA, label):
         from partitionedarrays_jl_tpu.parallel.tpu import (
-            _matrix_operands, _spmv_body,
+            _matrix_operands, _shard_ops, _spmv_body,
         )
 
         dx = DeviceVector.from_pvector(xe, backend, dA.col_layout)
@@ -90,7 +90,7 @@ def main():
         @partial(jax.jit, static_argnums=2)
         def chain(x, m, k):
             def shard_fn(xs, ms):
-                mm = {k2: v[0] for k2, v in ms.items()}
+                mm = _shard_ops(jax, ms)
 
                 def step(_, y):
                     y2, _x = body(y, mm)
