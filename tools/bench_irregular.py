@@ -1,16 +1,21 @@
 """Irregular-graph SpMV throughput on one chip (BASELINE configs[5]):
-the Morton-ordered unstructured-tet elasticity operator. The generic
-lowering is padded-ELL, whose per-element gathers run element-at-a-time
-on TPU; the shipped fast path is the node-block BSR lowering
-(`DeviceMatrix._detect_bsr`): one gather index per bs×bs block + batched
-einsum block products (measured 27x over ELL when first prototyped).
-This tool records the before/after on the real integrated paths.
+the Morton-ordered unstructured-tet elasticity operator, at SEVERAL mesh
+sizes, recorded to ``IRREGULAR_BENCH.json`` with a reproducibility band
+(round-5 directive 3 — the round-4 "11.1 GFLOP/s" lived only in a commit
+message).
 
-    python tools/bench_irregular.py          # 32^3 nodes = 98k dofs
-    PA_IRR_N=24 python tools/bench_irregular.py
+Lowerings measured per size on the real integrated paths:
+* SD — supernode-dense MXU path with BUCKETED group widths (default),
+* BSR — 3x3 node-block gather path (PA_TPU_SD=0),
+* ELL — generic padded-ELL (both fast paths off; smallest size only,
+  its element-at-a-time gathers take minutes on big meshes).
+
+    python tools/bench_irregular.py            # sizes 32,48
+    PA_IRR_SIZES=32 python tools/bench_irregular.py
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -19,23 +24,87 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+#: reproducibility band for the headline (32^3 SD GFLOP/s), derived from
+#: repeated same-protocol runs on this chip — see docs/performance.md
+#: (irregular section) for the provenance table
+BAND_SD_32 = (10.0, 14.0)
+METHODOLOGY = "v5-irregular"
 
-def main():
-    import jax
 
-    import partitionedarrays_jl_tpu as pa
+def measure(dA, label, backend, xe, jax):
+    import statistics
+    from functools import partial
+
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        DeviceVector, _matrix_operands, _shard_ops, _spmv_body,
+    )
+
+    dx = DeviceVector.from_pvector(xe, backend, dA.col_layout)
+    flops = dA.flops_per_spmv
+    # the timing chain must pass the staged matrix operands as
+    # ARGUMENTS: closing over them would inline hundreds of MB of
+    # constants into the relay's compile request (HTTP 413 on the
+    # SD lowering's densified blocks)
+    ops = _matrix_operands(dA)
+    body = _spmv_body(dA)
+    mesh = backend.mesh(dA.row_layout.P)
+    spec = backend.parts_spec()
+    specs = jax.tree.map(lambda _: spec, ops)
+
+    @partial(jax.jit, static_argnums=2)
+    def chain(x, m, k):
+        def shard_fn(xs, ms):
+            mm = _shard_ops(jax, ms)
+
+            def step(_, y):
+                y2, _x = body(y, mm)
+                return y2 * np.float32(1e-3)
+
+            return jax.lax.fori_loop(0, k, step, xs[0])[None]
+
+        from jax import shard_map
+
+        return shard_map(
+            shard_fn, mesh=mesh, in_specs=(spec, specs),
+            out_specs=spec, check_vma=False,
+        )(x, m).sum()
+
+    def chain_time(k, nreps=5):
+        float(chain(dx.data, ops, k))
+        float(chain(dx.data, ops, k))
+        ts = []
+        for _ in range(nreps):
+            t0 = time.perf_counter()
+            v = float(chain(dx.data, ops, k))
+            ts.append(time.perf_counter() - t0)
+        assert v == v
+        return statistics.median(ts)
+
+    def measure_once():
+        k1, k2 = 20, 220
+        t1 = chain_time(k1)
+        for _ in range(4):
+            t2 = chain_time(k2)
+            dt = (t2 - t1) / (k2 - k1)
+            if dt > 0:
+                return dt
+            k2 *= 2
+        return t2 / (k2 // 2)
+
+    dt = sorted(measure_once() for _ in range(3))[1]
+    print(
+        f"{label}: {dt*1e6:.1f} us -> {flops/dt/1e9:.1f} GFLOP/s",
+        flush=True,
+    )
+    return dt
+
+
+def bench_size(n, backend, jax, pa, with_ell):
     from partitionedarrays_jl_tpu.models import assemble_elasticity_tet
     from partitionedarrays_jl_tpu.ops.sparse import csr_spmv
     from partitionedarrays_jl_tpu.parallel.tpu import (
-        DeviceMatrix,
-        DeviceVector,
-        TPUBackend,
-        device_matrix,
-        make_spmv_fn,
+        DeviceMatrix, device_matrix,
     )
-
-    n = int(os.environ.get("PA_IRR_N", "32"))
-    backend = TPUBackend(devices=jax.devices()[:1])
 
     def driver(parts):
         t0 = time.perf_counter()
@@ -58,104 +127,45 @@ def main():
 
     A, xe = pa.prun(driver, backend, 1)
     M = A.values.part_values()[0]
-    lengths = np.diff(M.indptr)
-    L = int(lengths.max())
     nnz, rows = int(M.nnz), M.shape[0]
-    print(
-        f"nnz={nnz/1e6:.1f}M rows={rows/1e3:.0f}k ELL width L={L} "
-        f"(mean row {nnz/rows:.1f}) padding overhead {rows*L/nnz:.2f}x",
-        flush=True,
-    )
+    rec = {"n": n, "dofs": rows, "nnz": nnz}
 
-    import statistics
-    from functools import partial
-
-    def measure(dA, label):
-        from partitionedarrays_jl_tpu.parallel.tpu import (
-            _matrix_operands, _shard_ops, _spmv_body,
-        )
-
-        dx = DeviceVector.from_pvector(xe, backend, dA.col_layout)
-        flops = dA.flops_per_spmv
-        # the timing chain must pass the staged matrix operands as
-        # ARGUMENTS: closing over them would inline hundreds of MB of
-        # constants into the relay's compile request (HTTP 413 on the
-        # SD lowering's densified blocks)
-        ops = _matrix_operands(dA)
-        body = _spmv_body(dA)
-        mesh = backend.mesh(dA.row_layout.P)
-        spec = backend.parts_spec()
-        specs = jax.tree.map(lambda _: spec, ops)
-
-        @partial(jax.jit, static_argnums=2)
-        def chain(x, m, k):
-            def shard_fn(xs, ms):
-                mm = _shard_ops(jax, ms)
-
-                def step(_, y):
-                    y2, _x = body(y, mm)
-                    return y2 * np.float32(1e-3)
-
-                return jax.lax.fori_loop(0, k, step, xs[0])[None]
-
-            from jax import shard_map
-
-            return shard_map(
-                shard_fn, mesh=mesh, in_specs=(spec, specs),
-                out_specs=spec, check_vma=False,
-            )(x, m).sum()
-
-        def chain_time(k, nreps=5):
-            float(chain(dx.data, ops, k))
-            float(chain(dx.data, ops, k))
-            ts = []
-            for _ in range(nreps):
-                t0 = time.perf_counter()
-                v = float(chain(dx.data, ops, k))
-                ts.append(time.perf_counter() - t0)
-            assert v == v
-            return statistics.median(ts)
-
-        def measure_once():
-            k1, k2 = 20, 220
-            t1 = chain_time(k1)
-            for _ in range(4):
-                t2 = chain_time(k2)
-                dt = (t2 - t1) / (k2 - k1)
-                if dt > 0:
-                    return dt
-                k2 *= 2
-            return t2 / (k2 // 2)
-
-        dt = sorted(measure_once() for _ in range(3))[1]
-        print(
-            f"{label}: {dt*1e6:.1f} us -> {flops/dt/1e9:.1f} GFLOP/s",
-            flush=True,
-        )
-        return dt
-
-    # integrated default: the supernode-dense MXU path (round 4)
+    # integrated default: the supernode-dense MXU path, bucketed widths
     dA = device_matrix(A, backend)
-    assert dA.sd_bs == 3, f"expected 3x3 SD lowering, got {dA.sd_bs}"
-    dt_sd = measure(dA, "SD supernode-dense SpMV (default lowering)")
+    rec["lowering"] = (
+        "sd" if dA.sd_bs else ("bsr" if dA.bsr_bs else "ell")
+    )
+    if dA.sd_bs:
+        rec["sd_buckets"] = len(dA.sd_idx)
+        rec["sd_widths"] = [int(v.shape[-1]) for v in dA.sd_vals]
+    flops = dA.flops_per_spmv
+    dt_sd = measure(
+        dA, f"{n}^3 default ({rec['lowering']})", backend, xe, jax
+    )
+    rec["sd_gflops"] = round(flops / dt_sd / 1e9, 2)
 
-    # forced BSR (the round-2/3 default), same matrix
     os.environ["PA_TPU_SD"] = "0"
     try:
         dA_bsr = DeviceMatrix(A, backend)
-        assert dA_bsr.bsr_bs == 3, f"expected 3x3 BSR, got {dA_bsr.bsr_bs}"
-        dt_bsr = measure(dA_bsr, "BSR(3x3) SpMV (PA_TPU_SD=0)")
-
-        # forced generic ELL (the pre-round-2 lowering)
-        os.environ["PA_TPU_BSR"] = "0"
-        try:
-            dA_ell = DeviceMatrix(A, backend)
-        finally:
-            del os.environ["PA_TPU_BSR"]
+        assert dA_bsr.bsr_bs == 3, dA_bsr.bsr_bs
+        dt_bsr = measure(dA_bsr, f"{n}^3 BSR(3x3)", backend, xe, jax)
+        rec["bsr_gflops"] = round(flops / dt_bsr / 1e9, 2)
+        if with_ell:
+            os.environ["PA_TPU_BSR"] = "0"
+            try:
+                dA_ell = DeviceMatrix(A, backend)
+            finally:
+                del os.environ["PA_TPU_BSR"]
+            assert dA_ell.bsr_bs is None and dA_ell.dia_mode is None
+            dt_ell = measure(
+                dA_ell, f"{n}^3 padded-ELL", backend, xe, jax
+            )
+            rec["ell_gflops"] = round(flops / dt_ell / 1e9, 2)
     finally:
         del os.environ["PA_TPU_SD"]
-    assert dA_ell.bsr_bs is None and dA_ell.dia_mode is None
-    dt_ell = measure(dA_ell, "padded-ELL SpMV (both fast paths off)")
+
+    # host oracle on the same local CSR
+    import statistics
 
     xv = np.asarray(xe.values.part_values()[0], dtype=np.float32)
     csr_spmv(M, xv)
@@ -164,22 +174,53 @@ def main():
         t0 = time.perf_counter()
         csr_spmv(M, xv)
         ts.append(time.perf_counter() - t0)
-    host_dt = statistics.median(ts)
-    flops = dA.flops_per_spmv  # same dA as the SD leg above
-    print(
-        f"host oracle: {host_dt*1e3:.1f} ms; SD vs BSR {dt_bsr/dt_sd:.1f}x, "
-        f"BSR vs ELL {dt_ell/dt_bsr:.1f}x, SD vs host {host_dt/dt_sd:.1f}x",
-        flush=True,
-    )
-    import json
+    rec["host_gflops"] = round(flops / statistics.median(ts) / 1e9, 2)
+    return rec
 
+
+def main():
+    import jax
+
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu.parallel.tpu import TPUBackend
+
+    sizes = [
+        int(s) for s in os.environ.get("PA_IRR_SIZES", "32,48").split(",")
+    ]
+    out_path = os.environ.get(
+        "PA_IRR_OUT",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "IRREGULAR_BENCH.json",
+        ),
+    )
+    backend = TPUBackend(devices=jax.devices()[:1])
+    rows = []
+    rec = {"methodology": METHODOLOGY, "sizes": rows}
+    for n in sizes:
+        # ELL only on the SMALLEST mesh (docstring contract): its
+        # element-at-a-time gathers take minutes on bigger ones
+        r = bench_size(n, backend, jax, pa, with_ell=(n == min(sizes)))
+        if n == 32:
+            lo, hi = BAND_SD_32
+            r["band"] = {
+                "key": "irregular_sd_gflops_32",
+                "lo": lo, "hi": hi, "measured": r["sd_gflops"],
+            }
+            r["in_band"] = bool(lo <= r["sd_gflops"] <= hi)
+        rows.append(r)
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1, sort_keys=True)
+        jax.clear_caches()
+    head = rows[0]
     print(json.dumps({
-        "metric": f"irregular_spmv_gflops_tet_elasticity_{n}cube_f32",
-        "value": round(flops / dt_sd / 1e9, 2),
+        "metric": f"irregular_spmv_gflops_tet_elasticity_{sizes[0]}cube_f32",
+        "value": head["sd_gflops"],
         "unit": "GFLOP/s",
-        "vs_baseline": round(dt_bsr / dt_sd, 2),
-        "bsr_gflops": round(flops / dt_bsr / 1e9, 2),
-        "ell_gflops": round(flops / dt_ell / 1e9, 2),
+        "vs_baseline": round(
+            head["sd_gflops"] / max(head.get("bsr_gflops", 1e-9), 1e-9), 2
+        ),
+        "artifact": os.path.basename(out_path),
     }))
 
 

@@ -61,8 +61,9 @@ def main():
             "spread_pct": round(100 * (max(v) - min(v)) / statistics.median(v), 1),
         }
     print(json.dumps(out, indent=1), flush=True)
+    name = os.environ.get("PA_REPRO_NAME", "repro_r5.json")
     with open(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "docs", "repro_r4.json"), "w") as f:
+            os.path.abspath(__file__))), "docs", name), "w") as f:
         json.dump(out, f, indent=1)
 
 

@@ -25,6 +25,57 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+#: Reproducibility bands for the 464^3 flagship record (round-5
+#: directive 5). Device-timed metrics get HARD bands (a same-chip rerun
+#: outside them means a kernel regression or relay trouble); host phases
+#: get ADVISORY bands — the driver shares this single-core host with
+#: background compiles, and contention alone has doubled host phases
+#: between otherwise identical runs (r4: hierarchy 86 s quiet vs 139 s
+#: contended). The guard rule: investigate a host-phase excursion only
+#: if it reproduces on a quiet host. Provenance: r4/r5 runs +
+#: SCALE_CURVE.json, docs/performance.md.
+SCALE_BANDS = {
+    "per_iteration_ms": (8.0, 10.5, "device"),
+    "gmg.per_iteration_ms": (170.0, 215.0, "device"),
+    "assembly_s": (55.0, 130.0, "host-advisory"),
+    "lowering_s": (28.0, 46.0, "host-advisory"),
+    "gmg.hierarchy_s": (75.0, 165.0, "host-advisory"),
+}
+
+
+def annotate_bands(rec):
+    """Stamp each banded metric with its band + in/out verdict (only at
+    the flagship n=464 — the bands are calibrated there)."""
+    if rec.get("n") != 464:
+        return
+    out = {}
+    for key, (lo, hi, kind) in SCALE_BANDS.items():
+        node, k = (
+            (rec.get("gmg", {}), key.split(".", 1)[1])
+            if key.startswith("gmg.")
+            else (rec, key)
+        )
+        if k not in node:
+            continue
+        v = node[k]
+        out[key] = {
+            "lo": lo, "hi": hi, "measured": v, "kind": kind,
+            "in_band": bool(lo <= v <= hi),
+        }
+    rec["bands"] = out
+    device_keys = {
+        k for k, (_lo, _hi, kind) in SCALE_BANDS.items() if kind == "device"
+    }
+    if device_keys <= set(out):
+        rec["bands_ok_device"] = all(
+            out[k]["in_band"] for k in device_keys
+        )
+    else:
+        # a leg died before its banded metric was recorded: the verdict
+        # must not read as "all device bands passed"
+        rec["bands_ok_device"] = None
+        rec["bands_missing"] = sorted(device_keys - set(out))
+
 
 def main():
     import jax
@@ -275,6 +326,7 @@ def main():
         return True
 
     def _flush():
+        annotate_bands(rec)
         with open(out_path, "w") as f:
             json.dump(rec, f, indent=1, sort_keys=True)
 
