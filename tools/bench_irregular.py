@@ -12,6 +12,7 @@ Lowerings measured per size on the real integrated paths:
 
     python tools/bench_irregular.py            # sizes 32,48
     PA_IRR_SIZES=32 python tools/bench_irregular.py
+    PA_IRR_ELL=0 ...                           # skip the ELL leg
 """
 from __future__ import annotations
 
@@ -199,8 +200,18 @@ def main():
     rec = {"methodology": METHODOLOGY, "sizes": rows}
     for n in sizes:
         # ELL only on the SMALLEST mesh (docstring contract): its
-        # element-at-a-time gathers take minutes on bigger ones
-        r = bench_size(n, backend, jax, pa, with_ell=(n == min(sizes)))
+        # element-at-a-time gathers take minutes on bigger ones, and its
+        # giant gather kernels FAULTED the relay's TPU worker at 64^3
+        # (isolated by probe: SD and BSR alone are fine there).
+        # PA_IRR_ELL=0 skips it entirely.
+        r = bench_size(
+            n, backend, jax, pa,
+            with_ell=(
+                n == min(sizes)
+                and n < 64  # ELL's gather kernels FAULT the device at 64^3
+                and os.environ.get("PA_IRR_ELL", "1") != "0"
+            ),
+        )
         if n == 32:
             lo, hi = BAND_SD_32
             r["band"] = {
