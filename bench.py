@@ -225,7 +225,8 @@ def bench_halo(n: int, backend, pa) -> dict:
                     0, k, lambda _, xv: step_body(xv), xs[0]
                 )[None]
 
-            from jax import shard_map
+            from partitionedarrays_jl_tpu.parallel.tpu import _shard_map
+            shard_map = _shard_map()
 
             return shard_map(
                 shard_fn, mesh=mesh, in_specs=(spec,), out_specs=spec,
@@ -256,7 +257,8 @@ def bench_halo(n: int, backend, pa) -> dict:
 
                 return jax.lax.fori_loop(0, k, step, xv)[None]
 
-            from jax import shard_map
+            from partitionedarrays_jl_tpu.parallel.tpu import _shard_map
+            shard_map = _shard_map()
 
             return shard_map(
                 shard_fn, mesh=mesh, in_specs=(spec,) * 4, out_specs=spec,

@@ -15,9 +15,15 @@ from __future__ import annotations
 
 import math
 import os
+import sys
 from typing import Sequence, Tuple
 
 import numpy as np
+
+#: one-shot latch for the PA_TPU_PLAN_PROCS fallback warning: a broken
+#: multi-process planning setup must be visible, but once per process,
+#: not once per part
+_PLAN_FALLBACK_WARNED = False
 
 from ..parallel.backends import AbstractPData, map_parts
 from ..utils.helpers import check
@@ -222,10 +228,23 @@ def _try_stencil_fast(rows, ns, center, arm_coefs, dtype, decoupled,
                     ns, iset.box_lo, iset.box_hi, center, arm_vals, gg,
                     dtype, plan_procs, decouple=decoupled, xtab=xtab,
                 )
-            except Exception:
+            except Exception as e:
                 # shm/spawn failures (small /dev/shm, guard-less user
                 # __main__) must degrade to the serial emission, which
-                # needs neither subprocesses nor shared memory
+                # needs neither subprocesses nor shared memory — but the
+                # operator who asked for K workers gets told ONCE why the
+                # run is planning serially
+                global _PLAN_FALLBACK_WARNED
+                if not _PLAN_FALLBACK_WARNED:
+                    _PLAN_FALLBACK_WARNED = True
+                    print(
+                        f"partitionedarrays_jl_tpu: PA_TPU_PLAN_PROCS="
+                        f"{plan_procs} requested but parallel stencil "
+                        f"emission failed ({type(e).__name__}: {e}); "
+                        "falling back to serial planning",
+                        file=sys.stderr,
+                        flush=True,
+                    )
                 res = None
         if res is None:
             res = native.stencil_emit(

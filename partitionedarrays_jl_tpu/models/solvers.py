@@ -50,6 +50,8 @@ def cg(
     maxiter: Optional[int] = None,
     verbose: bool = False,
     pipelined: bool = False,
+    checkpoint=None,
+    _resume_state: Optional[dict] = None,
 ) -> Tuple[PVector, dict]:
     """Conjugate gradients for SPD `A`. The start vector lives on
     ``A.cols`` — the PRange carrying the column ghost layer — mirroring the
@@ -68,44 +70,104 @@ def cg(
     textbook recurrence, so the iteration trajectory is identical; on
     the host backend the flag is a no-op (eager NumPy has no fusion to
     exploit — the standard loop IS the lag-1 loop's value sequence).
+
+    Resilience hooks: ``checkpoint`` takes a
+    `parallel.checkpoint.SolverCheckpointer`; every ``checkpoint.every``
+    iterations the FULL recurrence state (x, r, p + scalars) is saved in
+    partition-independent form, and `resume_solve` /
+    `solve_with_recovery` continue the exact recurrence from it (same
+    trajectory, bit-identical final iterate on the same partition).
+    Health guards (parallel/health.py) cost one scalar test per
+    iteration on the already-reduced r·r — no extra collectives — and
+    raise typed `SolverHealthError`s instead of silently diverging.
     """
     from ..parallel.tpu import TPUBackend, tpu_cg
 
     if isinstance(b.values.backend, TPUBackend):
+        if checkpoint is not None or _resume_state is not None:
+            raise ValueError(
+                "cg: per-iteration checkpointing is a host-loop feature — "
+                "the compiled device solve cannot stop mid-program; use "
+                "models.solvers.solve_with_recovery, which chunks the "
+                "compiled solve at checkpoint boundaries"
+            )
         # Device path: the whole loop is one compiled shard_map program.
         return tpu_cg(
             A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose,
             pipelined=pipelined,
         )
+    from ..parallel.health import (
+        SolverBreakdownError,
+        StagnationDetector,
+        check_finite_scalar,
+        health_enabled,
+        stagnation_raises,
+    )
 
-    x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
     floor_warned = warn_tol_below_floor(tol, b.dtype, name="cg")
 
-    r = b.copy()  # rows-range residual
-    q = A @ x
-    _owned_update(r, lambda rv, qv: rv - qv, q)
-    p = PVector.full(0.0, A.cols, dtype=b.dtype)
-    _owned_assign(p, r)
-    rs = r.dot(r)
-    rs0 = rs
-    history = [np.sqrt(rs)]
-    it = 0
+    if _resume_state is not None:
+        x, r, p = _resume_state["x"], _resume_state["r"], _resume_state["p"]
+        meta = _resume_state["meta"]
+        rs, rs0, it = meta["rs"], meta["rs0"], int(meta["it"])
+        history = [np.float64(h) for h in meta["history"]]
+    else:
+        x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
+        r = b.copy()  # rows-range residual
+        q = A @ x
+        _owned_update(r, lambda rv, qv: rv - qv, q)
+        p = PVector.full(0.0, A.cols, dtype=b.dtype)
+        _owned_assign(p, r)
+        rs = r.dot(r)
+        rs0 = rs
+        history = [np.sqrt(rs)]
+        it = 0
+    health = health_enabled()
+    if health and _resume_state is None:
+        # a NaN in b/x0 (or in the initial residual's halo exchange)
+        # makes the while test silently False — guard BEFORE the loop so
+        # a poisoned start raises instead of returning converged=False
+        check_finite_scalar(rs, "cg", it=0, vectors=(("r", r), ("x", x)))
+    stag = StagnationDetector("cg") if health and stagnation_raises() else None
     while np.sqrt(rs) > tol * max(1.0, np.sqrt(rs0)) and it < maxiter:
         q = A @ p
         pq = p.dot(q)  # owned dot across owned-compatible PRanges
-        check(pq != 0.0, "cg: breakdown, p'Ap == 0")
+        if pq == 0.0:
+            raise SolverBreakdownError(
+                "cg: breakdown, p'Ap == 0",
+                diagnostics={"iteration": it, "rs": float(rs)},
+            )
         alpha = rs / pq
         _owned_update(x, lambda xv, pv: xv + alpha * pv, p)
         _owned_update(r, lambda rv, qv: rv - alpha * qv, q)
         rs_new = r.dot(r)
+        if health:
+            # free: rs_new was reduced anyway; the per-part sweep only
+            # runs after the scalar trips
+            check_finite_scalar(
+                rs_new, "cg", it=it + 1,
+                vectors=(("r", r), ("q", q), ("x", x)),
+            )
         beta = rs_new / rs
         _owned_update(p, lambda pv, rv: rv + beta * pv, r)
         rs = rs_new
         history.append(np.sqrt(rs))
         it += 1
+        if stag is not None:
+            stag.update(float(np.sqrt(rs)), it)
+        if checkpoint is not None and checkpoint.due(it):
+            checkpoint.save_state(
+                {"x": x, "r": r, "p": p},
+                {
+                    "method": "cg", "it": it, "rs": rs, "rs0": rs0,
+                    "tol": tol, "maxiter": maxiter, "history": history,
+                },
+            )
         if verbose:
             print(f"cg it={it} residual={np.sqrt(rs):.3e}")
+    if checkpoint is not None:
+        checkpoint.wait()  # the last write must land before we return
     return x, krylov_info(
         it, history, np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
         tol, b.dtype, floor_warned,
@@ -1018,6 +1080,8 @@ def pcg(
     tol: float = 1e-8,
     maxiter: Optional[int] = None,
     verbose: bool = False,
+    checkpoint=None,
+    _resume_state: Optional[dict] = None,
 ) -> Tuple[PVector, dict]:
     """Preconditioned CG. ``minv`` is either an inverse-diagonal PVector
     over A.cols (defaults to `jacobi_preconditioner(A)`) or a *callable*
@@ -1038,6 +1102,11 @@ def pcg(
         minv = jacobi_preconditioner(A)
     apply_minv = callable(minv)
     if isinstance(b.values.backend, TPUBackend):
+        if checkpoint is not None or _resume_state is not None:
+            raise ValueError(
+                "pcg: per-iteration checkpointing is a host-loop feature — "
+                "use models.solvers.solve_with_recovery on the compiled path"
+            )
         from .gmg import GMGHierarchy
 
         if isinstance(minv, GMGHierarchy):
@@ -1055,13 +1124,17 @@ def pcg(
         if not apply_minv:
             return tpu_cg(A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose, minv=minv)
 
-    x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
+    from ..parallel.health import (
+        SolverBreakdownError,
+        StagnationDetector,
+        check_finite_scalar,
+        health_enabled,
+        stagnation_raises,
+    )
+
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
     floor_warned = warn_tol_below_floor(tol, b.dtype, name="pcg")
 
-    r = b.copy()
-    q = A @ x
-    _owned_update(r, lambda rv, qv: rv - qv, q)
     z = PVector.full(0.0, A.cols, dtype=b.dtype)
 
     def _apply_precond():
@@ -1070,31 +1143,69 @@ def pcg(
         else:
             _owned_zip(z, lambda _z, mv, rv: mv * rv, minv, r)
 
-    _apply_precond()
-    p = PVector.full(0.0, A.cols, dtype=b.dtype)
-    _owned_assign(p, z)
-    rs = r.dot(r)
-    rz = r.dot(z)
-    rs0 = rs
-    history = [np.sqrt(rs)]
-    it = 0
+    if _resume_state is not None:
+        x, r, p = _resume_state["x"], _resume_state["r"], _resume_state["p"]
+        meta = _resume_state["meta"]
+        rs, rz, rs0 = meta["rs"], meta["rz"], meta["rs0"]
+        it = int(meta["it"])
+        history = [np.float64(h) for h in meta["history"]]
+    else:
+        x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
+        r = b.copy()
+        q = A @ x
+        _owned_update(r, lambda rv, qv: rv - qv, q)
+        _apply_precond()
+        p = PVector.full(0.0, A.cols, dtype=b.dtype)
+        _owned_assign(p, z)
+        rs = r.dot(r)
+        rz = r.dot(z)
+        rs0 = rs
+        history = [np.sqrt(rs)]
+        it = 0
+    health = health_enabled()
+    if health and _resume_state is None:
+        # see cg: a poisoned start must raise, not silently skip the loop
+        check_finite_scalar(rs, "pcg", it=0, vectors=(("r", r), ("x", x)))
+    stag = StagnationDetector("pcg") if health and stagnation_raises() else None
     while np.sqrt(rs) > tol * max(1.0, np.sqrt(rs0)) and it < maxiter:
         q = A @ p
         pq = p.dot(q)
-        check(pq != 0.0, "pcg: breakdown, p'Ap == 0")
+        if pq == 0.0:
+            raise SolverBreakdownError(
+                "pcg: breakdown, p'Ap == 0",
+                diagnostics={"iteration": it, "rs": float(rs)},
+            )
         alpha = rz / pq
         _owned_update(x, lambda xv, pv: xv + alpha * pv, p)
         _owned_update(r, lambda rv, qv: rv - alpha * qv, q)
         _apply_precond()
         rz_new = r.dot(z)
         rs = r.dot(r)
+        if health:
+            check_finite_scalar(
+                rs, "pcg", it=it + 1,
+                vectors=(("r", r), ("z", z), ("x", x)),
+            )
         beta = rz_new / rz
         _owned_update(p, lambda pv, zv: zv + beta * pv, z)
         rz = rz_new
         history.append(np.sqrt(rs))
         it += 1
+        if stag is not None:
+            stag.update(float(np.sqrt(rs)), it)
+        if checkpoint is not None and checkpoint.due(it):
+            checkpoint.save_state(
+                {"x": x, "r": r, "p": p},
+                {
+                    "method": "pcg", "it": it, "rs": rs, "rz": rz,
+                    "rs0": rs0, "tol": tol, "maxiter": maxiter,
+                    "history": history,
+                },
+            )
         if verbose:
             print(f"pcg it={it} residual={np.sqrt(rs):.3e}")
+    if checkpoint is not None:
+        checkpoint.wait()
     return x, krylov_info(
         it, history, np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
         tol, b.dtype, floor_warned,
@@ -1161,8 +1272,14 @@ def gmres(
         _owned_zip(r, lambda _r, bv, qv: bv - qv, b, q)
         return precond(r)
 
+    from ..parallel.health import check_finite_scalar, health_enabled
+
+    health = health_enabled()
     r = residual_vec()
     beta = r.norm()
+    if health:
+        # see cg: a poisoned b/x0 must raise, not silently "converge"
+        check_finite_scalar(beta, "gmres", it=0, vectors=(("r", r),))
     rs0 = beta
     history = [beta]
     it = 0
@@ -1185,6 +1302,10 @@ def gmres(
                 H[i, j] = hij
                 _owned_update(w, lambda wv, vv: wv - hij * vv, V[i])
             hj1 = w.norm()
+            if health:
+                # free: the norm was reduced anyway; a NaN anywhere in
+                # the Arnoldi step (corrupted halo, overflow) poisons it
+                check_finite_scalar(hj1, "gmres", it=it + 1, vectors=(("w", w),))
             H[j + 1, j] = hj1
             # apply the accumulated rotations to the new column
             for i in range(j):
@@ -1563,3 +1684,291 @@ def bicgstab(
             tol, force=floor_warned,
         ),
     )
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-based recovery (the restart half of the resilience layer;
+# detection lives in parallel/health.py, injection in parallel/faults.py)
+# ---------------------------------------------------------------------------
+
+
+def _solver_state_ranges(A: PSparseMatrix, b: PVector) -> dict:
+    """The target PRanges of a cg/pcg full-state checkpoint: x and p ride
+    A.cols (the ghosted column range every SpMV halo-updates), r rides
+    b's row range."""
+    return {"x": A.cols, "r": b.rows, "p": A.cols}
+
+
+def resume_solve(
+    directory: str,
+    A: PSparseMatrix,
+    b: PVector,
+    method: Optional[str] = None,
+    minv=None,
+    tol: Optional[float] = None,
+    maxiter: Optional[int] = None,
+    verbose: bool = False,
+    checkpoint=None,
+) -> Tuple[PVector, dict]:
+    """Continue a checkpointed Krylov run from its last saved state.
+
+    ``directory`` holds a full-state checkpoint written by a
+    ``SolverCheckpointer`` (the solvers' ``checkpoint=`` hook). The
+    state restores onto WHATEVER partition ``A``/``b`` live on —
+    including a different part count or backend than the run that wrote
+    it (the checkpoint format is partition-independent). On the same
+    host partition the recurrence continues exactly: the resumed run's
+    final iterate is bit-identical to an uninterrupted one. Resuming on
+    the TPU backend (whose compiled loop cannot ingest mid-recurrence
+    state) restarts Krylov from the checkpointed iterate — same answer
+    to solver tolerance, not bitwise.
+
+    ``method``, ``tol``, and ``maxiter`` default to whatever the
+    checkpoint recorded, so a bare ``resume_solve(dir, A, b)`` continues
+    the run the original caller configured; pass ``checkpoint=``
+    (another `SolverCheckpointer`, typically on the same directory) to
+    keep checkpointing the resumed run.
+    """
+    from ..parallel.checkpoint import load_solver_state
+    from ..parallel.tpu import TPUBackend
+
+    state = load_solver_state(directory, _solver_state_ranges(A, b))
+    if state is None:
+        raise ValueError(
+            f"resume_solve: {directory!r} holds no complete solver "
+            "checkpoint (no manifest.json)"
+        )
+    meta = state["meta"]
+    method = method or meta.get("method", "cg")
+    check(method in ("cg", "pcg"), "resume_solve: method is 'cg' or 'pcg'")
+    tol = tol if tol is not None else float(meta.get("tol", 1e-8))
+    if maxiter is None and meta.get("maxiter") is not None:
+        maxiter = int(meta["maxiter"])
+    kw = dict(tol=tol, maxiter=maxiter, verbose=verbose)
+    # exact-recurrence resume needs the full (x, r, p)+scalars state AND
+    # a method match — a cg checkpoint has no rz for pcg (and vice versa
+    # the recurrences differ), so a method switch restarts from the
+    # iterate instead of crashing on the missing scalar
+    full_state = (
+        all(k in state for k in ("x", "r", "p"))
+        and "rs" in meta
+        and meta.get("method") == method
+    )
+    on_device = isinstance(b.values.backend, TPUBackend)
+    if on_device or not full_state:
+        if on_device and checkpoint is not None:
+            raise ValueError(
+                "resume_solve: per-iteration checkpointing is a host-loop "
+                "feature — on the device backend use "
+                "models.solvers.solve_with_recovery to keep checkpointing"
+            )
+        # device loop (cannot ingest mid-recurrence state), an
+        # iterate-only checkpoint (written by the chunked device path),
+        # or a method switch: restart Krylov from the checkpointed
+        # iterate; `checkpoint` keeps checkpointing the restarted run
+        if method == "pcg":
+            x, info = pcg(
+                A, b, x0=state["x"], minv=minv,
+                checkpoint=None if on_device else checkpoint, **kw,
+            )
+        else:
+            x, info = cg(
+                A, b, x0=state["x"],
+                checkpoint=None if on_device else checkpoint, **kw,
+            )
+    elif method == "pcg":
+        x, info = pcg(
+            A, b, minv=minv, checkpoint=checkpoint, _resume_state=state, **kw
+        )
+    else:
+        x, info = cg(A, b, checkpoint=checkpoint, _resume_state=state, **kw)
+    info["resumed_from_iteration"] = int(meta["it"])
+    return x, info
+
+
+def solve_with_recovery(
+    A: PSparseMatrix,
+    b: PVector,
+    method: str = "cg",
+    checkpoint_dir: Optional[str] = None,
+    every: int = 25,
+    max_restarts: int = 2,
+    minv=None,
+    x0: Optional[PVector] = None,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    verbose: bool = False,
+) -> Tuple[PVector, dict]:
+    """Run a Krylov solve under the full resilience layer: periodic
+    checkpoints every ``every`` iterations plus automatic
+    restart-from-last-checkpoint when any `SolverHealthError` fires —
+    a NaN-poisoned halo exchange caught by the health guards, an
+    exchange timeout from a dropped part, a lost controller, a Krylov
+    breakdown. Up to ``max_restarts`` restarts; the final info dict
+    carries ``info["restarts"]`` (and the per-failure record under
+    ``info["failures"]``).
+
+    Host backends checkpoint the FULL recurrence state in-loop, so a
+    restart replays the exact trajectory (the fault-free and
+    faulted-then-recovered runs agree bitwise on the same partition).
+    On the TPU backend the whole solve is one compiled program that
+    cannot stop mid-loop, so the solve runs in ``every``-iteration
+    chunks with the iterate checkpointed between chunks; a restart
+    re-enters Krylov from the checkpointed iterate (same answer to
+    solver tolerance, not bitwise — conjugacy restarts at the chunk
+    boundary).
+
+    Without ``checkpoint_dir`` nothing is written and a restart begins
+    from ``x0`` — detection and bounded retry, no persistence.
+    """
+    import sys
+
+    from ..parallel.checkpoint import SolverCheckpointer, load_solver_state
+    from ..parallel.health import SolverHealthError
+    from ..parallel.tpu import TPUBackend
+
+    check(
+        method in ("cg", "pcg"), "solve_with_recovery: method is 'cg' or 'pcg'"
+    )
+    ckpt = (
+        SolverCheckpointer(checkpoint_dir, every=every)
+        if checkpoint_dir is not None
+        else None
+    )
+    if isinstance(b.values.backend, TPUBackend):
+        return _solve_with_recovery_chunked(
+            A, b, method, ckpt, every, max_restarts, minv, x0, tol,
+            maxiter, verbose,
+        )
+
+    restarts = 0
+    failures = []
+    state = None
+    while True:
+        try:
+            kwargs = dict(
+                tol=tol, maxiter=maxiter, verbose=verbose,
+                checkpoint=ckpt, _resume_state=state,
+            )
+            if method == "pcg":
+                x, info = pcg(A, b, x0=x0, minv=minv, **kwargs)
+            else:
+                x, info = cg(A, b, x0=x0, **kwargs)
+            info["restarts"] = restarts
+            if failures:
+                info["failures"] = failures
+            return x, info
+        except SolverHealthError as e:
+            failures.append(
+                {"type": type(e).__name__, "message": str(e),
+                 "diagnostics": e.diagnostics}
+            )
+            if restarts >= max_restarts:
+                raise
+            restarts += 1
+            state = None
+            if ckpt is not None:
+                try:
+                    ckpt.wait()  # let an in-flight write land first
+                except Exception:
+                    pass
+                if ckpt.has_state():
+                    state = load_solver_state(
+                        ckpt.directory, _solver_state_ranges(A, b)
+                    )
+            print(
+                f"[partitionedarrays_jl_tpu] {method}: "
+                f"{type(e).__name__}: {e} — restart {restarts}/"
+                f"{max_restarts} from "
+                + ("last checkpoint" if state is not None else "scratch"),
+                file=sys.stderr,
+                flush=True,
+            )
+
+
+def _solve_with_recovery_chunked(
+    A, b, method, ckpt, every, max_restarts, minv, x0, tol, maxiter, verbose
+):
+    """Device-backend recovery: the compiled one-program solve runs in
+    ``every``-iteration chunks, checkpointing the iterate between chunks
+    (x only — the compiled loop's internals never leave the device).
+    Convergence is judged against the FIRST chunk's initial residual so
+    the chunked run answers the same relative-tolerance question as an
+    unchunked one."""
+    import sys
+
+    from ..parallel.checkpoint import load_solver_state
+    from ..parallel.health import SolverHealthError
+
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    chunk = max(1, int(every)) if ckpt is not None else maxiter
+    x = x0.copy() if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
+    solver = pcg if method == "pcg" else cg
+    kw = {"minv": minv} if method == "pcg" else {}
+    done = 0
+    restarts = 0
+    failures = []
+    residuals = []
+    rs0 = None
+    info = None
+    while done < maxiter:
+        try:
+            x_new, info = solver(
+                A, b, x0=x, tol=tol, maxiter=min(chunk, maxiter - done),
+                verbose=verbose, **kw,
+            )
+        except SolverHealthError as e:
+            failures.append(
+                {"type": type(e).__name__, "message": str(e),
+                 "diagnostics": e.diagnostics}
+            )
+            if restarts >= max_restarts:
+                raise
+            restarts += 1
+            if ckpt is not None and ckpt.has_state():
+                # full ranges: the directory may hold a FULL-state (x,r,p)
+                # checkpoint written by a host run of the same job —
+                # load_checkpoint needs a target range for every object
+                # present (extra entries for absent objects are ignored)
+                st = load_solver_state(
+                    ckpt.directory, _solver_state_ranges(A, b)
+                )
+                x = st["x"]
+                done = int(st["meta"].get("it", done))
+            print(
+                f"[partitionedarrays_jl_tpu] {method} (chunked): "
+                f"{type(e).__name__}: {e} — restart {restarts}/{max_restarts}",
+                file=sys.stderr,
+                flush=True,
+            )
+            continue
+        x = x_new
+        if rs0 is None:
+            rs0 = float(info["residuals"][0]) if len(info["residuals"]) else 0.0
+        done += int(info["iterations"])
+        residuals.extend(float(v) for v in info["residuals"][1:])
+        final = float(info["residuals"][-1]) if len(info["residuals"]) else 0.0
+        if final <= tol * max(1.0, rs0):
+            break
+        if int(info["iterations"]) == 0:
+            break  # the chunk made no progress; avoid spinning forever
+        if ckpt is not None:
+            ckpt.save_state(
+                {"x": x}, {"method": method, "it": done, "tol": tol}
+            )
+    if ckpt is not None:
+        ckpt.wait()
+    final = residuals[-1] if residuals else (rs0 or 0.0)
+    from ..utils.helpers import krylov_info
+
+    out = krylov_info(
+        done, [rs0 or 0.0] + residuals,
+        final <= tol * max(1.0, rs0 or 0.0), tol, b.dtype, False,
+        final_rel=_final_true_rel(
+            A, x, b, final / max(1.0, rs0 or 1.0), rs0 or 0.0, tol
+        ),
+    )
+    out["restarts"] = restarts
+    if failures:
+        out["failures"] = failures
+    return x, out

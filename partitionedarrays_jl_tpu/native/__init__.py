@@ -635,6 +635,42 @@ def stencil_emit(
     return out + (bout,) if with_b else out
 
 
+def _interior_prefix(dims, lo, hi, t, d):
+    """#cells with ALL coordinates grid-interior among the first ``t``
+    cells (C-order) of the box restricted to dims ``d..``."""
+    if t <= 0:
+        return 0
+    if d == len(dims):
+        return 1
+    inner = 1
+    for e in range(d + 1, len(dims)):
+        inner *= int(hi[e]) - int(lo[e])
+    s, r = divmod(int(t), inner)
+    # full leading planes: interior dim-d coords in [lo, lo+s)
+    lead = max(0, min(int(lo[d]) + s, int(dims[d]) - 1) - max(int(lo[d]), 1))
+    full_inner = 1
+    for e in range(d + 1, len(dims)):
+        full_inner *= max(
+            0, min(int(hi[e]), int(dims[e]) - 1) - max(int(lo[e]), 1)
+        )
+    cnt = lead * full_inner
+    if r and 1 <= int(lo[d]) + s <= int(dims[d]) - 2:
+        cnt += _interior_prefix(dims, lo, hi, r, d + 1)
+    return cnt
+
+
+def _range_nnz(dims, lo, hi, row0, row1):
+    """Exact nonzero count of box rows [row0, row1): interior grid cells
+    emit 2*dim+1 entries, boundary (identity) cells 1 — the closed form
+    `parallel_emit.slab_nnz` uses for whole dim-0 slabs, generalized to
+    an arbitrary row range via an interior-cell prefix count."""
+    dim = len(dims)
+    return (row1 - row0) + 2 * dim * (
+        _interior_prefix(dims, lo, hi, row1, 0)
+        - _interior_prefix(dims, lo, hi, row0, 0)
+    )
+
+
 def stencil_emit_range(
     dims, lo, hi, center, arm_vals, ghost_gids, dtype, row0, row1,
     indptr_out, cols_out, vals_out, b_out=None, decouple=False, xtab=None,
@@ -646,13 +682,44 @@ def stencil_emit_range(
     read when `xtab` is given). Column ids stay in the FULL part's
     numbering, so K workers over disjoint ranges fill disjoint slices of
     the one-shot emission's arrays byte-identically. Returns the range's
-    nnz, or None when the native layer is absent/ineligible."""
+    nnz, or None when the native layer is absent/ineligible.
+
+    Buffer geometry is validated against the closed-form range nnz
+    BEFORE the C++ kernel runs: an undersized caller buffer is a Python
+    `ValueError` here, never a native out-of-bounds write."""
     lib = _load()
     dim = len(dims)
     dt = np.dtype(dtype).name
     if lib is None or dim > 3 or dt not in _FLOAT_FN:
         return None
+    row0, row1 = int(row0), int(row1)
+    no = 1
+    for l, h in zip(lo, hi):
+        no *= int(h - l)
+    if not (0 <= row0 <= row1 <= no):
+        raise ValueError(
+            f"stencil_emit_range: row range [{row0}, {row1}) outside the "
+            f"box's {no} rows"
+        )
+    if len(indptr_out) != row1 - row0 + 1:
+        raise ValueError(
+            f"stencil_emit_range: indptr_out has {len(indptr_out)} "
+            f"entries, range [{row0}, {row1}) needs {row1 - row0 + 1}"
+        )
+    need = _range_nnz(dims, lo, hi, row0, row1)
+    if len(cols_out) < need or len(vals_out) < need:
+        raise ValueError(
+            f"stencil_emit_range: cols_out/vals_out hold "
+            f"{len(cols_out)}/{len(vals_out)} entries, rows "
+            f"[{row0}, {row1}) emit {need} nonzeros"
+        )
     with_b = xtab is not None
+    if with_b and (b_out is None or len(b_out) < row1 - row0):
+        raise ValueError(
+            f"stencil_emit_range: b_out holds "
+            f"{0 if b_out is None else len(b_out)} entries, "
+            f"range [{row0}, {row1}) needs {row1 - row0}"
+        )
     if with_b:
         xt = np.ascontiguousarray(xtab, dtype=np.float64)
         if len(xt) != int(np.sum(dims)):

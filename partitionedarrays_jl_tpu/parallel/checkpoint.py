@@ -18,11 +18,13 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import threading
 from typing import Dict, Optional, Union
 
 import numpy as np
 
 from .backends import AbstractPData, map_parts
+from .health import retry_with_backoff
 from .prange import PRange
 from .psparse import PSparseMatrix
 from .pvector import PVector, _owned
@@ -349,10 +351,38 @@ def _atomic_json(path: str, obj: dict) -> None:
     try:
         with open(tmp, "w") as f:
             json.dump(obj, f, indent=1, sort_keys=True)
-        os.replace(tmp, path)
+        _replace_with_retry(
+            tmp, path, f"checkpoint index publish ({os.path.basename(path)})"
+        )
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+def _replace_with_retry(tmp: str, path: str, describe: str) -> None:
+    """`os.replace` with backoff for shared-filesystem races (NFS ESTALE,
+    transient EACCES on overlay mounts) — aware that the failure mode
+    being retried may have COMMITTED the rename before erroring: a retry
+    that finds tmp gone and path present after such an error is a
+    success, not a FileNotFoundError to propagate."""
+    maybe_landed = [False]
+
+    def _do():
+        try:
+            os.replace(tmp, path)
+        except FileNotFoundError:
+            if (
+                maybe_landed[0]
+                and not os.path.exists(tmp)
+                and os.path.exists(path)
+            ):
+                return  # the errored attempt actually landed
+            raise
+        except OSError:
+            maybe_landed[0] = True
+            raise
+
+    retry_with_backoff(_do, exceptions=(OSError,), describe=describe)
 
 
 def save_checkpoint(
@@ -450,7 +480,114 @@ def _atomic_savez(path: str, **arrays) -> None:
         # np.savez(appends .npz to bare paths) — hand it the open file
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
-        os.replace(tmp, path)
+        _replace_with_retry(
+            tmp, path, f"checkpoint write ({os.path.basename(path)})"
+        )
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+# ---------------------------------------------------------------------------
+# solver-state checkpointing (the recovery half of the resilience layer)
+# ---------------------------------------------------------------------------
+
+
+class SolverCheckpointer:
+    """Periodic, optionally asynchronous checkpointing hook for solver
+    loops (``cg``/``pcg`` take one via their ``checkpoint=`` argument;
+    `models.solvers.solve_with_recovery` builds one for you).
+
+    Every ``every`` iterations the loop hands over its FULL recurrence
+    state (the iterate plus the residual/direction vectors and scalars),
+    which is snapshotted synchronously — owned-value copies, so the loop
+    may keep mutating — and serialized through `save_checkpoint`'s
+    partition-independent format in a background thread
+    (``async_write=True``, the default). A checkpoint therefore restores
+    onto ANY part count, and a resumed run continues the recurrence
+    exactly: same trajectory, bit-identical final iterate on the same
+    partition (the `tests/test_faults.py` contract).
+
+    One write is in flight at a time; a failed background write
+    re-raises on the next `save_state`/`wait`. The manifest is written
+    last (see `save_checkpoint`), so a crash mid-write leaves the
+    previous complete checkpoint readable.
+    """
+
+    def __init__(self, directory: str, every: int = 25, async_write: bool = True):
+        self.directory = str(directory)
+        self.every = int(every)
+        self.async_write = bool(async_write)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def due(self, it: int) -> bool:
+        return self.every > 0 and it > 0 and it % self.every == 0
+
+    def save_state(self, vectors: Dict[str, PVector], meta: dict) -> None:
+        """Snapshot ``vectors`` (copied now) + ``meta`` (scalars; numpy
+        types are converted to JSON-native) and write the checkpoint."""
+        self.wait()  # one writer at a time; surfaces a prior failure
+        objs = {k: v.copy() for k, v in vectors.items()}
+        meta = _json_safe_meta(meta)
+        if self.async_write:
+            t = threading.Thread(
+                target=self._write, args=(objs, meta), daemon=True,
+                name="pa-checkpoint-writer",
+            )
+            self._thread = t
+            t.start()
+        else:
+            self._write(objs, meta)
+            self.wait()
+
+    def _write(self, objs, meta):
+        try:
+            save_checkpoint(self.directory, objs, meta=meta)
+        except BaseException as e:  # surfaced on the next save/wait
+            self._error = e
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) lands; re-raise its
+        failure if it had one."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e
+
+    def has_state(self) -> bool:
+        return os.path.isfile(os.path.join(self.directory, "manifest.json"))
+
+
+def _json_safe_meta(meta: dict) -> dict:
+    """Scalars/lists of numpy numbers -> JSON-native (Python repr round-
+    trips floats exactly, so resumed scalars are bit-identical)."""
+
+    def conv(v):
+        if isinstance(v, (np.floating,)):
+            return float(v)
+        if isinstance(v, (np.integer,)):
+            return int(v)
+        if isinstance(v, np.ndarray):
+            return [conv(x) for x in v.tolist()]
+        if isinstance(v, (list, tuple)):
+            return [conv(x) for x in v]
+        if isinstance(v, dict):
+            return {k: conv(x) for k, x in v.items()}
+        return v
+
+    return conv(dict(meta))
+
+
+def load_solver_state(
+    directory: str, ranges: Dict[str, PRange]
+) -> Optional[Dict[str, Union[PVector, PSparseMatrix, dict]]]:
+    """Restore a solver-state checkpoint written by `SolverCheckpointer`
+    onto ``ranges`` (any partition of the same global sizes), or None
+    when ``directory`` holds no complete checkpoint yet — the caller
+    then restarts from scratch instead of failing."""
+    if not os.path.isfile(os.path.join(directory, "manifest.json")):
+        return None
+    return load_checkpoint(directory, ranges)

@@ -199,8 +199,38 @@ def async_exchange_into(
     """Non-blocking in-place sparse exchange: per part, one value (or one
     Table row) per neighbor (reference async_exchange!:
     src/Interfaces.jl:349-367 and the Table variant :393-450). Returns a
-    PData of Tokens."""
-    return data_snd._async_exchange(data_rcv, parts_rcv, parts_snd)
+    PData of Tokens.
+
+    This is the ONE choke point every halo update, ghost assembly, and
+    planning exchange funnels through, so it is where the chaos harness
+    (parallel/faults.py) injects: corrupted payloads are swapped in
+    before the wire copy, and a `drop` clause turns the returned tokens
+    into the timeout path — waiting on them raises
+    `ExchangeTimeoutError` naming the missing senders. With no active
+    fault spec (the default) the only overhead is one boolean check."""
+    from .faults import exchange_faults_hook, faults_active
+
+    dropped = None
+    if faults_active():
+        data_snd, dropped = exchange_faults_hook(data_snd, parts_snd)
+    t = data_snd._async_exchange(data_rcv, parts_rcv, parts_snd)
+    if dropped:
+        from .health import ExchangeTimeoutError
+
+        def _timeout(tok: Token):
+            def _wait():
+                tok.wait()
+                raise ExchangeTimeoutError(
+                    f"exchange deadline expired: no contribution from "
+                    f"part(s) {dropped} (injected drop); received buffers "
+                    "are in an unspecified partial state",
+                    diagnostics={"missing_parts": list(dropped), "injected": True},
+                )
+
+            return Token(wait_fn=_wait)
+
+        t = map_parts(_timeout, t)
+    return t
 
 
 def async_exchange(

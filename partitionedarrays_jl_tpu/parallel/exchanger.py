@@ -28,7 +28,36 @@ from ..utils.helpers import check
 from ..utils.table import INDEX_DTYPE, Table
 from .backends import AbstractPData, Token, map_parts, schedule_and_wait
 from .collectives import async_exchange_into, discover_parts_snd, exchange
+from .health import NonFiniteError, exchange_validation_enabled
 from .index_sets import AbstractIndexSet
+
+
+def _validate_rcv_finite(data_rcv: AbstractPData, exchanger: "Exchanger"):
+    """Opt-in (``PA_HEALTH_EXCHANGE=1``) post-exchange guard: every
+    RECEIVED halo payload must be finite, and a violation is reported
+    with the receiving part, the sending neighbor, and the entry count —
+    the earliest possible detection point for a NaN-poisoned exchange
+    (the solvers' free scalar guards catch it one reduction later)."""
+    bad = {}
+    for p, (buf, nbrs) in enumerate(
+        zip(data_rcv.part_values(), exchanger.parts_rcv.part_values())
+    ):
+        data = np.asarray(buf.data) if isinstance(buf, Table) else np.asarray(buf)
+        if data.dtype.kind != "f" or np.isfinite(data).all():
+            continue
+        per = {}
+        if isinstance(buf, Table):
+            for j, q in enumerate(np.asarray(nbrs)):
+                row = np.asarray(buf[j])
+                n = int((~np.isfinite(row)).sum())
+                if n:
+                    per[int(q)] = n
+        bad[int(p)] = {"from_parts": per, "total": int((~np.isfinite(data)).sum())}
+    if bad:
+        raise NonFiniteError(
+            f"exchange: non-finite halo payload received on part(s) "
+            f"{sorted(bad)}", diagnostics={"parts": bad},
+        )
 
 
 class Exchanger:
@@ -221,6 +250,8 @@ def async_exchange_values(
     )
     t = async_exchange_into(data_rcv, data_snd, exchanger.parts_rcv, exchanger.parts_snd)
     schedule_and_wait(t)
+    if exchange_validation_enabled():
+        _validate_rcv_finite(data_rcv, exchanger)
 
     def _unpack_all():
         def _unpack(vals, buf: Table, t: Table):

@@ -1,0 +1,299 @@
+"""Deterministic fault injection for the parallel stack (chaos harness).
+
+Resilience is only real if it is testable without real TPUs dying. This
+module injects failures into the ONE choke point every halo update,
+ghost-assembly, and planning exchange funnels through —
+`collectives.async_exchange_into` — deterministically, driven by a
+seeded spec. The detection/recovery half lives in `parallel/health.py`
+and `models/solvers.py` (`solve_with_recovery`).
+
+Activation (either):
+
+* environment: ``PA_FAULT_SPEC="nan@part=1,call=3"`` (read dynamically —
+  set it before the run you want poisoned), seed via ``PA_FAULT_SEED``;
+* code: ``with inject_faults("nan@part=1,call=3", seed=42) as st: ...``
+  (nestable; the innermost spec wins; ``st.events`` records what fired).
+
+Spec grammar — ``;``-separated clauses, each ``kind@key=val,key=val``:
+
+    kind    one of
+            nan        overwrite selected snd-payload entries with NaN
+            bitflip    XOR one mantissa bit of selected entries
+            drop       the matched part's contribution never completes:
+                       waiting on the exchange raises ExchangeTimeoutError
+                       naming the missing sender (the timeout path)
+            delay      sleep `seconds` at the matched call — one slow
+                       host stalls the whole exchange (everyone waits on
+                       the slowest sender), so the sleep applies to the
+                       call; `part` gates whether the clause fires
+            controller part's controller dies: ControllerLostError
+    part    sending part id, or ``*`` (default: any part). An id outside
+            the run's part grid matches nothing (the clause is inert).
+    call    global exchange-call index this clause fires at (``*`` = every
+            call; default ``*``).  The counter starts at 0 when the spec
+            becomes active and counts every `async_exchange_into`.
+    after   fire at every call index >= this value
+    prob    per-entry corruption probability for nan/bitflip (default 1.0;
+            at least one entry is corrupted when the payload is nonempty)
+    seconds delay duration for `delay` (default 0.01)
+
+Examples::
+
+    nan@part=1,call=3            # poison part 1's 4th exchange payload
+    bitflip@part=*,after=10,prob=0.01
+    drop@part=2,call=5; controller@call=9
+
+Determinism: one `numpy` Generator seeded from the spec seed drives all
+entry selection; the sequential backend executes parts in order, so a
+given (spec, seed, program) corrupts identical bits on every run.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.table import Table
+from .health import ControllerLostError
+
+__all__ = [
+    "FaultClause",
+    "FaultSpec",
+    "FaultState",
+    "inject_faults",
+    "faults_active",
+    "active_fault_state",
+]
+
+_KINDS = ("nan", "bitflip", "drop", "delay", "controller")
+
+
+@dataclass(frozen=True)
+class FaultClause:
+    kind: str
+    part: Optional[int] = None  # None = any part
+    call: Optional[int] = None  # None = every call (unless `after` set)
+    after: Optional[int] = None  # fire at every call >= after
+    prob: float = 1.0
+    seconds: float = 0.01
+
+    def matches(self, call: int, part: Optional[int] = None) -> bool:
+        if self.after is not None:
+            if call < self.after:
+                return False
+        elif self.call is not None and call != self.call:
+            return False
+        if part is not None and self.part is not None and part != self.part:
+            return False
+        return True
+
+
+class FaultSpec:
+    """A parsed set of fault clauses (see module docstring for grammar)."""
+
+    def __init__(self, clauses: List[FaultClause]):
+        self.clauses = list(clauses)
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        clauses = []
+        for raw in text.split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            kind, _, rest = raw.partition("@")
+            kind = kind.strip().lower()
+            if kind not in _KINDS:
+                raise ValueError(
+                    f"fault spec: unknown kind {kind!r} in {raw!r} "
+                    f"(expected one of {_KINDS})"
+                )
+            kw = {}
+            for item in filter(None, (s.strip() for s in rest.split(","))):
+                key, eq, val = item.partition("=")
+                if not eq:
+                    raise ValueError(
+                        f"fault spec: expected key=value, got {item!r}"
+                    )
+                key = key.strip().lower()
+                val = val.strip()
+                if key in ("part", "call", "after"):
+                    kw[key] = None if val == "*" else int(val)
+                elif key == "prob":
+                    kw[key] = float(val)
+                elif key == "seconds":
+                    kw[key] = float(val)
+                else:
+                    raise ValueError(f"fault spec: unknown key {key!r}")
+            clauses.append(FaultClause(kind=kind, **kw))
+        return cls(clauses)
+
+    def __repr__(self):
+        return f"FaultSpec({self.clauses!r})"
+
+
+@dataclass
+class FaultState:
+    """One active injection session: the spec, the seeded RNG, the
+    global exchange-call counter, and the record of every fault that
+    actually fired (``events`` — tests assert on it)."""
+
+    spec: FaultSpec
+    seed: int = 0
+    call_index: int = 0
+    events: List[dict] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.rng = np.random.default_rng(self.seed)
+
+    def record(self, **ev) -> None:
+        self.events.append(ev)
+
+
+_lock = threading.Lock()
+_stack: List[FaultState] = []
+_env_cache: Tuple[Optional[str], Optional[FaultState]] = (None, None)
+
+
+@contextmanager
+def inject_faults(spec, seed: int = 0):
+    """Activate a fault spec for the dynamic extent of the block.
+    ``spec`` is a `FaultSpec` or a grammar string. Yields the
+    `FaultState` so callers can inspect ``.events`` afterwards."""
+    if isinstance(spec, str):
+        spec = FaultSpec.parse(spec)
+    state = FaultState(spec=spec, seed=seed)
+    with _lock:
+        _stack.append(state)
+    try:
+        yield state
+    finally:
+        with _lock:
+            _stack.remove(state)
+
+
+def active_fault_state() -> Optional[FaultState]:
+    """The innermost active `FaultState`: the top of the context-manager
+    stack, else one built from ``PA_FAULT_SPEC`` (cached per env value so
+    the call counter survives across exchanges)."""
+    if _stack:
+        return _stack[-1]
+    global _env_cache
+    text = os.environ.get("PA_FAULT_SPEC")
+    if not text:
+        if _env_cache[0] is not None:
+            _env_cache = (None, None)
+        return None
+    if _env_cache[0] != text:
+        _env_cache = (
+            text,
+            FaultState(
+                spec=FaultSpec.parse(text),
+                seed=int(os.environ.get("PA_FAULT_SEED", "0") or "0"),
+            ),
+        )
+    return _env_cache[1]
+
+
+def faults_active() -> bool:
+    return bool(_stack) or bool(os.environ.get("PA_FAULT_SPEC"))
+
+
+# ---------------------------------------------------------------------------
+# the exchange hook (called from collectives.async_exchange_into)
+# ---------------------------------------------------------------------------
+
+
+def _corrupt_array(a: np.ndarray, kind: str, prob: float, rng) -> int:
+    """In-place corruption of a float payload; returns #entries hit."""
+    if a.size == 0 or a.dtype.kind != "f":
+        return 0
+    mask = rng.random(a.size) < prob
+    if not mask.any():
+        mask[int(rng.integers(a.size))] = True  # nonempty payload: >= 1 hit
+    idx = np.nonzero(mask)[0]
+    if kind == "nan":
+        a[idx] = np.nan
+    else:  # bitflip: XOR one mantissa bit per selected entry
+        bits = a.view(np.uint64 if a.dtype.itemsize == 8 else np.uint32)
+        shift = rng.integers(0, 20, size=len(idx))
+        bits[idx] ^= (np.uint64(1) << shift.astype(np.uint64)) if a.dtype.itemsize == 8 else (
+            np.uint32(1) << shift.astype(np.uint32)
+        )
+    return int(len(idx))
+
+
+def exchange_faults_hook(data_snd, parts_snd):
+    """Apply the active spec to one exchange. Returns
+    ``(data_snd, dropped_parts)`` — a possibly-corrupted COPY of the snd
+    payloads plus the list of parts whose contribution must be treated
+    as lost (None when nothing fired). Raises `ControllerLostError` for
+    a matched controller clause. Must stay near-free when no spec is
+    active: the caller guards on `faults_active()` first."""
+    state = active_fault_state()
+    if state is None:
+        return data_snd, None
+    call = state.call_index
+    state.call_index += 1
+    live = [c for c in state.spec.clauses if c.matches(call)]
+    if not live:
+        return data_snd, None
+
+    for c in live:
+        if c.kind == "controller":
+            state.record(kind="controller", call=call, part=c.part)
+            raise ControllerLostError(
+                f"injected controller failure at exchange call {call}"
+                + (f" (part {c.part})" if c.part is not None else ""),
+                diagnostics={"call": call, "part": c.part, "injected": True},
+            )
+
+    from .backends import get_part_ids, map_parts
+
+    corrupt = [c for c in live if c.kind in ("nan", "bitflip")]
+    nparts = data_snd.num_parts
+    dropped: List[int] = []
+    for c in live:
+        # a part id outside this run's grid (spec written for a larger
+        # mesh, or a typo) matches NOTHING — it must not widen into the
+        # part=* meaning
+        if c.part is not None and not (0 <= c.part < nparts):
+            continue
+        if c.kind == "drop":
+            hit = [c.part] if c.part is not None else list(range(nparts))
+            for p in hit:
+                if p not in dropped:
+                    dropped.append(p)
+                    state.record(kind="drop", call=call, part=p)
+        elif c.kind == "delay":
+            import time
+
+            state.record(kind="delay", call=call, part=c.part, seconds=c.seconds)
+            time.sleep(c.seconds)
+
+    if corrupt:
+        rng, rec = state.rng, state.record
+
+        def _corrupt_part(p, payload):
+            hits = [c for c in corrupt if c.matches(call, int(p))]
+            if not hits:
+                return payload
+            if isinstance(payload, Table):
+                out = Table(np.array(payload.data, copy=True), payload.ptrs)
+                arr = out.data
+            else:
+                arr = np.array(payload, copy=True)
+                out = arr
+            for c in hits:
+                n = _corrupt_array(arr, c.kind, c.prob, rng)
+                if n:
+                    rec(kind=c.kind, call=call, part=int(p), entries=n)
+            return out
+
+        data_snd = map_parts(_corrupt_part, get_part_ids(data_snd), data_snd)
+
+    return data_snd, (dropped or None)

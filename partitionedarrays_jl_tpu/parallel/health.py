@@ -1,0 +1,283 @@
+"""Solver / communication health guards (the detection half of the
+resilience layer; the injection half is `parallel/faults.py`).
+
+The reference assumes every rank and every exchange succeeds; at the
+production scale the ROADMAP targets (multi-slice meshes over ICI+DCN)
+that assumption breaks. This module supplies the *typed* failure
+vocabulary — `SolverHealthError` and its subclasses, each carrying a
+machine-readable ``diagnostics`` dict — plus the cheap checks that raise
+them:
+
+* **Non-finite detection** piggybacks on reductions the solvers already
+  perform: a NaN/Inf anywhere in a part's owned values poisons the r·r
+  dot, so testing the already-reduced *scalar* costs nothing and adds NO
+  collectives. Only after the scalar trips does the (expensive,
+  off-hot-path) per-part localization pass run to fill in diagnostics.
+  The compiled device loops get the same property in-graph: their
+  `while_loop` condition folds a `jnp.isfinite` of the carried residual
+  into the existing convergence test (parallel/tpu.py:make_cg_fn).
+* **Stagnation / breakdown detection** for the Krylov loops
+  (models/solvers.py): p'Ap == 0 raises `SolverBreakdownError` instead
+  of a strippable assert; an optional stagnation window
+  (``PA_HEALTH_STAGNATION=1``) raises `SolverStagnationError` when the
+  best residual stops improving.
+* **`retry_with_backoff`** — the shared transient-failure wrapper used
+  by `multihost_init` (coordinator not yet up) and the compile-cache /
+  checkpoint I/O paths (shared-filesystem races).
+
+Env knobs (all read dynamically so tests can toggle them):
+
+* ``PA_HEALTH_CHECKS=0`` — disable every health guard (default: on;
+  the guards are scalar tests on already-computed reductions).
+* ``PA_HEALTH_EXCHANGE=1`` — additionally validate *received* halo
+  payloads for finiteness after each host-path exchange (default: off;
+  this one does touch every received entry).
+* ``PA_HEALTH_STAGNATION=1`` — raise on residual stagnation instead of
+  returning ``converged=False`` (default: off — classification via
+  ``info["status"]`` stays the default contract).
+* ``PA_HEALTH_STAGNATION_WINDOW`` (default 32) / ``_FACTOR`` (default
+  0.99) — the stagnation test: over the last WINDOW iterations the best
+  residual must improve below FACTOR x the previous best.
+* ``PA_RETRY_ATTEMPTS`` (default 3) / ``PA_RETRY_BACKOFF`` (default
+  0.5, seconds, doubling, capped at 30) — `retry_with_backoff` defaults.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Callable, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+__all__ = [
+    "SolverHealthError",
+    "NonFiniteError",
+    "SolverBreakdownError",
+    "SolverStagnationError",
+    "ExchangeTimeoutError",
+    "ControllerLostError",
+    "health_enabled",
+    "exchange_validation_enabled",
+    "stagnation_raises",
+    "StagnationDetector",
+    "check_finite_scalar",
+    "check_finite_pvector",
+    "nonfinite_part_diagnostics",
+    "retry_with_backoff",
+]
+
+
+# ---------------------------------------------------------------------------
+# typed failures
+# ---------------------------------------------------------------------------
+
+
+class SolverHealthError(RuntimeError):
+    """Base of every detected-unhealthy condition in the parallel stack.
+
+    ``diagnostics`` is a plain dict safe to log/serialize: per-part
+    findings, the iteration the guard tripped at, the residual history
+    tail, ... — whatever the raising guard knows. Recovery drivers
+    (`models.solvers.solve_with_recovery`) catch THIS type: anything
+    that subclasses it is considered survivable-by-restart.
+    """
+
+    def __init__(self, message: str, diagnostics: Optional[dict] = None):
+        super().__init__(message)
+        self.diagnostics = dict(diagnostics or {})
+
+
+class NonFiniteError(SolverHealthError):
+    """NaN/Inf detected in solver state or an exchanged payload."""
+
+
+class SolverBreakdownError(SolverHealthError):
+    """A Krylov recurrence hit an exact breakdown (p'Ap == 0, ...)."""
+
+
+class SolverStagnationError(SolverHealthError):
+    """The residual stopped improving (only raised when
+    ``PA_HEALTH_STAGNATION=1``; the default contract is
+    ``info["status"] == "stalled"``)."""
+
+
+class ExchangeTimeoutError(SolverHealthError):
+    """A neighbor's contribution never arrived within the exchange
+    deadline (real runs: a slow/failed host; chaos runs: a `drop`
+    fault clause). ``diagnostics["missing_parts"]`` names the senders."""
+
+
+class ControllerLostError(SolverHealthError):
+    """A controller process died mid-run (chaos runs: a `controller`
+    fault clause; multi-host runs: surfaced by the runtime)."""
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+
+def health_enabled() -> bool:
+    return os.environ.get("PA_HEALTH_CHECKS", "1") != "0"
+
+
+def exchange_validation_enabled() -> bool:
+    return os.environ.get("PA_HEALTH_EXCHANGE", "0") == "1"
+
+
+def stagnation_raises() -> bool:
+    return os.environ.get("PA_HEALTH_STAGNATION", "0") == "1"
+
+
+def _stagnation_window() -> int:
+    return max(2, int(os.environ.get("PA_HEALTH_STAGNATION_WINDOW", "32")))
+
+
+def _stagnation_factor() -> float:
+    return float(os.environ.get("PA_HEALTH_STAGNATION_FACTOR", "0.99"))
+
+
+# ---------------------------------------------------------------------------
+# finite checks
+# ---------------------------------------------------------------------------
+
+
+def nonfinite_part_diagnostics(*vectors) -> dict:
+    """Per-part non-finite census over PVectors: for each part with any
+    NaN/Inf, the counts and the first offending local id. This is the
+    *localization* pass — only called after a cheap scalar guard already
+    tripped, so its full sweep is off the hot path."""
+    parts = {}
+    for name, v in vectors:
+        for p, vals in enumerate(v.values.part_values()):
+            a = np.asarray(vals)
+            if a.dtype.kind != "f":
+                continue
+            bad = ~np.isfinite(a)
+            if bad.any():
+                d = parts.setdefault(int(p), {})
+                d[name] = {
+                    "nan": int(np.isnan(a).sum()),
+                    "inf": int(np.isinf(a).sum()),
+                    "first_lid": int(np.nonzero(bad)[0][0]),
+                }
+    return {"parts": parts}
+
+
+def check_finite_scalar(
+    value, context: str, it: Optional[int] = None, vectors: Sequence = ()
+) -> None:
+    """Raise `NonFiniteError` when an already-reduced scalar (a dot, a
+    norm) is NaN/Inf. The scalar test is free — the reduction happened
+    anyway; ``vectors`` (pairs of (name, PVector)) are only swept for
+    per-part diagnostics after the guard trips."""
+    if np.isfinite(value):
+        return
+    diag = {"context": context, "value": float(value)}
+    if it is not None:
+        diag["iteration"] = int(it)
+    try:
+        diag.update(nonfinite_part_diagnostics(*vectors))
+    except Exception:  # diagnostics must never mask the primary failure
+        pass
+    raise NonFiniteError(
+        f"{context}: non-finite reduction value {value!r}"
+        + (f" at iteration {it}" if it is not None else "")
+        + " — a NaN/Inf entered the solver state (see .diagnostics)",
+        diagnostics=diag,
+    )
+
+
+def check_finite_pvector(v, context: str) -> None:
+    """Full finiteness sweep of a PVector (used by the opt-in exchange
+    validation, ``PA_HEALTH_EXCHANGE=1``)."""
+    diag = nonfinite_part_diagnostics(("values", v))
+    if diag["parts"]:
+        diag["context"] = context
+        raise NonFiniteError(
+            f"{context}: non-finite values on parts "
+            f"{sorted(diag['parts'])}", diagnostics=diag
+        )
+
+
+class StagnationDetector:
+    """Windowed best-residual tracker for Krylov loops. ``update(res)``
+    raises `SolverStagnationError` when over the last WINDOW updates the
+    best residual failed to improve below FACTOR x the previous best —
+    but only when stagnation raising is enabled; constructing the
+    detector is free and `update` is two floats and a counter."""
+
+    def __init__(self, context: str):
+        self.context = context
+        self.window = _stagnation_window()
+        self.factor = _stagnation_factor()
+        self.best = np.inf
+        self.since_improvement = 0
+
+    def update(self, res: float, it: int) -> None:
+        if res < self.factor * self.best:
+            self.best = res
+            self.since_improvement = 0
+            return
+        self.since_improvement += 1
+        if self.since_improvement >= self.window:
+            raise SolverStagnationError(
+                f"{self.context}: best residual {self.best:.3e} has not "
+                f"improved by {1.0 - self.factor:.1%} over the last "
+                f"{self.window} iterations (it={it})",
+                diagnostics={
+                    "context": self.context,
+                    "iteration": int(it),
+                    "best_residual": float(self.best),
+                    "window": self.window,
+                },
+            )
+
+
+# ---------------------------------------------------------------------------
+# transient-failure retry
+# ---------------------------------------------------------------------------
+
+
+def _default_attempts() -> int:
+    return max(1, int(os.environ.get("PA_RETRY_ATTEMPTS", "3")))
+
+
+def _default_backoff() -> float:
+    return float(os.environ.get("PA_RETRY_BACKOFF", "0.5"))
+
+
+def retry_with_backoff(
+    fn: Callable,
+    *,
+    attempts: Optional[int] = None,
+    backoff: Optional[float] = None,
+    max_backoff: float = 30.0,
+    exceptions: Tuple[Type[BaseException], ...] = (OSError,),
+    describe: str = "operation",
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Call ``fn()`` up to ``attempts`` times, sleeping ``backoff`` then
+    doubling (capped at ``max_backoff``) between tries; only the listed
+    ``exceptions`` are treated as transient. The last failure re-raises
+    unchanged. Each retry prints one stderr line (operators watching a
+    cluster come up need to see the wait, not a silent hang)."""
+    attempts = attempts if attempts is not None else _default_attempts()
+    backoff = backoff if backoff is not None else _default_backoff()
+    delay = max(0.0, float(backoff))
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except exceptions as e:
+            if attempt >= attempts:
+                raise
+            print(
+                f"[partitionedarrays_jl_tpu] {describe} failed "
+                f"(attempt {attempt}/{attempts}: {type(e).__name__}: {e}); "
+                f"retrying in {delay:.1f}s",
+                file=sys.stderr,
+                flush=True,
+            )
+            sleep(delay)
+            delay = min(max_backoff, delay * 2 if delay > 0 else 0.1)

@@ -46,13 +46,23 @@ def multihost_init(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    attempts: Optional[int] = None,
+    backoff: Optional[float] = None,
 ) -> None:
     """Join the multi-controller runtime (idempotent).
 
     With no arguments, relies on the cluster environment (TPU pods set
     everything automatically); arguments are forwarded for manual
     clusters. Call once per process, before any other JAX use. The
-    single-host case is a no-op so drivers can call it unconditionally."""
+    single-host case is a no-op so drivers can call it unconditionally.
+
+    An EXPLICIT cluster spec is retried with exponential backoff before
+    failing: in practice the coordinator process is usually still coming
+    up when the workers first dial it, and one refused connection must
+    not kill an N-host launch. ``attempts``/``backoff`` default to the
+    shared retry knobs (``PA_RETRY_ATTEMPTS``/``PA_RETRY_BACKOFF``,
+    parallel/health.py). A spec that still fails after the budget raises
+    — it must not silently degrade into N independent single-host runs."""
     import jax
 
     try:
@@ -68,18 +78,29 @@ def multihost_init(
         or num_processes is not None
         or process_id is not None
     )
-    try:
+
+    def _init():
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
+
+    if explicit:
+        from .health import retry_with_backoff
+
+        retry_with_backoff(
+            _init,
+            attempts=attempts,
+            backoff=backoff,
+            exceptions=(RuntimeError,),  # ValueError = bad spec: no retry
+            describe=f"multihost_init (coordinator {coordinator_address})",
+        )
+        return
+    try:
+        _init()
     except (RuntimeError, ValueError):
-        if explicit:
-            # a manual cluster spec that fails must fail fast, not silently
-            # degrade into N independent single-host runs
-            raise
-        # no cluster environment: single-process run, keep the local runtime
+        pass  # no cluster environment: single-process run, keep local runtime
 
 
 def is_main_process() -> bool:

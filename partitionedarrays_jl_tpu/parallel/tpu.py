@@ -52,6 +52,48 @@ def _jax():
     return jax
 
 
+_shard_map_cached = None
+
+
+def _shard_map():
+    """`jax.shard_map` across jax versions (resolved once): newer jax
+    exports it at top level, older releases keep it in
+    `jax.experimental.shard_map`; the replication-check keyword was
+    renamed ``check_rep`` -> ``check_vma`` along the way — on a SEPARATE
+    schedule from the relocation, so the adapter keys the rename on the
+    resolved function's own signature, not on where it was imported
+    from. All call sites here pass keyword arguments only."""
+    global _shard_map_cached
+    if _shard_map_cached is not None:
+        return _shard_map_cached
+    import inspect
+
+    try:
+        from jax import shard_map as resolved
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as resolved
+    try:
+        params = inspect.signature(resolved).parameters
+        takes_vma = "check_vma" in params or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+        )
+    except (TypeError, ValueError):  # unsignaturable wrapper: assume new API
+        takes_vma = True
+    if takes_vma:
+        sm = resolved
+    else:
+
+        def sm(f, *, mesh, in_specs, out_specs, **kw):
+            if "check_vma" in kw:
+                kw["check_rep"] = kw.pop("check_vma")
+            return resolved(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+            )
+
+    _shard_map_cached = sm
+    return sm
+
+
 _backend_tokens = itertools.count()
 
 
@@ -1498,7 +1540,7 @@ def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") ->
     current (combine='set') or owners accumulated (combine='add', reverse
     plan) — the device form of exchange!/assemble!."""
     import jax
-    from jax import shard_map
+    shard_map = _shard_map()
 
     from .tpu_box import BoxExchangePlan
 
@@ -1866,7 +1908,7 @@ def make_spmv_fn(dA: DeviceMatrix) -> Callable:
     (P, Wc) column-range vector to the (P, Wr) row-range product (ghost
     slots of y zero, like the host mul)."""
     import jax
-    from jax import shard_map
+    shard_map = _shard_map()
 
     mesh = dA.backend.mesh(dA.row_layout.P)
     spec = dA.backend.parts_spec()
@@ -1924,7 +1966,7 @@ def make_cg_fn(
     (validated in tests/test_tpu.py)."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    shard_map = _shard_map()
 
     mesh = dA.backend.mesh(dA.row_layout.P)
     spec = dA.backend.parts_spec()
@@ -1990,6 +2032,12 @@ def make_cg_fn(
                     jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0)),
                     it < maxiter,
                 )
+                # in-graph health guard, folded into the reduction the
+                # loop already carries (NaN exits via the > test; this
+                # also stops an Inf blow-up within one iteration). The
+                # host wrapper (_run_krylov) turns the non-finite exit
+                # into a typed NonFiniteError.
+                go = jnp.logical_and(go, jnp.isfinite(rs))
                 if precond:
                     # r'M^-1 r == 0 with rs > 0 is a preconditioner
                     # breakdown (indefinite/zero minv): exit, converged
@@ -2027,8 +2075,10 @@ def make_cg_fn(
             def cond_pipe(state):
                 _x, _r, _p, _pp, _ap, rs, it, _h = state
                 return (
-                    jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0))
-                ) & (it < maxiter)
+                    (jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0)))
+                    & (it < maxiter)
+                    & jnp.isfinite(rs)  # same in-graph guard as `cond`
+                )
 
             def step_pipe(state):
                 x, r, p, p_prev, alpha_prev, rs, it, hist = state
@@ -2176,7 +2226,7 @@ def make_bicgstab_fn(
     inverse-diagonal operand (residuals stay true residuals)."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    shard_map = _shard_map()
 
     mesh = dA.backend.mesh(dA.row_layout.P)
     spec = dA.backend.parts_spec()
@@ -2329,7 +2379,7 @@ def make_gmres_fn(
     left-preconditioned by an inverse-diagonal operand (owned slots)."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    shard_map = _shard_map()
 
     m = int(restart)
     # m < 1 would compile an inner loop that never advances `it`, leaving
@@ -2516,7 +2566,7 @@ def make_minres_fn(dA: DeviceMatrix, tol: float, maxiter: int) -> Callable:
     sequential oracle the same way CG's do."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    shard_map = _shard_map()
 
     mesh = dA.backend.mesh(dA.row_layout.P)
     spec = dA.backend.parts_spec()
@@ -2703,7 +2753,7 @@ def make_chebyshev_fn(
     decide termination. Spectrum bounds are compile-time constants."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    shard_map = _shard_map()
 
     mesh = dA.backend.mesh(dA.row_layout.P)
     spec = dA.backend.parts_spec()
@@ -2873,6 +2923,22 @@ def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg"):
     if verbose:
         for i, r in enumerate(residuals[1:], start=1):
             print(f"{name} it={i} residual={r:.3e}")
+    from .health import NonFiniteError, health_enabled
+
+    if health_enabled() and not (np.isfinite(rs) and np.isfinite(rs0)):
+        # the compiled loop exited on its in-graph finite guard (one
+        # iteration after the poison entered); surface it typed, with
+        # the history tail as the diagnostic
+        raise NonFiniteError(
+            f"{name}: non-finite residual after {it} device iterations "
+            f"(rs={rs!r}) — solver state was NaN/Inf-poisoned",
+            diagnostics={
+                "context": name,
+                "iteration": it,
+                "rs": rs,
+                "residual_tail": [float(v) for v in residuals[-4:]],
+            },
+        )
     converged = bool(np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)))
     return x, krylov_info(
         it, residuals, converged, tol, b.dtype, floor_warned,

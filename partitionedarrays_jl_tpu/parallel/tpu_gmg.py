@@ -806,7 +806,8 @@ def make_gmg_solve_fn(h, backend: TPUBackend, tol: float, maxiter: int):
     compiled program (the device form of models.gmg.gmg_solve)."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from .tpu import _shard_map
+    shard_map = _shard_map()
 
     dh = _device_hierarchy(h, backend)
     dA0 = dh["levels"][0]["dA"]
@@ -886,7 +887,8 @@ def make_gmg_pcg_fn(h, backend: TPUBackend, tol: float, maxiter: int):
     a single `lax.while_loop`."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from .tpu import _shard_map
+    shard_map = _shard_map()
 
     dh = _device_hierarchy(h, backend)
     dA0 = dh["levels"][0]["dA"]
@@ -995,7 +997,8 @@ def make_fgmres_gmg_fn(
     `lax.while_loop` over restart cycles serves any trip count."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from .tpu import _shard_map
+    shard_map = _shard_map()
 
     dh = _device_hierarchy(h, backend)
     dA0 = dh["levels"][0]["dA"]

@@ -60,13 +60,33 @@ def enable_compilation_cache(path: str | None = None) -> str:
     if path is None:
         path = _DEFAULT_DIR
     path = os.path.abspath(os.path.expanduser(path))
-    os.makedirs(path, exist_ok=True)
+    # cache dirs usually live on a shared filesystem (that is the point:
+    # one host compiles, every host loads) — N processes race to create
+    # the same directory tree and NFS/overlay mounts surface transient
+    # errors even under exist_ok; retry before giving up
+    from ..parallel.health import retry_with_backoff
+
+    retry_with_backoff(
+        lambda: os.makedirs(path, exist_ok=True),
+        exceptions=(OSError,),
+        describe=f"compilation-cache dir create ({path})",
+    )
     jax.config.update("jax_compilation_cache_dir", path)
     # solver programs are large; cache them all (no size floor), but
     # keep the 1 s compile-time floor so the cache isn't littered with
     # the trivial convert/broadcast programs staging emits
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # the cache object is a lazily-created singleton: once the first
+    # compile has initialized it (possibly with the cache OFF), a config
+    # update alone never reaches it — drop the instance so the next
+    # compile rebuilds it against the new directory
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except (ImportError, AttributeError):
+        pass  # newer jax picks the config change up directly
     _enabled_dir = path
     return path
 

@@ -28,6 +28,7 @@ from partitionedarrays_jl_tpu.parallel.tpu import (
 def test_enable_populates_dir_and_warm_rebuild_matches(tmp_path):
     cache_dir = str(tmp_path / "xla")
     prev = pa.compilation_cache_dir()
+    prev_secs = jax.config.jax_persistent_cache_min_compile_time_secs
     got = pa.enable_compilation_cache(cache_dir)
     try:
         assert got == cache_dir == pa.compilation_cache_dir()
@@ -67,7 +68,6 @@ def test_enable_populates_dir_and_warm_rebuild_matches(tmp_path):
         entries = os.listdir(cache_dir)
         assert entries, "persistent cache wrote no entries"
     finally:
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         if prev is not None:
             pa.enable_compilation_cache(prev)
         else:
@@ -77,6 +77,11 @@ def test_enable_populates_dir_and_warm_rebuild_matches(tmp_path):
 
             jax.config.update("jax_compilation_cache_dir", None)
             cc._enabled_dir = None
+        # restore what was actually set before the test, not a literal —
+        # LAST, because enable_compilation_cache above re-pins 1.0
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_secs
+        )
 
 
 def test_env_var_hook(monkeypatch, tmp_path):

@@ -1,0 +1,574 @@
+"""Resilience layer: seeded fault injection (parallel/faults.py), typed
+health guards (parallel/health.py), and checkpoint-based auto-restart
+(`solve_with_recovery` / `resume_solve`).
+
+The load-bearing contract (ISSUE 1 acceptance): a corrupted halo payload
+at iteration k is detected within one solver iteration, the solve
+auto-restarts from the last checkpoint, and the recovered run's answer
+matches the fault-free run — np.allclose always, BITWISE on the same
+partition (the host checkpoints carry the full recurrence state, so a
+resume replays the exact trajectory). Everything runs on the sequential
+backend under JAX_PLATFORMS=cpu (conftest); the device variants use the
+8-device CPU mesh TPUBackend and skip when it cannot be built.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.models import (
+    assemble_poisson,
+    cg,
+    gather_pvector,
+    resume_solve,
+    solve_with_recovery,
+)
+from partitionedarrays_jl_tpu.parallel.checkpoint import (
+    SolverCheckpointer,
+    load_solver_state,
+)
+from partitionedarrays_jl_tpu.parallel.faults import (
+    FaultClause,
+    FaultSpec,
+    active_fault_state,
+    faults_active,
+    inject_faults,
+)
+from partitionedarrays_jl_tpu.parallel.health import (
+    ControllerLostError,
+    ExchangeTimeoutError,
+    NonFiniteError,
+    SolverBreakdownError,
+    SolverStagnationError,
+    retry_with_backoff,
+)
+
+
+def _setup(parts, ns=(8, 8)):
+    return assemble_poisson(parts, ns)
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_grammar():
+    spec = FaultSpec.parse(
+        "nan@part=1,call=3; bitflip@part=*,after=2,prob=0.25;"
+        "drop@part=2,call=5; delay@seconds=0.5; controller@call=7"
+    )
+    kinds = [c.kind for c in spec.clauses]
+    assert kinds == ["nan", "bitflip", "drop", "delay", "controller"]
+    assert spec.clauses[0] == FaultClause("nan", part=1, call=3)
+    assert spec.clauses[1].part is None and spec.clauses[1].after == 2
+    assert spec.clauses[1].prob == 0.25
+    assert spec.clauses[3].seconds == 0.5
+    # clause matching: exact call, open call, after-threshold
+    assert spec.clauses[0].matches(3, 1) and not spec.clauses[0].matches(4, 1)
+    assert not spec.clauses[0].matches(3, 0)
+    assert spec.clauses[1].matches(2, 0) and spec.clauses[1].matches(9, 3)
+    assert not spec.clauses[1].matches(1, 0)
+
+
+def test_fault_spec_rejects_garbage():
+    with pytest.raises(ValueError):
+        FaultSpec.parse("meteor@part=1")
+    with pytest.raises(ValueError):
+        FaultSpec.parse("nan@part")
+    with pytest.raises(ValueError):
+        FaultSpec.parse("nan@color=red")
+
+
+def test_env_var_activation(monkeypatch):
+    assert not faults_active()
+    monkeypatch.setenv("PA_FAULT_SPEC", "nan@part=0,call=0")
+    monkeypatch.setenv("PA_FAULT_SEED", "7")
+    assert faults_active()
+    st = active_fault_state()
+    assert st.seed == 7 and st.spec.clauses[0].kind == "nan"
+    # the state (and its call counter) is cached per env value
+    assert active_fault_state() is st
+    monkeypatch.delenv("PA_FAULT_SPEC")
+    assert not faults_active() and active_fault_state() is None
+
+
+# ---------------------------------------------------------------------------
+# fault classes on the sequential backend
+# ---------------------------------------------------------------------------
+
+
+def test_corrupted_exchange_detected_within_one_iteration():
+    """NaN-poisoned halo payload at a known exchange call -> the solver's
+    free scalar guard raises a typed NonFiniteError on THAT iteration,
+    with per-part diagnostics naming the poisoned vectors."""
+    k = 9
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        # warm run: builds + caches the exchanger plans, so the faulted
+        # run's exchange calls map 1:1 onto solver iterations (call 0 =
+        # the initial residual's A@x0, call i = iteration i's A@p)
+        _, info_clean = cg(A, b, x0=x0, tol=1e-9)
+        assert info_clean["converged"] and info_clean["iterations"] > k
+        with inject_faults(f"nan@part=1,call={k}", seed=3) as st:
+            with pytest.raises(NonFiniteError) as ei:
+                cg(A, b, x0=x0, tol=1e-9)
+        assert abs(ei.value.diagnostics["iteration"] - k) <= 1
+        assert ei.value.diagnostics["parts"], "no per-part diagnostics"
+        assert [e["kind"] for e in st.events] == ["nan"]
+        assert st.events[0]["call"] == k and st.events[0]["part"] == 1
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_exchange_level_validation(monkeypatch):
+    """PA_HEALTH_EXCHANGE=1: the receiving side of the exchange itself
+    rejects a non-finite payload, reporting receiver part and sending
+    neighbor — one reduction earlier than the solver guard."""
+    monkeypatch.setenv("PA_HEALTH_EXCHANGE", "1")
+
+    def driver(parts):
+        rows = pa.prange(parts, (8, 8), pa.with_ghost)
+        v = pa.PVector.full(1.0, rows)
+        v.exchange()  # warm: plan-building exchanges carry int payloads
+        with inject_faults("nan@part=0,call=0", seed=0):
+            with pytest.raises(NonFiniteError) as ei:
+                v.exchange()
+        parts_diag = ei.value.diagnostics["parts"]
+        assert parts_diag, "no receiver diagnostics"
+        # part 0's poisoned payload shows up as from_parts == {0: n}
+        assert any(
+            0 in d["from_parts"] for d in parts_diag.values()
+        )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_bitflip_is_silent_but_recorded():
+    """A mantissa bitflip stays finite — the point of the fault class is
+    that finiteness guards canNOT see it (silent corruption); the
+    injection record and the changed answer witness it."""
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        x_clean, _ = cg(A, b, x0=x0, tol=1e-9)
+        with inject_faults("bitflip@part=1,call=4,prob=1.0", seed=11) as st:
+            x_flip, info = cg(A, b, x0=x0, tol=1e-9)
+        assert any(e["kind"] == "bitflip" for e in st.events)
+        assert np.isfinite(gather_pvector(x_flip)).all()
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_dropped_part_triggers_timeout_path():
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        with inject_faults("drop@part=2,call=5", seed=0) as st:
+            with pytest.raises(ExchangeTimeoutError) as ei:
+                cg(A, b, x0=x0, tol=1e-9)
+        assert ei.value.diagnostics["missing_parts"] == [2]
+        assert st.events[0] == {"kind": "drop", "call": 5, "part": 2}
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_controller_failure_is_typed_and_survivable():
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        with inject_faults("controller@call=6", seed=0):
+            with pytest.raises(ControllerLostError):
+                cg(A, b, x0=x0, tol=1e-9)
+        # ControllerLostError subclasses SolverHealthError, so the
+        # recovery driver treats it as survivable-by-restart
+        with inject_faults("controller@call=6", seed=0):
+            x, info = solve_with_recovery(
+                A, b, method="cg", x0=x0, tol=1e-9
+            )
+        assert info["restarts"] == 1 and info["converged"]
+        assert info["failures"][0]["type"] == "ControllerLostError"
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_delay_fault_records_event():
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        with inject_faults("delay@call=2,seconds=0.0", seed=0) as st:
+            x, info = cg(A, b, x0=x0, tol=1e-9)
+        assert info["converged"]  # a slow host is not an error
+        assert st.events[0]["kind"] == "delay"
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+# ---------------------------------------------------------------------------
+# health guards beyond injection
+# ---------------------------------------------------------------------------
+
+
+def test_breakdown_raises_typed_error():
+    """p'Ap == 0 on an indefinite operator is a typed
+    SolverBreakdownError (not a strippable assert): diag(1, -1) with
+    b = (1, 1) breaks down on the very first iteration."""
+
+    def driver(parts):
+        rows = pa.prange(parts, 2)
+        g = pa.map_parts(lambda i: np.asarray(i.oid_to_gid), rows.partition)
+        V = pa.map_parts(lambda gi: np.where(gi == 0, 1.0, -1.0), g)
+        A = pa.PSparseMatrix.from_coo(g, g, V, rows, rows, ids="global")
+        b = pa.PVector.full(1.0, rows)
+        with pytest.raises(SolverBreakdownError) as ei:
+            cg(A, b, tol=1e-12)
+        assert ei.value.diagnostics["iteration"] == 0
+        return True
+
+    assert pa.prun(driver, pa.sequential, 1)
+
+
+def test_stagnation_detector_unit():
+    from partitionedarrays_jl_tpu.parallel.health import StagnationDetector
+
+    os.environ["PA_HEALTH_STAGNATION_WINDOW"] = "4"
+    try:
+        det = StagnationDetector("unit")
+        for i, r in enumerate([10.0, 5.0, 2.0, 1.0]):  # improving: no trip
+            det.update(r, i)
+        with pytest.raises(SolverStagnationError) as ei:
+            for i in range(4, 9):
+                det.update(0.999, i)  # flat: trips after the window
+        assert ei.value.diagnostics["window"] == 4
+    finally:
+        del os.environ["PA_HEALTH_STAGNATION_WINDOW"]
+
+
+def test_stagnation_guard_opt_in(monkeypatch):
+    """PA_HEALTH_STAGNATION=1 turns a flat-lining residual into a typed
+    error instead of a silent maxiter burn. The fixture: cg WITHOUT the
+    boundary-imposing x0 runs on the nonsymmetric-coupled Dirichlet
+    system and plateaus far above tol."""
+    monkeypatch.setenv("PA_HEALTH_STAGNATION", "1")
+    monkeypatch.setenv("PA_HEALTH_STAGNATION_WINDOW", "8")
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        with pytest.raises(SolverStagnationError) as ei:
+            cg(A, b, tol=1e-12)
+        assert ei.value.diagnostics["window"] == 8
+        assert ei.value.diagnostics["best_residual"] > 1e-12
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_retry_with_backoff():
+    calls = []
+    sleeps = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert (
+        retry_with_backoff(
+            flaky, attempts=4, backoff=0.25, sleep=sleeps.append,
+            describe="flaky-io",
+        )
+        == "ok"
+    )
+    assert len(calls) == 3 and sleeps == [0.25, 0.5]
+
+    with pytest.raises(OSError):
+        retry_with_backoff(
+            lambda: (_ for _ in ()).throw(OSError("hard")),
+            attempts=2, backoff=0.0, sleep=sleeps.append,
+        )
+    # non-listed exceptions pass straight through, no retry burn
+    boom = []
+
+    def wrong_type():
+        boom.append(1)
+        raise KeyError("x")
+
+    with pytest.raises(KeyError):
+        retry_with_backoff(wrong_type, attempts=5, backoff=0.0)
+    assert len(boom) == 1
+
+
+def test_multihost_init_retries_explicit_spec(monkeypatch):
+    """An explicit cluster spec retries RuntimeError (coordinator not up
+    yet) with backoff before failing; a bad-value spec fails fast."""
+    import jax
+
+    from partitionedarrays_jl_tpu.parallel.multihost import multihost_init
+
+    tries = []
+
+    def fake_init(coordinator_address=None, num_processes=None, process_id=None):
+        tries.append(coordinator_address)
+        if len(tries) < 3:
+            raise RuntimeError("connection refused")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setenv("PA_RETRY_BACKOFF", "0.0")
+    multihost_init("10.0.0.1:1234", 2, 0, attempts=3)
+    assert len(tries) == 3
+
+    def bad_spec(**kw):
+        raise ValueError("num_processes must be positive")
+
+    monkeypatch.setattr(jax.distributed, "initialize", bad_spec)
+    with pytest.raises(ValueError):
+        multihost_init("10.0.0.1:1234", -1, 0, attempts=3)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / restart
+# ---------------------------------------------------------------------------
+
+
+def test_checkpointer_roundtrip(tmp_path):
+    d = str(tmp_path / "ck")
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        ck = SolverCheckpointer(d, every=5)
+        assert not ck.due(0) and ck.due(5) and not ck.due(7)
+        assert not ck.has_state()
+        assert load_solver_state(d, {}) is None
+        x, info = cg(A, b, x0=x0, tol=1e-9, checkpoint=ck)
+        assert info["converged"] and ck.has_state()
+        st = load_solver_state(d, {"x": A.cols, "r": b.rows, "p": A.cols})
+        assert st["meta"]["method"] == "cg"
+        assert st["meta"]["it"] % 5 == 0 and st["meta"]["it"] > 0
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_fault_recovery_reproduces_clean_run(tmp_path, monkeypatch):
+    """THE acceptance scenario: corrupted halo payload at iteration k →
+    detected within one iteration → auto-restart from the last
+    checkpoint → same answer as the fault-free run. Bitwise on the same
+    partition, in default AND strict-bits mode."""
+    for strict in ("0", "1"):
+        monkeypatch.setenv("PA_TPU_STRICT_BITS", strict)
+        d = str(tmp_path / f"ck{strict}")
+
+        def driver(parts):
+            A, b, x_exact, x0 = _setup(parts, ns=(12, 12))
+            x_clean, info_clean = cg(A, b, x0=x0, tol=1e-9)
+            assert info_clean["converged"]
+            with inject_faults("nan@part=1,call=20", seed=5) as st:
+                x_rec, info_rec = solve_with_recovery(
+                    A, b, method="cg", x0=x0, checkpoint_dir=d, every=6,
+                    tol=1e-9,
+                )
+            assert [e["kind"] for e in st.events] == ["nan"]
+            assert info_rec["converged"] and info_rec["restarts"] == 1
+            assert info_rec["failures"][0]["type"] == "NonFiniteError"
+            a, c = gather_pvector(x_clean), gather_pvector(x_rec)
+            np.testing.assert_allclose(a, c, rtol=0, atol=0)  # bitwise
+            # the recovered run solves the PDE, not just itself
+            assert (
+                float(np.linalg.norm(c - gather_pvector(x_exact))) < 1e-6
+            )
+            return True
+
+        assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_recovery_without_checkpoint_dir_restarts_from_scratch():
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        with inject_faults("nan@part=0,call=7", seed=1):
+            x, info = solve_with_recovery(
+                A, b, method="cg", x0=x0, tol=1e-9, max_restarts=1
+            )
+        assert info["converged"] and info["restarts"] == 1
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_recovery_exhausts_restart_budget():
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        # after=0: every exchange is poisoned, restarts cannot help
+        with inject_faults("nan@part=0,after=0", seed=1):
+            with pytest.raises(NonFiniteError):
+                solve_with_recovery(
+                    A, b, method="cg", x0=x0, tol=1e-9, max_restarts=2
+                )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_pcg_recovery_with_jacobi(tmp_path):
+    d = str(tmp_path / "ck")
+
+    def driver(parts):
+        from partitionedarrays_jl_tpu.models import jacobi_preconditioner, pcg
+
+        A, b, x_exact, x0 = _setup(parts, ns=(12, 12))
+        minv = jacobi_preconditioner(A)
+        x_clean, info_clean = pcg(A, b, x0=x0, minv=minv, tol=1e-9)
+        with inject_faults("nan@part=2,call=15", seed=2):
+            x_rec, info_rec = solve_with_recovery(
+                A, b, method="pcg", minv=minv, x0=x0, checkpoint_dir=d,
+                every=4, tol=1e-9,
+            )
+        assert info_rec["converged"] and info_rec["restarts"] == 1
+        np.testing.assert_array_equal(
+            gather_pvector(x_clean), gather_pvector(x_rec)
+        )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_resume_onto_different_part_count(tmp_path):
+    """The checkpoint is partition-independent: a 4-part run's solver
+    state resumes on 3 parts and still converges to the PDE solution."""
+    d = str(tmp_path / "ck")
+    ref = {}
+
+    def save4(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (24,))
+        ck = SolverCheckpointer(d, every=4)
+        # stop mid-solve: the checkpoint holds a genuinely unconverged state
+        cg(A, b, x0=x0, tol=1e-12, maxiter=9, checkpoint=ck)
+        ref["exact"] = gather_pvector(x_exact)
+        return True
+
+    def resume3(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (24,))
+        # tol/maxiter default to the checkpointed run's values (here the
+        # deliberately-tiny maxiter=9) — override both to run to the end
+        x, info = resume_solve(d, A, b, tol=1e-10, maxiter=500)
+        assert info["resumed_from_iteration"] == 8
+        assert info["converged"]
+        np.testing.assert_allclose(
+            gather_pvector(x), ref["exact"], atol=1e-8
+        )
+        return True
+
+    assert pa.prun(save4, pa.sequential, 4)
+    assert pa.prun(resume3, pa.sequential, 3)
+
+
+def test_resume_solve_rejects_empty_dir(tmp_path):
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        with pytest.raises(ValueError):
+            resume_solve(str(tmp_path / "nothing"), A, b)
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+# ---------------------------------------------------------------------------
+# device backend (8-device CPU mesh; skipped when unavailable)
+# ---------------------------------------------------------------------------
+
+
+def _tpu_backend():
+    import jax
+
+    try:
+        from partitionedarrays_jl_tpu.parallel.tpu import TPUBackend
+
+        return TPUBackend(devices=jax.devices()[:8])
+    except Exception as e:  # pragma: no cover - environment-dependent
+        pytest.skip(f"device mesh unavailable: {e}")
+
+
+def test_device_nonfinite_guard_raises_typed():
+    """The compiled CG's in-graph isfinite guard exits the loop within
+    one iteration of NaN poisoning and the host wrapper raises the same
+    typed NonFiniteError as the host loop."""
+    backend = _tpu_backend()
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        # poison ONE owned entry of b: the first residual reduction sees it
+        bad = pa.map_parts(
+            lambda i, v: np.where(
+                np.arange(len(np.asarray(v))) == 0, np.nan, np.asarray(v)
+            )
+            if int(i.part) == 1
+            else np.asarray(v),
+            b.rows.partition,
+            b.values,
+        )
+        b_bad = pa.PVector(bad, b.rows)
+        with pytest.raises(NonFiniteError) as ei:
+            cg(A, b_bad, x0=x0, tol=1e-9)
+        assert ei.value.diagnostics["iteration"] <= 1
+        return True
+
+    assert pa.prun(driver, backend, (2, 2))
+
+
+def test_device_resume_from_host_checkpoint(tmp_path):
+    """Cross-backend restore: a host run's FULL-state checkpoint resumes
+    on the device backend (iterate-only restart — the compiled loop
+    cannot ingest mid-recurrence state) and still converges."""
+    backend = _tpu_backend()
+    d = str(tmp_path / "ck")
+
+    def save(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        cg(
+            A, b, x0=x0, tol=1e-12, maxiter=7,
+            checkpoint=SolverCheckpointer(d, every=3),
+        )
+        return True
+
+    assert pa.prun(save, pa.sequential, (2, 2))
+
+    def resume_dev(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        x, info = resume_solve(d, A, b, tol=1e-9, maxiter=500)
+        assert info["resumed_from_iteration"] == 6
+        assert info["converged"]
+        err = float(
+            np.linalg.norm(gather_pvector(x) - gather_pvector(x_exact))
+        )
+        assert err < 1e-6, err
+        return True
+
+    assert pa.prun(resume_dev, backend, (2, 2))
+
+
+def test_device_chunked_recovery_converges(tmp_path):
+    """solve_with_recovery on the device backend: the compiled solve runs
+    in checkpointed chunks and matches the one-shot device solve to
+    solver tolerance."""
+    backend = _tpu_backend()
+    d = str(tmp_path / "ck")
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        x_one, info_one = cg(A, b, x0=x0, tol=1e-9)
+        x, info = solve_with_recovery(
+            A, b, method="cg", x0=x0, checkpoint_dir=d, every=10, tol=1e-9
+        )
+        assert info["converged"] and info["restarts"] == 0
+        err = float(
+            np.linalg.norm(gather_pvector(x) - gather_pvector(x_one))
+        )
+        assert err < 1e-7, err
+        return True
+
+    assert pa.prun(driver, backend, (2, 2))
