@@ -59,7 +59,13 @@ BANDS = {
     # 9.97-11.69 GB/s (median 10.36, docs/repro_r5.json) — single
     # protocol, unlike r4's band that mixed the short chain in
     "halo_bytes_per_s": (9.5e9, 12.0e9),
-    "cg_device_s_per_it": (230e-6, 260e-6),  # r4; r5 leg: 253.9 us
+    # r4 band was 230-260 us on the standard body (r5 leg: 253.9 us);
+    # the r6 fused default measures ~232-236 us at 192^3 (the sweeps it
+    # merges are VMEM-resident at this size, so the gain is small here —
+    # the fusion's target is the >=320^3 HBM-roofline regime, see
+    # SCALE_CURVE.json). Low edge extended to cover the fused body;
+    # above 260 us is a regression for either body.
+    "cg_device_s_per_it": (215e-6, 260e-6),
 }
 
 
@@ -361,11 +367,13 @@ def bench_cg_vs_cpu(n: int, backend, pa, dA) -> dict:
     return rec
 
 
-def cg_marginal_s_per_it(pa, dA, k1: int, k2: int) -> float:
+def cg_marginal_s_per_it(pa, dA, k1: int, k2: int, fused=None) -> float:
     """Fixed-trip compiled-CG marginal cost per iteration: two solves at
     maxiter k1/k2 (tol=0), each warmed then median-of-5 timed, so the
     relay RTT and compile cancel in the difference. Shared by the
-    single-chip CG comparand and the ICI leg (one protocol, one place)."""
+    single-chip CG comparand, the ICI leg, and the scale curve's fused
+    A/B (one protocol, one place). ``fused=None`` measures the shipped
+    default body; True/False pin a body for A/B legs."""
     import statistics
 
     from partitionedarrays_jl_tpu.parallel.tpu import DeviceVector, make_cg_fn
@@ -377,7 +385,7 @@ def cg_marginal_s_per_it(pa, dA, k1: int, k2: int) -> float:
     dz = DeviceVector.from_pvector(z, dA.backend, dA.col_layout)
 
     def run_k(k):
-        fn = make_cg_fn(dA, tol=0.0, maxiter=k)
+        fn = make_cg_fn(dA, tol=0.0, maxiter=k, fused=fused)
         fn(db.data, dz.data, None)
 
         def once():

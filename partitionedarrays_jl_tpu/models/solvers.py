@@ -50,6 +50,7 @@ def cg(
     maxiter: Optional[int] = None,
     verbose: bool = False,
     pipelined: bool = False,
+    fused: Optional[bool] = None,
     checkpoint=None,
     _resume_state: Optional[dict] = None,
 ) -> Tuple[PVector, dict]:
@@ -70,6 +71,15 @@ def cg(
     textbook recurrence, so the iteration trajectory is identical; on
     the host backend the flag is a no-op (eager NumPy has no fusion to
     exploit — the standard loop IS the lag-1 loop's value sequence).
+
+    ``fused`` selects the TPU backend's fused streaming body (default:
+    resolved from ``PA_TPU_FUSED_CG`` — ON outside strict-bits): one
+    update+dot sweep, direction fold riding the SpMV pass, packed
+    (3, W) carry — same trajectory, fewer large-N HBM sweeps per
+    iteration (tpu.py:make_cg_fn). This host loop IS the fused body's
+    value sequence already (eager NumPy), so the flag is likewise a
+    host no-op; the device info dict records the body under
+    ``cg_body``.
 
     Resilience hooks: ``checkpoint`` takes a
     `parallel.checkpoint.SolverCheckpointer`; every ``checkpoint.every``
@@ -94,7 +104,7 @@ def cg(
         # Device path: the whole loop is one compiled shard_map program.
         return tpu_cg(
             A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose,
-            pipelined=pipelined,
+            pipelined=pipelined, fused=fused,
         )
     from ..parallel.health import (
         SolverBreakdownError,
@@ -1080,6 +1090,7 @@ def pcg(
     tol: float = 1e-8,
     maxiter: Optional[int] = None,
     verbose: bool = False,
+    fused: Optional[bool] = None,
     checkpoint=None,
     _resume_state: Optional[dict] = None,
 ) -> Tuple[PVector, dict]:
@@ -1095,7 +1106,14 @@ def pcg(
     preconditioned solve — parallel/tpu_gmg.py; the hierarchy must be
     built on this exact `A`); any other callable runs the host loop on
     any backend (each application is whatever the callable compiles
-    to)."""
+    to).
+
+    ``fused`` selects the device loop's body exactly as in `cg` (the
+    fused PCG body additionally rides its r·z / r·r reductions on one
+    shared all_gather) on the diagonal-``minv`` compiled path; a host
+    no-op. The GMG-preconditioned device program compiles its own PCG
+    body with no fused variant, so an explicit ``fused`` there raises
+    rather than silently measuring the same body twice."""
     from ..parallel.tpu import TPUBackend, tpu_cg
 
     if minv is None:
@@ -1114,6 +1132,14 @@ def pcg(
             # program for the whole multigrid-preconditioned solve
             from ..parallel.tpu_gmg import tpu_gmg_pcg
 
+            if fused is not None:
+                # unconditional (not check()): silently dropping the flag
+                # would hand an A/B user two identical runs
+                raise ValueError(
+                    "pcg: the GMG-preconditioned device program has its "
+                    "own compiled PCG body with no fused variant — drop "
+                    "the fused argument for GMG preconditioning"
+                )
             check(
                 minv.levels[0].A is A,
                 "pcg: the hierarchy's fine operator must be A itself",
@@ -1122,7 +1148,10 @@ def pcg(
                 minv, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose
             )
         if not apply_minv:
-            return tpu_cg(A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose, minv=minv)
+            return tpu_cg(
+                A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose,
+                minv=minv, fused=fused,
+            )
 
     from ..parallel.health import (
         SolverBreakdownError,
@@ -1867,20 +1896,38 @@ def solve_with_recovery(
                 raise
             restarts += 1
             state = None
+            how = "scratch"
             if ckpt is not None:
                 try:
                     ckpt.wait()  # let an in-flight write land first
                 except Exception:
                     pass
                 if ckpt.has_state():
-                    state = load_solver_state(
+                    st = load_solver_state(
                         ckpt.directory, _solver_state_ranges(A, b)
                     )
+                    # same contract as resume_solve: the exact-recurrence
+                    # resume needs the full (x, r, p)+scalars state AND a
+                    # method match — an iterate-only checkpoint (e.g.
+                    # written into this directory by the chunked device
+                    # path of the same job) restarts from the iterate
+                    # instead of crashing the recovery on a missing key
+                    if st is not None:
+                        meta_ = st.get("meta", {})
+                        if (
+                            all(k in st for k in ("x", "r", "p"))
+                            and "rs" in meta_
+                            and meta_.get("method") == method
+                        ):
+                            state = st
+                            how = "last checkpoint (exact recurrence)"
+                        else:
+                            x0 = st["x"]
+                            how = "checkpointed iterate (Krylov restart)"
             print(
                 f"[partitionedarrays_jl_tpu] {method}: "
                 f"{type(e).__name__}: {e} — restart {restarts}/"
-                f"{max_restarts} from "
-                + ("last checkpoint" if state is not None else "scratch"),
+                f"{max_restarts} from " + how,
                 file=sys.stderr,
                 flush=True,
             )

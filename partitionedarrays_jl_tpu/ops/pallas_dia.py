@@ -198,13 +198,24 @@ def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, *refs,
                    n_blocks: int, block_rows: int, halo_rows: int,
                    n_coded: int,
                    cls_pattern: Tuple[Tuple[bool, ...], ...] = None,
-                   has_axpy: bool = False):
+                   has_axpy: bool = False, has_pfold: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    if has_axpy:
+    if has_pfold:
+        # leading-edge direction fold (fused CG): the SpMV operand is
+        # p = r + beta*p_prev, built IN the window pass — the kernel
+        # DMAs one window each of r and p_prev, combines them once in
+        # VMEM, runs the shifted-read band sum on the combined window,
+        # and emits the center rows as the materialized new direction.
+        # The standalone p-update sweep (read r, read p, write p) of the
+        # standard loop disappears into the SpMV's own streaming pass;
+        # xw_ref is the r window source here.
+        (pw_ref, beta_ref, y_ref, po_ref,
+         xs_ref, ps_ref, comb_ref, cs_ref, xsem, psem, csem) = refs
+    elif has_axpy:
         # lagged-axpy fusion (pipelined CG): while the VPU-bound SpMV
         # streams, the DMA engines also move one block each of the
         # PREVIOUS search direction and the solution accumulator, and the
@@ -227,6 +238,13 @@ def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, *refs,
             xsem.at[slot],
         )
 
+    def p_dma(slot, blk):
+        return pltpu.make_async_copy(
+            pw_ref.at[pl.ds(blk * BR - halo_rows, win_rows), :],
+            ps_ref.at[slot],
+            psem.at[slot],
+        )
+
     def codes_dma(slot, blk):
         return pltpu.make_async_copy(
             codes_ref.at[:, pl.ds((blk - 1) * BR, BR), :],
@@ -240,6 +258,8 @@ def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, *refs,
     @pl.when(j == 0)
     def _():
         x_dma(1, 1).start()
+        if has_pfold:
+            p_dma(1, 1).start()
         if n_coded:
             codes_dma(1, 1).start()
 
@@ -247,20 +267,34 @@ def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, *refs,
     def _():
         nxt = jax.lax.rem(j + 1, two)
         x_dma(nxt, j + 1).start()
+        if has_pfold:
+            p_dma(nxt, j + 1).start()
         if n_coded:
             codes_dma(nxt, j + 1).start()
 
     @pl.when((j >= 1) & (j <= n_blocks))
     def _compute():
         x_dma(slot, j).wait()
+        if has_pfold:
+            p_dma(slot, j).wait()
+            # one in-VMEM pass builds the combined operand window; every
+            # shifted diagonal read then hits the combined copy, so the
+            # fold costs ONE add per element instead of one per diagonal
+            comb_ref[:] = xs_ref[slot] + beta_ref[0] * ps_ref[slot]
         if n_coded:
             codes_dma(slot, j).wait()
 
         def shift_of(q, r):
-            a = xs_ref[slot, pl.ds(q, BR), :]
-            if r == 0:
-                return a
-            b = xs_ref[slot, pl.ds(q + 1, BR), :]
+            if has_pfold:
+                a = comb_ref[pl.ds(q, BR), :]
+                if r == 0:
+                    return a
+                b = comb_ref[pl.ds(q + 1, BR), :]
+            else:
+                a = xs_ref[slot, pl.ds(q, BR), :]
+                if r == 0:
+                    return a
+                b = xs_ref[slot, pl.ds(q + 1, BR), :]
             return jnp.concatenate([a[:, r:], b[:, :r]], axis=1)
 
         if cls_pattern is not None:
@@ -325,6 +359,32 @@ def _padded_kernel(cb_ref, no_ref, codes_ref, xw_ref, *refs,
     def _zero():
         y_ref[:] = jnp.zeros_like(y_ref)
 
+    if has_pfold:
+        # materialize the combined direction for the rest of the
+        # iteration (pq dot, x update, next fold): the center rows of
+        # the window ARE block j of p = r + beta*p_prev — no extra read.
+        # Masking to the owned band keeps the zero-pad invariant exact.
+        @pl.when((j >= 1) & (j <= n_blocks))
+        def _pfold_out():
+            e2 = (
+                (j - 1) * block_rows * LANES
+                + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_rows, LANES), 0
+                ) * LANES
+                + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_rows, LANES), 1
+                )
+            )
+            po_ref[:] = jnp.where(
+                e2 < no_ref[0],
+                comb_ref[pl.ds(halo_rows, block_rows), :],
+                jnp.zeros_like(po_ref),
+            )
+
+        @pl.when((j < 1) | (j > n_blocks))
+        def _pfold_zero():
+            po_ref[:] = jnp.zeros_like(po_ref)
+
     if has_axpy:
         # frame block j holds owned elements (j-1)*BR*LANES..; pads,
         # ghost and trash slots copy through unchanged (x keeps its
@@ -364,6 +424,7 @@ def dia_coded_padded_pallas(
     interpret: bool = False,
     cls_pattern: Tuple[Tuple[bool, ...], ...] = None,
     axpy: Tuple["jax.Array", "jax.Array", "jax.Array"] = None,  # noqa: F821
+    pfold: Tuple["jax.Array", "jax.Array"] = None,  # noqa: F821
 ):
     """Full-vector coded SpMV on the padded layout: x is a whole
     (total_rows, 128) padded vector (owned at flat offset plan['o0'],
@@ -381,11 +442,24 @@ def dia_coded_padded_pallas(
     SMEM scalar). The update rides the kernel's spare DMA bandwidth
     instead of its own HBM pass (tpu.py:make_cg_fn); callers must first
     check `axpy_vmem_ok(plan)` — the plan's VMEM gate does not include
-    the three extra double-buffered pipeline blocks."""
+    the three extra double-buffered pipeline blocks.
+
+    ``pfold=(pprev, beta)`` (fused CG, mutually exclusive with axpy)
+    instead treats ``x`` as the RESIDUAL vector and computes the SpMV of
+    the combined direction ``p = x + beta*pprev`` without ever reading a
+    materialized p: both windows are DMA'd, combined once in VMEM, and
+    the band sum runs on the combined copy. Returns ``(y, p)`` with
+    ``y = A_oo p`` and ``p`` masked to the owned band (every other slot
+    exactly zero) — the standard loop's standalone direction-update
+    sweep is absorbed by the SpMV pass (tpu.py:make_cg_fn fused body).
+    Callers must first check `pfold_vmem_ok(plan)`."""
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    assert not (axpy is not None and pfold is not None), (
+        "axpy and pfold fusions are mutually exclusive"
+    )
     D = codebook.shape[0]
     Dc = codes.shape[0]
     assert D == len(offsets) == len(kk) == len(code_row)
@@ -402,6 +476,7 @@ def dia_coded_padded_pallas(
         code_row=tuple(int(c) for c in code_row), n_blocks=nB,
         block_rows=BR, halo_rows=H, n_coded=Dc,
         cls_pattern=cls_pattern, has_axpy=axpy is not None,
+        has_pfold=pfold is not None,
     )
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # codebook
@@ -419,6 +494,31 @@ def dia_coded_padded_pallas(
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((2,)),
     ]
+    if pfold is not None:
+        pprev, beta = pfold
+        assert pprev.shape == x.shape
+        return pl.pallas_call(
+            kernel,
+            grid=(total_rows // BR,),
+            in_specs=in_specs + [
+                pl.BlockSpec(memory_space=pl.ANY),  # pprev: manual DMA
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # beta
+            ],
+            out_specs=[y_spec, y_spec],
+            out_shape=[
+                y_shape, jax.ShapeDtypeStruct(x.shape, x.dtype),
+            ],
+            scratch_shapes=[
+                scratch[0],  # r window (xs slot)
+                pltpu.VMEM((2, win_rows, LANES), codebook.dtype),  # p win
+                pltpu.VMEM((win_rows, LANES), codebook.dtype),  # combined
+                scratch[1],  # codes
+                pltpu.SemaphoreType.DMA((2,)),  # r window sem
+                pltpu.SemaphoreType.DMA((2,)),  # p window sem
+                pltpu.SemaphoreType.DMA((2,)),  # codes sem
+            ],
+            interpret=interpret,
+        )(codebook, no, codes, x, pprev, beta)
     if axpy is None:
         return pl.pallas_call(
             kernel,
@@ -453,6 +553,17 @@ def axpy_vmem_ok(plan: dict, itemsize: int = 4) -> bool:
     (BR, 128) pipeline blocks still fit the VMEM budget the plan was
     gated on."""
     extra = 6 * plan["block_rows"] * LANES * itemsize
+    return plan.get("vmem", 0) + extra <= 13 * 2**20
+
+
+def pfold_vmem_ok(plan: dict, itemsize: int = 4) -> bool:
+    """Whether the direction-fold variant's extra VMEM — a second
+    double-buffered operand window, the combined-window copy, and the
+    double-buffered p output block — still fits the budget the plan was
+    gated on."""
+    BR, H = plan["block_rows"], plan["halo_rows"]
+    win = _win_rows(BR, H)
+    extra = (3 * win + 2 * BR) * LANES * itemsize
     return plan.get("vmem", 0) + extra <= 13 * 2**20
 
 

@@ -243,8 +243,15 @@ def exchange_faults_hook(data_snd, parts_snd):
     if not live:
         return data_snd, None
 
+    nparts = data_snd.num_parts
     for c in live:
         if c.kind == "controller":
+            # same out-of-grid inertness as every other clause kind (the
+            # spec grammar: an id outside this run's part grid matches
+            # nothing) — a controller clause written for a larger mesh
+            # must not kill a smaller run
+            if c.part is not None and not (0 <= c.part < nparts):
+                continue
             state.record(kind="controller", call=call, part=c.part)
             raise ControllerLostError(
                 f"injected controller failure at exchange call {call}"
@@ -255,7 +262,6 @@ def exchange_faults_hook(data_snd, parts_snd):
     from .backends import get_part_ids, map_parts
 
     corrupt = [c for c in live if c.kind in ("nan", "bitflip")]
-    nparts = data_snd.num_parts
     dropped: List[int] = []
     for c in live:
         # a part id outside this run's grid (spec written for a larger

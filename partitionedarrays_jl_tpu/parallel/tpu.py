@@ -499,6 +499,93 @@ def _box_exchange_enabled() -> bool:
     return os.environ.get("PA_TPU_BOX", "1") != "0" and not strict_bits()
 
 
+def _fused_cg_enabled() -> bool:
+    """The fused streaming CG body (packed (k, W) carry, one-sweep
+    x/r updates + shared-gather dot partials, direction fold riding the
+    SpMV pass — see `make_cg_fn`), default ON. Strict-bits keeps the
+    standard body as the bit-exact oracle; strict tests opt back in
+    explicitly via ``make_cg_fn(..., fused=True)`` to pin trajectory
+    identity. ``PA_TPU_FUSED_CG=0`` reverts to the standard body."""
+    return os.environ.get("PA_TPU_FUSED_CG", "1") != "0" and not strict_bits()
+
+
+def _resolve_fused(fused, pipelined: bool) -> bool:
+    """The ONE resolution of the CG body choice: an explicit ``fused``
+    wins; ``None`` takes the env default (off under pipelined — the two
+    forms are mutually exclusive). Every layer (`tpu_cg`, the program
+    cache key, `make_cg_fn`) resolves through here so the compiled
+    program, the cache key, and the reported ``cg_body`` can never
+    disagree."""
+    if fused is None:
+        return _fused_cg_enabled() and not pipelined
+    return bool(fused)
+
+
+class ELLFootprintError(RuntimeError):
+    """The generic padded-ELL lowering was refused: its per-row gather
+    program at this operator size is past the footprint ceiling that has
+    faulted real TPU workers (the 64^3 tet-elasticity probe — see
+    IRREGULAR_BENCH.json's 64^3 note). Raised INSTEAD of staging the
+    program, so no documented env-flag combination can reach the
+    device-fault path."""
+
+
+#: Ceiling on the padded-ELL A_oo gather footprint (``no_max * L_oo``
+#: elements per part). The generic ELL SpMV gathers element-at-a-time;
+#: past this scale its gather kernels have faulted the TPU worker
+#: outright (isolated by probe at the 64^3 tet-elasticity operator —
+#: 786432 rows at mean width 35.5, so the padded footprint is >= 28M
+#: elements; SD and BSR on the same operator are fine). The ceiling sits
+#: between the largest ELL program ever measured healthy (32^3, ~6M
+#: padded elements) and that fault's proven lower bound, conservative
+#: side. Override with PA_TPU_ELL_MAX_GATHER; PA_TPU_ELL_GUARD=0
+#: disables the guard, =1 enforces it even off-TPU (CPU meshes only
+#: WARN by default — they are slow there, not unsafe).
+ELL_MAX_GATHER = int(2.5e7)
+
+
+def _ell_guard_check(P: int, no_max: int, L_oo: int, backend) -> None:
+    """Refuse (real TPU) or warn (host mesh) when the padded-ELL gather
+    footprint is past the device-fault ceiling. Called by the lowering
+    BEFORE the ELL arrays are built, whether ELL was auto-selected (every
+    fast path declined) or forced by strict-bits mode."""
+    mode = os.environ.get("PA_TPU_ELL_GUARD", "auto")
+    if mode == "0":
+        return
+    ceiling = int(
+        float(os.environ.get("PA_TPU_ELL_MAX_GATHER", ELL_MAX_GATHER))
+    )
+    footprint = int(no_max) * int(L_oo)
+    if footprint <= ceiling:
+        return
+    why = (
+        "strict-bits mode forces the pure-ELL lowering"
+        if strict_bits()
+        else "every fast-path lowering (DIA/SD/BSR) declined this operator"
+    )
+    msg = (
+        f"padded-ELL lowering refused: gather footprint no_max*L = "
+        f"{no_max}*{L_oo} = {footprint} elements/part exceeds the "
+        f"device-fault ceiling {ceiling} (P={P}). {why}. The generic ELL "
+        "gather program at this scale has faulted TPU workers outright. "
+        "Options: relax the operator so a fast path engages "
+        "(PA_TPU_SD=1 / PA_TPU_BSR=1, node-block-aligned dofs), drop "
+        "PA_TPU_STRICT_BITS for this size, run on the host backend, or "
+        "raise PA_TPU_ELL_MAX_GATHER explicitly if your worker tolerates "
+        "it."
+    )
+    on_tpu = backend.devices()[0].platform == "tpu"
+    if on_tpu or mode == "1":
+        raise ELLFootprintError(msg)
+    import warnings
+
+    warnings.warn(
+        "partitionedarrays_jl_tpu: " + msg + " (host mesh: continuing — "
+        "slow but safe)",
+        stacklevel=3,
+    )
+
+
 def device_layout(rows: PRange, padded: bool = False) -> DeviceLayout:
     from .tpu_box import box_structure
 
@@ -687,6 +774,11 @@ class DeviceMatrix:
                 default=0,
             )
             L_oo = max(L_oo, 1)
+            # device-fault guard (moved here from tools/bench_irregular):
+            # the library must never stage an ELL gather program past the
+            # footprint that faults real TPU workers — neither by
+            # auto-selection nor forced by strict-bits
+            _ell_guard_check(P, no_max, L_oo, backend)
             oo_vals = np.zeros((P, no_max, L_oo))
             oo_cols = np.full(
                 (P, no_max, L_oo), col_layout.trash, dtype=INDEX_DTYPE
@@ -1456,6 +1548,14 @@ def _lowering_env_key() -> tuple:
         os.environ.get("PA_TPU_SD", "1") != "0",
         os.environ.get("PA_TPU_CLASS_ACC", "1") != "0",
         _box_exchange_enabled(),
+        # the fused-CG mode does not change the MATRIX lowering itself
+        # (the program caches re-key on the concrete body choice), but
+        # keying it here means every derived cache — including future
+        # ones that bake a CG body without threading the flag — rekeys
+        # on a flip. Cost: an env-flip A/B restages the matrix; the
+        # bench tooling therefore A/Bs via make_cg_fn(fused=...), not
+        # the env var.
+        _fused_cg_enabled(),
     )
 
 
@@ -1494,6 +1594,21 @@ def _strict_rounded_product(t):
     return jnp.where(t == t, t, jnp.full_like(t, jnp.nan))
 
 
+def _strict_pairwise_partial(t, no_max: int):
+    """Per-shard strict partial: the fixed-tree pairwise sum of the
+    (already separately-rounded) products — `utils.helpers.pairwise_sum`
+    runs the identical tree on host. The ONE definition both dot
+    factories share; the bit-exactness contract lives here."""
+    import jax.numpy as jnp
+
+    n = 1 << int(no_max - 1).bit_length() if no_max > 1 else 1
+    t = jnp.pad(t, (0, n - no_max))
+    while n > 1:
+        t = t[0::2] + t[1::2]
+        n //= 2
+    return t[0] if no_max else jnp.zeros((), t.dtype)
+
+
 def _pdot_factory(o0: int, no_max: int):
     """Deterministic across-parts dot: per-shard partial (owned region;
     padding is zero by invariant), `all_gather`, fold in part order — the
@@ -1501,9 +1616,9 @@ def _pdot_factory(o0: int, no_max: int):
     order (and hence bits) matches the oracle.
 
     In strict-bits mode the per-shard partial is the fixed-tree pairwise
-    sum of separately-rounded products (`utils.helpers.pairwise_sum` runs
-    the identical tree on host), and the cross-part fold is an explicit
-    left fold — bit-identical to the sequential `PVector.dot`."""
+    sum of separately-rounded products (`_strict_pairwise_partial`), and
+    the cross-part fold is an explicit left fold — bit-identical to the
+    sequential `PVector.dot`."""
     import jax
     import jax.numpy as jnp
 
@@ -1513,13 +1628,9 @@ def _pdot_factory(o0: int, no_max: int):
             t = _strict_rounded_product(
                 a[o0 : o0 + no_max] * b[o0 : o0 + no_max]
             )
-            n = 1 << int(no_max - 1).bit_length() if no_max > 1 else 1
-            t = jnp.pad(t, (0, n - no_max))
-            while n > 1:
-                t = t[0::2] + t[1::2]
-                n //= 2
-            partial_ = t[0] if no_max else jnp.zeros((), a.dtype)
-            allp = jax.lax.all_gather(partial_, "parts")
+            allp = jax.lax.all_gather(
+                _strict_pairwise_partial(t, no_max), "parts"
+            )
             acc = allp[0]
             for i in range(1, allp.shape[0]):
                 acc = acc + allp[i]
@@ -1533,6 +1644,48 @@ def _pdot_factory(o0: int, no_max: int):
         return jnp.sum(allp)
 
     return pdot
+
+
+def _pdot_owned_factory(no_max: int):
+    """Deterministic dots over ALREADY-SLICED owned arrays, for the fused
+    CG body whose update sweep holds the owned slices in hand: returns
+    ``(dot1, dot2)`` where ``dot1(a, b)`` IS `_pdot_factory`'s pdot at
+    offset 0 (an owned array is its own owned region), and
+    ``dot2(a, b, c, d)`` computes TWO dots (a·b, c·d) riding ONE
+    all_gather of a stacked partial pair — the preconditioned loop's
+    r·z / r·r reductions share a collective instead of paying two.
+    Per-component partials and the cross-part fold order are identical
+    to two separate dot1 calls, so the pairing changes collective count,
+    not bits."""
+    import jax
+    import jax.numpy as jnp
+
+    dot1 = _pdot_factory(0, no_max)
+
+    if strict_bits():
+
+        def dot2(a, b, c, d):
+            p1 = _strict_pairwise_partial(
+                _strict_rounded_product(a * b), no_max
+            )
+            p2 = _strict_pairwise_partial(
+                _strict_rounded_product(c * d), no_max
+            )
+            allp = jax.lax.all_gather(jnp.stack([p1, p2]), "parts")
+            acc1, acc2 = allp[0, 0], allp[0, 1]
+            for i in range(1, allp.shape[0]):
+                acc1 = acc1 + allp[i, 0]
+                acc2 = acc2 + allp[i, 1]
+            return acc1, acc2
+
+        return dot1, dot2
+
+    def dot2(a, b, c, d):
+        p_ = jnp.stack([jnp.sum(a * b), jnp.sum(c * d)])
+        s = jnp.sum(jax.lax.all_gather(p_, "parts"), axis=0)
+        return s[0], s[1]
+
+    return dot1, dot2
 
 
 def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") -> Callable:
@@ -1644,7 +1797,7 @@ def _matrix_operands(dA: DeviceMatrix) -> dict:
     return ops
 
 
-def _spmv_body(dA: DeviceMatrix, axpy: bool = False):
+def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
     """Per-shard overlapped SpMV: pack+permute the halo, compute the A_oo
     partial on pre-exchange owned values (independent of the collective —
     XLA overlaps them), then unpack and add the A_oh ghost contribution
@@ -1657,7 +1810,14 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False):
     kernel's spare DMA bandwidth (see pipelined CG in `make_cg_fn` —
     measured: the standalone x pass costs ~1/3 of a CG iteration because
     x spills the loop's VMEM-resident working set); elsewhere it is the
-    plain in-loop update (same values, no overlap)."""
+    plain in-loop update (same values, no overlap).
+
+    With ``pfold=True`` (fused CG, `make_cg_fn(fused=True)`) the body is
+    ``body(rv, pv, beta, m, mvv=None) -> (y, p)``: the next search
+    direction ``p = z + beta*pv`` materializes inside the SpMV's own
+    streaming pass instead of its own HBM sweep — the generalization of
+    the `_dia_coded_full_axpy` pattern to the direction update, with a
+    jnp fold covering the BSR/SD/ELL/XLA-DIA lowerings."""
     import jax
     import jax.numpy as jnp
 
@@ -1763,6 +1923,17 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False):
     else:
         _axpy_in_kernel = False
 
+    if pfold and pplan is not None and dA.dia_cb is not None:
+        from ..ops.pallas_dia import pfold_vmem_ok
+
+        # same reasoning for the direction-fold variant's extra window /
+        # combined-copy / p-output VMEM
+        _pfold_in_kernel = pfold_vmem_ok(
+            pplan, itemsize=np.dtype(dA.dia_cb.dtype).itemsize
+        )
+    else:
+        _pfold_in_kernel = False
+
     def _dia_coded_full_axpy(cb, no, codes, xv, xacc, pprev, alpha):
         from ..ops.pallas_dia import LANES, dia_coded_padded_pallas
 
@@ -1777,26 +1948,35 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False):
         )
         return y.reshape(-1), xacc2.reshape(-1)
 
-    def body(xv, m, *ax):
-        full = None
-        xacc2 = None
+    def _dia_coded_full_pfold(cb, no, codes, rv, pv, beta):
+        from ..ops.pallas_dia import LANES, dia_coded_padded_pallas
+
+        y, pnew = dia_coded_padded_pallas(
+            cb, no.astype(jnp.int32), codes, rv.reshape(-1, LANES),
+            offsets, kk, code_row, pplan, rv.shape[0] // LANES,
+            interpret=interpret, cls_pattern=dA.dia_cls_pattern,
+            pfold=(
+                pv.reshape(-1, LANES),
+                jnp.reshape(beta, (1,)).astype(rv.dtype),
+            ),
+        )
+        return y.reshape(-1), pnew.reshape(-1)
+
+    def _aoo(xv, m):
+        """The A_oo block applied to xv: ``(full, partial_)`` with
+        exactly one non-None — `full` is a complete row-frame vector
+        (padded coded kernel), `partial_` an owned-region array."""
         if mode == "coded":
             # coded-diagonal path: 1 byte/element per non-constant
             # diagonal, decoded against the SMEM codebook — independent of
             # the wire, so it still overlaps the halo collective
             if pplan is not None:
-                if axpy and _axpy_in_kernel:
-                    full, xacc2 = _dia_coded_full_axpy(
-                        m["cb"], m["no"], m["codes"], xv, *ax
-                    )
-                else:
-                    full = _dia_coded_full(m["cb"], m["no"], m["codes"], xv)
-            else:
-                partial_ = _dia_coded_xla(m["cb"], m["no"], m["codes"], xv)
-        elif offsets is not None:  # owned block first: overlaps the wire
+                return _dia_coded_full(m["cb"], m["no"], m["codes"], xv), None
+            return None, _dia_coded_xla(m["cb"], m["no"], m["codes"], xv)
+        if offsets is not None:  # owned block first: overlaps the wire
             rowsum = _dia_rowsum_pallas if pplan is not None else _dia_rowsum
-            partial_ = rowsum(m["oo_v"], xv)
-        elif dA.sd_bs is not None:
+            return None, rowsum(m["oo_v"], xv)
+        if dA.sd_bs is not None:
             # supernode-dense path: self blocks arrive by RESHAPE of the
             # owned region (no gather), only the per-group external
             # unions are gathered (~4x fewer element-at-a-time gather
@@ -1831,8 +2011,8 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False):
                     )
                 )
                 g0_ += len_c
-            partial_ = jnp.concatenate(outs, axis=0).reshape(-1)[:no_max]
-        elif dA.bsr_bs is not None:
+            return None, jnp.concatenate(outs, axis=0).reshape(-1)[:no_max]
+        if dA.bsr_bs is not None:
             # node-block gather: one index per bs×bs block (~bs²× fewer
             # element-at-a-time gathers than ELL), block products as one
             # batched einsum — the irregular-graph fast path
@@ -1843,20 +2023,17 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False):
             # HIGHEST precision: at DEFAULT the TPU MXU would run this f32
             # dot as lossy bf16 passes, silently breaking the "matches the
             # sequential oracle to FMA rounding" accuracy contract
-            partial_ = jnp.einsum(
+            return None, jnp.einsum(
                 "nlij,nlj->ni", m["bsr_v"], xg,
                 preferred_element_type=xv.dtype,
                 precision=jax.lax.Precision.HIGHEST,
             ).reshape(-1)
-        else:
-            partial_ = _ell_rowsum(m["oo_v"], m["oo_c"], xv)
-        if axpy and xacc2 is None:
-            # fallback paths: the plain (unfused) lagged update — same
-            # values and order as the standard recurrence's axpy
-            xacc, pprev, alpha = ax
-            colL = dA.col_plan.layout
-            cs = slice(colL.o0, colL.o0 + colL.no_max)
-            xacc2 = xacc.at[cs].add(_rp(alpha * pprev[cs]))
+        return None, _ell_rowsum(m["oo_v"], m["oo_c"], xv)
+
+    def _finish(full, partial_, xv, m):
+        """Shared SpMV tail: halo-exchange the operand, embed the A_oo
+        product in the row frame, add the boundary (A_oh) contribution.
+        Returns (y, exchanged operand)."""
         xv = exch(xv, m["si"], m["sm"], m["ri"])
         if full is not None:
             y = full  # already a complete vector, pads exactly zero
@@ -1892,9 +2069,54 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False):
                     _ell_rowsum(m["oh_v"], m["oh_c"], xv)
                 )
             y = y.at[g0:].set(0)
+        return y, xv
+
+    def body(xv, m, *ax):
+        xacc2 = None
+        if mode == "coded" and pplan is not None and axpy and _axpy_in_kernel:
+            full, xacc2 = _dia_coded_full_axpy(
+                m["cb"], m["no"], m["codes"], xv, *ax
+            )
+            partial_ = None
+        else:
+            full, partial_ = _aoo(xv, m)
+        if axpy and xacc2 is None:
+            # fallback paths: the plain (unfused) lagged update — same
+            # values and order as the standard recurrence's axpy
+            xacc, pprev, alpha = ax
+            colL = dA.col_plan.layout
+            cs = slice(colL.o0, colL.o0 + colL.no_max)
+            xacc2 = xacc.at[cs].add(_rp(alpha * pprev[cs]))
+        y, xv = _finish(full, partial_, xv, m)
         return (y, xacc2) if axpy else (y, xv)
 
-    return body
+    def body_pfold(rv, pv, beta, m, mvv=None):
+        """Fused-CG leading-edge fold: materialize the next search
+        direction ``p = z + beta*pv`` (``z = mvv*rv`` when a diagonal
+        preconditioner row is supplied, else ``rv``) INSIDE the SpMV
+        pass, and return ``(A p, p)``. On the coded padded path the fold
+        rides the Pallas kernel's window DMA (`_padded_kernel`
+        has_pfold) so p is never read back for the band sum; on every
+        other lowering the fold is a jnp expression adjacent to the A_oo
+        read, which XLA fuses into the operand's first touch. Note the
+        halo pack depends on the folded p, so the wire no longer fully
+        overlaps the A_oo compute — a surface-sized effect that the
+        fused body's saved volume sweeps dominate."""
+        colL = dA.col_plan.layout
+        cs = slice(colL.o0, colL.o0 + colL.no_max)
+        if _pfold_in_kernel and mvv is None:
+            full, pnew = _dia_coded_full_pfold(
+                m["cb"], m["no"], m["codes"], rv, pv, beta
+            )
+            partial_ = None
+        else:
+            z = mvv[cs] * rv[cs] if mvv is not None else rv[cs]
+            pnew = jnp.zeros_like(rv).at[cs].set(z + _rp(beta * pv[cs]))
+            full, partial_ = _aoo(pnew, m)
+        y, _ = _finish(full, partial_, pnew, m)
+        return y, pnew
+
+    return body_pfold if pfold else body
 
 
 def _shard_ops(jax, ms):
@@ -1944,7 +2166,7 @@ def make_spmv_fn(dA: DeviceMatrix) -> Callable:
 
 def make_cg_fn(
     dA: DeviceMatrix, tol: float, maxiter: int, precond: bool = False,
-    pipelined: bool = False,
+    pipelined: bool = False, fused: Optional[bool] = None,
 ) -> Callable:
     """The whole CG solve as ONE compiled shard_map program:
     `lax.while_loop` whose body does the overlapped SpMV, deterministic
@@ -1952,6 +2174,33 @@ def make_cg_fn(
     preconditioned CG against a diagonal preconditioner supplied as an
     extra (P, W) operand (owned slots = inverse diagonal). Returns
     (x_stacked, iterations, final_residual).
+
+    ``fused`` (default: `_fused_cg_enabled()` — ON except strict-bits,
+    ``PA_TPU_FUSED_CG=0`` reverts) selects the fused streaming body for
+    large-N bandwidth-bound iterations (docs/performance.md §Per-DOF
+    scaling: at ≥320³ the standard body's five separate axpy/dot sweeps
+    run AT the ~677 GB/s HBM roofline, ~4.8 GB/iteration at 464³):
+
+    * the solution/residual updates ``x += α·p``, ``r -= α·q`` and the
+      ``r·r`` (and ``r·z``) dot partials run in ONE sweep over the owned
+      region — a structured jnp block XLA fuses (collective count pinned
+      by tests/test_fused_cg.py); the preconditioned pair of reductions
+      rides one shared all_gather;
+    * the direction update ``p = z + β·p`` folds into the leading edge
+      of the NEXT SpMV pass (`_spmv_body(pfold=True)` — in-kernel on the
+      coded padded path, a fused jnp expression on the BSR/SD/ELL/XLA
+      lowerings);
+    * the vector state lives in ONE packed (3, W) carry — x, r, p share
+      a buffer, which also sidesteps the per-carry while-loop copies
+      behind the 292³–300³ XLA anomaly (SCALE_CURVE.json): inside that
+      window the packed-carry body is logged as the structural escape.
+
+    Every scalar follows the textbook recurrence on the same dots in the
+    same order, so the iteration trajectory is IDENTICAL to the standard
+    body (bit-identical under strict-bits arithmetic — pinned on the
+    4-part conformance fixture by tests/test_fused_cg.py). The standard
+    (unfused) body remains the strict-bits oracle and the default when
+    ``PA_TPU_FUSED_CG=0``.
 
     ``pipelined=True`` (unpreconditioned only) is the lag-1 form: the
     solution update x += α·p is applied one iteration LATE, fused into
@@ -1968,11 +2217,19 @@ def make_cg_fn(
     import jax.numpy as jnp
     shard_map = _shard_map()
 
+    fused = _resolve_fused(fused, pipelined)
+    if fused and pipelined:
+        # unconditional (not check()): the two bodies place the x update
+        # differently — silently picking one would change the program
+        raise ValueError(
+            "make_cg_fn: fused and pipelined are mutually exclusive forms"
+        )
     mesh = dA.backend.mesh(dA.row_layout.P)
     spec = dA.backend.parts_spec()
     none_spec = jax.sharding.PartitionSpec()
     body_spmv = _spmv_body(dA)
     body_axpy = _spmv_body(dA, axpy=True) if pipelined else None
+    body_pfold = _spmv_body(dA, pfold=True) if fused else None
     no_max = dA.row_layout.no_max
     o0 = dA.row_layout.o0
     g0 = dA.row_layout.g0
@@ -1983,7 +2240,20 @@ def make_cg_fn(
             "make_cg_fn: the pipelined (lag-1) form is unpreconditioned-"
             "only — drop precond or pipelined"
         )
+    if fused and 24.5e6 <= no_max <= 27.5e6:
+        # the 292³–300³ regional XLA anomaly (SCALE_CURVE.json: the
+        # standard body's per-carry buffer copies spike 2-3x here): the
+        # packed-carry fused body is the structural escape — say so, so
+        # a user A/B-ing the window knows which body ran
+        print(
+            "[partitionedarrays_jl_tpu] make_cg_fn: owned size "
+            f"{no_max} is inside the 292³–300³ XLA anomaly window — "
+            "using the packed-carry fused body as the structural escape "
+            "(PA_TPU_FUSED_CG=0 reverts to the standard body)",
+            flush=True,
+        )
     pdot = _pdot_factory(o0, no_max)
+    odot1, odot2 = _pdot_owned_factory(no_max)
     ops = _matrix_operands(dA)
     specs = jax.tree.map(lambda _: spec, ops)
     strict = strict_bits()
@@ -2025,6 +2295,66 @@ def make_cg_fn(
             rs0 = pdot(r, r)
             rz0 = pdot(r, z) if precond else rs0
             hist = jnp.full(H, jnp.nan, dtype=bv.dtype).at[0].set(jnp.sqrt(rs0))
+
+            if fused:
+                slf = slice(o0, o0 + no_max)
+                # packed (k, W) carry: x, r, p_prev share ONE buffer, so
+                # the update sweep reads/writes one stacked region and
+                # the while loop carries one vector buffer instead of
+                # three (the structural escape from XLA's per-carry
+                # copies). p_prev starts at 0 with beta 0, so the first
+                # fold yields p_0 = z_0 exactly like the standard body.
+                S0 = jnp.stack([xv, r, jnp.zeros_like(xv)])
+                zero = jnp.zeros((), bv.dtype)
+
+                def cond_fused(state):
+                    _S, rz, rs, _beta, it, _h = state
+                    go = jnp.logical_and(
+                        jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0)),
+                        it < maxiter,
+                    )
+                    # same in-graph health guard as the standard body
+                    go = jnp.logical_and(go, jnp.isfinite(rs))
+                    if precond:
+                        go = jnp.logical_and(go, rz != 0)
+                    return go
+
+                def step_fused(state):
+                    S, rz, rs, beta, it, hist = state
+                    x, r_, p_prev = S[0], S[1], S[2]
+                    # (b) direction fold rides the SpMV pass itself
+                    q, p = body_pfold(
+                        r_, p_prev, beta, mats, mvv if precond else None
+                    )
+                    pq = pdot(p, q)
+                    alpha = rz / pq
+                    # (a) ONE sweep: both vector updates and the dot
+                    # partial(s); the preconditioned pair of reductions
+                    # shares one all_gather (odot2)
+                    xo = x[slf] + _rp(alpha * p[slf])
+                    ro = r_[slf] + _rp(-alpha * q[slf])
+                    if precond:
+                        zo = mvv[slf] * ro
+                        rz_new, rs_new = odot2(ro, zo, ro, ro)
+                    else:
+                        rs_new = odot1(ro, ro)
+                        rz_new = rs_new
+                    beta_new = rz_new / rz
+                    S2 = (
+                        S.at[0, slf].set(xo)
+                        .at[1, slf].set(ro)
+                        .at[2, slf].set(p[slf])
+                    )
+                    hist2 = hist.at[jnp.minimum(it + 1, H - 1)].set(
+                        jnp.sqrt(rs_new)
+                    )
+                    return (S2, rz_new, rs_new, beta_new, it + 1, hist2)
+
+                S, rz, rs, beta, it, hist = jax.lax.while_loop(
+                    cond_fused, step_fused,
+                    (S0, rz0, rs0, zero, jnp.int32(0), hist),
+                )
+                return S[0][None], rs, rs0, it, hist
 
             def cond(state):
                 _x, _r, _p, rz, rs, it, _h = state
@@ -2134,6 +2464,12 @@ def make_cg_fn(
             )
         return fn(b, x0, b if mv is None else mv, ops)
 
+    # introspection hooks (tests/benches): the inner jitted program and
+    # its staged operands, so callers can `jit_fn.lower(...)` and count
+    # collectives/fusions without reaching into closures
+    run.jit_fn = fn
+    run.operands = ops
+    run.fused = bool(fused)
     return run
 
 
@@ -2898,12 +3234,14 @@ def tpu_chebyshev(
     )
 
 
-def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg"):
+def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg",
+                info_extra=None):
     """Shared device-Krylov driver: stage vectors in the matrix's col
     layout, run the single compiled program, lift the result back to a
     host PVector. The info dict matches the host solvers' contract:
     `residuals` has iterations+1 entries (capped at the compiled history
-    length)."""
+    length); ``info_extra`` keys (e.g. the CG body variant) merge into
+    it."""
     from ..utils.helpers import krylov_info, warn_tol_below_floor
 
     backend = b.values.backend
@@ -2946,6 +3284,7 @@ def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg"):
             A, x, b, np.sqrt(rs) / max(1.0, np.sqrt(rs0)), np.sqrt(rs0),
             tol, force=floor_warned,
         ),
+        **(info_extra or {}),
     )
 
 
@@ -2964,22 +3303,30 @@ def tpu_cg(
     verbose: bool = False,
     minv: Optional[PVector] = None,
     pipelined: bool = False,
+    fused: Optional[bool] = None,
 ) -> Tuple[PVector, dict]:
     """Device (preconditioned) CG: the whole loop is one compiled
     shard_map program. `minv` is an optional diagonal preconditioner (a
     PVector over A.cols holding the inverse diagonal in its owned
     entries). ``pipelined`` selects the lag-1 form with the solution
-    update fused into the SpMV kernel (see `make_cg_fn`)."""
+    update fused into the SpMV kernel; ``fused`` (default: resolved from
+    ``PA_TPU_FUSED_CG``, ON outside strict-bits) selects the fused
+    streaming body with the packed (3, W) carry (see `make_cg_fn`). The
+    info dict records which body ran under ``cg_body``."""
     backend = b.values.backend
     check(isinstance(backend, TPUBackend), "tpu_cg needs a TPU-backend PVector")
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
     dA = device_matrix(A, backend)
+    fused = _resolve_fused(fused, pipelined)
     solve = _krylov_fn_for(
-        dA, "cg", tol, maxiter, precond=minv is not None, pipelined=pipelined
+        dA, "cg", tol, maxiter, precond=minv is not None,
+        pipelined=pipelined, fused=fused,
     )
+    body = "pipelined" if pipelined else ("fused" if fused else "standard")
     return _run_krylov(
         A, b, x0, tol, verbose, solve, minv=minv,
         name="pcg" if minv is not None else "cg",
+        info_extra={"cg_body": body},
     )
 
 
@@ -3007,12 +3354,22 @@ def tpu_bicgstab(
 def _krylov_fn_for(
     dA: DeviceMatrix, method: str, tol: float, maxiter: int,
     precond: bool = False, pipelined: bool = False,
+    fused: Optional[bool] = None,
 ):
-    key = (method, float(tol), int(maxiter), bool(precond), bool(pipelined))
+    if method == "cg":
+        # the cache key must be the CONCRETE body choice (the env mode is
+        # also part of _lowering_env_key, which rekeys the DeviceMatrix
+        # itself on a flip)
+        fused = _resolve_fused(fused, pipelined)
+    key = (
+        method, float(tol), int(maxiter), bool(precond), bool(pipelined),
+        bool(fused),
+    )
     if key not in dA._cg_cache:
         if method == "cg":
             dA._cg_cache[key] = make_cg_fn(
-                dA, tol, maxiter, precond=precond, pipelined=pipelined
+                dA, tol, maxiter, precond=precond, pipelined=pipelined,
+                fused=fused,
             )
         else:
             dA._cg_cache[key] = make_bicgstab_fn(

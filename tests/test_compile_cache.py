@@ -88,6 +88,10 @@ def test_env_var_hook(monkeypatch, tmp_path):
     import partitionedarrays_jl_tpu.utils.compile_cache as cc
 
     prev_dir = cc.compilation_cache_dir()
+    # _maybe_enable_from_env / enable_compilation_cache pin the compile-
+    # time floor to their own value — save what was ACTUALLY set before
+    # the test and restore it (not a literal) in the finally
+    prev_secs = jax.config.jax_persistent_cache_min_compile_time_secs
     try:
         target = str(tmp_path / "envcache")
         monkeypatch.setenv("PA_TPU_COMPILE_CACHE", target)
@@ -108,7 +112,10 @@ def test_env_var_hook(monkeypatch, tmp_path):
         if prev_dir is not None:
             cc.enable_compilation_cache(prev_dir)
         else:
-            import jax
-
             jax.config.update("jax_compilation_cache_dir", None)
             cc._enabled_dir = None
+        # LAST: the enable call above re-pins the floor — put back the
+        # pre-test value
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_secs
+        )

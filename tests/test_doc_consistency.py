@@ -1,0 +1,158 @@
+"""Doc/artifact traceability guard (round-5 rule: every number in the
+docs traces to a committed artifact or carries its round tag).
+
+Two stale-doc classes have actually shipped in this repo's history —
+a capability claim that code had already obsoleted (docs/roadmap.md §1
+"still require equal per-part boxes", contradicted by the shape-variant
+`lax.switch` transfers in tpu_gmg.py and GMG_BENCH.json), and
+historical bench numbers quoted without their round tag (the round-4
+"11.1 GFLOP/s" lived only in a commit message). This file makes the
+traceability rule enforce itself:
+
+* known-stale claim patterns must not reappear in committed docs;
+* superseded historical figures may only appear in a paragraph that
+  carries a round/era tag;
+* the committed artifacts and the bench guards that gate them must
+  agree (band bounds in the artifact == the guard tables in tools/).
+"""
+import importlib.util
+import json
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DOC_FILES = [
+    "README.md",
+    "docs/performance.md",
+    "docs/roadmap.md",
+    "docs/design.md",
+    "docs/api.md",
+    "docs/migration.md",
+    "docs/resilience.md",
+]
+
+#: Claims proven wrong by shipped code: these exact phrases must never
+#: come back (each entry documents what obsoleted it).
+BANNED_PATTERNS = [
+    (
+        r"still require equal per-part boxes",
+        "obsoleted by the shape-variant lax.switch transfers "
+        "(tpu_gmg.py, round 5; GMG_BENCH.json records the paths)",
+    ),
+    (
+        r"practical floor under current XLA\s+while-loop semantics",
+        "the round-2 conclusion was size-specific; superseded by the "
+        "round-6 fused streaming CG body at large N",
+    ),
+]
+
+#: Historical figures superseded by later rounds: quoting one is fine
+#: ONLY in a paragraph that names its era (round N / rN / historical).
+HISTORICAL_FIGURES = [
+    "876 s",      # r2 assembly, now 30-108 s
+    "365 s",      # r3 GMG hierarchy, now 54-139 s
+    "299 s",      # r2 lowering, now 27-77 s
+    "797 ms",     # r1 V-cycle, now 7.7 ms
+    "9.32 ms",    # r5 standard-body CG iteration, now 6.77 ms fused
+    "9.323",      # same figure as recorded in the r5 artifact
+]
+ERA_TAG = re.compile(r"(historical|rounds?\s*[0-9]|\br[0-9]\b)", re.I)
+
+
+def _doc_paragraphs():
+    for rel in DOC_FILES:
+        path = os.path.join(REPO, rel)
+        if not os.path.exists(path):
+            continue
+        text = open(path, encoding="utf-8").read()
+        for para in re.split(r"\n\s*\n", text):
+            yield rel, para
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_no_banned_stale_claims():
+    hits = []
+    for rel, para in _doc_paragraphs():
+        for pat, why in BANNED_PATTERNS:
+            if re.search(pat, para):
+                hits.append((rel, pat, why))
+    assert not hits, (
+        "stale claims back in the docs (each was proven wrong by shipped "
+        f"code): {hits}"
+    )
+
+
+def test_historical_figures_carry_their_round_tag():
+    untagged = []
+    for rel, para in _doc_paragraphs():
+        for fig in HISTORICAL_FIGURES:
+            if fig in para and not ERA_TAG.search(para):
+                untagged.append((rel, fig, para[:120]))
+    assert not untagged, (
+        "superseded figures quoted without a round/era tag — either tag "
+        f"the paragraph or update the number: {untagged}"
+    )
+
+
+def test_scale_bench_artifact_agrees_with_guard_bands():
+    """The committed flagship artifact and the bench guard must agree:
+    identical band bounds, and the recorded device metrics inside them
+    (a lowered band with a stale artifact — or vice versa — is exactly
+    the drift this file exists to catch)."""
+    bench_scale = _load_tool("bench_scale")
+    rec = json.load(open(os.path.join(REPO, "SCALE_BENCH.json")))
+    for key, (lo, hi, kind) in bench_scale.SCALE_BANDS.items():
+        band = rec["bands"].get(key)
+        assert band is not None, f"artifact missing band {key}"
+        assert (band["lo"], band["hi"]) == (lo, hi), (
+            f"band bounds for {key} drifted: guard ({lo}, {hi}) vs "
+            f"artifact ({band['lo']}, {band['hi']})"
+        )
+        if kind == "device":
+            assert band["in_band"], (key, band)
+    assert rec["bands_ok_device"] is True
+
+
+def test_irregular_artifact_agrees_with_guard_bands():
+    bench_irr = _load_tool("bench_irregular")
+    rec = json.load(open(os.path.join(REPO, "IRREGULAR_BENCH.json")))
+    assert rec["methodology"] == bench_irr.METHODOLOGY
+    banded = 0
+    for row in rec["sizes"]:
+        n = row["n"]
+        if row.get("lowering") == "sd" and n in bench_irr.BANDS_SD:
+            lo, hi = bench_irr.BANDS_SD[n]
+            band = row.get("band")
+            assert band is not None, f"SD row n={n} missing its band"
+            assert (band["lo"], band["hi"]) == (lo, hi), (n, band)
+            assert band["measured"] == row[f"{row['lowering']}_gflops"]
+            assert row["in_band"] == (lo <= band["measured"] <= hi)
+            banded += 1
+    # every measured size is banded (the 48^3/64^3 rows used to ship
+    # silently unbanded — round-6 satellite)
+    assert banded == len(rec["sizes"]), (banded, len(rec["sizes"]))
+
+
+def test_scale_curve_fused_headline_consistent_with_bench():
+    """SCALE_CURVE's 464^3 fused marginal and SCALE_BENCH's full-solve
+    per-iteration must describe the same kernel: marginal <= full-solve
+    (the full solve carries dispatch overhead) and within ~15%."""
+    curve = json.load(open(os.path.join(REPO, "SCALE_CURVE.json")))
+    rec = json.load(open(os.path.join(REPO, "SCALE_BENCH.json")))
+    row = next(r for r in curve["sizes"] if r["n"] == rec["n"])
+    marginal_ms = row["cg_s_per_it"] * 1e3
+    full_ms = rec["per_iteration_ms"]
+    assert marginal_ms <= full_ms <= 1.15 * marginal_ms, (
+        marginal_ms, full_ms,
+    )
+    # the A/B leg is present wherever the fused default is the headline
+    assert "cg_unfused_s_per_it" in row and "cg_fused_speedup" in row

@@ -197,6 +197,23 @@ def test_controller_failure_is_typed_and_survivable():
     assert pa.prun(driver, pa.sequential, (2, 2))
 
 
+def test_controller_clause_outside_grid_is_inert():
+    """The spec grammar's promise — an id outside this run's part grid
+    matches nothing — must hold for `controller` clauses too (it used to
+    be checked only for drop/delay): a spec written for a larger mesh
+    must not kill a smaller run."""
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        with inject_faults("controller@part=9,call=3", seed=0) as st:
+            x, info = cg(A, b, x0=x0, tol=1e-9)
+        assert info["converged"]
+        assert not st.events  # the clause fired nothing
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
 def test_delay_fault_records_event():
     def driver(parts):
         A, b, x_exact, x0 = _setup(parts)
@@ -465,6 +482,37 @@ def test_resume_onto_different_part_count(tmp_path):
 
     assert pa.prun(save4, pa.sequential, 4)
     assert pa.prun(resume3, pa.sequential, 3)
+
+
+def test_recovery_restarts_from_iterate_only_checkpoint(tmp_path):
+    """A checkpoint directory holding an ITERATE-ONLY state (exactly what
+    the chunked device path of the same job writes: {"x"} with no r/p or
+    rs scalar) must not crash the host recovery path — the restart falls
+    back to the checkpointed iterate, same contract as resume_solve."""
+    d = str(tmp_path / "ck")
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        # seed the directory with an x-only checkpoint mid-trajectory
+        x_mid, _ = cg(A, b, x0=x0, tol=1e-12, maxiter=6)
+        seeder = SolverCheckpointer(d, every=1)
+        seeder.save_state({"x": x_mid}, {"method": "cg", "it": 6, "tol": 1e-9})
+        seeder.wait()
+        # `every` large: the failing attempt writes no full-state
+        # checkpoint of its own, so the restart sees ONLY the x-only one
+        with inject_faults("nan@part=0,call=7", seed=1):
+            x, info = solve_with_recovery(
+                A, b, method="cg", x0=x0, checkpoint_dir=d, every=10_000,
+                tol=1e-9, max_restarts=1,
+            )
+        assert info["converged"] and info["restarts"] == 1
+        err = float(
+            np.abs(gather_pvector(x) - gather_pvector(x_exact)).max()
+        )
+        assert err < 1e-6, err
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
 
 
 def test_resume_solve_rejects_empty_dir(tmp_path):

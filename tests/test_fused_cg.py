@@ -1,0 +1,363 @@
+"""The fused streaming CG body (`make_cg_fn(fused=True)`, the
+``PA_TPU_FUSED_CG`` default outside strict-bits).
+
+The fusion's three contracts, each pinned here:
+
+* **Trajectory identity.** Every scalar follows the textbook recurrence
+  on the same dots in the same order, so the iterate sequence matches
+  the standard (unfused) body — bit-for-bit under strict-bits
+  arithmetic, where the unfused body is the oracle. Pinned on the
+  asymmetric 4-part conformance partition (the 10-gid fixture of
+  test_conformance.py / reference test_interfaces.jl:177-207), whose
+  ghost graph exercises the generic exchange plan.
+* **Collective parity.** The fused body restructures the VECTOR sweeps;
+  it must not add collectives (the preconditioned pair of reductions
+  actually shares one all_gather). Asserted on the lowered HLO of the
+  compiled programs — the same A/B discipline the round-1 in-graph
+  health guard was verified with.
+* **Kernel fold parity.** On the padded coded frame the direction
+  update rides the Pallas kernel's window pass (`_padded_kernel`
+  has_pfold); validated on CPU through the Pallas interpreter exactly
+  like the other padded-frame tests.
+"""
+import re
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.models import (
+    assemble_poisson,
+    gather_pvector,
+    jacobi_preconditioner,
+)
+from partitionedarrays_jl_tpu.parallel.tpu import (
+    DeviceVector,
+    TPUBackend,
+    _b_on_cols_layout,
+    device_matrix,
+    make_cg_fn,
+    tpu_cg,
+)
+
+
+def _backend(n=8):
+    import jax
+
+    return TPUBackend(devices=jax.devices()[:n])
+
+
+def test_fused_cg_matches_standard_device_loop():
+    """Default mode, f64: identical iteration counts, residual history to
+    tight rounding, solutions to rounding; the info dict records which
+    body ran."""
+
+    def run(fused):
+        def driver(parts):
+            A, b, xe, x0 = assemble_poisson(parts, (8, 8, 8))
+            x, info = tpu_cg(A, b, x0=x0, tol=1e-9, maxiter=500, fused=fused)
+            return gather_pvector(x), info
+
+        return pa.prun(driver, _backend(), (2, 2, 2))
+
+    xf, inf_f = run(True)
+    xu, inf_u = run(False)
+    assert inf_f["cg_body"] == "fused" and inf_u["cg_body"] == "standard"
+    assert inf_f["converged"] and inf_u["converged"]
+    assert inf_f["iterations"] == inf_u["iterations"]
+    n = inf_u["iterations"] + 1
+    np.testing.assert_allclose(
+        np.asarray(inf_f["residuals"])[:n],
+        np.asarray(inf_u["residuals"])[:n],
+        rtol=1e-12,
+    )
+    np.testing.assert_allclose(np.asarray(xf), np.asarray(xu), atol=1e-10)
+
+
+def test_fused_pcg_matches_standard_and_shares_gather():
+    """Preconditioned fused loop: same trajectory as the standard PCG
+    body (its r·z / r·r reductions ride ONE all_gather — collective
+    count covered by the HLO test below)."""
+
+    def run(fused):
+        def driver(parts):
+            A, b, xe, x0 = assemble_poisson(parts, (8, 8, 8))
+            mv = jacobi_preconditioner(A)
+            x, info = tpu_cg(
+                A, b, x0=x0, tol=1e-9, maxiter=500, minv=mv, fused=fused
+            )
+            return gather_pvector(x), info
+
+        return pa.prun(driver, _backend(), (2, 2, 2))
+
+    xf, inf_f = run(True)
+    xu, inf_u = run(False)
+    assert inf_f["converged"] and inf_u["converged"]
+    assert inf_f["iterations"] == inf_u["iterations"]
+    np.testing.assert_allclose(np.asarray(xf), np.asarray(xu), atol=1e-8)
+
+
+# ---------------------------------------------------------------------------
+# strict-bits trajectory identity on the 4-part conformance fixture
+# ---------------------------------------------------------------------------
+
+# the 10-gid 4-part fixture (reference: test_interfaces.jl:177-207), each
+# part's lids reordered owned-first (same ownership, same ghost sets, same
+# neighbor graph — the block split requires owned-first local layouts)
+LID_TO_GID = [
+    [0, 1, 2, 4, 6, 7],
+    [3, 4, 1, 9],
+    [5, 6, 7, 4, 3, 9],
+    [8, 9, 0, 2, 6],
+]
+LID_TO_PART = [
+    [0, 0, 0, 1, 2, 2],
+    [1, 1, 0, 3],
+    [2, 2, 2, 1, 1, 3],
+    [3, 3, 0, 0, 2],
+]
+
+
+def _fixture_spd_system(parts):
+    """A symmetric positive-definite operator over the conformance
+    partition: couplings only between MUTUALLY visible gid pairs (each
+    owner holds the other's gid), so both triangle entries exist and the
+    assembled matrix is exactly symmetric; a dominant diagonal makes it
+    SPD."""
+    owner = {}
+    for p, (gids, ps) in enumerate(zip(LID_TO_GID, LID_TO_PART)):
+        for g, q in zip(gids, ps):
+            if q == p:
+                owner[g] = p
+    visible = [set(g) for g in LID_TO_GID]
+    pairs = {
+        (a, b)
+        for a in range(10)
+        for b in range(10)
+        if a != b and b in visible[owner[a]] and a in visible[owner[b]]
+    }
+
+    def triplets(p):
+        I, J, V = [], [], []
+        for g, q in zip(LID_TO_GID[p], LID_TO_PART[p]):
+            if q != p:
+                continue
+            I.append(g)
+            J.append(g)
+            V.append(40.0 + g)
+            for b in sorted(visible[p]):
+                if (g, b) in pairs:
+                    I.append(g)
+                    J.append(b)
+                    V.append(-(1.0 + (g + b) % 3))
+        return np.array(I), np.array(J), np.array(V, dtype=np.float64)
+
+    partition = pa.map_parts(
+        lambda p: pa.IndexSet(p, LID_TO_GID[p], LID_TO_PART[p]), parts
+    )
+    rows = pa.PRange(10, partition)
+    I = pa.map_parts(lambda p: triplets(p)[0], parts)
+    J = pa.map_parts(lambda p: triplets(p)[1], parts)
+    V = pa.map_parts(lambda p: triplets(p)[2], parts)
+    A = pa.PSparseMatrix.from_coo(I, J, V, rows, rows.copy(), ids="global")
+    b = pa.PVector(
+        pa.map_parts(
+            lambda i: np.where(
+                np.asarray(i.lid_to_part) == i.part,
+                np.sin(1.0 + np.asarray(i.lid_to_gid, dtype=np.float64)),
+                0.0,
+            ),
+            A.rows.partition,
+        ),
+        A.rows,
+    )
+    return A, b
+
+
+def test_strict_bits_fused_trajectory_identity(monkeypatch):
+    """Under strict-bits arithmetic the fused body must reproduce the
+    unfused oracle's ITERATE SEQUENCE bit for bit: same iteration count,
+    identical residual-history bits, identical solution bits — on the
+    asymmetric 4-part conformance partition."""
+    monkeypatch.setenv("PA_TPU_STRICT_BITS", "1")
+    backend = _backend(4)
+
+    def run(fused):
+        def driver(parts):
+            A, b = _fixture_spd_system(parts)
+            x, info = tpu_cg(
+                A, b, tol=1e-12, maxiter=200, fused=fused
+            )
+            return gather_pvector(x), info
+
+        return pa.prun(driver, backend, 4)
+
+    xf, inf_f = run(True)
+    xu, inf_u = run(False)
+    assert inf_f["cg_body"] == "fused" and inf_u["cg_body"] == "standard"
+    assert inf_f["converged"] and inf_u["converged"]
+    assert inf_f["iterations"] == inf_u["iterations"]
+    assert inf_f["iterations"] > 3  # a real trajectory, not a 1-step solve
+    n = inf_u["iterations"] + 1
+    np.testing.assert_array_equal(
+        np.asarray(inf_f["residuals"])[:n], np.asarray(inf_u["residuals"])[:n]
+    )
+    np.testing.assert_array_equal(np.asarray(xf), np.asarray(xu))
+
+
+def test_strict_bits_default_resolves_to_standard_body(monkeypatch):
+    """Strict-bits keeps the unfused body as the oracle by DEFAULT: the
+    env resolution must not hand strict mode the fused form."""
+    monkeypatch.setenv("PA_TPU_STRICT_BITS", "1")
+    from partitionedarrays_jl_tpu.parallel.tpu import _fused_cg_enabled
+
+    assert not _fused_cg_enabled()
+    monkeypatch.delenv("PA_TPU_STRICT_BITS")
+    assert _fused_cg_enabled()
+    monkeypatch.setenv("PA_TPU_FUSED_CG", "0")
+    assert not _fused_cg_enabled()
+
+
+# ---------------------------------------------------------------------------
+# HLO A/B: the fused body must not add collectives
+# ---------------------------------------------------------------------------
+
+
+def _collective_counts(run_fn, *args):
+    txt = run_fn.jit_fn.lower(*args).as_text()
+    return {
+        k: len(re.findall(k, txt))
+        for k in ("collective_permute", "all_gather", "all_reduce")
+    }
+
+
+def test_fused_body_no_extra_collectives():
+    """Lower the fused and unfused compiled CG programs and count the
+    collectives in the HLO: the fusion restructures vector sweeps only —
+    per-kind collective counts must not grow (the same A/B that verified
+    the in-graph health guard costs zero extra collectives)."""
+    backend = _backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (6, 6, 6))
+        return A, b
+
+    A, b = pa.prun(driver, backend, (2, 2, 2))
+    dA = device_matrix(A, backend)
+    db = _b_on_cols_layout(b, dA)
+    dx0 = DeviceVector.from_pvector(
+        pa.PVector.full(0.0, A.cols), backend, dA.col_layout
+    )
+    from partitionedarrays_jl_tpu.parallel.tpu import _matrix_operands
+
+    ops = _matrix_operands(dA)
+    fused = make_cg_fn(dA, tol=1e-9, maxiter=100, fused=True)
+    unfused = make_cg_fn(dA, tol=1e-9, maxiter=100, fused=False)
+    cf = _collective_counts(fused, db.data, dx0.data, db.data, ops)
+    cu = _collective_counts(unfused, db.data, dx0.data, db.data, ops)
+    assert any(cu.values()), "unfused program shows no collectives at all"
+    for kind in cu:
+        assert cf[kind] <= cu[kind], (kind, cf, cu)
+
+
+def test_fused_pcg_fewer_gathers_than_standard():
+    """The preconditioned fused body's paired r·z / r·r reduction rides
+    ONE all_gather where the standard body pays two — the fused PCG
+    program must show strictly fewer gathers."""
+    backend = _backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (6, 6, 6))
+        return A, b
+
+    A, b = pa.prun(driver, backend, (2, 2, 2))
+    dA = device_matrix(A, backend)
+    db = _b_on_cols_layout(b, dA)
+    dx0 = DeviceVector.from_pvector(
+        pa.PVector.full(0.0, A.cols), backend, dA.col_layout
+    )
+    from partitionedarrays_jl_tpu.parallel.tpu import _matrix_operands
+
+    ops = _matrix_operands(dA)
+    fused = make_cg_fn(dA, tol=1e-9, maxiter=100, precond=True, fused=True)
+    unfused = make_cg_fn(dA, tol=1e-9, maxiter=100, precond=True, fused=False)
+    cf = _collective_counts(fused, db.data, dx0.data, db.data, ops)
+    cu = _collective_counts(unfused, db.data, dx0.data, db.data, ops)
+    assert cf["all_gather"] < cu["all_gather"], (cf, cu)
+
+
+# ---------------------------------------------------------------------------
+# padded coded frame: the in-kernel direction fold (Pallas interpret)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_padded_frame_kernel_fold_parity(monkeypatch):
+    """Force the real-TPU padded frame on the CPU mesh: the fused CG
+    then routes the direction fold through the Pallas kernel's pfold
+    variant (interpret mode), and must agree with the standard body —
+    same iterations, same solution to rounding."""
+    import importlib
+
+    tpu_mod = importlib.import_module("partitionedarrays_jl_tpu.parallel.tpu")
+    monkeypatch.setattr(tpu_mod, "_padded_for", lambda backend: True)
+    backend = _backend()
+
+    def run(fused):
+        def driver(parts):
+            # f32 like the real padded flagship frame: the f64 plan
+            # legitimately fails the pfold VMEM gate (doubled windows) and
+            # would silently fall back to the jnp fold
+            A, b, xe, x0 = assemble_poisson(
+                parts, (8, 8, 8), dtype=np.float32
+            )
+            dA = device_matrix(A, parts.backend)
+            assert dA.padded and dA.dia_mode == "coded"
+            assert dA.pallas_plan is not None
+            from partitionedarrays_jl_tpu.ops.pallas_dia import pfold_vmem_ok
+
+            # the kernel fold must actually be reachable for this plan —
+            # otherwise this test silently degrades to the jnp fold
+            assert pfold_vmem_ok(dA.pallas_plan)
+            x, info = tpu_cg(A, b, x0=x0, tol=1e-5, maxiter=500, fused=fused)
+            return gather_pvector(x), info
+
+        return pa.prun(driver, backend, (2, 2, 2))
+
+    xf, inf_f = run(True)
+    xu, inf_u = run(False)
+    assert inf_f["converged"] and inf_u["converged"]
+    assert inf_f["iterations"] == inf_u["iterations"]
+    np.testing.assert_allclose(
+        np.asarray(xf), np.asarray(xu), atol=5e-4, rtol=1e-4
+    )
+
+
+def test_fused_and_pipelined_mutually_exclusive():
+    backend = _backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (6, 6))
+        return A
+
+    A = pa.prun(driver, backend, (2, 2))
+    dA = device_matrix(A, backend)
+    with pytest.raises(ValueError):
+        make_cg_fn(dA, tol=1e-9, maxiter=10, pipelined=True, fused=True)
+
+
+def test_pcg_gmg_branch_rejects_explicit_fused():
+    """The GMG-preconditioned device program compiles its own PCG body
+    with no fused variant — an explicit fused flag there must raise, not
+    silently run the same body twice under an A/B label."""
+    backend = _backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8, 8))
+        h = pa.gmg_hierarchy(parts, A, (8, 8, 8), coarse_threshold=30)
+        from partitionedarrays_jl_tpu.models import pcg
+
+        with pytest.raises(ValueError, match="no fused variant"):
+            pcg(A, b, x0=x0, minv=h, tol=1e-8, fused=True)
+        return True
+
+    assert pa.prun(driver, backend, (2, 2, 2))

@@ -35,7 +35,13 @@ import numpy as np
 #: if it reproduces on a quiet host. Provenance: r4/r5 runs +
 #: SCALE_CURVE.json, docs/performance.md.
 SCALE_BANDS = {
-    "per_iteration_ms": (8.0, 10.5, "device"),
+    # r6: the fused streaming CG body (PA_TPU_FUSED_CG default) merges
+    # the loop's separate axpy/dot sweeps into the SpMV passes; the 464^3
+    # iteration drops 9.32 -> ~6.8 ms (SCALE_CURVE.json r6 leg). The r5
+    # band on the standard body was 8.0-10.5; a reading above 7.8 now
+    # means the fusion disengaged (or regressed) — that is the point of
+    # the band.
+    "per_iteration_ms": (5.8, 7.8, "device"),
     "gmg.per_iteration_ms": (170.0, 215.0, "device"),
     # host-advisory bands gate the HIGH side only (faster is fine);
     # r4-r5 observed ranges: assembly 51-108, lowering 31-77 (the 77
@@ -399,12 +405,23 @@ def curve():
         r["spmv_ps_per_dof"] = round(dt / dofs * 1e12, 1)
         print(json.dumps(r), flush=True)
 
-        # CG marginal on the same operator (the band's protocol)
+        # CG marginal on the same operator (the band's protocol): the
+        # shipped default (fused body) is the headline, and the standard
+        # body rides along as the A/B — inside the 292-300 XLA anomaly
+        # window this pair IS the packed-carry-escape measurement
+        # (docs/performance.md §Per-DOF scaling)
         k1, k2 = (60, 1000) if dofs < 2e7 else (40, 440)
-        it_s = benchmod.cg_marginal_s_per_it(pa, dA, k1, k2)
+        # both bodies PINNED explicitly (not env-resolved): the artifact's
+        # note declares cg_s_per_it IS the fused body, so a run under
+        # PA_TPU_FUSED_CG=0 must not silently record a standard-vs-
+        # standard self-comparison as the A/B
+        it_s = benchmod.cg_marginal_s_per_it(pa, dA, k1, k2, fused=True)
         r["cg_s_per_it"] = round(it_s, 7)
         r["cg_ps_per_dof"] = round(it_s / dofs * 1e12, 1)
         r["cg_over_spmv"] = round(it_s / dt, 2)
+        it_std = benchmod.cg_marginal_s_per_it(pa, dA, k1, k2, fused=False)
+        r["cg_unfused_s_per_it"] = round(it_std, 7)
+        r["cg_fused_speedup"] = round(it_std / it_s, 2)
 
         # stream leg: 3-access elementwise chain on the live vector
         # layout -> effective HBM GB/s for the CG's axpy-shaped traffic
