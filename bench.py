@@ -401,6 +401,71 @@ def cg_marginal_s_per_it(pa, dA, k1: int, k2: int, fused=None) -> float:
     return max((t2 - t1) / (k2 - k1), 1e-9)
 
 
+def block_cg_marginal_s_per_it(pa, dA, K: int, k1: int, k2: int, fused=None):
+    """`cg_marginal_s_per_it` widened to a K-column RHS block: the
+    fixed-trip marginal per iteration of the (P, W, K) block-CG program
+    (tol=0 keeps every column active, so the trip count is exact).
+    Divide by K for the per-RHS figure — the multi-RHS story is that
+    this ratio DROPS as K grows while the operator stream is paid once
+    per K columns."""
+    import statistics
+
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        _block_on_cols_layout, make_cg_fn,
+    )
+
+    dtype = np.float32
+    b = pa.PVector.full(np.float32(1.0), dA.cols, dtype=dtype)
+    z = pa.PVector.full(np.float32(0.0), dA.cols, dtype=dtype)
+    db = _block_on_cols_layout([b] * K, dA)
+    dz = _block_on_cols_layout([z] * K, dA, with_ghosts=True)
+
+    def run_k(k):
+        fn = make_cg_fn(dA, tol=0.0, maxiter=k, fused=fused, rhs_batch=K)
+        fn(db, dz, None)
+
+        def once():
+            t0 = time.perf_counter()
+            out = fn(db, dz, None)
+            np.asarray(out[1])  # host fetch closes the chain
+            return time.perf_counter() - t0
+
+        once()
+        return statistics.median(once() for _ in range(5))
+
+    t1, t2 = run_k(k1), run_k(k2)
+    return max((t2 - t1) / (k2 - k1), 1e-9)
+
+
+def bench_multirhs(n: int, pa, dA, ks) -> list:
+    """The --rhs leg: block-CG marginals at each K, reported per RHS
+    with the K=1 leg as the denominator. The full banded flagship curve
+    lives in tools/bench_multirhs.py / MULTIRHS_BENCH.json; this leg is
+    the quick per-size probe."""
+    recs = []
+    base = None
+    for K in ks:
+        t_it = block_cg_marginal_s_per_it(pa, dA, K, 40, 240)
+        per_rhs = t_it / K
+        if base is None:
+            base = per_rhs if K == 1 else None
+        recs.append(
+            {
+                "metric": f"multirhs_cg_s_per_it_per_rhs_{n}cube_K{K}_f32",
+                "value": round(per_rhs, 9),
+                "unit": "s/iteration/rhs",
+                "vs_baseline": 0.0,
+                "block_s_per_iteration": round(t_it, 9),
+                "rhs_batch": K,
+                "per_rhs_speedup_vs_k1": (
+                    round(base / per_rhs, 3) if base else None
+                ),
+                "methodology": METHODOLOGY,
+            }
+        )
+    return recs
+
+
 def bench_ici(n: int, devices, pa, fabric: str):
     """Multi-device halo + CG legs with TRUE neighbor `ppermute`s
     (round-4 directive 8): the day a real TPU slice is reachable these
@@ -611,6 +676,23 @@ def main():
         print(json.dumps(bench_cg_vs_cpu(n, backend, pa, dA)), flush=True)
     except Exception as e:
         print(f"cg-vs-cpu bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+
+    # multi-RHS leg: `--rhs 1,2,4,8` (or PA_BENCH_RHS) runs block-CG
+    # marginals at each K and reports per-RHS cost vs the K=1 leg
+    rhs_arg = os.environ.get("PA_BENCH_RHS", "")
+    argv = sys.argv[1:]
+    if "--rhs" in argv and argv.index("--rhs") + 1 < len(argv):
+        rhs_arg = argv[argv.index("--rhs") + 1]
+    if rhs_arg:
+        ks = [int(s) for s in rhs_arg.split(",") if s]
+        try:
+            for r in bench_multirhs(n, pa, dA, ks):
+                print(json.dumps(r), flush=True)
+        except Exception as e:
+            print(
+                f"multirhs bench failed: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
 
     # ICI legs: only when MORE than one real device is reachable (the
     # virtual-mesh form runs via tools/bench_ici.py) — true neighbor

@@ -95,17 +95,23 @@ def interpolation_cartesian(
     ncs: Sequence[int],
     fine_rows: PRange,
     coarse_rows: PRange,
+    dtype=None,
 ) -> PSparseMatrix:
     """The prolongation P as a rectangular PSparseMatrix: rows =
     ``fine_rows`` (ghost-free), cols = ``coarse_rows`` extended by the
     interpolation ghost layer. Pure index arithmetic per part — building
-    P needs no communication beyond the ghost discovery."""
+    P needs no communication beyond the ghost discovery. ``dtype``
+    selects the weight dtype (the hierarchy passes its operator dtype,
+    so f32 hierarchies stage f32 transfers end-to-end — the weights are
+    exact in both widths: 1, 0.5, and their d-fold products)."""
     nfs = tuple(int(n) for n in nfs)
     ncs = tuple(int(n) for n in ncs)
+    dtype = np.float64 if dtype is None else dtype
 
     def _local(iset):
         g = np.asarray(iset.oid_to_gid, dtype=np.int64)
-        return _interp_rows(g, g, nfs, ncs)
+        i, j, w = _interp_rows(g, g, nfs, ncs)
+        return i, j, w.astype(dtype, copy=False)
 
     coo = map_parts(_local, fine_rows.partition)
     I = map_parts(lambda c: c[0], coo)
@@ -576,7 +582,7 @@ def restriction_from(P: PSparseMatrix, coarse_rows: PRange) -> PSparseMatrix:
 
 
 def interp_stencil_cartesian(
-    nfs: Sequence[int], fine_rows: PRange
+    nfs: Sequence[int], fine_rows: PRange, dtype=None
 ) -> PSparseMatrix:
     """The SQUARE fine-grid interpolation stencil S of the factorization
     P = S·E: S[f, g] = Π_d w(g_d − f_d) with w(0) = 1, w(±1) = 1/2,
@@ -585,9 +591,13 @@ def interp_stencil_cartesian(
     streams, stencil-speed SpMV. Because w is symmetric, Sᵀ = S and the
     same operator serves prolongation (S · embed) and restriction
     (extract · S). 3^d-point band; reference-free (this factorization is
-    the TPU-native answer to the reference's absent multigrid)."""
+    the TPU-native answer to the reference's absent multigrid).
+    ``dtype`` selects the weight dtype (exact powers of 1/2 either
+    way); the device hierarchy passes its operator dtype so the staged
+    S matches an f32 hierarchy instead of detouring through f64."""
     nfs = tuple(int(n) for n in nfs)
     dim = len(nfs)
+    dtype = np.float64 if dtype is None else dtype
 
     def _local(iset):
         g = np.asarray(iset.oid_to_gid, dtype=np.int64)
@@ -608,7 +618,7 @@ def interp_stencil_cartesian(
             )
             I_out.append(g[ok])
             J_out.append(gj[ok])
-            V_out.append(np.full(int(ok.sum()), w))
+            V_out.append(np.full(int(ok.sum()), w, dtype=dtype))
         return (
             np.concatenate(I_out),
             np.concatenate(J_out),
@@ -817,8 +827,13 @@ def gmg_hierarchy(
         )
         A_c = galerkin_cartesian(A_l, nfs, ncs, coarse_rows)
 
-        def _mk(nfs=nfs, ncs=ncs, fine_rows=A_l.rows, coarse_rows=coarse_rows):
-            P = interpolation_cartesian(nfs, ncs, fine_rows, coarse_rows)
+        def _mk(nfs=nfs, ncs=ncs, fine_rows=A_l.rows, coarse_rows=coarse_rows,
+                dt=A_l.dtype):
+            # transfers inherit the level dtype: an f32 hierarchy stays
+            # f32 end-to-end instead of staging f64 transfer operators
+            P = interpolation_cartesian(
+                nfs, ncs, fine_rows, coarse_rows, dtype=dt
+            )
             return P, restriction_from(P, coarse_rows)
 
         levels.append(GMGLevel(A_l, nfs=nfs, ncs=ncs, mk_transfers=_mk))

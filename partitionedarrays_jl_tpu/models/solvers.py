@@ -42,9 +42,66 @@ def _final_true_rel(A, x, b, rel_est, rs0_norm, tol, force=False):
     return float(r.norm()) / max(1.0, rs0_norm)
 
 
+def _host_block_solve(solve_one, B, X0):
+    """Host multi-RHS driver: each column runs the SOLO loop — by
+    definition the per-column oracle semantics the device block program
+    (`tpu.tpu_block_cg`) reproduces. Returns the same ``(xs, info)``
+    contract: per-column infos under ``columns``, worst-column
+    aggregates at top level."""
+    K = len(B)
+    check(K >= 1, "block solve: B must hold at least one right-hand side")
+    X0 = list(X0) if X0 is not None else [None] * K
+    check(len(X0) == K, "block solve: X0 must hold one start per RHS")
+    xs, columns = [], []
+    for bk, x0k in zip(B, X0):
+        x, inf = solve_one(bk, x0k)
+        xs.append(x)
+        columns.append(inf)
+    # unconverged columns dominate the aggregate (see tpu_block_cg: the
+    # top-level status must never read 'converged' when converged=False)
+    bad_cols = [k for k in range(K) if not columns[k]["converged"]]
+    worst = (
+        max(bad_cols, key=lambda k: columns[k]["iterations"])
+        if bad_cols
+        else max(range(K), key=lambda k: columns[k]["iterations"])
+    )
+    info = {
+        "iterations": max(c["iterations"] for c in columns),
+        "iterations_per_column": [c["iterations"] for c in columns],
+        "residuals": columns[worst]["residuals"],
+        "converged": not bad_cols,
+        "status": columns[worst]["status"],
+        "columns": columns,
+        "rhs_batch": K,
+        "cg_body": "host",
+    }
+    return xs, info
+
+
+def _check_block_args(name, b, x0, B, checkpoint, _resume_state):
+    """Validate the multi-RHS call shape; returns B as a list (so an
+    empty or generator B fails HERE with the friendly message, not at a
+    downstream ``B[0]``)."""
+    check(
+        b is None and x0 is None,
+        f"{name}: pass b/x0 OR the multi-RHS block B/X0, not both",
+    )
+    B = list(B)
+    check(
+        len(B) >= 1,
+        f"{name}: B must hold at least one right-hand side",
+    )
+    if checkpoint is not None or _resume_state is not None:
+        raise ValueError(
+            f"{name}: checkpoint/resume is a single-RHS feature — solve "
+            "columns individually to checkpoint them"
+        )
+    return B
+
+
 def cg(
     A: PSparseMatrix,
-    b: PVector,
+    b: Optional[PVector] = None,
     x0: Optional[PVector] = None,
     tol: float = 1e-8,
     maxiter: Optional[int] = None,
@@ -53,11 +110,26 @@ def cg(
     fused: Optional[bool] = None,
     checkpoint=None,
     _resume_state: Optional[dict] = None,
+    B=None,
+    X0=None,
 ) -> Tuple[PVector, dict]:
     """Conjugate gradients for SPD `A`. The start vector lives on
     ``A.cols`` — the PRange carrying the column ghost layer — mirroring the
     reference's `zerox` axes shim (src/Interfaces.jl:2752-2757), so every
     SpMV can halo-update it in place.
+
+    ``B`` (a sequence of K right-hand-side PVectors, with optional
+    matching starts ``X0``) selects the MULTI-RHS block solve instead of
+    ``b``/``x0``: on the TPU backend the whole block runs as one
+    compiled program whose SpMV streams the operator once per K columns
+    (tpu.make_block_cg_fn — SpMV becomes SpMM, halo rounds ship K-column
+    slabs, all K dot partials ride the existing collectives); each
+    column still follows the textbook single-vector recurrence exactly,
+    freezing when it converges, so per-column trajectories match solo
+    solves (bitwise under strict-bits). On the host backend the columns
+    simply run the solo loop in sequence — the semantics oracle. Returns
+    ``(xs, info)`` with a list of K solutions and per-column infos under
+    ``info["columns"]``.
 
     Deterministic: all reductions are fixed-order part folds; the residual
     history is reproducible bit-for-bit for a given backend, and on the TPU
@@ -91,8 +163,27 @@ def cg(
     iteration on the already-reduced r·r — no extra collectives — and
     raise typed `SolverHealthError`s instead of silently diverging.
     """
-    from ..parallel.tpu import TPUBackend, tpu_cg
+    from ..parallel.tpu import TPUBackend, tpu_block_cg, tpu_cg
 
+    if B is not None:
+        B = _check_block_args("cg", b, x0, B, checkpoint, _resume_state)
+        if pipelined:
+            raise ValueError(
+                "cg: the pipelined (lag-1) form is single-RHS only — "
+                "drop pipelined or B"
+            )
+        if isinstance(B[0].values.backend, TPUBackend):
+            return tpu_block_cg(
+                A, B, X0=X0, tol=tol, maxiter=maxiter, verbose=verbose,
+                fused=fused,
+            )
+        return _host_block_solve(
+            lambda bk, x0k: cg(
+                A, bk, x0=x0k, tol=tol, maxiter=maxiter, verbose=verbose
+            ),
+            B, X0,
+        )
+    check(b is not None, "cg: a right-hand side b (or a block B) is required")
     if isinstance(b.values.backend, TPUBackend):
         if checkpoint is not None or _resume_state is not None:
             raise ValueError(
@@ -1084,7 +1175,7 @@ def decouple_dirichlet(
 
 def pcg(
     A: PSparseMatrix,
-    b: PVector,
+    b: Optional[PVector] = None,
     x0: Optional[PVector] = None,
     minv: Optional[PVector] = None,
     tol: float = 1e-8,
@@ -1093,6 +1184,8 @@ def pcg(
     fused: Optional[bool] = None,
     checkpoint=None,
     _resume_state: Optional[dict] = None,
+    B=None,
+    X0=None,
 ) -> Tuple[PVector, dict]:
     """Preconditioned CG. ``minv`` is either an inverse-diagonal PVector
     over A.cols (defaults to `jacobi_preconditioner(A)`) or a *callable*
@@ -1113,12 +1206,41 @@ def pcg(
     shared all_gather) on the diagonal-``minv`` compiled path; a host
     no-op. The GMG-preconditioned device program compiles its own PCG
     body with no fused variant, so an explicit ``fused`` there raises
-    rather than silently measuring the same body twice."""
-    from ..parallel.tpu import TPUBackend, tpu_cg
+    rather than silently measuring the same body twice.
+
+    ``B``/``X0`` select the multi-RHS block solve exactly as in `cg`:
+    the ONE shared preconditioner applies per column. The diagonal form
+    compiles to the block device program (its r·z / r·r reduction pairs
+    ride one all_gather as a (K, 2) payload); callable preconditioners
+    (including a `GMGHierarchy`) solve the columns in sequence, each
+    through its usual solo path."""
+    from ..parallel.tpu import TPUBackend, tpu_block_cg, tpu_cg
 
     if minv is None:
         minv = jacobi_preconditioner(A)
     apply_minv = callable(minv)
+    if B is not None:
+        B = _check_block_args("pcg", b, x0, B, checkpoint, _resume_state)
+        if (
+            isinstance(B[0].values.backend, TPUBackend)
+            and not apply_minv
+        ):
+            return tpu_block_cg(
+                A, B, X0=X0, tol=tol, maxiter=maxiter, verbose=verbose,
+                minv=minv, fused=fused,
+            )
+        # forward `fused` so the solo path's contracts hold per column —
+        # in particular a GMG hierarchy with an explicit fused flag must
+        # RAISE (its compiled PCG body has no fused variant), not
+        # silently run the same body under both A/B labels
+        return _host_block_solve(
+            lambda bk, x0k: pcg(
+                A, bk, x0=x0k, minv=minv, tol=tol, maxiter=maxiter,
+                verbose=verbose, fused=fused,
+            ),
+            B, X0,
+        )
+    check(b is not None, "pcg: a right-hand side b (or a block B) is required")
     if isinstance(b.values.backend, TPUBackend):
         if checkpoint is not None or _resume_state is not None:
             raise ValueError(

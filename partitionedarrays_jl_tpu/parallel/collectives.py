@@ -250,8 +250,16 @@ def async_exchange(
         t = async_exchange_into(counts_rcv, counts_snd, parts_rcv, parts_snd)
         schedule_and_wait(t)
         dtype = get_main_part(data_snd).data.dtype
+        # a part with NO senders must still allocate in the exchange
+        # dtype — Table.from_rows([]) would default to f64 and poison
+        # downstream concatenations (an f32 COO migration used to come
+        # back f64 on such parts)
         data_rcv = map_parts(
-            lambda c: Table.from_rows([np.zeros(int(k), dtype=dtype) for k in c]),
+            lambda c: (
+                Table.from_rows([np.zeros(int(k), dtype=dtype) for k in c])
+                if len(c)
+                else Table.empty(dtype)
+            ),
             counts_rcv,
         )
     else:

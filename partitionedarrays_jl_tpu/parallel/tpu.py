@@ -408,7 +408,12 @@ def _shard_exchange(plan, combine: str):
 
     Dispatch: a BoxExchangePlan (Cartesian partitions, tpu_box.py) gets
     the gather-free slice body; the generic plan keeps the index-vector
-    form below. Both bodies share the (xv, si, sm, ri) signature."""
+    form below. Both bodies share the (xv, si, sm, ri) signature, and
+    both are RANK-POLYMORPHIC over the operand: ``xv`` is ``(W,)`` for a
+    single vector or ``(W, K)`` for a multi-RHS block — slot indexing
+    stays on the leading axis, so one wire round ships all K columns of
+    a slot at once (the node-aware amortization of arxiv 1612.08060:
+    the latency/coloring cost of a round is paid once per K columns)."""
     import jax
     import jax.numpy as jnp
 
@@ -423,7 +428,8 @@ def _shard_exchange(plan, combine: str):
 
     def body(xv, si, sm, ri):
         for r in range(R):
-            buf = jnp.where(sm[r], xv[si[r]], 0)
+            mask = sm[r].reshape(sm[r].shape + (1,) * (xv.ndim - 1))
+            buf = jnp.where(mask, xv[si[r]], 0)
             buf = jax.lax.ppermute(buf, "parts", perm=perms[r])
             if combine == "add":
                 xv = xv.at[ri[r]].add(buf)
@@ -469,20 +475,27 @@ class DeviceVector:
         from .multihost import fetch_global
 
         host = fetch_global(self.data)
-        o0, g0 = self.layout.o0, self.layout.g0
-        vals = []
-        for p, iset in enumerate(self.rows.partition.part_values()):
-            owned = host[p, o0 : o0 + iset.num_oids]
-            ghost = host[p, self.layout.hid_slots[p]]
-            if iset.owned_first:
-                v = np.concatenate([owned, ghost])
-            else:
-                v = np.empty(iset.num_lids, dtype=host.dtype)
-                v[np.asarray(iset.oid_to_lid)] = owned
-                v[np.asarray(iset.hid_to_lid)] = ghost
-            vals.append(v)
-        parts = self.rows.partition
-        return PVector(parts._like(vals), self.rows)
+        return _host_frame_to_pvector(host, self.rows, self.layout)
+
+
+def _host_frame_to_pvector(host: np.ndarray, rows: PRange, layout) -> PVector:
+    """A fetched (P, W) host frame lifted back to a PVector (shared by
+    DeviceVector.to_pvector and the multi-RHS block unstaging, which
+    fetches one (P, W, K) slab and lifts each column)."""
+    o0 = layout.o0
+    vals = []
+    for p, iset in enumerate(rows.partition.part_values()):
+        owned = host[p, o0 : o0 + iset.num_oids]
+        ghost = host[p, layout.hid_slots[p]]
+        if iset.owned_first:
+            v = np.concatenate([owned, ghost])
+        else:
+            v = np.empty(iset.num_lids, dtype=host.dtype)
+            v[np.asarray(iset.oid_to_lid)] = owned
+            v[np.asarray(iset.hid_to_lid)] = ghost
+        vals.append(v)
+    parts = rows.partition
+    return PVector(parts._like(vals), rows)
 
 
 def _padded_for(backend: TPUBackend) -> bool:
@@ -813,10 +826,18 @@ class DeviceMatrix:
                 dt,
             )
         if ohb is not None:
+            # one staged (rows, cols, vals) triple per width bucket —
+            # the same per-bucket padding the owned SD groups get
             self.ohb_bs = ohb["bs"]
-            self.ohb_rows = _stage(backend, ohb["rows"], P)
-            self.ohb_cols = _stage(backend, ohb["cols"], P)
-            self.ohb_vals = _stage(backend, ohb["vals"], P)
+            self.ohb_rows = tuple(
+                _stage(backend, c["rows"], P) for c in ohb["chunks"]
+            )
+            self.ohb_cols = tuple(
+                _stage(backend, c["cols"], P) for c in ohb["chunks"]
+            )
+            self.ohb_vals = tuple(
+                _stage(backend, c["vals"], P) for c in ohb["chunks"]
+            )
         else:
             nb_max = max(
                 (int(np.count_nonzero(m.row_lengths())) for m in oh),
@@ -1154,7 +1175,17 @@ class DeviceMatrix:
         reordering), the ghost gather runs at one index per NODE instead
         of per element — the same ~bs^2 serial-gather reduction the
         A_oo block already gets. Returns None whenever any precondition
-        fails; callers keep the per-element ELL boundary path."""
+        fails; callers keep the per-element ELL boundary path.
+
+        BUCKETED widths (the round-4 directive-7 leftover, closing the
+        docs/roadmap.md §4 note): boundary rows are padded per
+        contiguous BUCKET of boundary nodes to that bucket's own
+        blocks-per-row maximum, not the global one — corner/edge nodes
+        with deep ghost coupling no longer inflate the padded gather
+        count of every face node (the same treatment `_detect_sd` gives
+        the owned groups). ``PA_TPU_OH_BUCKETS=0`` collapses to one
+        global-width bucket (the pre-bucketing program) for A/B runs —
+        tools/bench_irregular.py records both legs."""
         from scipy.sparse import csr_matrix
 
         if col_layout.box_info is not None:
@@ -1188,28 +1219,71 @@ class DeviceMatrix:
             plans.append((S, bn, lens))
             nb_max = max(nb_max, len(bn))
             Lb_max = max(Lb_max, int(lens.max()))
-        if P * nb_max * Lb_max * bs * bs * 8 > DeviceMatrix.SD_MAX_BYTES:
-            return None
-        rows = np.full(
-            (P, nb_max, bs), row_layout.trash, dtype=INDEX_DTYPE
+        B = (
+            1
+            if os.environ.get("PA_TPU_OH_BUCKETS", "1") == "0"
+            else int(min(DeviceMatrix.SD_BUCKETS, nb_max))
         )
-        colsb = np.zeros((P, nb_max, Lb_max), dtype=INDEX_DTYPE)
-        # operator dtype directly: no f64 transient (review r4)
-        vals = np.zeros((P, nb_max, Lb_max, bs, bs), dtype=dt)
+        bounds = [round(i * nb_max / B) for i in range(B + 1)]
+        # two passes: size every bucket FIRST so the byte guard runs
+        # before any padded array exists — an over-budget boundary block
+        # must be rejected to the ELL path without the multi-GB host
+        # allocation spike it is rejecting
+        geom = []  # (b0, b1, Lb_c)
+        total_bytes = 0
+        for c in range(B):
+            b0, b1 = bounds[c], bounds[c + 1]
+            if b0 == b1:
+                continue
+            # per-bucket width: the max blocks-per-row over every part's
+            # boundary nodes landing in this bucket's slot range
+            Lb_c = 1
+            for pl in plans:
+                if pl is None:
+                    continue
+                _S, bn, lens = pl
+                sel = lens[bn[b0:b1]]
+                if sel.size:
+                    Lb_c = max(Lb_c, int(sel.max()))
+            total_bytes += P * (b1 - b0) * Lb_c * bs * bs * 8
+            geom.append((b0, b1, Lb_c))
+        if total_bytes > DeviceMatrix.SD_MAX_BYTES:
+            return None
+        chunks = [
+            {
+                "b0": b0,
+                "rows": np.full(
+                    (P, b1 - b0, bs), row_layout.trash, dtype=INDEX_DTYPE
+                ),
+                "cols": np.zeros((P, b1 - b0, Lb_c), dtype=INDEX_DTYPE),
+                # operator dtype directly: no f64 transient (review r4)
+                "vals": np.zeros((P, b1 - b0, Lb_c, bs, bs), dtype=dt),
+            }
+            for b0, b1, Lb_c in geom
+        ]
+        starts = [c["b0"] for c in chunks]
         for p, pl in enumerate(plans):
             if pl is None:
                 continue
             S, bn, lens = pl
-            rows[p, : len(bn)] = (
-                row_layout.o0 + bn[:, None] * bs + np.arange(bs)
-            )
             slot = np.arange(len(S.indices)) - np.repeat(S.indptr[:-1], lens)
             rr = np.repeat(np.arange(len(lens)), lens)
             inv = np.full(len(lens), -1)
             inv[bn] = np.arange(len(bn))
-            colsb[p, inv[rr], slot] = S.indices
-            vals[p, inv[rr], slot] = S.data
-        return {"bs": bs, "rows": rows, "cols": colsb, "vals": vals}
+            bpos = inv[rr]  # boundary-LIST position of each block
+            ci = np.searchsorted(starts, bpos, side="right") - 1
+            for k, ch in enumerate(chunks):
+                b0 = ch["b0"]
+                b1 = b0 + ch["rows"].shape[1]
+                j = np.arange(b0, min(b1, len(bn)))
+                if j.size:
+                    ch["rows"][p, j - b0] = (
+                        row_layout.o0 + bn[j][:, None] * bs + np.arange(bs)
+                    )
+                e = ci == k
+                ch["cols"][p, bpos[e] - b0, slot[e]] = S.indices[e]
+                ch["vals"][p, bpos[e] - b0, slot[e]] = S.data[e]
+        return {"bs": bs, "chunks": chunks}
 
     @classmethod
     def _detect_bsr(cls, oo, P, noids, no_max, dt):
@@ -1547,6 +1621,7 @@ def _lowering_env_key() -> tuple:
         os.environ.get("PA_TPU_BSR", "1") != "0",
         os.environ.get("PA_TPU_SD", "1") != "0",
         os.environ.get("PA_TPU_CLASS_ACC", "1") != "0",
+        os.environ.get("PA_TPU_OH_BUCKETS", "1") != "0",
         _box_exchange_enabled(),
         # the fused-CG mode does not change the MATRIX lowering itself
         # (the program caches re-key on the concrete body choice), but
@@ -1609,11 +1684,35 @@ def _strict_pairwise_partial(t, no_max: int):
     return t[0] if no_max else jnp.zeros((), t.dtype)
 
 
+def _strict_partial_any(t, no_max: int):
+    """`_strict_pairwise_partial` lifted over an optional trailing batch
+    axis: ``(no_max,) -> scalar`` or ``(no_max, K) -> (K,)`` with the
+    IDENTICAL fixed tree per column — each column's partial is
+    bit-identical to the single-vector partial of that column alone."""
+    import jax.numpy as jnp
+
+    if t.ndim == 1:
+        return _strict_pairwise_partial(t, no_max)
+    return jnp.stack(
+        [
+            _strict_pairwise_partial(t[:, k], no_max)
+            for k in range(t.shape[1])
+        ]
+    )
+
+
 def _pdot_factory(o0: int, no_max: int):
     """Deterministic across-parts dot: per-shard partial (owned region;
     padding is zero by invariant), `all_gather`, fold in part order — the
     compiled form of the sequential `preduce` left-fold, so the reduction
     order (and hence bits) matches the oracle.
+
+    Rank-polymorphic: operands may carry a trailing multi-RHS batch axis
+    (``(W, K)``), in which case the partial is per-column, ONE
+    all_gather ships the whole ``(K,)`` payload, and the part-order fold
+    runs per column — the per-iteration collective COUNT is
+    K-independent while each column's reduction order (and bits) stays
+    exactly the single-vector order.
 
     In strict-bits mode the per-shard partial is the fixed-tree pairwise
     sum of separately-rounded products (`_strict_pairwise_partial`), and
@@ -1629,7 +1728,7 @@ def _pdot_factory(o0: int, no_max: int):
                 a[o0 : o0 + no_max] * b[o0 : o0 + no_max]
             )
             allp = jax.lax.all_gather(
-                _strict_pairwise_partial(t, no_max), "parts"
+                _strict_partial_any(t, no_max), "parts"
             )
             acc = allp[0]
             for i in range(1, allp.shape[0]):
@@ -1639,9 +1738,11 @@ def _pdot_factory(o0: int, no_max: int):
         return pdot
 
     def pdot(a, b):
-        partial_ = jnp.sum(a[o0 : o0 + no_max] * b[o0 : o0 + no_max])
+        partial_ = jnp.sum(
+            a[o0 : o0 + no_max] * b[o0 : o0 + no_max], axis=0
+        )
         allp = jax.lax.all_gather(partial_, "parts")
-        return jnp.sum(allp)
+        return jnp.sum(allp, axis=0)
 
     return pdot
 
@@ -1656,7 +1757,13 @@ def _pdot_owned_factory(no_max: int):
     r·z / r·r reductions share a collective instead of paying two.
     Per-component partials and the cross-part fold order are identical
     to two separate dot1 calls, so the pairing changes collective count,
-    not bits."""
+    not bits.
+
+    Like `_pdot_factory`, both dots are rank-polymorphic: ``(no_max, K)``
+    operands produce per-column results, with dot2's shared all_gather
+    widened from a partial pair to a ``(K, 2)`` payload — the block-CG
+    loop's whole reduction set still rides ONE collective per
+    iteration."""
     import jax
     import jax.numpy as jnp
 
@@ -1665,25 +1772,29 @@ def _pdot_owned_factory(no_max: int):
     if strict_bits():
 
         def dot2(a, b, c, d):
-            p1 = _strict_pairwise_partial(
+            p1 = _strict_partial_any(
                 _strict_rounded_product(a * b), no_max
             )
-            p2 = _strict_pairwise_partial(
+            p2 = _strict_partial_any(
                 _strict_rounded_product(c * d), no_max
             )
-            allp = jax.lax.all_gather(jnp.stack([p1, p2]), "parts")
-            acc1, acc2 = allp[0, 0], allp[0, 1]
+            allp = jax.lax.all_gather(
+                jnp.stack([p1, p2], axis=-1), "parts"
+            )
+            acc1, acc2 = allp[0, ..., 0], allp[0, ..., 1]
             for i in range(1, allp.shape[0]):
-                acc1 = acc1 + allp[i, 0]
-                acc2 = acc2 + allp[i, 1]
+                acc1 = acc1 + allp[i, ..., 0]
+                acc2 = acc2 + allp[i, ..., 1]
             return acc1, acc2
 
         return dot1, dot2
 
     def dot2(a, b, c, d):
-        p_ = jnp.stack([jnp.sum(a * b), jnp.sum(c * d)])
+        p_ = jnp.stack(
+            [jnp.sum(a * b, axis=0), jnp.sum(c * d, axis=0)], axis=-1
+        )
         s = jnp.sum(jax.lax.all_gather(p_, "parts"), axis=0)
-        return s[0], s[1]
+        return s[..., 0], s[..., 1]
 
     return dot1, dot2
 
@@ -1817,7 +1928,20 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
     direction ``p = z + beta*pv`` materializes inside the SpMV's own
     streaming pass instead of its own HBM sweep — the generalization of
     the `_dia_coded_full_axpy` pattern to the direction update, with a
-    jnp fold covering the BSR/SD/ELL/XLA-DIA lowerings."""
+    jnp fold covering the BSR/SD/ELL/XLA-DIA lowerings.
+
+    Every body is RANK-POLYMORPHIC over the operand: ``(W,)`` applies the
+    operator to one vector, ``(W, K)`` to a K-column multi-RHS block —
+    SpMV becomes SpMM. The operator stream (DIA values/codebooks, SD
+    group blocks, BSR blocks, ELL arrays) is read ONCE per K columns:
+    DIA diagonals broadcast over the block's trailing axis, the SD/BSR
+    group products widen to one batched ``(rows, U) @ (U, K)`` MXU
+    einsum, and the halo exchange ships ``(…, K)`` slabs per wire round
+    (JITSPMM, arxiv 2312.05639 — amortize the operand stream across
+    columns and feed the MXU). The Pallas kernels (coded padded frame,
+    streaming DIA, in-kernel pfold/axpy) keep a K=1-only guard and the
+    block path falls back to the equivalent XLA forms of the same
+    arithmetic."""
     import jax
     import jax.numpy as jnp
 
@@ -1834,14 +1958,23 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
         # (the one rounding difference vs the NumPy oracle)
         return _strict_rounded_product(t) if strict else t
 
+    def _bc(a, xv):
+        """Lift a per-row (rows,) coefficient/mask array to broadcast
+        over the operand's trailing multi-RHS axis (no-op at K=1)."""
+        return a[:, None] if xv.ndim == 2 else a
+
+    def _tpad(xv, lo, hi):
+        """Leading-axis pad, rank-generic over the trailing batch axis."""
+        return jnp.pad(xv, ((lo, hi),) + ((0, 0),) * (xv.ndim - 1))
+
     def _ell_rowsum(vals, cols, xv):
         # strict left-to-right fold over the (static, small) row width, the
         # same accumulation order as the host CSR kernel's reduceat — keeps
         # the device result bit-comparable with the sequential oracle
         L = vals.shape[-1]
-        acc = _rp(vals[:, 0] * xv[cols[:, 0]])
+        acc = _rp(_bc(vals[:, 0], xv) * xv[cols[:, 0]])
         for l in range(1, L):
-            acc = acc + _rp(vals[:, l] * xv[cols[:, l]])
+            acc = acc + _rp(_bc(vals[:, l], xv) * xv[cols[:, l]])
         return acc
 
     offsets = dA.dia_offsets
@@ -1859,7 +1992,9 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
 
     def _dia_rowsum_pallas(vals, xv):
         # Pallas streaming path (real TPU, variable-coefficient band):
-        # see ops/pallas_dia.py for the memory schedule
+        # see ops/pallas_dia.py for the memory schedule. K=1-only — the
+        # block path reads the same staged values through the XLA
+        # shifted-slice form instead (`_dia_vals_dense`).
         from ..ops.pallas_dia import dia_spmv_pallas
 
         y = dia_spmv_pallas(
@@ -1868,18 +2003,29 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
         )
         return y.reshape(-1)[:no_max]
 
+    def _dia_vals_dense(vals):
+        # the streaming-DIA staging is lane-tiled (D, R, LANES) when a
+        # Pallas plan exists; flatten back to the (D, no_max) dense form
+        # the XLA shifted-slice body reads (block fallback path)
+        if pplan is not None:
+            return vals.reshape(vals.shape[0], -1)[:, :no_max]
+        return vals
+
     def _dia_rowsum(vals, xv):
         # banded fast path: no gather — one zero-padded copy of the owned
         # region, then each diagonal is a *static slice* of it, so XLA
         # fuses the whole band sum into one streaming VPU kernel (rolls
         # would materialize a full copy per diagonal). Ascending-offset
         # order == ascending-column order per row, so bits match the ELL
-        # fold; pad/absent-diagonal terms are exact zeros (val 0).
-        xp = jnp.pad(xv[o0 : o0 + no_max], (pad, pad))
-        acc = vals[0] * jax.lax.slice(xp, (pad + offsets[0],), (pad + offsets[0] + no_max,))
+        # fold; pad/absent-diagonal terms are exact zeros (val 0). With a
+        # trailing batch axis each diagonal broadcasts over the K
+        # columns — the band values stream once per K.
+        xp = _tpad(xv[o0 : o0 + no_max], pad, pad)
+        o = pad + offsets[0]
+        acc = _bc(vals[0], xv) * xp[o : o + no_max]
         for d in range(1, len(offsets)):
             o = pad + offsets[d]
-            acc = acc + vals[d] * jax.lax.slice(xp, (o,), (o + no_max,))
+            acc = acc + _bc(vals[d], xv) * xp[o : o + no_max]
         return acc
 
     kk = dA.dia_kk
@@ -1898,18 +2044,34 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
         )
         return y.reshape(-1)
 
+    def _codes_stream(codes, j):
+        """Stream ``j`` of the staged codes as (no_max,) int32: unpacked
+        (S, no_max) bytes off-plan, nibble-unpacked from the kernel's
+        packed (ceil(S/2), nlen//LANES, LANES) staging on the padded
+        plan (`pack_nibble_codes`: two streams per byte, low nibble =
+        even stream index)."""
+        if pplan is None:
+            return codes[j].astype(jnp.int32)
+        raw = codes.reshape(codes.shape[0], -1).astype(jnp.uint8)
+        byte = raw[j // 2, :no_max]
+        nib = (byte >> 4) if (j % 2) else (byte & 0xF)
+        return nib.astype(jnp.int32)
+
     def _dia_coded_xla(cb, no, codes, xv):
-        xp = jnp.pad(xv[o0 : o0 + no_max], (pad, pad))
+        xp = _tpad(xv[o0 : o0 + no_max], pad, pad)
         acc = None
         for d in range(len(offsets)):
             o = pad + offsets[d]
-            shifted = jax.lax.slice(xp, (o,), (o + no_max,))
+            shifted = xp[o : o + no_max]
             if kk[d] == 1:
                 term = cb[d, 0] * shifted
             else:
-                term = jnp.take(cb[d], codes[code_row[d]].astype(jnp.int32)) * shifted
+                term = (
+                    _bc(jnp.take(cb[d], _codes_stream(codes, code_row[d])), xv)
+                    * shifted
+                )
             acc = term if acc is None else acc + term
-        return jnp.where(jnp.arange(no_max) < no[0], acc, 0)
+        return jnp.where(_bc(jnp.arange(no_max) < no[0], xv), acc, 0)
 
     if axpy and pplan is not None and dA.dia_cb is not None:
         from ..ops.pallas_dia import axpy_vmem_ok
@@ -1969,13 +2131,16 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
         if mode == "coded":
             # coded-diagonal path: 1 byte/element per non-constant
             # diagonal, decoded against the SMEM codebook — independent of
-            # the wire, so it still overlaps the halo collective
-            if pplan is not None:
+            # the wire, so it still overlaps the halo collective. The
+            # Pallas kernel is K=1-only; a block operand decodes the same
+            # codebooks through the XLA shifted-broadcast form.
+            if pplan is not None and xv.ndim == 1:
                 return _dia_coded_full(m["cb"], m["no"], m["codes"], xv), None
             return None, _dia_coded_xla(m["cb"], m["no"], m["codes"], xv)
         if offsets is not None:  # owned block first: overlaps the wire
-            rowsum = _dia_rowsum_pallas if pplan is not None else _dia_rowsum
-            return None, rowsum(m["oo_v"], xv)
+            if pplan is not None and xv.ndim == 1:
+                return None, _dia_rowsum_pallas(m["oo_v"], xv)
+            return None, _dia_rowsum(_dia_vals_dense(m["oo_v"]), xv)
         if dA.sd_bs is not None:
             # supernode-dense path: self blocks arrive by RESHAPE of the
             # owned region (no gather), only the per-group external
@@ -1986,48 +2151,61 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
             # maximum — round-5 directive 3)
             bs, G = dA.sd_bs, dA.sd_g
             cl = dA.col_plan.layout
-            yn = xv[cl.o0 : cl.o0 + cl.no_max].reshape(-1, bs)
+            tail = xv.shape[1:]  # () or (K,)
+            yn = xv[cl.o0 : cl.o0 + cl.no_max].reshape((-1, bs) + tail)
             ngr = sum(i.shape[0] for i in m["sd_i"])
             nn = yn.shape[0]
             yp = (
-                jnp.pad(yn, ((0, ngr * G - nn), (0, 0)))
+                jnp.pad(
+                    yn,
+                    ((0, ngr * G - nn), (0, 0)) + ((0, 0),) * len(tail),
+                )
                 if ngr * G > nn
                 else yn
             )
             outs = []
             g0_ = 0
+            # block operands widen the per-bucket group product from a
+            # (G·bs, U·bs) @ (U·bs,) matvec to ONE (G·bs, U·bs) @
+            # (U·bs, K) MXU einsum — the densified group blocks stream
+            # from HBM once per K columns
+            eq = "grc,gck->grk" if tail else "grc,gc->gr"
             for idx_c, val_c in zip(m["sd_i"], m["sd_v"]):
                 len_c, emax_c = idx_c.shape
                 xs = yp[g0_ * G : (g0_ + len_c) * G].reshape(
-                    len_c, G * bs
+                    (len_c, G * bs) + tail
                 )
-                xe = yn[idx_c].reshape(len_c, emax_c * bs)
+                xe = yn[idx_c].reshape((len_c, emax_c * bs) + tail)
                 xg = jnp.concatenate([xs, xe], axis=1)
                 outs.append(
                     jnp.einsum(
-                        "grc,gc->gr", val_c, xg,
+                        eq, val_c, xg,
                         preferred_element_type=xv.dtype,
                         precision=jax.lax.Precision.HIGHEST,
                     )
                 )
                 g0_ += len_c
-            return None, jnp.concatenate(outs, axis=0).reshape(-1)[:no_max]
+            return None, jnp.concatenate(outs, axis=0).reshape(
+                (-1,) + tail
+            )[:no_max]
         if dA.bsr_bs is not None:
             # node-block gather: one index per bs×bs block (~bs²× fewer
             # element-at-a-time gathers than ELL), block products as one
             # batched einsum — the irregular-graph fast path
             bs = dA.bsr_bs
             cl = dA.col_plan.layout
-            yn = xv[cl.o0 : cl.o0 + cl.no_max].reshape(-1, bs)
-            xg = yn[m["bsr_c"]]  # (nn, Lb, bs)
+            tail = xv.shape[1:]
+            yn = xv[cl.o0 : cl.o0 + cl.no_max].reshape((-1, bs) + tail)
+            xg = yn[m["bsr_c"]]  # (nn, Lb, bs[, K])
             # HIGHEST precision: at DEFAULT the TPU MXU would run this f32
             # dot as lossy bf16 passes, silently breaking the "matches the
             # sequential oracle to FMA rounding" accuracy contract
             return None, jnp.einsum(
-                "nlij,nlj->ni", m["bsr_v"], xg,
+                "nlij,nljk->nik" if tail else "nlij,nlj->ni",
+                m["bsr_v"], xg,
                 preferred_element_type=xv.dtype,
                 precision=jax.lax.Precision.HIGHEST,
-            ).reshape(-1)
+            ).reshape((-1,) + tail)
         return None, _ell_rowsum(m["oo_v"], m["oo_c"], xv)
 
     def _finish(full, partial_, xv, m):
@@ -2035,13 +2213,14 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
         product in the row frame, add the boundary (A_oh) contribution.
         Returns (y, exchanged operand)."""
         xv = exch(xv, m["si"], m["sm"], m["ri"])
+        tail = xv.shape[1:]  # () or (K,) for a multi-RHS block
         if full is not None:
             y = full  # already a complete vector, pads exactly zero
         else:
             # the product lives in the ROW-layout frame: for rectangular
             # operators (restriction/prolongation transfers) the column
             # frame can be narrower than the row count
-            y = jnp.zeros(layout.W, dtype=xv.dtype).at[
+            y = jnp.zeros((layout.W,) + tail, dtype=xv.dtype).at[
                 o0 : o0 + no_max
             ].set(partial_)
         if dA.oh_nnz:
@@ -2050,20 +2229,29 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
             if dA.ohb_bs is not None:
                 # node-block boundary path (directive 7): one gather per
                 # ghost NODE, block products as a batched einsum — same
-                # structure as the A_oo SD/BSR paths
+                # structure as the A_oo SD/BSR paths. BUCKETED like the
+                # owned SD groups: each contiguous chunk of boundary
+                # nodes is padded to its own block-row maximum, one
+                # einsum per bucket (round-4 directive 7 leftover).
                 bs_ = dA.ohb_bs
                 cl2 = dA.col_plan.layout
                 nhn = (cl2.W - cl2.g0 - 1) // bs_
-                gh = jax.lax.slice(
-                    xv, (cl2.g0,), (cl2.g0 + nhn * bs_,)
-                ).reshape(-1, bs_)
-                xb = gh[m["ohb_c"]]
-                yb = jnp.einsum(
-                    "nlij,nlj->ni", m["ohb_v"], xb,
-                    preferred_element_type=xv.dtype,
-                    precision=jax.lax.Precision.HIGHEST,
+                gh = xv[cl2.g0 : cl2.g0 + nhn * bs_].reshape(
+                    (-1, bs_) + tail
                 )
-                y = y.at[m["ohb_r"]].add(yb.reshape(m["ohb_r"].shape))
+                for rows_c, cols_c, vals_c in zip(
+                    m["ohb_r"], m["ohb_c"], m["ohb_v"]
+                ):
+                    xb = gh[cols_c]
+                    yb = jnp.einsum(
+                        "nlij,nljk->nik" if tail else "nlij,nlj->ni",
+                        vals_c, xb,
+                        preferred_element_type=xv.dtype,
+                        precision=jax.lax.Precision.HIGHEST,
+                    )
+                    y = y.at[rows_c].add(
+                        yb.reshape(rows_c.shape + tail)
+                    )
             else:
                 y = y.at[m["oh_r"]].add(
                     _ell_rowsum(m["oh_v"], m["oh_c"], xv)
@@ -2104,13 +2292,17 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
         fused body's saved volume sweeps dominate."""
         colL = dA.col_plan.layout
         cs = slice(colL.o0, colL.o0 + colL.no_max)
-        if _pfold_in_kernel and mvv is None:
+        if _pfold_in_kernel and mvv is None and rv.ndim == 1:
+            # has_pfold Pallas kernel: K=1-only this round — a block
+            # operand takes the fused jnp fold below instead
             full, pnew = _dia_coded_full_pfold(
                 m["cb"], m["no"], m["codes"], rv, pv, beta
             )
             partial_ = None
         else:
-            z = mvv[cs] * rv[cs] if mvv is not None else rv[cs]
+            # beta is a scalar (K=1) or a (K,) per-column vector — both
+            # broadcast against the trailing axis of the owned slice
+            z = _bc(mvv[cs], rv) * rv[cs] if mvv is not None else rv[cs]
             pnew = jnp.zeros_like(rv).at[cs].set(z + _rp(beta * pv[cs]))
             full, partial_ = _aoo(pnew, m)
         y, _ = _finish(full, partial_, pnew, m)
@@ -2128,7 +2320,9 @@ def _shard_ops(jax, ms):
 def make_spmv_fn(dA: DeviceMatrix) -> Callable:
     """Compiled y = A @ x over the mesh: returns a function mapping the
     (P, Wc) column-range vector to the (P, Wr) row-range product (ghost
-    slots of y zero, like the host mul)."""
+    slots of y zero, like the host mul). A (P, Wc, K) multi-RHS block
+    maps to the (P, Wr, K) block product — one operator stream per K
+    columns (the body is rank-polymorphic; jit re-traces per rank)."""
     import jax
     shard_map = _shard_map()
 
@@ -2155,9 +2349,10 @@ def make_spmv_fn(dA: DeviceMatrix) -> Callable:
 
     def run(x):
         check(
-            tuple(x.shape) == shape,
-            f"spmv: vector laid out {tuple(x.shape)}, matrix expects {shape} "
-            "— build vectors with the matrix's col_layout",
+            tuple(x.shape[:2]) == shape and x.ndim in (2, 3),
+            f"spmv: vector laid out {tuple(x.shape)}, matrix expects "
+            f"{shape} (optionally + a trailing rhs-batch axis) — build "
+            "vectors with the matrix's col_layout",
         )
         return fn(x, ops)
 
@@ -2167,6 +2362,7 @@ def make_spmv_fn(dA: DeviceMatrix) -> Callable:
 def make_cg_fn(
     dA: DeviceMatrix, tol: float, maxiter: int, precond: bool = False,
     pipelined: bool = False, fused: Optional[bool] = None,
+    rhs_batch: Optional[int] = None,
 ) -> Callable:
     """The whole CG solve as ONE compiled shard_map program:
     `lax.while_loop` whose body does the overlapped SpMV, deterministic
@@ -2212,10 +2408,28 @@ def make_cg_fn(
     Every scalar (α, β, residuals) follows the textbook recurrence on
     the same dots in the same order, so the iteration trajectory is
     IDENTICAL to the standard form — only where x materializes changes
-    (validated in tests/test_tpu.py)."""
+    (validated in tests/test_tpu.py).
+
+    ``rhs_batch=K`` selects the BLOCK (multi-RHS) program instead: the
+    operands become (P, W, K) slabs, the operator streams once per K
+    columns (`_spmv_body`'s rank-polymorphic lowerings), and every
+    column runs the textbook single-vector recurrence with per-column
+    scalars — see `make_block_cg_fn`, to which this delegates."""
     import jax
     import jax.numpy as jnp
     shard_map = _shard_map()
+
+    if rhs_batch is not None:
+        if pipelined:
+            # unconditional (not check()): the lag-1 x placement has no
+            # block generalization this round — refuse, don't reinterpret
+            raise ValueError(
+                "make_cg_fn: the pipelined (lag-1) form is single-RHS "
+                "only — drop pipelined or rhs_batch"
+            )
+        return make_block_cg_fn(
+            dA, tol, maxiter, rhs_batch, precond=precond, fused=fused
+        )
 
     fused = _resolve_fused(fused, pipelined)
     if fused and pipelined:
@@ -2473,6 +2687,249 @@ def make_cg_fn(
     return run
 
 
+def make_block_cg_fn(
+    dA: DeviceMatrix, tol: float, maxiter: int, rhs_batch: int,
+    precond: bool = False, fused: Optional[bool] = None,
+) -> Callable:
+    """Block (multi-RHS) CG: ONE compiled shard_map program solving
+    ``A X = B`` for K = ``rhs_batch`` right-hand sides against the SAME
+    operator. The per-iteration operator stream — DIA values/codebooks,
+    SD group blocks, BSR blocks, halo slabs — is read ONCE per K
+    columns (`_spmv_body`'s rank-polymorphic lowerings turn SpMV into
+    SpMM), which is what makes the HBM-roofline-bound large-N iteration
+    cheaper PER RHS as K grows (docs/performance.md, Multi-RHS).
+
+    Semantics contract: every column follows the TEXTBOOK single-vector
+    recurrence exactly — per-column α/β from per-column dots (identical
+    partial-sum trees, identical part-order folds), so column k's
+    trajectory is the trajectory `make_cg_fn` at K=1 would produce for
+    (b_k, x0_k), bit-for-bit under strict-bits arithmetic (pinned by
+    tests/test_block_cg.py on the 4-part conformance fixture).
+    Converged (or broken-down / non-finite) columns FREEZE — their α is
+    zeroed and their state re-selected unchanged — rather than exiting,
+    keeping the loop shape static; the loop ends when every column is
+    frozen or maxiter hits. Collective count per iteration is
+    K-INDEPENDENT: the dot payloads widen from scalars to (K,) /
+    (K, 2) stacks riding the same all_gathers (`_pdot_owned_factory`),
+    and the halo ppermutes ship (…, K) slabs — pinned by the HLO A/B in
+    tests/test_block_cg.py.
+
+    ``fused`` selects the fused streaming body exactly as in
+    `make_cg_fn` (default: env-resolved): one update+dot sweep, the
+    direction fold riding the SpMV pass (jnp fold on every lowering —
+    the Pallas has_pfold kernel keeps its K=1-only guard), and the
+    preconditioned reduction pair sharing ONE all_gather as a (K, 2)
+    payload.
+
+    Returns ``run(b, x0, mv=None) -> (x, rs, rs0, iters, hist)`` with
+    b/x0/x of shape (P, W, K), per-column ``rs``/``rs0``/``iters`` of
+    shape (K,), and an (H, K) residual history (NaN past each column's
+    freeze point)."""
+    import jax
+    import jax.numpy as jnp
+    shard_map = _shard_map()
+
+    K = int(rhs_batch)
+    check(K >= 1, "make_block_cg_fn: rhs_batch must be >= 1")
+    fused = _resolve_fused(fused, False)
+    mesh = dA.backend.mesh(dA.row_layout.P)
+    spec = dA.backend.parts_spec()
+    none_spec = jax.sharding.PartitionSpec()
+    body_spmv = _spmv_body(dA)
+    body_pfold = _spmv_body(dA, pfold=True) if fused else None
+    no_max = dA.row_layout.no_max
+    o0 = dA.row_layout.o0
+    pdot = _pdot_factory(o0, no_max)
+    odot1, odot2 = _pdot_owned_factory(no_max)
+    ops = _matrix_operands(dA)
+    specs = jax.tree.map(lambda _: spec, ops)
+    strict = strict_bits()
+
+    def _rp(t):
+        return _strict_rounded_product(t) if strict else t
+
+    H = int(min(maxiter + 1, 4096))
+
+    @jax.jit
+    def fn(b, x0, mv, m):
+        def shard_fn(bs, x0s, mvs, ms):
+            bv, xv = bs[0], x0s[0]  # (W, K)
+            mats = _shard_ops(jax, ms)
+            mvv = mvs[0]  # (W,) — ONE preconditioner for all columns
+            slf = slice(o0, o0 + no_max)
+
+            def spmv(z):
+                y, _ = body_spmv(z, mats)
+                return y
+
+            def apply_minv(r):
+                if not precond:
+                    return r
+                return jnp.zeros_like(r).at[slf].set(
+                    mvv[slf][:, None] * r[slf]
+                )
+
+            q = spmv(xv)
+            r = jnp.zeros_like(xv).at[slf].set(bv[slf] - q[slf])
+            z = apply_minv(r)
+            p = jnp.zeros_like(xv).at[slf].set(z[slf])
+            rs0 = pdot(r, r)  # (K,)
+            rz0 = pdot(r, z) if precond else rs0
+            hist = (
+                jnp.full((H, K), jnp.nan, dtype=bv.dtype)
+                .at[0]
+                .set(jnp.sqrt(rs0))
+            )
+            it0 = jnp.zeros((K,), jnp.int32)
+
+            def active(rs, rz):
+                # the SAME per-column predicate the K=1 cond tests: a
+                # column below tol, non-finite, or (preconditioned)
+                # broken down is permanently inactive — its state is
+                # frozen, so the predicate stays False once it trips
+                go = jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0))
+                go = jnp.logical_and(go, jnp.isfinite(rs))
+                if precond:
+                    go = jnp.logical_and(go, rz != 0)
+                return go
+
+            def _sel(act, new, old):
+                # per-column freeze: re-select the OLD value so a frozen
+                # column's bits never move (x + 0*p could still flip a
+                # -0.0; the select cannot)
+                return jnp.where(act, new, old)
+
+            if fused:
+                S0 = jnp.stack([xv, r, jnp.zeros_like(xv)])
+                beta0 = jnp.zeros((K,), bv.dtype)
+
+                def cond_f(state):
+                    _S, rz, rs, _beta, _itk, it, _h = state
+                    return jnp.logical_and(
+                        jnp.any(active(rs, rz)), it < maxiter
+                    )
+
+                def step_f(state):
+                    S, rz, rs, beta, itk, it, hist = state
+                    act = active(rs, rz)
+                    x, r_, p_prev = S[0], S[1], S[2]
+                    q, p = body_pfold(
+                        r_, p_prev, beta, mats, mvv if precond else None
+                    )
+                    pq = pdot(p, q)
+                    alpha = jnp.where(act, rz / pq, 0)
+                    xo = _sel(act, x[slf] + _rp(alpha * p[slf]), x[slf])
+                    ro = _sel(act, r_[slf] + _rp(-alpha * q[slf]), r_[slf])
+                    if precond:
+                        zo = mvv[slf][:, None] * ro
+                        rz_new, rs_new = odot2(ro, zo, ro, ro)
+                    else:
+                        rs_new = odot1(ro, ro)
+                        rz_new = rs_new
+                    S2 = (
+                        S.at[0, slf].set(xo)
+                        .at[1, slf].set(ro)
+                        .at[2, slf].set(_sel(act, p[slf], p_prev[slf]))
+                    )
+                    rz2 = _sel(act, rz_new, rz)
+                    rs2 = _sel(act, rs_new, rs)
+                    beta2 = _sel(act, rz_new / rz, beta)
+                    itk2 = itk + act.astype(jnp.int32)
+                    idx = jnp.minimum(it + 1, H - 1)
+                    hist2 = hist.at[idx].set(
+                        _sel(act, jnp.sqrt(rs2), hist[idx])
+                    )
+                    return (S2, rz2, rs2, beta2, itk2, it + 1, hist2)
+
+                S, rz, rs, beta, itk, it, hist = jax.lax.while_loop(
+                    cond_f, step_f,
+                    (S0, rz0, rs0, beta0, it0, jnp.int32(0), hist),
+                )
+                return S[0][None], rs, rs0, itk, hist
+
+            def cond(state):
+                _x, _r, _p, rz, rs, _itk, it, _h = state
+                return jnp.logical_and(
+                    jnp.any(active(rs, rz)), it < maxiter
+                )
+
+            def step(state):
+                x, r_, p_, rz, rs, itk, it, hist = state
+                act = active(rs, rz)
+                q = spmv(p_)
+                pq = pdot(p_, q)
+                alpha = jnp.where(act, rz / pq, 0)
+                x2 = x.at[slf].set(
+                    _sel(act, x[slf] + _rp(alpha * p_[slf]), x[slf])
+                )
+                r2 = r_.at[slf].set(
+                    _sel(act, r_[slf] + _rp(-alpha * q[slf]), r_[slf])
+                )
+                z = apply_minv(r2)
+                rz_new = pdot(r2, z) if precond else None
+                rs_new = pdot(r2, r2)
+                if not precond:
+                    rz_new = rs_new
+                p2 = p_.at[slf].set(
+                    _sel(
+                        act,
+                        z[slf] + _rp(jnp.where(act, rz_new / rz, 0) * p_[slf]),
+                        p_[slf],
+                    )
+                )
+                rz2 = _sel(act, rz_new, rz)
+                rs2 = _sel(act, rs_new, rs)
+                itk2 = itk + act.astype(jnp.int32)
+                idx = jnp.minimum(it + 1, H - 1)
+                hist2 = hist.at[idx].set(
+                    _sel(act, jnp.sqrt(rs2), hist[idx])
+                )
+                return (x2, r2, p2, rz2, rs2, itk2, it + 1, hist2)
+
+            x, r, p, rz, rs, itk, it, hist = jax.lax.while_loop(
+                cond, step, (xv, r, p, rz0, rs0, it0, jnp.int32(0), hist)
+            )
+            return x[None], rs, rs0, itk, hist
+
+        return shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, specs),
+            out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
+            check_vma=False,
+        )(b, x0, mv, m)
+
+    shape = (dA.col_plan.layout.P, dA.col_plan.layout.W, K)
+
+    def run(b, x0, mv=None):
+        check(
+            tuple(b.shape) == shape and tuple(x0.shape) == shape,
+            f"block cg: operands laid out {tuple(b.shape)}/"
+            f"{tuple(x0.shape)}, program expects {shape} — stage the "
+            "RHS block with the matrix's col_layout and this rhs_batch",
+        )
+        vshape = shape[:2]
+        if precond:
+            check(
+                mv is not None and tuple(mv.shape) == vshape,
+                "block pcg: the (single, shared) preconditioner vector "
+                "must share the matrix layout",
+            )
+        else:
+            check(
+                mv is None,
+                "this compiled block CG was built without preconditioning"
+                " — rebuild with precond=True to use minv",
+            )
+        return fn(b, x0, b[..., 0] if mv is None else mv, ops)
+
+    run.jit_fn = fn
+    run.operands = ops
+    run.fused = bool(fused)
+    run.rhs_batch = K
+    return run
+
+
 def make_diff_solve_fn(
     dA: DeviceMatrix,
     tol: float = 1e-10,
@@ -2512,7 +2969,8 @@ def make_diff_solve_fn(
     op_dt = next(
         a.dtype
         for a in (
-            dA.oh_vals, dA.ohb_vals,
+            dA.oh_vals,
+            dA.ohb_vals[0] if dA.ohb_vals else None,  # per-bucket tuple
             dA.sd_vals[0] if dA.sd_vals else None,  # per-bucket tuple
             dA.bsr_vals, dA.dia_cb, dA.dia_vals, dA.oo_vals,
         )
@@ -3330,6 +3788,147 @@ def tpu_cg(
     )
 
 
+def _block_on_cols_layout(Bs, dA: DeviceMatrix, with_ghosts: bool = False):
+    """Stage K column PVectors as ONE (P, W, K) device slab in the
+    matrix's col layout (owned values; ``with_ghosts`` also places the
+    ghost slots — used for start vectors that already carry a halo)."""
+    layout = dA.col_layout
+    K = len(Bs)
+    dt = np.result_type(*[b.dtype for b in Bs])
+    stacked = np.zeros((layout.P, layout.W, K), dtype=dt)
+    for k, b in enumerate(Bs):
+        for p, (iset, vals) in enumerate(
+            zip(b.rows.partition.part_values(), b.values.part_values())
+        ):
+            vals = np.asarray(vals)
+            stacked[p, layout.o0 : layout.o0 + iset.num_oids, k] = _owned(
+                iset, vals
+            )
+            if with_ghosts:
+                stacked[p, layout.hid_slots[p], k] = _ghost(iset, vals)
+    return _stage(dA.backend, stacked, layout.P)
+
+
+def tpu_block_cg(
+    A: PSparseMatrix,
+    B,
+    X0=None,
+    tol: float = 1e-8,
+    maxiter: Optional[int] = None,
+    verbose: bool = False,
+    minv: Optional[PVector] = None,
+    fused: Optional[bool] = None,
+) -> Tuple[list, dict]:
+    """Device block (multi-RHS) CG: solve ``A x_k = b_k`` for every
+    right-hand side in ``B`` (a sequence of PVectors over ``A.rows``) as
+    ONE compiled program whose SpMV streams the operator once per K
+    columns (`make_block_cg_fn`). ``minv`` is the usual shared diagonal
+    preconditioner. Returns ``(xs, info)``: a list of K solution
+    PVectors and an info dict whose ``columns`` entry holds one
+    per-column krylov info each (iterations, residual history, status —
+    each column's trajectory is its solo `tpu_cg` trajectory); the
+    top-level fields aggregate (worst column)."""
+    from ..utils.helpers import krylov_info, warn_tol_below_floor
+    from .multihost import fetch_global
+
+    B = list(B)
+    K = len(B)
+    check(K >= 1, "tpu_block_cg: B must hold at least one right-hand side")
+    backend = B[0].values.backend
+    check(
+        isinstance(backend, TPUBackend),
+        "tpu_block_cg needs TPU-backend PVectors",
+    )
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    dA = device_matrix(A, backend)
+    fused = _resolve_fused(fused, False)
+    solve = _krylov_fn_for(
+        dA, "cg", tol, maxiter, precond=minv is not None, fused=fused,
+        rhs_batch=K,
+    )
+    dt = np.result_type(*[b.dtype for b in B])
+    floor_warned = warn_tol_below_floor(tol, dt, name="block-cg")
+    db = _block_on_cols_layout(B, dA)
+    if X0 is None:
+        X0 = [PVector.full(0.0, A.cols, dtype=dt) for _ in range(K)]
+    else:
+        X0 = list(X0)
+        check(len(X0) == K, "tpu_block_cg: X0 must hold one start per RHS")
+    dx0 = _block_on_cols_layout(X0, dA, with_ghosts=True)
+    if minv is not None:
+        dmv = DeviceVector.from_pvector(minv, backend, dA.col_layout)
+        x_data, rs, rs0, itk, hist = solve(db, dx0, dmv.data)
+    else:
+        x_data, rs, rs0, itk, hist = solve(db, dx0)
+    host = fetch_global(x_data)  # (P, W, K)
+    rs = np.asarray(rs, dtype=np.float64)
+    rs0 = np.asarray(rs0, dtype=np.float64)
+    itk = np.asarray(itk, dtype=np.int64)
+    hist = np.asarray(hist)
+    xs, columns = [], []
+    name = "block-pcg" if minv is not None else "block-cg"
+    for k in range(K):
+        x = _host_frame_to_pvector(host[..., k], A.cols, dA.col_layout)
+        xs.append(x)
+        it_k = int(itk[k])
+        residuals = hist[: min(it_k + 1, hist.shape[0]), k]
+        if verbose:
+            for i, rv in enumerate(residuals[1:], start=1):
+                print(f"{name} col={k} it={i} residual={rv:.3e}")
+        converged = bool(
+            np.sqrt(rs[k]) <= tol * max(1.0, np.sqrt(rs0[k]))
+        )
+        columns.append(
+            krylov_info(
+                it_k, residuals, converged, tol, dt, floor_warned,
+                final_rel=_final_true_rel(
+                    A, x, B[k],
+                    np.sqrt(rs[k]) / max(1.0, np.sqrt(rs0[k])),
+                    np.sqrt(rs0[k]), tol, force=floor_warned,
+                ),
+            )
+        )
+    from .health import NonFiniteError, health_enabled
+
+    bad = [k for k in range(K) if not np.isfinite(rs[k])]
+    if health_enabled() and bad:
+        raise NonFiniteError(
+            f"{name}: non-finite residual in column(s) {bad} — those "
+            "columns' solver state was NaN/Inf-poisoned (each froze one "
+            "iteration after the poison entered; the other columns "
+            "completed normally)",
+            diagnostics={
+                "context": name,
+                "columns": bad,
+                "iterations": [int(itk[k]) for k in bad],
+                "rs": [float(rs[k]) for k in bad],
+            },
+        )
+    # the aggregate's "worst" column: an UNCONVERGED column wins over a
+    # merely-slow converged one (a broken-down column frozen at 3
+    # iterations must not let argmax(iterations) stamp the aggregate
+    # status 'converged' while converged is False)
+    bad_cols = [k for k in range(K) if not columns[k]["converged"]]
+    worst = (
+        max(bad_cols, key=lambda k: int(itk[k]))
+        if bad_cols
+        else int(np.argmax(itk))
+    )
+    info = {
+        "iterations": int(itk.max()),
+        "iterations_per_column": [int(v) for v in itk],
+        "residuals": columns[worst]["residuals"],
+        "converged": not bad_cols,
+        "status": columns[worst]["status"],
+        "columns": columns,
+        "rhs_batch": K,
+        "cg_body": "fused" if fused else "standard",
+    }
+    if floor_warned:
+        info["tol_below_dtype_floor"] = True
+    return xs, info
+
+
 def tpu_bicgstab(
     A: PSparseMatrix,
     b: PVector,
@@ -3354,7 +3953,7 @@ def tpu_bicgstab(
 def _krylov_fn_for(
     dA: DeviceMatrix, method: str, tol: float, maxiter: int,
     precond: bool = False, pipelined: bool = False,
-    fused: Optional[bool] = None,
+    fused: Optional[bool] = None, rhs_batch: Optional[int] = None,
 ):
     if method == "cg":
         # the cache key must be the CONCRETE body choice (the env mode is
@@ -3363,13 +3962,13 @@ def _krylov_fn_for(
         fused = _resolve_fused(fused, pipelined)
     key = (
         method, float(tol), int(maxiter), bool(precond), bool(pipelined),
-        bool(fused),
+        bool(fused), rhs_batch,
     )
     if key not in dA._cg_cache:
         if method == "cg":
             dA._cg_cache[key] = make_cg_fn(
                 dA, tol, maxiter, precond=precond, pipelined=pipelined,
-                fused=fused,
+                fused=fused, rhs_batch=rhs_batch,
             )
         else:
             dA._cg_cache[key] = make_bicgstab_fn(

@@ -156,12 +156,20 @@ def analyze_box_structure(rows: PRange) -> Optional[BoxInfo]:
             return None
     # unequal Cartesian splits (floor/ceil interval lengths per dim)
     # produce <= 2^d distinct box shapes: each becomes a pack-slice
-    # VARIANT selected per shard by a lax.switch in the exchange body
+    # VARIANT selected per shard by a lax.switch in the exchange body.
+    # EMPTY boxes are the agglomerated-coarse-level case (tpu_gmg
+    # part_stride parks whole parts): an INACTIVE part — no owned ids
+    # AND no ghosts — is admitted as a degenerate variant that never
+    # sends or receives, so slab-shaped transfer ghost sets on the
+    # active parts still get the slice plan (docs/roadmap.md §4: the
+    # matrix-S fallback used to drop to the generic gather plan here).
+    # An empty box WITH ghosts is not that case — decline.
+    for i in isets:
+        if math.prod(i.box_shape) == 0 and i.num_hids:
+            return None
     box_shapes = sorted({i.box_shape for i in isets})
-    if len(box_shapes) > (1 << dim):
+    if sum(1 for s in box_shapes if math.prod(s) > 0) > (1 << dim):
         return None  # not a tensor-product split
-    if any(math.prod(s) == 0 for s in box_shapes):
-        return None
     variants = np.array(
         [box_shapes.index(i.box_shape) for i in isets], dtype=np.int32
     )
@@ -271,8 +279,13 @@ def analyze_box_structure(rows: PRange) -> Optional[BoxInfo]:
         for v in range(V):
             if slab_lo[v] is None:
                 # variant never sends in this direction: any in-bounds
-                # degenerate slice keeps the switch branch well-formed
-                geo.append(((0,) * dim, (1,) * dim))
+                # degenerate slice keeps the switch branch well-formed.
+                # An EMPTY (inactive-part) variant has no in-bounds
+                # element at all — its branch slices zero elements.
+                if math.prod(box_shapes[v]) == 0:
+                    geo.append(((0,) * dim, (0,) * dim))
+                else:
+                    geo.append(((0,) * dim, (1,) * dim))
             else:
                 geo.append(
                     (
@@ -351,7 +364,13 @@ def shard_box_exchange(plan: BoxExchangePlan, combine: str):
     the owned box, unpack = static contiguous segment store.
     Reverse (ghost->owner, combine='add'): pack = the contiguous segment,
     unpack = static strided `.add` into the owned box; ghosts zeroed
-    after, like the generic plan and the host `assemble`."""
+    after, like the generic plan and the host `assemble`.
+
+    Rank-polymorphic over the operand: ``xv`` is ``(W,)`` for a single
+    vector or ``(W, K)`` for a multi-RHS block — slot geometry stays on
+    the leading axis (the owned box reshapes to ``box_shape + (K,)``),
+    so each direction's `ppermute` ships the whole K-column slab in one
+    wire round."""
     import jax
     import jax.numpy as jnp
 
@@ -370,17 +389,24 @@ def shard_box_exchange(plan: BoxExchangePlan, combine: str):
     shapes = info.box_shapes
     V = len(shapes)
 
+    def _tail(xv):
+        return tuple(xv.shape[1:])  # () or (K,)
+
     def _pack(xv, d, v):
         """Variant v's static pack: slice the owned box, pad the slab to
         the direction's segment size."""
         bs_v = shapes[v]
         no_v = int(math.prod(bs_v))
         start, shape = d.geo[v]
-        own = jax.lax.slice(xv, (o0,), (o0 + no_v,)).reshape(bs_v)
+        own = xv[o0 : o0 + no_v].reshape(bs_v + _tail(xv))
         sl = tuple(slice(a, a + s) for a, s in zip(start, shape))
-        buf = own[sl].reshape(-1)
+        buf = own[sl].reshape((-1,) + _tail(xv))
         pad = d.size - buf.shape[0]
-        return jnp.pad(buf, (0, pad)) if pad else buf
+        if pad:
+            buf = jnp.pad(
+                buf, ((0, pad),) + ((0, 0),) * (buf.ndim - 1)
+            )
+        return buf
 
     def _unpack_add(xv, buf, d, v):
         """Variant v's static reverse unpack: accumulate the (sender-
@@ -389,10 +415,12 @@ def shard_box_exchange(plan: BoxExchangePlan, combine: str):
         no_v = int(math.prod(bs_v))
         start, shape = d.geo[v]
         n_v = int(math.prod(shape))
-        own = jax.lax.slice(xv, (o0,), (o0 + no_v,)).reshape(bs_v)
+        own = xv[o0 : o0 + no_v].reshape(bs_v + _tail(xv))
         sl = tuple(slice(a, a + s) for a, s in zip(start, shape))
-        own = own.at[sl].add(buf[:n_v].reshape(shape))
-        return jax.lax.dynamic_update_slice(xv, own.reshape(-1), (o0,))
+        own = own.at[sl].add(buf[:n_v].reshape(tuple(shape) + _tail(xv)))
+        return xv.at[o0 : o0 + no_v].set(
+            own.reshape((-1,) + _tail(xv))
+        )
 
     if not plan.reverse_mode:
 
@@ -413,9 +441,7 @@ def shard_box_exchange(plan: BoxExchangePlan, combine: str):
                         xv,
                     )
                 buf = jax.lax.ppermute(buf, "parts", perm=d.perm)
-                xv = jax.lax.dynamic_update_slice(
-                    xv, buf, (g0 + d.off,)
-                )
+                xv = xv.at[g0 + d.off : g0 + d.off + d.size].set(buf)
             return xv
 
         return body
@@ -427,9 +453,10 @@ def shard_box_exchange(plan: BoxExchangePlan, combine: str):
         # accumulate into owners
         del ri
         for d in info.dirs:
-            buf = jax.lax.slice(xv, (g0 + d.off,), (g0 + d.off + d.size,))
+            buf = xv[g0 + d.off : g0 + d.off + d.size]
+            mask = sm[d.off : d.off + d.size]
             buf = jnp.where(
-                jax.lax.slice(sm, (d.off,), (d.off + d.size,)), buf, 0
+                mask.reshape(mask.shape + (1,) * (buf.ndim - 1)), buf, 0
             )
             rperm = tuple((q, p) for p, q in d.perm)
             buf = jax.lax.ppermute(buf, "parts", perm=rperm)

@@ -341,7 +341,10 @@ def _stage_structured_transfer(h, li: int, backend: TPUBackend):
     coarse_rows = (
         h.levels[li + 1].A.rows if li + 1 < len(h.levels) else h.coarse_A.rows
     )
-    S = interp_stencil_cartesian(lvl.nfs, lvl.A.rows)
+    # S inherits the level dtype: an f32 hierarchy stages f32 transfer
+    # operators end-to-end (the stencil weights — powers of 1/2 — are
+    # exact in both widths), closing the docs/roadmap.md §4 f64 detour
+    S = interp_stencil_cartesian(lvl.nfs, lvl.A.rows, dtype=lvl.A.dtype)
     dS = device_matrix(S, backend)
     LS = dS.col_plan.layout
     nc_max = max(

@@ -1,0 +1,452 @@
+"""Block multi-RHS CG (`make_cg_fn(rhs_batch=K)` / `cg(B=...)` /
+`pcg(B=...)`): the operator streams once per K right-hand sides.
+
+The block program's three contracts, each pinned here:
+
+* **Per-column trajectory identity.** Every column follows the textbook
+  single-vector recurrence with per-column α/β — column k's iterate
+  sequence IS the K=1 program's sequence for (b_k, x0_k), bit-for-bit
+  under strict-bits arithmetic (pinned on the asymmetric 4-part
+  conformance partition, like the fused-body tests). Converged columns
+  freeze (α=0 / state re-select) rather than exiting, so ragged blocks
+  keep every column's solo trajectory.
+* **Collective parity, K-independent.** The dot payloads widen from
+  scalars to (K,) / (K, 2) stacks riding the SAME all_gathers
+  (`_pdot_owned_factory`), and the halo ppermutes ship (…, K) slabs —
+  the per-iteration collective count in the lowered HLO must not depend
+  on K, for both the standard and the fused body.
+* **Lowering-independent SpMM.** Every SpMV lowering (coded-DIA,
+  XLA-DIA, SD, BSR, ELL) accepts the (P, W, K) block operand and agrees
+  with K separate SpMVs (bitwise under strict-bits, where the ELL path
+  is the oracle).
+"""
+import re
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.models import (
+    assemble_poisson,
+    gather_pvector,
+    jacobi_preconditioner,
+)
+from partitionedarrays_jl_tpu.models.solvers import cg, pcg
+from partitionedarrays_jl_tpu.parallel.pvector import _write_owned
+from partitionedarrays_jl_tpu.parallel.tpu import (
+    DeviceVector,
+    TPUBackend,
+    _block_on_cols_layout,
+    _matrix_operands,
+    device_matrix,
+    make_cg_fn,
+    make_spmv_fn,
+    tpu_block_cg,
+    tpu_cg,
+)
+
+from test_fused_cg import _fixture_spd_system
+
+
+def _backend(n=8):
+    import jax
+
+    return TPUBackend(devices=jax.devices()[:n])
+
+
+def _rand_rhs(A, seed):
+    v = pa.PVector.full(0.0, A.cols)
+
+    def fill(i, vals):
+        rng = np.random.default_rng(seed + int(i.part))
+        _write_owned(i, vals, rng.standard_normal(i.num_oids))
+
+    pa.map_parts(fill, v.rows.partition, v.values)
+    return v
+
+
+def _ragged_block(A, b):
+    """Three RHS of very different difficulty: the assembled b, a random
+    vector, and a tiny constant forcing — their solo iteration counts
+    differ, which is the point (ragged convergence)."""
+    w = pa.PVector.full(0.0, A.cols)
+
+    def fill(i, vals):
+        _write_owned(i, vals, np.full(i.num_oids, 1e-3))
+
+    pa.map_parts(fill, w.rows.partition, w.values)
+    return [b, _rand_rhs(A, 11), w]
+
+
+# ---------------------------------------------------------------------------
+# block SpMM parity across lowerings
+# ---------------------------------------------------------------------------
+
+
+def test_block_spmv_matches_columns_coded_dia():
+    backend = _backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8, 8))
+        return A
+
+    A = pa.prun(driver, backend, (2, 2, 2))
+    dA = device_matrix(A, backend)
+    assert dA.dia_mode == "coded"  # the stencil fast path engaged
+    spmv = make_spmv_fn(dA)
+    Bs = [_rand_rhs(A, 7 * k) for k in range(4)]
+    yblk = np.asarray(spmv(_block_on_cols_layout(Bs, dA)))
+    assert yblk.shape[-1] == 4
+    for k, bk in enumerate(Bs):
+        dx = DeviceVector.from_pvector(bk, backend, dA.col_layout)
+        np.testing.assert_allclose(
+            yblk[..., k], np.asarray(spmv(dx.data)), rtol=0, atol=1e-12
+        )
+
+
+def test_block_spmv_strict_bits_ell_bitwise(monkeypatch):
+    """Strict-bits forces the pure-ELL lowering and the generic exchange
+    plan; the block product must equal the column products BITWISE."""
+    monkeypatch.setenv("PA_TPU_STRICT_BITS", "1")
+    backend = _backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8, 8))
+        return A
+
+    A = pa.prun(driver, backend, (2, 2, 2))
+    dA = device_matrix(A, backend)
+    assert dA.oo_vals is not None  # ELL path
+    spmv = make_spmv_fn(dA)
+    Bs = [_rand_rhs(A, 3 * k) for k in range(3)]
+    yblk = np.asarray(spmv(_block_on_cols_layout(Bs, dA)))
+    for k, bk in enumerate(Bs):
+        dx = DeviceVector.from_pvector(bk, backend, dA.col_layout)
+        np.testing.assert_array_equal(yblk[..., k], np.asarray(spmv(dx.data)))
+
+
+def test_block_spmv_matches_columns_sd_and_bsr():
+    """The irregular-graph lowerings (SD einsum buckets, node-block BSR,
+    and the bucketed node-block A_oh boundary path) take the block
+    operand: one (G·bs, U·bs) @ (U·bs, K) einsum per bucket."""
+    import os
+
+    from partitionedarrays_jl_tpu.models.elasticity_tet import (
+        assemble_elasticity_tet,
+    )
+    from partitionedarrays_jl_tpu.parallel.tpu import DeviceMatrix
+
+    def driver(parts):
+        A, b, xh, x0 = assemble_elasticity_tet(parts, (4, 4, 4))
+        backend = parts.backend
+        dA = device_matrix(A, backend)
+        assert dA.sd_bs == 3 and dA.ohb_bs == 3, (dA.sd_bs, dA.ohb_bs)
+        Bs = [_rand_rhs(A, 13 * k) for k in range(3)]
+        xblk = _block_on_cols_layout(Bs, dA)
+        y_sd = np.asarray(make_spmv_fn(dA)(xblk))
+        os.environ["PA_TPU_SD"] = "0"
+        try:
+            dA_bsr = DeviceMatrix(A, backend)
+            assert dA_bsr.bsr_bs == 3
+            y_bsr = np.asarray(
+                make_spmv_fn(dA_bsr)(_block_on_cols_layout(Bs, dA_bsr))
+            )
+        finally:
+            del os.environ["PA_TPU_SD"]
+        np.testing.assert_allclose(y_sd, y_bsr, rtol=1e-10, atol=1e-10)
+        for k, bk in enumerate(Bs):
+            dx = DeviceVector.from_pvector(bk, backend, dA.col_layout)
+            yk = np.asarray(make_spmv_fn(dA)(dx.data))
+            np.testing.assert_allclose(
+                y_sd[..., k], yk, rtol=1e-12, atol=1e-12
+            )
+        return True
+
+    assert pa.prun(driver, pa.tpu, 4)
+
+
+# ---------------------------------------------------------------------------
+# ragged convergence: every column matches its solo trajectory
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_block_cg_ragged_columns_match_solo(fused):
+    backend = _backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8, 8))
+        return A, _ragged_block(A, b)
+
+    A, B = pa.prun(driver, backend, (2, 2, 2))
+    xs, info = cg(A, B=B, tol=1e-8, maxiter=400, fused=fused)
+    assert info["cg_body"] == ("fused" if fused else "standard")
+    assert info["rhs_batch"] == 3
+    its = info["iterations_per_column"]
+    assert len(set(its)) > 1, f"block is not ragged: {its}"
+    assert info["iterations"] == max(its)
+    for k, bk in enumerate(B):
+        xk, ik = tpu_cg(A, bk, tol=1e-8, maxiter=400, fused=fused)
+        assert ik["iterations"] == its[k], (k, ik["iterations"], its)
+        np.testing.assert_allclose(
+            gather_pvector(xs[k]), gather_pvector(xk), rtol=0, atol=1e-10
+        )
+        n = ik["iterations"] + 1
+        np.testing.assert_allclose(
+            np.asarray(info["columns"][k]["residuals"])[:n],
+            np.asarray(ik["residuals"])[:n],
+            rtol=1e-12,
+        )
+        # frozen tail: nothing is logged past a column's freeze point
+        hist_k = np.asarray(info["columns"][k]["residuals"])
+        assert len(hist_k) == n
+
+
+def test_block_pcg_matches_solo_and_host():
+    backend = _backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8, 8))
+        return A, _ragged_block(A, b)
+
+    A, B = pa.prun(driver, backend, (2, 2, 2))
+    mv = jacobi_preconditioner(A)
+    xs, info = pcg(A, B=B, minv=mv, tol=1e-8, maxiter=400)
+    for k, bk in enumerate(B):
+        xk, ik = pcg(A, bk, minv=mv, tol=1e-8, maxiter=400)
+        assert ik["iterations"] == info["iterations_per_column"][k]
+        np.testing.assert_allclose(
+            gather_pvector(xs[k]), gather_pvector(xk), rtol=0, atol=1e-9
+        )
+
+
+def test_host_backend_block_runs_solo_loops():
+    """On the host backend `cg(B=...)` solves the columns with the solo
+    loop — the oracle semantics — and reports the same info shape."""
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (6, 6, 6))
+        B = [b, _rand_rhs(A, 5)]
+        xs, info = cg(A, B=B, tol=1e-9, maxiter=300)
+        assert info["cg_body"] == "host" and info["rhs_batch"] == 2
+        for k, bk in enumerate(B):
+            xk, ik = cg(A, bk, tol=1e-9, maxiter=300)
+            assert ik["iterations"] == info["iterations_per_column"][k]
+            np.testing.assert_array_equal(
+                gather_pvector(xs[k]), gather_pvector(xk)
+            )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2, 2))
+
+
+# ---------------------------------------------------------------------------
+# K=1 degenerate batch == the unbatched program
+# ---------------------------------------------------------------------------
+
+
+def test_k1_degenerate_batch_equals_unbatched(monkeypatch):
+    """Under strict-bits the K=1 block program must reproduce the
+    unbatched program bit-for-bit: same iterations, identical residual
+    bits, identical solution bits."""
+    monkeypatch.setenv("PA_TPU_STRICT_BITS", "1")
+    backend = _backend(4)
+
+    def driver(parts):
+        A, b = _fixture_spd_system(parts)
+        return A, b
+
+    A, b = pa.prun(driver, backend, 4)
+    xs, binfo = tpu_block_cg(A, [b], tol=1e-12, maxiter=200)
+    xk, sinfo = tpu_cg(A, b, tol=1e-12, maxiter=200)
+    assert binfo["columns"][0]["iterations"] == sinfo["iterations"]
+    assert sinfo["iterations"] > 3
+    np.testing.assert_array_equal(
+        gather_pvector(xs[0]), gather_pvector(xk)
+    )
+    n = sinfo["iterations"] + 1
+    np.testing.assert_array_equal(
+        np.asarray(binfo["columns"][0]["residuals"])[:n],
+        np.asarray(sinfo["residuals"])[:n],
+    )
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_strict_bits_block_per_column_identity(fused, monkeypatch):
+    """The tentpole pin: per-column BITWISE identity against the K=1
+    oracle under strict-bits on the asymmetric 4-part conformance
+    fixture, for a RAGGED block (different per-column freeze points),
+    with both the standard and the fused body."""
+    monkeypatch.setenv("PA_TPU_STRICT_BITS", "1")
+    backend = _backend(4)
+
+    def driver(parts):
+        A, b = _fixture_spd_system(parts)
+        # second column: a different, rougher RHS (solo counts differ)
+        b2 = pa.PVector(
+            pa.map_parts(
+                lambda i: np.where(
+                    np.asarray(i.lid_to_part) == i.part,
+                    np.cos(2.0 + 3.0 * np.asarray(i.lid_to_gid, dtype=np.float64)),
+                    0.0,
+                ),
+                A.rows.partition,
+            ),
+            A.rows,
+        )
+        return A, [b, b2]
+
+    A, B = pa.prun(driver, backend, 4)
+    xs, binfo = tpu_block_cg(A, B, tol=1e-10, maxiter=200, fused=fused)
+    assert binfo["cg_body"] == ("fused" if fused else "standard")
+    for k, bk in enumerate(B):
+        xk, sinfo = tpu_cg(A, bk, tol=1e-10, maxiter=200, fused=fused)
+        assert (
+            binfo["columns"][k]["iterations"] == sinfo["iterations"]
+        ), (k, binfo["iterations_per_column"], sinfo["iterations"])
+        np.testing.assert_array_equal(
+            gather_pvector(xs[k]), gather_pvector(xk)
+        )
+        n = sinfo["iterations"] + 1
+        np.testing.assert_array_equal(
+            np.asarray(binfo["columns"][k]["residuals"])[:n],
+            np.asarray(sinfo["residuals"])[:n],
+        )
+
+
+# ---------------------------------------------------------------------------
+# fused × batched interaction under the env default
+# ---------------------------------------------------------------------------
+
+
+def test_fused_env_default_applies_to_block(monkeypatch):
+    """PA_TPU_FUSED_CG governs the block body exactly like the solo
+    body: default ON, =0 reverts to standard — and both bodies agree on
+    trajectories."""
+    backend = _backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8, 8))
+        return A, _ragged_block(A, b)
+
+    A, B = pa.prun(driver, backend, (2, 2, 2))
+    xs_f, inf_f = cg(A, B=B, tol=1e-8, maxiter=400)
+    assert inf_f["cg_body"] == "fused"
+    monkeypatch.setenv("PA_TPU_FUSED_CG", "0")
+    xs_u, inf_u = cg(A, B=B, tol=1e-8, maxiter=400)
+    assert inf_u["cg_body"] == "standard"
+    assert (
+        inf_f["iterations_per_column"] == inf_u["iterations_per_column"]
+    )
+    for xf, xu in zip(xs_f, xs_u):
+        np.testing.assert_allclose(
+            gather_pvector(xf), gather_pvector(xu), rtol=0, atol=1e-10
+        )
+
+
+# ---------------------------------------------------------------------------
+# HLO A/B: collective count per iteration is K-independent
+# ---------------------------------------------------------------------------
+
+
+def _collective_counts(run_fn, *args):
+    txt = run_fn.jit_fn.lower(*args).as_text()
+    return {
+        k: len(re.findall(k, txt))
+        for k in ("collective_permute", "all_gather", "all_reduce")
+    }
+
+
+@pytest.mark.parametrize("fused", [False, True])
+@pytest.mark.parametrize("precond", [False, True])
+def test_block_collective_count_k_independent(fused, precond):
+    backend = _backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (6, 6, 6))
+        return A, b
+
+    A, b = pa.prun(driver, backend, (2, 2, 2))
+    dA = device_matrix(A, backend)
+    ops = _matrix_operands(dA)
+    mv = None
+    if precond:
+        dmv = DeviceVector.from_pvector(
+            jacobi_preconditioner(A), backend, dA.col_layout
+        )
+        mv = dmv.data
+    counts = {}
+    for K in (1, 4, 8):
+        Bs = [b] * K
+        db = _block_on_cols_layout(Bs, dA)
+        dx0 = _block_on_cols_layout(
+            [pa.PVector.full(0.0, A.cols) for _ in range(K)],
+            dA, with_ghosts=True,
+        )
+        fn = make_cg_fn(
+            dA, tol=1e-9, maxiter=50, fused=fused, precond=precond,
+            rhs_batch=K,
+        )
+        counts[K] = _collective_counts(
+            fn, db, dx0, db[..., 0] if mv is None else mv, ops
+        )
+    assert any(counts[1].values()), "no collectives found at all"
+    assert counts[1] == counts[4] == counts[8], counts
+
+
+def test_block_matches_solo_collective_counts():
+    """The K=1 block program must not pay MORE collectives than the solo
+    program of the same body — widening payloads is free, extra rounds
+    are not."""
+    backend = _backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (6, 6, 6))
+        return A, b
+
+    A, b = pa.prun(driver, backend, (2, 2, 2))
+    dA = device_matrix(A, backend)
+    ops = _matrix_operands(dA)
+    db1 = _block_on_cols_layout([b], dA)
+    dx01 = _block_on_cols_layout(
+        [pa.PVector.full(0.0, A.cols)], dA, with_ghosts=True
+    )
+    db = DeviceVector.from_pvector(b, backend, dA.col_layout)
+    dx0 = DeviceVector.from_pvector(
+        pa.PVector.full(0.0, A.cols), backend, dA.col_layout
+    )
+    for fused in (False, True):
+        blk = make_cg_fn(dA, tol=1e-9, maxiter=50, fused=fused, rhs_batch=1)
+        solo = make_cg_fn(dA, tol=1e-9, maxiter=50, fused=fused)
+        cb = _collective_counts(blk, db1, dx01, db1[..., 0], ops)
+        cs = _collective_counts(solo, db.data, dx0.data, db.data, ops)
+        for kind in cs:
+            assert cb[kind] <= cs[kind], (fused, kind, cb, cs)
+
+
+# ---------------------------------------------------------------------------
+# guard rails
+# ---------------------------------------------------------------------------
+
+
+def test_block_rejects_pipelined_and_checkpoint():
+    backend = _backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (6, 6))
+        return A, b
+
+    A, b = pa.prun(driver, backend, (2, 2))
+    dA = device_matrix(A, backend)
+    with pytest.raises(ValueError, match="single-RHS"):
+        make_cg_fn(dA, tol=1e-9, maxiter=10, pipelined=True, rhs_batch=2)
+    with pytest.raises(ValueError, match="single-RHS"):
+        cg(A, B=[b, b], pipelined=True)
+    with pytest.raises(ValueError, match="single-RHS"):
+        cg(A, B=[b], checkpoint=object())
+    with pytest.raises(Exception):
+        cg(A, b, B=[b])  # both b and B
+    with pytest.raises(Exception, match="at least one"):
+        cg(A, B=[])  # empty block fails with the friendly message
+    with pytest.raises(Exception, match="at least one"):
+        pcg(A, B=iter(()))  # generator B is normalized before the check

@@ -724,3 +724,99 @@ def test_cartesian_partition_dim_firsts():
         return True
 
     assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_matrix_s_fallback_gets_box_plan_on_agglomerated_levels():
+    """docs/roadmap.md §4 (round-7 satellite): the matrix-S fallback's
+    cols exchanger must take the slice-based box plan whenever its ghost
+    set is slab-shaped — including AGGLOMERATED coarse levels, whose
+    inactive parts own empty boxes (the case that used to fail the slab
+    detector outright and silently lower to the generic gather plan).
+    Also pins solve parity: the box-plan program reproduces the
+    full-mesh hierarchy's iteration count."""
+    import os
+
+    from partitionedarrays_jl_tpu.parallel.tpu_box import BoxExchangePlan
+    from partitionedarrays_jl_tpu.parallel.tpu_gmg import _device_hierarchy
+
+    os.environ["PA_TPU_GMG_STENCIL"] = "0"  # force the matrix-S path
+    try:
+
+        def driver(parts):
+            ns = (16, 16, 16)
+            A, b, x_exact, _ = _poisson(parts, ns)
+            Ah, bh = pa.decouple_dirichlet(A, b)
+            h = pa.gmg_hierarchy(
+                parts, Ah, ns, coarse_threshold=30, agg_threshold=200,
+            )
+            # the hierarchy must actually agglomerate somewhere
+            assert any(
+                min(
+                    i.num_oids
+                    for i in lvl.A.rows.partition.part_values()
+                ) == 0
+                for lvl in h.levels[1:]
+            ) or min(
+                i.num_oids
+                for i in h.coarse_A.rows.partition.part_values()
+            ) == 0
+            dh = _device_hierarchy(h, parts.backend)
+            s_levels = [l for l in dh["levels"] if "dS" in l]
+            assert s_levels, "no level took the matrix-S fallback"
+            for l in s_levels:
+                assert isinstance(l["dS"].col_plan, BoxExchangePlan), (
+                    "matrix-S cols exchanger lowered to the generic "
+                    "gather plan on a slab-shaped ghost set"
+                )
+            x, info = pa.tpu_gmg_pcg(h, bh, tol=1e-9)
+            assert info["converged"]
+            err = np.abs(
+                pa.gather_pvector(x) - pa.gather_pvector(x_exact)
+            ).max()
+            assert err < 1e-6, err
+            return info["iterations"]
+
+        it_agg = pa.prun(driver, pa.tpu, (2, 2, 2))
+        assert it_agg > 0
+    finally:
+        del os.environ["PA_TPU_GMG_STENCIL"]
+
+
+def test_f32_hierarchy_stages_f32_end_to_end():
+    """docs/roadmap.md §4 (round-7 satellite): an f32 hierarchy must
+    stage f32 everywhere — transfers (P/R/S), coarse inverse, smoother
+    diagonals — with no f64 detour on host. The interpolation weights
+    are exact powers of 1/2, so the f32 transfers lose nothing."""
+    from partitionedarrays_jl_tpu.parallel.tpu_gmg import _device_hierarchy
+
+    def driver(parts):
+        ns = (16, 16, 16)
+        A, b, x_exact, x0 = pa.assemble_poisson(
+            parts, ns, dtype=np.float32
+        )
+        h = pa.gmg_hierarchy(parts, A, ns, coarse_threshold=30)
+        for lvl in h.levels:
+            assert lvl.A.dtype == np.float32
+            assert lvl.dinv.dtype == np.float32
+            # lazily-built assembled transfers inherit the level dtype
+            assert lvl.P.dtype == np.float32, lvl.P.dtype
+            assert lvl.R.dtype == np.float32, lvl.R.dtype
+        assert h.coarse_A.dtype == np.float32
+        dh = _device_hierarchy(h, parts.backend)
+        assert dh["cinv"].dtype == np.float32, dh["cinv"].dtype
+        for l in dh["levels"]:
+            assert np.dtype(l["dinv"].dtype) == np.float32
+            if "dS" in l:
+                dS = l["dS"]
+                staged = next(
+                    a
+                    for a in (dS.dia_cb, dS.dia_vals, dS.oo_vals)
+                    if a is not None
+                )
+                assert np.dtype(staged.dtype) == np.float32, staged.dtype
+        # and the preconditioner still works at f32
+        x, info = pa.pcg(A, b, x0=x0, minv=h, tol=1e-4, maxiter=200)
+        assert info["converged"]
+        return True
+
+    assert pa.prun(driver, pa.tpu, (2, 2, 2))

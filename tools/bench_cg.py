@@ -100,6 +100,58 @@ def main():
         f"speedup_vs_standard={dt / dtp:.3f}x"
     )
 
+    # --rhs leg: block (multi-RHS) CG marginals — per-RHS cost at each
+    # K against the K=1 block leg (the operator streams once per K)
+    argv = sys.argv[1:]
+    rhs_arg = os.environ.get("PA_BENCH_RHS", "")
+    if "--rhs" in argv and argv.index("--rhs") + 1 < len(argv):
+        rhs_arg = argv[argv.index("--rhs") + 1]
+    if rhs_arg:
+        from partitionedarrays_jl_tpu.parallel.tpu import (
+            _block_on_cols_layout, make_cg_fn as _mk,
+        )
+        import statistics
+
+        ks = [int(s) for s in rhs_arg.split(",") if s]
+
+        def measure_block(K: int) -> float:
+            db_b = _block_on_cols_layout([b] * K, dA)
+            dz_b = _block_on_cols_layout([x0] * K, dA, with_ghosts=True)
+            solves = {
+                k: _mk(dA, tol=0.0, maxiter=k, rhs_batch=K)
+                for k in (K0, K1)
+            }
+            for s in solves.values():
+                np.asarray(s(db_b, dz_b, None)[1])
+
+            def run_k(k):
+                ts = []
+                for _i in range(5):
+                    t0 = time.perf_counter()
+                    out = solves[k](db_b, dz_b, None)
+                    np.asarray(out[1])
+                    ts.append(time.perf_counter() - t0)
+                return float(np.median(ts))
+
+            per_it = []
+            for _round in range(3):
+                t0, t1 = run_k(K0), run_k(K1)
+                per_it.append((t1 - t0) / (K1 - K0))
+            return float(statistics.median(per_it))
+
+        base = None
+        for K in ks:
+            t_it = measure_block(K)
+            per_rhs = t_it / K
+            if K == 1:
+                base = per_rhs
+            speed = f" per_rhs_speedup_vs_k1={base / per_rhs:.3f}x" if base else ""
+            print(
+                f"block_cg_K{K}_per_iteration_us={t_it * 1e6:.1f} "
+                f"per_rhs_us={per_rhs * 1e6:.1f}{speed} "
+                f"(rhs block, operator streamed once per {K} columns)"
+            )
+
 
 if __name__ == "__main__":
     main()

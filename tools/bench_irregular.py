@@ -206,6 +206,74 @@ def bench_size(n, backend, jax, pa, with_ell):
     return rec
 
 
+def oh_bucket_ab(n, backend, jax, pa):
+    """A/B of the BUCKETED A_oh boundary-block staging (round-7
+    satellite, closing the round-4 directive-7 leftover): lower the
+    multi-part elasticity operator with PA_TPU_OH_BUCKETS on (default)
+    and off (one global-width pad), and record the padded ghost-NODE
+    gather count per SpMV for each — on a TPU the element-at-a-time
+    gathers ARE the boundary cost, so the static count is the signal
+    (the kernel is identical math either way; tests pin value parity).
+    Needs >= 2 devices for a real boundary block; returns None
+    otherwise."""
+    from partitionedarrays_jl_tpu.models import assemble_elasticity_tet
+    from partitionedarrays_jl_tpu.parallel.tpu import DeviceMatrix
+
+    del backend  # the A/B builds its own multi-part mesh
+    devs = jax.devices()
+    P = max(p for p in (8, 4, 2, 1) if p <= len(devs))
+    if P < 2:
+        return None
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_elasticity_tet(parts, (n, n, n))
+        A.values = pa.map_parts(
+            lambda M: pa.CSRMatrix(
+                M.indptr, M.indices,
+                (M.data / np.abs(M.data).max()).astype(np.float32),
+                M.shape,
+            ),
+            A.values,
+        )
+        A.invalidate_blocks()
+        return A
+
+    from partitionedarrays_jl_tpu.parallel.tpu import TPUBackend
+
+    b2 = TPUBackend(devices=devs[:P])
+    A = pa.prun(driver, b2, P)
+
+    def gathers(dA):
+        if dA.ohb_bs is None:
+            return None
+        return int(
+            sum(
+                int(np.prod(c.shape[:3]))  # P * rows_c * Lb_c node ids
+                for c in dA.ohb_cols
+            )
+        )
+
+    dA_b = DeviceMatrix(A, b2)
+    os.environ["PA_TPU_OH_BUCKETS"] = "0"
+    try:
+        dA_g = DeviceMatrix(A, b2)
+    finally:
+        del os.environ["PA_TPU_OH_BUCKETS"]
+    gb, gg = gathers(dA_b), gathers(dA_g)
+    if gb is None or gg is None:
+        return {"n": n, "parts": P, "note": "A_oh node-block path did not engage"}
+    return {
+        "n": n,
+        "parts": P,
+        "oh_buckets": len(dA_b.ohb_cols),
+        "bucket_widths": [int(c.shape[-1]) for c in dA_b.ohb_cols],
+        "global_pad_width": int(dA_g.ohb_cols[0].shape[-1]),
+        "padded_node_gathers_bucketed": gb,
+        "padded_node_gathers_global": gg,
+        "gather_reduction": round(gg / max(gb, 1), 3),
+    }
+
+
 def main():
     import jax
 
@@ -254,6 +322,15 @@ def main():
         with open(out_path, "w") as f:
             json.dump(rec, f, indent=1, sort_keys=True)
         jax.clear_caches()
+    try:
+        ab = oh_bucket_ab(min(sizes), backend, jax, pa)
+        if ab is not None:
+            rec["oh_bucket_ab"] = ab
+            print(json.dumps({"oh_bucket_ab": ab}), flush=True)
+            with open(out_path, "w") as f:
+                json.dump(rec, f, indent=1, sort_keys=True)
+    except Exception as e:  # the A/B must never mask the primary rows
+        print(f"oh-bucket A/B failed: {type(e).__name__}: {e}", file=sys.stderr)
     head = rows[0]
     head_gflops = head[f"{head['lowering']}_gflops"]
     # vs_baseline compares the default lowering against the dedicated
