@@ -99,6 +99,130 @@ def _check_block_args(name, b, x0, B, checkpoint, _resume_state):
     return B
 
 
+class _SDCGuard:
+    """Host-loop silent-corruption defense shared by `cg` and `pcg`: the
+    periodic true-residual audit plus the bounded in-memory rollback
+    ring (`parallel.health.RollbackRing`) — the same audit/rollback
+    logic the compiled device loops run in-graph, making the host loop
+    the oracle for the SDC recovery ladder:
+
+    1. a detection (`SilentCorruptionError` from an ABFT exchange
+       checksum, or a failed audit here) rewinds the recurrence to the
+       newest audited ring state — at most ``audit_every`` iterations
+       back, NO disk I/O;
+    2. consecutive failed replays walk to older ring entries;
+    3. after ``PA_HEALTH_MAX_ROLLBACKS`` rollbacks the next detection
+       escalates: `SilentCorruptionError` (carrying the counters under
+       ``diagnostics["sdc"]``) propagates to `solve_with_recovery`,
+       whose checkpoint restart is the disk tier of the ladder.
+
+    Inactive (every call a cheap no-op) unless ``PA_TPU_ABFT=1`` or
+    ``PA_HEALTH_AUDIT_EVERY > 0``. The audit's extra ``A @ x`` runs one
+    exchange, so the chaos harness's call counter advances faster when
+    audits are on (the counter is wire-level, and replayed iterations
+    are NEW wire calls — a one-shot ``call=k`` clause never refires on
+    replay, which is exactly why a clean replay self-heals)."""
+
+    def __init__(self, name: str, A, b, rs0, health: bool):
+        from ..parallel.health import (
+            RollbackRing,
+            abft_enabled,
+            audit_every,
+            audit_tolerance,
+            max_rollbacks,
+        )
+
+        self.name = name
+        self.A, self.b = A, b
+        self.rs0 = float(rs0)
+        self.every = audit_every()
+        self.active = bool(health) and (abft_enabled() or self.every > 0)
+        self.ring = RollbackRing() if self.active else None
+        self.max_rb = max_rollbacks()
+        self.tol = audit_tolerance(b.dtype) if self.active else 0.0
+        self.strike = 0
+        self.counters = {
+            "detections": 0,
+            "rollbacks": 0,
+            "escalations": 0,
+            "audit_iterations": 0,
+        }
+
+    def push(self, vectors: dict, meta: dict, history) -> None:
+        """Record an audited-good state (the initial state counts: it is
+        consistent by construction)."""
+        if not self.active:
+            return
+        m = dict(meta)
+        m["history"] = [np.float64(h) for h in history]
+        self.ring.push(vectors, m)
+        self.strike = 0
+
+    def audit(self, x, r, it: int, meta: dict, extra_vectors: dict, history):
+        """Every ``audit_every`` iterations: drift = ||(b - A x) - r||
+        must sit inside the recurrence's rounding envelope; a pass
+        pushes the state onto the ring, a failure raises
+        `SilentCorruptionError` (caught by the loop's rollback arm)."""
+        if not self.active or self.every <= 0 or it == 0 or it % self.every:
+            return
+        from ..parallel.health import SilentCorruptionError
+
+        self.counters["audit_iterations"] += 1
+        rt = self.b.copy()
+        qx = self.A @ x
+        _owned_update(rt, lambda tv, qv: tv - qv, qx)
+        _owned_update(rt, lambda tv, rv: tv - rv, r)
+        drift = float(rt.norm())
+        thresh = self.tol * max(1.0, float(np.sqrt(self.rs0)))
+        if not (drift <= thresh):  # NaN-safe
+            raise SilentCorruptionError(
+                f"{self.name}: true-residual audit failed at iteration "
+                f"{it} — ||(b - A x) - r|| = {drift:.3e} exceeds "
+                f"{thresh:.3e}: the recurrence has silently diverged "
+                "from the true residual (finite corruption)",
+                diagnostics={
+                    "detector": "true_residual_audit",
+                    "iteration": int(it),
+                    "drift": drift,
+                    "threshold": thresh,
+                },
+            )
+        self.push({"x": x, "r": r, **extra_vectors}, meta, history)
+
+    def rollback(self, e, it: int):
+        """Handle a detection: restore the ring state ``strike`` slots
+        back, or escalate once the budget is spent. Returns
+        ``(vectors, meta, history)`` for the loop to reinstate."""
+        from ..parallel.health import SilentCorruptionError
+
+        self.counters["detections"] += 1
+        exhausted = self.counters["rollbacks"] >= self.max_rb
+        st = (
+            self.ring.restore(self.strike)
+            if self.active and not exhausted
+            else None
+        )
+        if st is None:
+            self.counters["escalations"] += 1
+            diag = dict(getattr(e, "diagnostics", {}))
+            diag["sdc"] = dict(self.counters)
+            diag["iteration"] = int(it)
+            raise SilentCorruptionError(
+                f"{self.name}: {e} — in-memory rollback budget "
+                f"({self.max_rb}) exhausted at iteration {it}; "
+                "escalating to the checkpoint-restart tier "
+                "(solve_with_recovery)",
+                diagnostics=diag,
+            ) from e
+        self.counters["rollbacks"] += 1
+        self.strike += 1
+        vecs, meta = st
+        return vecs, meta, list(meta["history"])
+
+    def info_extra(self) -> dict:
+        return {"sdc": dict(self.counters)} if self.active else {}
+
+
 def cg(
     A: PSparseMatrix,
     b: Optional[PVector] = None,
@@ -198,6 +322,7 @@ def cg(
             pipelined=pipelined, fused=fused,
         )
     from ..parallel.health import (
+        SilentCorruptionError,
         SolverBreakdownError,
         StagnationDetector,
         check_finite_scalar,
@@ -231,30 +356,47 @@ def cg(
         # a poisoned start raises instead of returning converged=False
         check_finite_scalar(rs, "cg", it=0, vectors=(("r", r), ("x", x)))
     stag = StagnationDetector("cg") if health and stagnation_raises() else None
+    sdc = _SDCGuard("cg", A, b, rs0, health)
+    sdc.push({"x": x, "r": r, "p": p}, {"rs": rs, "it": it}, history)
     while np.sqrt(rs) > tol * max(1.0, np.sqrt(rs0)) and it < maxiter:
-        q = A @ p
-        pq = p.dot(q)  # owned dot across owned-compatible PRanges
-        if pq == 0.0:
-            raise SolverBreakdownError(
-                "cg: breakdown, p'Ap == 0",
-                diagnostics={"iteration": it, "rs": float(rs)},
-            )
-        alpha = rs / pq
-        _owned_update(x, lambda xv, pv: xv + alpha * pv, p)
-        _owned_update(r, lambda rv, qv: rv - alpha * qv, q)
-        rs_new = r.dot(r)
-        if health:
-            # free: rs_new was reduced anyway; the per-part sweep only
-            # runs after the scalar trips
-            check_finite_scalar(
-                rs_new, "cg", it=it + 1,
-                vectors=(("r", r), ("q", q), ("x", x)),
-            )
-        beta = rs_new / rs
-        _owned_update(p, lambda pv, rv: rv + beta * pv, r)
-        rs = rs_new
-        history.append(np.sqrt(rs))
-        it += 1
+        try:
+            q = A @ p
+            pq = p.dot(q)  # owned dot across owned-compatible PRanges
+            if pq == 0.0:
+                raise SolverBreakdownError(
+                    "cg: breakdown, p'Ap == 0",
+                    diagnostics={"iteration": it, "rs": float(rs)},
+                )
+            alpha = rs / pq
+            _owned_update(x, lambda xv, pv: xv + alpha * pv, p)
+            _owned_update(r, lambda rv, qv: rv - alpha * qv, q)
+            rs_new = r.dot(r)
+            if health:
+                # free: rs_new was reduced anyway; the per-part sweep only
+                # runs after the scalar trips
+                check_finite_scalar(
+                    rs_new, "cg", it=it + 1,
+                    vectors=(("r", r), ("q", q), ("x", x)),
+                )
+            beta = rs_new / rs
+            _owned_update(p, lambda pv, rv: rv + beta * pv, r)
+            rs = rs_new
+            history.append(np.sqrt(rs))
+            it += 1
+            # periodic true-residual audit: recompute b - A x and cross-
+            # check the recurrence residual (catches the drift a FINITE
+            # corruption leaves behind); the passing state is pushed onto
+            # the in-memory rollback ring
+            sdc.audit(x, r, it, {"rs": rs, "it": it}, {"p": p}, history)
+        except SilentCorruptionError as e:
+            # in-memory rollback: rewind to the newest audited ring state
+            # (<= audit_every iterations back), no disk I/O; escalate to
+            # the caller (solve_with_recovery's checkpoint restart) once
+            # the rollback budget is spent
+            vecs, meta_r, history = sdc.rollback(e, it)
+            x, r, p = vecs["x"], vecs["r"], vecs["p"]
+            rs, it = meta_r["rs"], meta_r["it"]
+            continue
         if stag is not None:
             stag.update(float(np.sqrt(rs)), it)
         if checkpoint is not None and checkpoint.due(it):
@@ -276,6 +418,7 @@ def cg(
             A, x, b, np.sqrt(rs) / max(1.0, np.sqrt(rs0)), np.sqrt(rs0),
             tol, force=floor_warned,
         ),
+        **sdc.info_extra(),
     )
 
 
@@ -1276,6 +1419,7 @@ def pcg(
             )
 
     from ..parallel.health import (
+        SilentCorruptionError,
         SolverBreakdownError,
         StagnationDetector,
         check_finite_scalar,
@@ -1318,30 +1462,42 @@ def pcg(
         # see cg: a poisoned start must raise, not silently skip the loop
         check_finite_scalar(rs, "pcg", it=0, vectors=(("r", r), ("x", x)))
     stag = StagnationDetector("pcg") if health and stagnation_raises() else None
+    sdc = _SDCGuard("pcg", A, b, rs0, health)
+    sdc.push({"x": x, "r": r, "p": p}, {"rs": rs, "rz": rz, "it": it}, history)
     while np.sqrt(rs) > tol * max(1.0, np.sqrt(rs0)) and it < maxiter:
-        q = A @ p
-        pq = p.dot(q)
-        if pq == 0.0:
-            raise SolverBreakdownError(
-                "pcg: breakdown, p'Ap == 0",
-                diagnostics={"iteration": it, "rs": float(rs)},
+        try:
+            q = A @ p
+            pq = p.dot(q)
+            if pq == 0.0:
+                raise SolverBreakdownError(
+                    "pcg: breakdown, p'Ap == 0",
+                    diagnostics={"iteration": it, "rs": float(rs)},
+                )
+            alpha = rz / pq
+            _owned_update(x, lambda xv, pv: xv + alpha * pv, p)
+            _owned_update(r, lambda rv, qv: rv - alpha * qv, q)
+            _apply_precond()
+            rz_new = r.dot(z)
+            rs = r.dot(r)
+            if health:
+                check_finite_scalar(
+                    rs, "pcg", it=it + 1,
+                    vectors=(("r", r), ("z", z), ("x", x)),
+                )
+            beta = rz_new / rz
+            _owned_update(p, lambda pv, zv: zv + beta * pv, z)
+            rz = rz_new
+            history.append(np.sqrt(rs))
+            it += 1
+            sdc.audit(
+                x, r, it, {"rs": rs, "rz": rz, "it": it}, {"p": p}, history
             )
-        alpha = rz / pq
-        _owned_update(x, lambda xv, pv: xv + alpha * pv, p)
-        _owned_update(r, lambda rv, qv: rv - alpha * qv, q)
-        _apply_precond()
-        rz_new = r.dot(z)
-        rs = r.dot(r)
-        if health:
-            check_finite_scalar(
-                rs, "pcg", it=it + 1,
-                vectors=(("r", r), ("z", z), ("x", x)),
-            )
-        beta = rz_new / rz
-        _owned_update(p, lambda pv, zv: zv + beta * pv, z)
-        rz = rz_new
-        history.append(np.sqrt(rs))
-        it += 1
+        except SilentCorruptionError as e:
+            # same in-memory rollback ladder as cg (see _SDCGuard)
+            vecs, meta_r, history = sdc.rollback(e, it)
+            x, r, p = vecs["x"], vecs["r"], vecs["p"]
+            rs, rz, it = meta_r["rs"], meta_r["rz"], meta_r["it"]
+            continue
         if stag is not None:
             stag.update(float(np.sqrt(rs)), it)
         if checkpoint is not None and checkpoint.due(it):
@@ -1364,6 +1520,7 @@ def pcg(
             A, x, b, np.sqrt(rs) / max(1.0, np.sqrt(rs0)), np.sqrt(rs0),
             tol, force=floor_warned,
         ),
+        **sdc.info_extra(),
     )
 
 
@@ -1937,6 +2094,28 @@ def resume_solve(
     return x, info
 
 
+def _new_recovery_ledger() -> dict:
+    """The cumulative `info["recovery"]` schema shared by the host and
+    chunked-device recovery drivers (ONE definition, so the two paths
+    cannot drift)."""
+    return {
+        "attempts": 0,
+        "detections": 0,
+        "rollbacks": 0,
+        "checkpoint_restarts": 0,
+        "restart_sources": [],
+    }
+
+
+def _ledger_fold_sdc(ledger: dict, counters) -> None:
+    """Fold one attempt's in-memory-tier counters (an `info["sdc"]`
+    dict, or the same carried on an escalated error's diagnostics) into
+    the cumulative ledger."""
+    if counters:
+        ledger["detections"] += int(counters.get("detections", 0))
+        ledger["rollbacks"] += int(counters.get("rollbacks", 0))
+
+
 def solve_with_recovery(
     A: PSparseMatrix,
     b: PVector,
@@ -1955,9 +2134,18 @@ def solve_with_recovery(
     restart-from-last-checkpoint when any `SolverHealthError` fires —
     a NaN-poisoned halo exchange caught by the health guards, an
     exchange timeout from a dropped part, a lost controller, a Krylov
-    breakdown. Up to ``max_restarts`` restarts; the final info dict
-    carries ``info["restarts"]`` (and the per-failure record under
-    ``info["failures"]``).
+    breakdown — or a `SilentCorruptionError` escalated by the in-memory
+    rollback tier (the SDC defense ladder's disk tier). Up to
+    ``max_restarts`` restarts; the final info dict carries
+    ``info["restarts"]`` (and the per-failure record under
+    ``info["failures"]``) plus a CUMULATIVE ``info["recovery"]`` ledger:
+    ``attempts`` (solver invocations, including the successful one),
+    ``rollbacks``/``detections`` consumed by the in-memory tier across
+    all attempts, and ``restart_sources`` recording, per restart, the
+    failure type and the state restarted from (exact-recurrence
+    checkpoint, checkpointed iterate, or scratch — with the checkpoint
+    iteration used), so callers and tests can assert the recovery path
+    taken instead of parsing logs.
 
     Host backends checkpoint the FULL recurrence state in-loop, so a
     restart replays the exact trajectory (the fault-free and
@@ -1995,8 +2183,14 @@ def solve_with_recovery(
     restarts = 0
     failures = []
     state = None
+    ledger = _new_recovery_ledger()
+
+    def _fold_sdc(counters):
+        _ledger_fold_sdc(ledger, counters)
+
     while True:
         try:
+            ledger["attempts"] += 1
             kwargs = dict(
                 tol=tol, maxiter=maxiter, verbose=verbose,
                 checkpoint=ckpt, _resume_state=state,
@@ -2008,26 +2202,41 @@ def solve_with_recovery(
             info["restarts"] = restarts
             if failures:
                 info["failures"] = failures
+            _fold_sdc(info.get("sdc"))
+            info["recovery"] = ledger
             return x, info
         except SolverHealthError as e:
             failures.append(
                 {"type": type(e).__name__, "message": str(e),
                  "diagnostics": e.diagnostics}
             )
+            # an escalated SilentCorruptionError carries the failed
+            # attempt's in-memory-tier counters — fold them so the
+            # ledger is cumulative across attempts
+            _fold_sdc(e.diagnostics.get("sdc"))
             if restarts >= max_restarts:
                 raise
             restarts += 1
             state = None
             how = "scratch"
+            source = {"failure": type(e).__name__, "from": "scratch"}
             if ckpt is not None:
                 try:
                     ckpt.wait()  # let an in-flight write land first
                 except Exception:
                     pass
                 if ckpt.has_state():
-                    st = load_solver_state(
-                        ckpt.directory, _solver_state_ranges(A, b)
-                    )
+                    from ..parallel.checkpoint import CheckpointCorruptError
+
+                    try:
+                        st = load_solver_state(
+                            ckpt.directory, _solver_state_ranges(A, b)
+                        )
+                    except CheckpointCorruptError as ce:
+                        # a rotted checkpoint must degrade the restart to
+                        # scratch, not crash the recovery itself
+                        st = None
+                        source["checkpoint_corrupt"] = str(ce)
                     # same contract as resume_solve: the exact-recurrence
                     # resume needs the full (x, r, p)+scalars state AND a
                     # method match — an iterate-only checkpoint (e.g.
@@ -2043,9 +2252,16 @@ def solve_with_recovery(
                         ):
                             state = st
                             how = "last checkpoint (exact recurrence)"
+                            source["from"] = "checkpoint_state"
                         else:
                             x0 = st["x"]
                             how = "checkpointed iterate (Krylov restart)"
+                            source["from"] = "checkpoint_iterate"
+                        source["checkpoint_iteration"] = int(
+                            meta_.get("it", 0)
+                        )
+                        ledger["checkpoint_restarts"] += 1
+            ledger["restart_sources"].append(source)
             print(
                 f"[partitionedarrays_jl_tpu] {method}: "
                 f"{type(e).__name__}: {e} — restart {restarts}/"
@@ -2080,30 +2296,54 @@ def _solve_with_recovery_chunked(
     residuals = []
     rs0 = None
     info = None
+    ledger = _new_recovery_ledger()
+
+    def _fold_sdc(counters):
+        _ledger_fold_sdc(ledger, counters)
+
     while done < maxiter:
         try:
+            ledger["attempts"] += 1
             x_new, info = solver(
                 A, b, x0=x, tol=tol, maxiter=min(chunk, maxiter - done),
                 verbose=verbose, **kw,
             )
+            _fold_sdc(info.get("sdc"))
         except SolverHealthError as e:
             failures.append(
                 {"type": type(e).__name__, "message": str(e),
                  "diagnostics": e.diagnostics}
             )
+            _fold_sdc(e.diagnostics.get("sdc"))
             if restarts >= max_restarts:
                 raise
             restarts += 1
+            # the chunked path keeps running from the last completed
+            # chunk's in-memory iterate when no (clean) checkpoint
+            # exists — say so, a test asserting the recovery path must
+            # not read "scratch" for a retained-iterate continue
+            source = {"failure": type(e).__name__, "from": "retained_iterate"}
             if ckpt is not None and ckpt.has_state():
+                from ..parallel.checkpoint import CheckpointCorruptError
+
                 # full ranges: the directory may hold a FULL-state (x,r,p)
                 # checkpoint written by a host run of the same job —
                 # load_checkpoint needs a target range for every object
                 # present (extra entries for absent objects are ignored)
-                st = load_solver_state(
-                    ckpt.directory, _solver_state_ranges(A, b)
-                )
-                x = st["x"]
-                done = int(st["meta"].get("it", done))
+                try:
+                    st = load_solver_state(
+                        ckpt.directory, _solver_state_ranges(A, b)
+                    )
+                except CheckpointCorruptError as ce:
+                    st = None
+                    source["checkpoint_corrupt"] = str(ce)
+                if st is not None:
+                    x = st["x"]
+                    done = int(st["meta"].get("it", done))
+                    source["from"] = "checkpoint_iterate"
+                    source["checkpoint_iteration"] = done
+                    ledger["checkpoint_restarts"] += 1
+            ledger["restart_sources"].append(source)
             print(
                 f"[partitionedarrays_jl_tpu] {method} (chunked): "
                 f"{type(e).__name__}: {e} — restart {restarts}/{max_restarts}",
@@ -2140,4 +2380,5 @@ def _solve_with_recovery_chunked(
     out["restarts"] = restarts
     if failures:
         out["failures"] = failures
+    out["recovery"] = ledger
     return x, out

@@ -12,13 +12,23 @@ solvers' ``x0`` argument this gives restartable Krylov runs.
 Format: one ``.npz`` per object (atomic: written to a temp name then
 renamed), plus a ``manifest.json`` per checkpoint directory naming the
 objects and their kinds.
+
+Bit-rot defense: every written file's CRC32 is recorded in the index it
+is committed under (the sharded formats' generation ``index.json``, the
+directory ``manifest.json`` for whole-object files). Loaders verify the
+CRC before deserializing; the sharded loaders additionally RETAIN the
+previous committed generation on disk and fall back to it when the
+newest one has a truncated or bit-rotted shard, raising the typed
+`CheckpointCorruptError` only when no clean generation exists.
 """
 from __future__ import annotations
 
 import json
 import os
+import sys
 import tempfile
 import threading
+import zlib
 from typing import Dict, Optional, Union
 
 import numpy as np
@@ -28,6 +38,14 @@ from .health import retry_with_backoff
 from .prange import PRange
 from .psparse import PSparseMatrix
 from .pvector import PVector, _owned
+
+
+class CheckpointCorruptError(RuntimeError):
+    """No clean generation of a checkpoint could be read: every retained
+    generation has a missing, truncated, or bit-rotted (CRC-mismatched)
+    file. Deliberately NOT a `SolverHealthError`: retrying the same read
+    cannot help, so the recovery drivers treat it as restart-from-
+    scratch, not restart-from-checkpoint."""
 
 
 def _global_owned(v: PVector) -> np.ndarray:
@@ -40,9 +58,12 @@ def _global_owned(v: PVector) -> np.ndarray:
     return out
 
 
-def save_pvector(path: str, v: PVector) -> None:
-    """Serialize a PVector (owned values by gid) to ``path`` (.npz)."""
-    _atomic_savez(path, kind="pvector", ngids=v.rows.ngids, values=_global_owned(v))
+def save_pvector(path: str, v: PVector) -> int:
+    """Serialize a PVector (owned values by gid) to ``path`` (.npz);
+    returns the file CRC32 (recorded by `save_checkpoint` manifests)."""
+    return _atomic_savez(
+        path, kind="pvector", ngids=v.rows.ngids, values=_global_owned(v)
+    )
 
 
 def load_pvector(path: str, rows: PRange) -> PVector:
@@ -63,10 +84,10 @@ def load_pvector(path: str, rows: PRange) -> PVector:
     return PVector(vals, rows)
 
 
-def save_psparse(path: str, A: PSparseMatrix) -> None:
-    """Serialize a PSparseMatrix as global owned-row COO triplets (.npz).
-    Nonzero ghost-row entries (unassembled contributions) are rejected —
-    call ``A.assemble()`` first."""
+def save_psparse(path: str, A: PSparseMatrix) -> int:
+    """Serialize a PSparseMatrix as global owned-row COO triplets (.npz);
+    returns the file CRC32. Nonzero ghost-row entries (unassembled
+    contributions) are rejected — call ``A.assemble()`` first."""
     from .psparse import psparse_owned_triplets
 
     trip = psparse_owned_triplets(A)
@@ -75,7 +96,7 @@ def save_psparse(path: str, A: PSparseMatrix) -> None:
         gi_all.append(gi)
         gj_all.append(gj)
         v_all.append(v)
-    _atomic_savez(
+    return _atomic_savez(
         path,
         kind="psparse",
         nrows=A.rows.ngids,
@@ -138,10 +159,11 @@ def save_pvector_sharded(directory: str, v: PVector) -> None:
     isets = v.rows.partition.part_values()
     vals = v.values.part_values()
     dtype = None
+    crcs = {}
     for p, (iset, vv) in enumerate(zip(isets, vals)):
         owned = _owned(iset, np.asarray(vv))
         dtype = owned.dtype
-        _atomic_savez(
+        crcs[_shard_name(p, gen)] = _atomic_savez(
             os.path.join(directory, _shard_name(p, gen)),
             kind="pvector_shard",
             gids=np.asarray(iset.oid_to_gid, dtype=np.int64),
@@ -155,6 +177,7 @@ def save_pvector_sharded(directory: str, v: PVector) -> None:
             "nshards": len(isets),
             "gen": gen,
             "dtype": np.dtype(dtype if dtype is not None else v.dtype).name,
+            "shards": crcs,
         },
     )
 
@@ -175,15 +198,16 @@ def load_pvector_sharded(directory: str, rows: PRange) -> PVector:
         raise ValueError(
             f"checkpoint has {idx['ngids']} gids, target PRange {rows.ngids}"
         )
+    g = _select_generation(directory, idx)
     isets = rows.partition.part_values()
-    dtype = np.dtype(idx.get("dtype", "float64"))
+    dtype = np.dtype(g.get("dtype") or "float64")
     out = [np.zeros(i.num_lids, dtype=dtype) for i in isets]
     owner_of = _owner_fn(rows)
-    gen = idx.get("gen")
+    gen = g.get("gen")
     hid_gids = [
         np.asarray(i.lid_to_gid)[np.asarray(i.hid_to_lid)] for i in isets
     ]
-    for s in range(int(idx["nshards"])):
+    for s in range(int(g["nshards"])):
         with np.load(os.path.join(directory, _shard_name(s, gen))) as z:
             gids, values = z["gids"], z["values"]
         # owned routing: one owner split per shard
@@ -223,10 +247,11 @@ def save_psparse_sharded(directory: str, A: PSparseMatrix) -> None:
     os.makedirs(directory, exist_ok=True)
     trip = psparse_owned_triplets(A).part_values()
     dtype = None
+    crcs = {}
     for p, (gi, gj, v) in enumerate(trip):
         v = np.asarray(v)
         dtype = v.dtype
-        _atomic_savez(
+        crcs[_shard_name(p, gen)] = _atomic_savez(
             os.path.join(directory, _shard_name(p, gen)),
             kind="psparse_shard",
             gi=np.asarray(gi, dtype=np.int64),
@@ -242,6 +267,7 @@ def save_psparse_sharded(directory: str, A: PSparseMatrix) -> None:
             "nshards": len(trip),
             "gen": gen,
             "dtype": np.dtype(dtype if dtype is not None else A.dtype).name,
+            "shards": crcs,
         },
     )
 
@@ -260,15 +286,16 @@ def load_psparse_sharded(
         raise ValueError(
             f"checkpoint has {idx['nrows']} rows, target PRange {rows.ngids}"
         )
+    g = _select_generation(directory, idx)
     isets = rows.partition.part_values()
     P = len(isets)
-    dtype = np.dtype(idx.get("dtype", "float64"))
+    dtype = np.dtype(g.get("dtype") or "float64")
     gi_p = [[] for _ in range(P)]
     gj_p = [[] for _ in range(P)]
     v_p = [[] for _ in range(P)]
     owner_of = _owner_fn(rows)
-    gen = idx.get("gen")
-    for s in range(int(idx["nshards"])):
+    gen = g.get("gen")
+    for s in range(int(g["nshards"])):
         with np.load(os.path.join(directory, _shard_name(s, gen))) as z:
             gi, gj, v = z["gi"], z["gj"], z["v"]
         ow = owner_of(gi)
@@ -317,18 +344,110 @@ def _shard_name(p: int, gen: Optional[str]) -> str:
     return f"shard{p:05d}-{gen}.npz" if gen else f"shard{p:05d}.npz"
 
 
+#: Committed generations retained on disk (newest + fallback). The cost
+#: is one extra copy of the object; the payoff is that a bit-rotted or
+#: truncated newest generation degrades to the previous committed state
+#: instead of to nothing.
+KEEP_GENERATIONS = 2
+
+
 def _commit_index(directory: str, idx: dict) -> None:
-    """Atomically publish the new generation, then best-effort remove
-    shards of older generations (their index is gone; a crash between the
-    two steps only leaks orphan files, never corrupts a read)."""
-    _atomic_json(os.path.join(directory, "index.json"), idx)
-    gen = idx["gen"]
+    """Atomically publish the new generation (recording per-shard CRCs
+    and carrying forward the previous generation's entry under
+    ``generations``), then best-effort remove shards of generations that
+    fell off the retention window (their index entry is gone; a crash
+    between the two steps only leaks orphan files, never corrupts a
+    read)."""
+    prev = []
+    ipath = os.path.join(directory, "index.json")
+    if os.path.isfile(ipath):
+        try:
+            with open(ipath) as f:
+                old = json.load(f)
+            if old.get("kind") == idx.get("kind"):
+                prev = old.get("generations") or [
+                    {
+                        k: old[k]
+                        for k in ("gen", "nshards", "dtype", "shards")
+                        if k in old
+                    }
+                ]
+        except (OSError, ValueError):
+            prev = []  # an unreadable old index must not block the commit
+    entry = {
+        k: idx[k] for k in ("gen", "nshards", "dtype", "shards") if k in idx
+    }
+    gens = [entry] + [g for g in prev if g.get("gen") != idx["gen"]]
+    idx["generations"] = gens[:KEEP_GENERATIONS]
+    _atomic_json(ipath, idx)
+    keep = {g["gen"] for g in idx["generations"]}
     for f in os.listdir(directory):
-        if f.startswith("shard") and f.endswith(".npz") and f"-{gen}." not in f:
+        if (
+            f.startswith("shard")
+            and f.endswith(".npz")
+            and not any(f"-{g}." in f for g in keep)
+        ):
             try:
                 os.unlink(os.path.join(directory, f))
             except OSError:
                 pass
+
+
+def _select_generation(directory: str, idx: dict) -> dict:
+    """The newest fully-verifiable generation of a sharded checkpoint:
+    every shard file present and matching its committed CRC32. A
+    truncated or bit-rotted newest generation falls back to the previous
+    retained one (with a stderr note — operators should know their
+    storage is eating data); `CheckpointCorruptError` only when no
+    retained generation is clean. Pre-CRC indexes (no ``shards`` map)
+    verify file presence only.
+
+    Deliberately a SEPARATE pass before any deserialization (each shard
+    is read twice on a clean load): the whole generation must be
+    verified before routing begins, or corruption discovered mid-load
+    would mean restarting the partially-filled restore against the
+    fallback generation — the double read is the price of a simple
+    all-or-nothing generation choice, and the second read hits the page
+    cache."""
+    gens = idx.get("generations")
+    if not gens:
+        gens = [
+            {
+                k: idx.get(k)
+                for k in ("gen", "nshards", "dtype", "shards")
+            }
+        ]
+    bad = {}
+    for rank, g in enumerate(gens):
+        ok = True
+        for s in range(int(g["nshards"])):
+            name = _shard_name(s, g.get("gen"))
+            path = os.path.join(directory, name)
+            if not os.path.isfile(path):
+                bad[str(g.get("gen"))] = f"missing shard {name}"
+                ok = False
+                break
+            want = (g.get("shards") or {}).get(name)
+            if want is not None and _crc_file(path) != int(want):
+                bad[str(g.get("gen"))] = (
+                    f"CRC mismatch on shard {name} (truncated or bit-rotted)"
+                )
+                ok = False
+                break
+        if ok:
+            if rank > 0:
+                print(
+                    f"[partitionedarrays_jl_tpu] checkpoint {directory}: "
+                    f"newest generation unreadable ({bad}); falling back "
+                    f"to previous committed generation {g.get('gen')!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            return g
+    raise CheckpointCorruptError(
+        f"checkpoint {directory}: no clean generation — every retained "
+        f"generation has a missing or corrupted shard: {bad}"
+    )
 
 
 def _read_index(directory: str, kind: str) -> dict:
@@ -397,7 +516,7 @@ def save_checkpoint(
     that never materialize a global array on one host); the manifest is
     written last, so a checkpoint with a readable manifest is complete."""
     os.makedirs(directory, exist_ok=True)
-    manifest = {"meta": meta or {}, "objects": {}}
+    manifest = {"meta": meta or {}, "objects": {}, "crcs": {}}
     if "meta" in objects:
         raise ValueError('the object name "meta" is reserved for checkpoint metadata')
     for name, obj in objects.items():
@@ -416,10 +535,10 @@ def save_checkpoint(
             continue
         p = os.path.join(directory, f"{name}.npz")
         if isinstance(obj, PVector):
-            save_pvector(p, obj)
+            manifest["crcs"][name] = save_pvector(p, obj)
             manifest["objects"][name] = "pvector"
         elif isinstance(obj, PSparseMatrix):
-            save_psparse(p, obj)
+            manifest["crcs"][name] = save_psparse(p, obj)
             manifest["objects"][name] = "psparse"
         else:
             raise TypeError(
@@ -444,11 +563,22 @@ def load_checkpoint(
     out: Dict[str, Union[PVector, PSparseMatrix, dict]] = {
         "meta": manifest["meta"]
     }
+    crcs = manifest.get("crcs") or {}
     for name, kind in manifest["objects"].items():
         if name not in ranges:
             raise ValueError(
                 f"no target PRange given for checkpoint object {name!r}"
             )
+        # whole-object files carry their CRC in the manifest; a mismatch
+        # (truncated / bit-rotted write) is typed, not an np.load crash —
+        # sharded objects verify per shard in _select_generation instead
+        if kind in ("pvector", "psparse") and name in crcs:
+            p = os.path.join(directory, f"{name}.npz")
+            if not os.path.isfile(p) or _crc_file(p) != int(crcs[name]):
+                raise CheckpointCorruptError(
+                    f"checkpoint {directory}: object {name!r} is missing "
+                    "or fails its committed CRC (truncated or bit-rotted)"
+                )
         if kind == "pvector":
             out[name] = load_pvector(
                 os.path.join(directory, f"{name}.npz"), ranges[name]
@@ -471,7 +601,10 @@ def load_checkpoint(
     return out
 
 
-def _atomic_savez(path: str, **arrays) -> None:
+def _atomic_savez(path: str, **arrays) -> int:
+    """Write atomically; returns the committed file's CRC32 (computed
+    from the bytes on disk before the rename, so what the index records
+    is what a clean later read must hash to)."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
@@ -480,12 +613,22 @@ def _atomic_savez(path: str, **arrays) -> None:
         # np.savez(appends .npz to bare paths) — hand it the open file
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
+        crc = _crc_file(tmp)
         _replace_with_retry(
             tmp, path, f"checkpoint write ({os.path.basename(path)})"
         )
+        return crc
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+def _crc_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
 
 
 # ---------------------------------------------------------------------------

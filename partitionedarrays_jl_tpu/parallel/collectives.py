@@ -190,6 +190,94 @@ def xscan_all(op, a: AbstractPData, init, with_total: bool = False):
 # ---------------------------------------------------------------------------
 
 
+def _slab_checksums(data_snd: AbstractPData):
+    """Sender-side ABFT checksums: per part, the (sum, abs-sum) of every
+    per-neighbor slab about to go on the wire — computed BEFORE the
+    chaos hook (i.e. before the wire), so wire corruption of any kind is
+    caught by the receiver-side verify. Returns None for non-float or
+    non-Table payloads (plan/count exchanges are exact integers and are
+    verified by the plan consistency checks instead).
+
+    One scalar checksum per slab, summing EVERY word of the slab — a
+    trailing multi-RHS axis (an (L, K) block payload) folds into its
+    slot's total, matching the receiver's whole-slab sum. Row totals
+    come from a cumulative sum rather than ``np.add.reduceat``, whose
+    empty-row semantics misindex when the empty slab is last."""
+    vals = data_snd.part_values()
+    if not vals or not isinstance(vals[0], Table):
+        return None
+    sums = []
+    for t in vals:
+        data = np.asarray(t.data)
+        if data.dtype.kind != "f":
+            return None
+        ptrs = np.asarray(t.ptrs, dtype=np.int64)
+        acc = np.asarray(data, dtype=np.float64)
+        if acc.ndim > 1:
+            # (slots, K, ...) block slab: fold trailing axes into the
+            # slot totals (axis-sum, not reshape — an EMPTY slab has no
+            # valid (0, -1) reshape)
+            tail_axes = tuple(range(1, acc.ndim))
+            per_slot = acc.sum(axis=tail_axes)
+            per_slot_abs = np.abs(acc).sum(axis=tail_axes)
+        else:
+            per_slot = acc
+            per_slot_abs = np.abs(acc)
+        c = np.concatenate([[0.0], np.cumsum(per_slot)])
+        ca = np.concatenate([[0.0], np.cumsum(per_slot_abs)])
+        sums.append((c[ptrs[1:]] - c[ptrs[:-1]], ca[ptrs[1:]] - ca[ptrs[:-1]]))
+    return sums
+
+
+def _verify_slab_checksums(data_rcv, parts_rcv, parts_snd, sums, tol):
+    """Receiver-side verify: every received slab's sum must match what
+    its sender computed before the wire, to checksum-rounding tolerance.
+    Raises `SilentCorruptionError` naming receiver, sender, and delta —
+    NaN deltas (a NaN-poisoned slab) fail the comparison too."""
+    from .health import SilentCorruptionError
+
+    # sender q's row i targets parts_snd[q][i]
+    sent = {}
+    for q, nbrs in enumerate(parts_snd.part_values()):
+        for i, p in enumerate(np.asarray(nbrs)):
+            sent[(q, int(p))] = (sums[q][0][i], sums[q][1][i])
+    bad = []
+    for p, (buf, nbrs) in enumerate(
+        zip(data_rcv.part_values(), parts_rcv.part_values())
+    ):
+        if not isinstance(buf, Table):
+            continue
+        ptrs = np.asarray(buf.ptrs, dtype=np.int64)
+        data = np.asarray(buf.data, dtype=np.float64)
+        for j, q in enumerate(np.asarray(nbrs)):
+            expect, scale = sent.get((int(q), p), (None, None))
+            if expect is None:
+                continue
+            # whole-slab sum, matching the sender (a trailing multi-RHS
+            # axis folds into the slot totals on both sides)
+            got = float(data[ptrs[j]: ptrs[j + 1]].sum())
+            thresh = tol * max(1.0, float(scale))
+            if not (abs(got - expect) <= thresh):  # NaN-safe: NaN fails <=
+                bad.append(
+                    {
+                        "part": int(p),
+                        "from_part": int(q),
+                        "sent_checksum": float(expect),
+                        "received_checksum": got,
+                        "threshold": thresh,
+                    }
+                )
+    if bad:
+        raise SilentCorruptionError(
+            "exchange: ABFT slab checksum mismatch on "
+            f"{len(bad)} received slab(s) (first: part "
+            f"{bad[0]['part']} from part {bad[0]['from_part']}) — the "
+            "payload was corrupted between sender pack and receiver "
+            "unpack",
+            diagnostics={"slabs": bad, "detector": "exchange_checksum"},
+        )
+
+
 def async_exchange_into(
     data_rcv: AbstractPData,
     data_snd: AbstractPData,
@@ -207,13 +295,45 @@ def async_exchange_into(
     before the wire copy, and a `drop` clause turns the returned tokens
     into the timeout path — waiting on them raises
     `ExchangeTimeoutError` naming the missing senders. With no active
-    fault spec (the default) the only overhead is one boolean check."""
-    from .faults import exchange_faults_hook, faults_active
+    fault spec (the default) the only overhead is one boolean check.
 
+    Being the choke point also makes it the ABFT seam: under
+    ``PA_TPU_ABFT=1`` every float slab's checksum is computed at the
+    sender BEFORE the wire (i.e. before the chaos hook) and verified on
+    the receiver at wait time — a FINITE wire corruption (bitflip) that
+    the finiteness guards cannot see raises a typed
+    `SilentCorruptionError` at the exchange itself (the earliest
+    possible detection point; the compiled device loops get the same
+    property from their in-graph per-round slab checksums)."""
+    from .faults import exchange_faults_hook, faults_active
+    from .health import abft_enabled
+
+    checksums = None
+    if abft_enabled():
+        checksums = _slab_checksums(data_snd)
     dropped = None
     if faults_active():
         data_snd, dropped = exchange_faults_hook(data_snd, parts_snd)
     t = data_snd._async_exchange(data_rcv, parts_rcv, parts_snd)
+    if checksums is not None:
+        from .health import abft_tolerance
+
+        dt = np.asarray(get_main_part(data_snd).data).dtype
+        tol = abft_tolerance(dt)
+        done = [False]  # verify once, on the first token waited on
+
+        def _verified(tok: Token):
+            def _wait():
+                tok.wait()
+                if not done[0]:
+                    done[0] = True
+                    _verify_slab_checksums(
+                        data_rcv, parts_rcv, parts_snd, checksums, tol
+                    )
+
+            return Token(wait_fn=_wait)
+
+        t = map_parts(_verified, t)
     if dropped:
         from .health import ExchangeTimeoutError
 

@@ -37,7 +37,13 @@ def _validate_rcv_finite(data_rcv: AbstractPData, exchanger: "Exchanger"):
     RECEIVED halo payload must be finite, and a violation is reported
     with the receiving part, the sending neighbor, and the entry count —
     the earliest possible detection point for a NaN-poisoned exchange
-    (the solvers' free scalar guards catch it one reduction later)."""
+    (the solvers' free scalar guards catch it one reduction later).
+
+    This guard only sees NON-finite corruption; the complementary
+    defense against FINITE corruption (a mantissa bitflip) is the ABFT
+    slab checksum at the `collectives.async_exchange_into` choke point
+    (``PA_TPU_ABFT=1``), which verifies every received slab's sum
+    against what the sender computed before the wire."""
     bad = {}
     for p, (buf, nbrs) in enumerate(
         zip(data_rcv.part_values(), exchanger.parts_rcv.part_values())

@@ -35,6 +35,13 @@ Spec grammar — ``;``-separated clauses, each ``kind@key=val,key=val``:
     after   fire at every call index >= this value
     prob    per-entry corruption probability for nan/bitflip (default 1.0;
             at least one entry is corrupted when the payload is nonempty)
+    bit     exact bit index to flip for `bitflip` (counted from the
+            mantissa LSB; default: a random bit in the low 20 — small,
+            truly silent perturbations. High indices model the DANGEROUS
+            silent corruptions: for f64, ``bit=51`` flips the mantissa
+            MSB, a ~0.5 relative error that stays finite. Interpreted
+            modulo the payload word width, so an f64-written spec stays
+            a real flip on an f32 payload instead of a silent no-op)
     seconds delay duration for `delay` (default 0.01)
 
 Examples::
@@ -46,6 +53,21 @@ Examples::
 Determinism: one `numpy` Generator seeded from the spec seed drives all
 entry selection; the sequential backend executes parts in order, so a
 given (spec, seed, program) corrupts identical bits on every run.
+
+Entry selection is SHAPE-POLYMORPHIC over a trailing multi-RHS batch
+axis and seed-stable across K: for an ``(L, K)`` block slab (the PR-3
+(…, K) exchange payloads) the random draws run over the L wire SLOTS
+only — the same slots are corrupted for any K, and the flip hits the
+same single word of each selected slot (column 0), exactly what the
+K=1 payload of the same spec corrupts (pinned by tests/test_faults.py).
+
+The compiled device loops cannot be reached through the host exchange
+hook; their chaos seam is ``PA_FAULT_DEVICE`` (`device_fault_clause`):
+``spmv@trip=N[,part=P][,factor=F]`` corrupts the SpMV product's first
+owned slot at while-loop trip N (on part P, by a finite perturbation of
+relative size F) inside the compiled program — read at program BUILD
+time, and active only when the SDC layer (PA_TPU_ABFT /
+PA_HEALTH_AUDIT_EVERY) is on, since only that layer can see it.
 """
 from __future__ import annotations
 
@@ -67,6 +89,7 @@ __all__ = [
     "inject_faults",
     "faults_active",
     "active_fault_state",
+    "device_fault_clause",
 ]
 
 _KINDS = ("nan", "bitflip", "drop", "delay", "controller")
@@ -79,6 +102,7 @@ class FaultClause:
     call: Optional[int] = None  # None = every call (unless `after` set)
     after: Optional[int] = None  # fire at every call >= after
     prob: float = 1.0
+    bit: Optional[int] = None  # exact mantissa bit for bitflip
     seconds: float = 0.01
 
     def matches(self, call: int, part: Optional[int] = None) -> bool:
@@ -121,7 +145,7 @@ class FaultSpec:
                     )
                 key = key.strip().lower()
                 val = val.strip()
-                if key in ("part", "call", "after"):
+                if key in ("part", "call", "after", "bit"):
                     kw[key] = None if val == "*" else int(val)
                 elif key == "prob":
                     kw[key] = float(val)
@@ -208,22 +232,49 @@ def faults_active() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _corrupt_array(a: np.ndarray, kind: str, prob: float, rng) -> int:
-    """In-place corruption of a float payload; returns #entries hit."""
+def _corrupt_array(a: np.ndarray, kind: str, prob: float, rng,
+                   bit: Optional[int] = None) -> int:
+    """In-place corruption of a float payload; returns #slots hit.
+
+    Shape-polymorphic over a trailing multi-RHS batch axis, seed-stable
+    across K: every random draw runs over the LEADING axis (the wire
+    slots), so an ``(L, K)`` block slab consumes exactly the draws of
+    the ``(L,)`` payload of the same spec — the same slots are selected
+    for any K — and the corruption hits the same single word of each
+    selected slot (column 0 of the trailing axes)."""
     if a.size == 0 or a.dtype.kind != "f":
         return 0
-    mask = rng.random(a.size) < prob
+    nslots = a.shape[0]
+    mask = rng.random(nslots) < prob
     if not mask.any():
-        mask[int(rng.integers(a.size))] = True  # nonempty payload: >= 1 hit
+        mask[int(rng.integers(nslots))] = True  # nonempty payload: >= 1 hit
     idx = np.nonzero(mask)[0]
+    # a 2-D (slots, K) slab corrupts each selected slot's FIRST word —
+    # one flipped wire word per slot, identical to the K=1 payload
+    flat = a.reshape(nslots, -1)
     if kind == "nan":
-        a[idx] = np.nan
-    else:  # bitflip: XOR one mantissa bit per selected entry
-        bits = a.view(np.uint64 if a.dtype.itemsize == 8 else np.uint32)
-        shift = rng.integers(0, 20, size=len(idx))
-        bits[idx] ^= (np.uint64(1) << shift.astype(np.uint64)) if a.dtype.itemsize == 8 else (
-            np.uint32(1) << shift.astype(np.uint32)
+        flat[idx, 0] = np.nan
+        return int(len(idx))
+    # bitflip: XOR one mantissa bit per selected slot (`bit` pins it;
+    # the default random low-20 draw models tiny, truly silent flips)
+    bits = flat[:, 0].copy().view(
+        np.uint64 if a.dtype.itemsize == 8 else np.uint32
+    )
+    if bit is not None:
+        # modulo the word width: an out-of-range index would shift the
+        # flip mask to 0 — a no-op the event log would still report as
+        # corruption (false confidence the detector was exercised)
+        shift = np.full(
+            len(idx), int(bit) % (8 * a.dtype.itemsize), dtype=np.int64
         )
+    else:
+        shift = rng.integers(0, 20, size=len(idx))
+    bits[idx] ^= (
+        np.uint64(1) << shift.astype(np.uint64)
+        if a.dtype.itemsize == 8
+        else np.uint32(1) << shift.astype(np.uint32)
+    )
+    flat[:, 0] = bits.view(a.dtype)
     return int(len(idx))
 
 
@@ -295,7 +346,7 @@ def exchange_faults_hook(data_snd, parts_snd):
                 arr = np.array(payload, copy=True)
                 out = arr
             for c in hits:
-                n = _corrupt_array(arr, c.kind, c.prob, rng)
+                n = _corrupt_array(arr, c.kind, c.prob, rng, bit=c.bit)
                 if n:
                     rec(kind=c.kind, call=call, part=int(p), entries=n)
             return out
@@ -303,3 +354,47 @@ def exchange_faults_hook(data_snd, parts_snd):
         data_snd = map_parts(_corrupt_part, get_part_ids(data_snd), data_snd)
 
     return data_snd, (dropped or None)
+
+
+# ---------------------------------------------------------------------------
+# device-graph injection (the compiled-loop chaos seam)
+# ---------------------------------------------------------------------------
+
+
+def device_fault_clause() -> Optional[dict]:
+    """Parse ``PA_FAULT_DEVICE`` — the chaos seam for the COMPILED
+    solver loops, which the host exchange hook cannot reach (their
+    exchanges are in-graph ppermutes). Grammar: one clause
+    ``spmv@trip=N[,part=P][,factor=F]`` — at while-loop trip N (a
+    monotone counter that never replays, so the clause is one-shot even
+    across rollbacks), on part P (default 0), the SpMV product's first
+    owned slot is perturbed by a FINITE relative error of size F
+    (default 1e3) inside the compiled program. Read at program build
+    time; `make_cg_fn`/`make_block_cg_fn` stage it only when the SDC
+    layer is active (it exists to exercise the in-graph ABFT
+    detection/rollback path deterministically)."""
+    text = os.environ.get("PA_FAULT_DEVICE")
+    if not text:
+        return None
+    kind, _, rest = text.strip().partition("@")
+    if kind.strip().lower() != "spmv":
+        raise ValueError(
+            f"PA_FAULT_DEVICE: unknown kind {kind!r} (expected 'spmv')"
+        )
+    out = {"trip": None, "part": 0, "factor": 1e3}
+    for item in filter(None, (s.strip() for s in rest.split(","))):
+        key, eq, val = item.partition("=")
+        if not eq:
+            raise ValueError(f"PA_FAULT_DEVICE: expected key=value, got {item!r}")
+        key = key.strip().lower()
+        if key == "trip":
+            out["trip"] = int(val)
+        elif key == "part":
+            out["part"] = int(val)
+        elif key == "factor":
+            out["factor"] = float(val)
+        else:
+            raise ValueError(f"PA_FAULT_DEVICE: unknown key {key!r}")
+    if out["trip"] is None:
+        raise ValueError("PA_FAULT_DEVICE: a trip=N index is required")
+    return out

@@ -40,6 +40,28 @@ Env knobs (all read dynamically so tests can toggle them):
   residual must improve below FACTOR x the previous best.
 * ``PA_RETRY_ATTEMPTS`` (default 3) / ``PA_RETRY_BACKOFF`` (default
   0.5, seconds, doubling, capped at 30) — `retry_with_backoff` defaults.
+
+Silent-corruption (SDC) defense knobs — the layer that catches what the
+finiteness guards cannot (a FINITE bitflip sails straight through
+``jnp.isfinite``):
+
+* ``PA_TPU_ABFT=1`` — algorithm-based fault tolerance: checksummed halo
+  exchanges (sender-side per-slab sums verified on receipt) and, on the
+  device backend, the in-graph ``c·(A x)`` vs ``(c·A)·x`` SpMV checksum
+  whose scalars ride the existing dot all_gather (default: off).
+* ``PA_HEALTH_AUDIT_EVERY`` — recompute the TRUE residual ``b - A x``
+  every N solver iterations and cross-check it against the recurrence
+  residual (catches drift the per-op checksums miss). Default: 32 when
+  ABFT is on, 0 (off) otherwise.
+* ``PA_HEALTH_MAX_ROLLBACKS`` (default 3) — in-memory rollbacks allowed
+  per solve before the detection escalates (raises
+  `SilentCorruptionError`, which `solve_with_recovery` treats as
+  survivable-by-checkpoint-restart).
+* ``PA_HEALTH_ROLLBACK_DEPTH`` (default 2) — ring depth R of retained
+  audited recurrence states (R·3 vectors).
+* ``PA_TPU_ABFT_TOL`` / ``PA_HEALTH_AUDIT_TOL`` — relative detection
+  thresholds; default dtype-scaled (see `abft_tolerance` /
+  `audit_tolerance`).
 """
 from __future__ import annotations
 
@@ -57,9 +79,17 @@ __all__ = [
     "SolverStagnationError",
     "ExchangeTimeoutError",
     "ControllerLostError",
+    "SilentCorruptionError",
     "health_enabled",
     "exchange_validation_enabled",
     "stagnation_raises",
+    "abft_enabled",
+    "audit_every",
+    "max_rollbacks",
+    "rollback_depth",
+    "abft_tolerance",
+    "audit_tolerance",
+    "RollbackRing",
     "StagnationDetector",
     "check_finite_scalar",
     "check_finite_pvector",
@@ -113,6 +143,19 @@ class ControllerLostError(SolverHealthError):
     fault clause; multi-host runs: surfaced by the runtime)."""
 
 
+class SilentCorruptionError(SolverHealthError):
+    """FINITE data corruption detected by the SDC defense layer — an
+    ABFT checksum mismatch (exchange slab or SpMV ``c·(A x)`` vs
+    ``(c·A)·x``) or a true-residual audit failure. The finiteness guards
+    cannot see this class of fault: a mantissa bitflip stays finite and
+    the recurrence "converges" to a wrong answer. Raised either at the
+    detection site (exchange verification) or after the in-memory
+    rollback budget (``PA_HEALTH_MAX_ROLLBACKS``) is exhausted, in which
+    case ``diagnostics["sdc"]`` carries the detection/rollback counters.
+    Subclasses `SolverHealthError`, so `solve_with_recovery` escalates
+    it to a checkpoint restart."""
+
+
 # ---------------------------------------------------------------------------
 # knobs
 # ---------------------------------------------------------------------------
@@ -136,6 +179,53 @@ def _stagnation_window() -> int:
 
 def _stagnation_factor() -> float:
     return float(os.environ.get("PA_HEALTH_STAGNATION_FACTOR", "0.99"))
+
+
+def abft_enabled() -> bool:
+    """Algorithm-based fault tolerance: checksummed exchanges + in-graph
+    SpMV checksums (``PA_TPU_ABFT=1``, default off — it is the opt-in
+    defense against FINITE corruption the isfinite guards cannot see)."""
+    return os.environ.get("PA_TPU_ABFT", "0") == "1"
+
+
+def audit_every() -> int:
+    """True-residual audit period in solver iterations; 0 disables.
+    Defaults to 32 under ABFT (the audit is the drift detector the
+    per-op checksums need as a backstop), 0 otherwise."""
+    v = os.environ.get("PA_HEALTH_AUDIT_EVERY")
+    if v is None or v == "":
+        return 32 if abft_enabled() else 0
+    return max(0, int(v))
+
+
+def max_rollbacks() -> int:
+    """In-memory rollbacks allowed per solve before escalating."""
+    return max(0, int(os.environ.get("PA_HEALTH_MAX_ROLLBACKS", "3")))
+
+
+def rollback_depth() -> int:
+    """Ring depth R of retained audited recurrence states."""
+    return max(1, int(os.environ.get("PA_HEALTH_ROLLBACK_DEPTH", "2")))
+
+
+def abft_tolerance(dtype) -> float:
+    """Relative ABFT checksum threshold: |Δ| > tol·scale is corruption.
+    The checksum sums accumulate rounding ~ O(n)·eps·Σ|terms|, so the
+    default leaves headroom above the dtype's eps; corruption below it
+    is by construction within the solve's own rounding noise."""
+    v = os.environ.get("PA_TPU_ABFT_TOL")
+    if v:
+        return float(v)
+    return 1e-3 if np.dtype(dtype).itemsize <= 4 else 1e-10
+
+
+def audit_tolerance(dtype) -> float:
+    """Relative true-residual drift threshold: ||(b - A x) - r|| >
+    tol·max(1, ||r0||) fails the audit."""
+    v = os.environ.get("PA_HEALTH_AUDIT_TOL")
+    if v:
+        return float(v)
+    return 1e-3 if np.dtype(dtype).itemsize <= 4 else 1e-8
 
 
 # ---------------------------------------------------------------------------
@@ -233,6 +323,46 @@ class StagnationDetector:
                     "window": self.window,
                 },
             )
+
+
+class RollbackRing:
+    """Bounded in-memory ring of the last R AUDITED solver recurrence
+    states — the no-disk recovery tier of the SDC defense: a detected
+    corruption rewinds at most ``audit_every`` iterations by restoring
+    the newest ring entry, escalating to `solve_with_recovery`'s
+    checkpoint restart only after ``PA_HEALTH_MAX_ROLLBACKS`` strikes.
+
+    Entries are ``(vectors, meta)``: deep copies of the recurrence
+    vectors (host PVectors here; the compiled device loops carry the
+    same ring as an (R, 3, W) array in their while-loop state) plus the
+    scalar recurrence state. ``push`` is called ONLY on states that just
+    passed a true-residual audit (plus the initial state, audited by
+    construction), so every ring entry is known-good.
+
+    ``restore(strike)`` returns the entry ``strike`` slots back
+    (clamped): consecutive failed replays walk to older states, bounding
+    a corruption that survives the newest snapshot."""
+
+    def __init__(self, depth: Optional[int] = None):
+        self.depth = depth if depth is not None else rollback_depth()
+        self._ring: list = []  # newest first
+
+    def push(self, vectors: dict, meta: dict) -> None:
+        entry = ({k: v.copy() for k, v in vectors.items()}, dict(meta))
+        self._ring.insert(0, entry)
+        del self._ring[self.depth:]
+
+    def restore(self, strike: int = 0):
+        """The entry ``strike`` slots back (clamped to the oldest), as
+        ``(vectors, meta)`` fresh copies — or None when the ring is
+        empty (the caller then restarts from scratch/escalates)."""
+        if not self._ring:
+            return None
+        vecs, meta = self._ring[min(max(0, strike), len(self._ring) - 1)]
+        return {k: v.copy() for k, v in vecs.items()}, dict(meta)
+
+    def __len__(self):
+        return len(self._ring)
 
 
 # ---------------------------------------------------------------------------

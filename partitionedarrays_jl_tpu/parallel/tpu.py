@@ -399,7 +399,7 @@ class DeviceExchangePlan:
         self.perms = tuple(self.perms)
 
 
-def _shard_exchange(plan, combine: str):
+def _shard_exchange(plan, combine: str, abft: bool = False):
     """Per-shard halo exchange body (used inside shard_map): R static
     `ppermute` rounds. `combine='set'` for owner->ghost halo updates,
     `'add'` for ghost->owner assembly scatter-accumulation (which, like the
@@ -413,18 +413,29 @@ def _shard_exchange(plan, combine: str):
     single vector or ``(W, K)`` for a multi-RHS block — slot indexing
     stays on the leading axis, so one wire round ships all K columns of
     a slot at once (the node-aware amortization of arxiv 1612.08060:
-    the latency/coloring cost of a round is paid once per K columns)."""
+    the latency/coloring cost of a round is paid once per K columns).
+
+    ``abft=True`` (generic plan only — ABFT mode pins the generic plan,
+    see `_box_exchange_enabled`) returns the checksummed variant
+    ``body(...) -> (xv, delta, scale)``: each round's permuted payload
+    carries ONE extra slot holding the sender-side slab sum, and the
+    receiver accumulates ``|Σ received - shipped sum|`` into ``delta``
+    (per column for a block operand). Zero extra collectives — the same
+    R ppermutes, each one slot wider; the deltas then ride the CG dot's
+    existing all_gather (`_pdot_extra_factory`)."""
     import jax
     import jax.numpy as jnp
 
     from .tpu_box import BoxExchangePlan, shard_box_exchange
 
     if isinstance(plan, BoxExchangePlan):
+        check(not abft, "ABFT exchange checksums require the generic plan")
         return shard_box_exchange(plan, combine)
 
     R = plan.R
     perms = plan.perms
     g0 = plan.layout.g0
+    L = plan.snd_idx.shape[-1]
 
     def body(xv, si, sm, ri):
         for r in range(R):
@@ -441,7 +452,33 @@ def _shard_exchange(plan, combine: str):
             xv = xv.at[g0:].set(0)  # ghost contributions now live on owners
         return xv
 
-    return body
+    if not abft:
+        return body
+
+    def body_abft(xv, si, sm, ri):
+        # delta/scale follow the operand rank: () or per-column (K,)
+        delta = jnp.zeros(xv.shape[1:], dtype=xv.dtype)
+        scale = jnp.zeros(xv.shape[1:], dtype=xv.dtype)
+        for r in range(R):
+            mask = sm[r].reshape(sm[r].shape + (1,) * (xv.ndim - 1))
+            buf = jnp.where(mask, xv[si[r]], 0)
+            cs = jnp.sum(buf, axis=0, keepdims=True)
+            payload = jax.lax.ppermute(
+                jnp.concatenate([buf, cs], axis=0), "parts", perm=perms[r]
+            )
+            buf, rcs = payload[:L], payload[L]
+            delta = delta + jnp.abs(jnp.sum(buf, axis=0) - rcs)
+            scale = scale + jnp.sum(jnp.abs(buf), axis=0) + jnp.abs(rcs)
+            if combine == "add":
+                xv = xv.at[ri[r]].add(buf)
+            else:
+                xv = xv.at[ri[r]].set(buf)
+            xv = xv.at[plan.layout.trash].set(0)
+        if combine == "add":
+            xv = xv.at[g0:].set(0)
+        return xv, delta, scale
+
+    return body_abft
 
 
 class DeviceVector:
@@ -508,8 +545,18 @@ def _box_exchange_enabled() -> bool:
     """The slice-based box exchange (tpu_box.py), default ON. Strict-bits
     keeps the generic plan: the box 'add' path accumulates ghost
     contributions in direction order, not the host assemble's edge
-    order, so its bits can differ on multiply-received cells."""
-    return os.environ.get("PA_TPU_BOX", "1") != "0" and not strict_bits()
+    order, so its bits can differ on multiply-received cells. ABFT mode
+    also keeps the generic plan this round — its per-round slab
+    checksums are implemented on the index-plan body (the box slices
+    would need per-variant checksum lanes; same precedent as
+    strict-bits, noted in docs/resilience.md)."""
+    from .health import abft_enabled
+
+    return (
+        os.environ.get("PA_TPU_BOX", "1") != "0"
+        and not strict_bits()
+        and not abft_enabled()
+    )
 
 
 def _fused_cg_enabled() -> bool:
@@ -532,6 +579,71 @@ def _resolve_fused(fused, pipelined: bool) -> bool:
     if fused is None:
         return _fused_cg_enabled() and not pipelined
     return bool(fused)
+
+
+def _sdc_config(maxiter: int) -> Optional[dict]:
+    """Build-time resolution of the in-graph SDC defense for the
+    compiled CG bodies — None when inactive (``PA_TPU_ABFT`` off and no
+    audit period), in which case the builders emit exactly the pre-SDC
+    program. Active config carries: ``abft`` (checksum lanes on),
+    ``ae`` (audit period in real iterations), ``R``/``mrb`` (ring depth
+    and rollback budget), the graph-injection clause (`PA_FAULT_DEVICE`,
+    the compiled loop's chaos seam), and ``trip_max`` — the static bound
+    on while-loop trips: real iterations + audit stall-trips + the
+    worst-case replay budget of ``mrb`` rollbacks (each rewinds at most
+    R·ae iterations, or to the start when audits are off)."""
+    from .faults import device_fault_clause
+    from .health import audit_every, max_rollbacks, rollback_depth
+
+    abft = _abft_enabled()
+    ae = audit_every()
+    if not abft and ae <= 0:
+        return None
+    R = rollback_depth()
+    mrb = max_rollbacks()
+    fault = device_fault_clause()
+    audits = (maxiter // ae + 2) if ae > 0 else 0
+    replay = (R * ae + 2) if ae > 0 else maxiter + 1
+    return {
+        "abft": abft,
+        "ae": ae,
+        "R": R,
+        "mrb": mrb,
+        "fault": fault,
+        # clamped: the trip counter is an int32 loop carry
+        "trip_max": int(
+            min(maxiter + audits + (mrb + 1) * replay, 2**31 - 1)
+        ),
+        # tolerance env strings join the program cache key so an
+        # override retraces instead of serving a stale threshold
+        "key": (
+            abft, ae, R, mrb,
+            os.environ.get("PA_TPU_ABFT_TOL", ""),
+            os.environ.get("PA_HEALTH_AUDIT_TOL", ""),
+            tuple(sorted(fault.items())) if fault else None,
+        ),
+    }
+
+
+def _sdc_tolerances(dtype, P: int, no_max: int):
+    """Trace-time detection thresholds. The SpMV checksum compares two
+    n-term f.p. sums, whose rounding grows ~ sqrt(n)·eps of the term
+    magnitude — the relative threshold scales with sqrt(P·no_max) (100x
+    headroom; ``PA_TPU_ABFT_TOL`` overrides with an absolute relative
+    threshold). Corruption below it is inside the solve's own rounding
+    noise — the audit tier catches what accumulates, and what never
+    accumulates was harmless. The audit threshold is the host
+    `audit_tolerance` (drift relative to the initial residual norm)."""
+    from .health import audit_tolerance
+
+    v = os.environ.get("PA_TPU_ABFT_TOL")
+    if v:
+        cs_tol = float(v)
+    else:
+        cs_tol = 100.0 * float(np.finfo(np.dtype(dtype)).eps) * float(
+            np.sqrt(max(1, P * no_max))
+        )
+    return cs_tol, audit_tolerance(dtype)
 
 
 class ELLFootprintError(RuntimeError):
@@ -643,6 +755,7 @@ class DeviceMatrix:
         "bsr_cols", "bsr_vals", "bsr_bs",
         "sd_idx", "sd_vals", "sd_g", "sd_bs",
         "ohb_rows", "ohb_cols", "ohb_vals", "ohb_bs",
+        "abft_w",
         "rows", "cols", "row_layout", "col_layout", "col_plan", "backend",
         "padded", "flops_per_spmv", "_cg_cache", "_ops_cache",
     )
@@ -870,6 +983,23 @@ class DeviceMatrix:
             self.oh_cols = _stage(backend, oh_cols, P)
             self.oh_rows = _stage(backend, oh_rows, P)
 
+        # ABFT checksum row: w = 1ᵀA per part over the local COLUMN
+        # frame, precomputed once per lowering — the compiled CG then
+        # verifies c·(A x) against (c·A)·x = w·x each iteration with two
+        # reduction lanes that ride the existing dot all_gather
+        # (_pdot_extra_factory). Staged in f64 when available: the
+        # checksum's own rounding is the detection floor.
+        self.abft_w = None
+        if _abft_enabled():
+            wdt = np.float64 if jax.config.jax_enable_x64 else dt
+            self.abft_w = _stage(
+                backend,
+                self._abft_checksum_row(
+                    A, oo, oh, full, P, noids, col_layout
+                ).astype(wdt),
+                P,
+            )
+
         self.dia_mode = None
         self.dia_offsets = None
         self.pallas_plan = None
@@ -1020,6 +1150,63 @@ class DeviceMatrix:
             else:
                 dia_stage = dia
             self.dia_vals = _stage(backend, dia_stage.astype(dt), P)
+
+    @staticmethod
+    def _abft_checksum_row(A, oo, oh, full, P, noids, col_layout):
+        """Per-part column sums of the owned-row block, placed at their
+        frame slots: ``w[p, slot(j)] = Σ_i A_p[i, j]`` over part p's
+        owned rows i — the staged ``(c·A)`` row of the ABFT identity
+        ``c·(A x) == (c·A)·x`` with c the all-ones vector. Works off
+        whichever host form this lowering kept: the oo/oh owned/ghost
+        block split (oid-/hid-indexed columns), or the no-split full
+        local CSRs (lid columns, mapped through the cols IndexSet so
+        non-owned-first layouts stay correct). Accumulated in f64: the
+        row is computed once, its accuracy bounds the detection floor."""
+        W = col_layout.W
+        w = np.zeros((P, W), dtype=np.float64)
+        col_isets = A.cols.partition.part_values()
+        for p in range(P):
+            iset = col_isets[p]
+            if oo is not None:
+                M = oo[p]
+                if M.nnz:
+                    w[p, col_layout.o0 : col_layout.o0 + M.shape[1]] += (
+                        np.bincount(
+                            M.indices,
+                            weights=M.data.astype(np.float64),
+                            minlength=M.shape[1],
+                        )
+                    )
+                Mh = oh[p]
+                if Mh.nnz:
+                    np.add.at(
+                        w[p],
+                        col_layout.hid_slots[p],
+                        np.bincount(
+                            Mh.indices,
+                            weights=Mh.data.astype(np.float64),
+                            minlength=len(col_layout.hid_slots[p]),
+                        ),
+                    )
+            else:
+                M = full[p]  # owned rows only (the no-split invariant)
+                if not M.nnz:
+                    continue
+                lid2slot = np.full(iset.num_lids, col_layout.trash)
+                lid2slot[np.asarray(iset.oid_to_lid)] = (
+                    col_layout.o0 + np.arange(iset.num_oids)
+                )
+                lid2slot[np.asarray(iset.hid_to_lid)] = col_layout.hid_slots[p]
+                colsum = np.bincount(
+                    M.indices,
+                    weights=M.data.astype(np.float64),
+                    minlength=iset.num_lids,
+                )
+                np.add.at(w[p], lid2slot, colsum)
+        # the trash slot absorbs masked scatter lanes and must stay an
+        # exact zero in every staged operand
+        w[:, col_layout.trash] = 0.0
+        return w
 
     #: Node rows per supernode group of the SD lowering (the MXU tile's
     #: row extent is G*bs = 192 at bs=3 — a multiple of the 128x128 MXU
@@ -1631,7 +1818,17 @@ def _lowering_env_key() -> tuple:
         # bench tooling therefore A/Bs via make_cg_fn(fused=...), not
         # the env var.
         _fused_cg_enabled(),
+        # ABFT changes the lowering twice over: the staged checksum row
+        # (c·A) joins the operand pytree, and the exchange falls back to
+        # the generic index plan (see _box_exchange_enabled)
+        _abft_enabled(),
     )
+
+
+def _abft_enabled() -> bool:
+    from .health import abft_enabled
+
+    return abft_enabled()
 
 
 def device_matrix(A: PSparseMatrix, backend: TPUBackend) -> DeviceMatrix:
@@ -1799,6 +1996,55 @@ def _pdot_owned_factory(no_max: int):
     return dot1, dot2
 
 
+def _pdot_extra_factory(o0: int, no_max: int):
+    """The deterministic dot with EXTRA scalar lanes riding the SAME
+    all_gather — the ABFT/audit transport: ``pdotx(a, b, extras)``
+    returns ``(a·b, folded extras)`` where ``extras`` is a tuple of
+    per-part partials (checksum delta/scale) stacked into the gather
+    payload as additional trailing lanes and summed across parts.
+
+    Lane 0's partial and cross-part fold arithmetic is EXACTLY
+    `_pdot_factory`'s (strict mode: the same fixed-tree pairwise partial
+    and explicit left fold, per lane), so carrying the extras widens the
+    collective's payload bytes, never its count, and never moves the
+    dot's bits — the property the ABFT-on/off bitwise identity test
+    pins. Rank-polymorphic like the other factories: ``(no_max, K)``
+    operands with ``(K,)`` extras produce per-column results."""
+    import jax
+    import jax.numpy as jnp
+
+    if strict_bits():
+
+        def pdotx(a, b, extras):
+            t = _strict_rounded_product(
+                a[o0 : o0 + no_max] * b[o0 : o0 + no_max]
+            )
+            p0 = _strict_partial_any(t, no_max)
+            lanes = [p0] + [
+                jnp.broadcast_to(e, p0.shape).astype(p0.dtype) for e in extras
+            ]
+            allp = jax.lax.all_gather(jnp.stack(lanes, axis=-1), "parts")
+            acc = allp[0]
+            for i in range(1, allp.shape[0]):
+                acc = acc + allp[i]
+            return acc[..., 0], tuple(
+                acc[..., i + 1] for i in range(len(extras))
+            )
+
+        return pdotx
+
+    def pdotx(a, b, extras):
+        p0 = jnp.sum(a[o0 : o0 + no_max] * b[o0 : o0 + no_max], axis=0)
+        lanes = [p0] + [
+            jnp.broadcast_to(e, p0.shape).astype(p0.dtype) for e in extras
+        ]
+        allp = jax.lax.all_gather(jnp.stack(lanes, axis=-1), "parts")
+        s = jnp.sum(allp, axis=0)
+        return s[..., 0], tuple(s[..., i + 1] for i in range(len(extras)))
+
+    return pdotx
+
+
 def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") -> Callable:
     """Compiled halo update: (P, W) sharded array -> same with ghosts
     current (combine='set') or owners accumulated (combine='add', reverse
@@ -1890,6 +2136,8 @@ def _matrix_operands(dA: DeviceMatrix) -> dict:
         sm = _stage(dA.backend, plan.snd_mask, P)
         ri = _stage(dA.backend, plan.rcv_idx, P)
     ops = {"si": si, "sm": sm, "ri": ri}
+    if dA.abft_w is not None:
+        ops["abft_w"] = dA.abft_w
     if dA.ohb_bs is not None:
         ops.update(ohb_r=dA.ohb_rows, ohb_c=dA.ohb_cols, ohb_v=dA.ohb_vals)
     elif dA.oh_vals is not None:
@@ -1908,7 +2156,8 @@ def _matrix_operands(dA: DeviceMatrix) -> dict:
     return ops
 
 
-def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
+def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False,
+               abft: bool = False, audit: bool = False):
     """Per-shard overlapped SpMV: pack+permute the halo, compute the A_oo
     partial on pre-exchange owned values (independent of the collective —
     XLA overlaps them), then unpack and add the A_oh ghost contribution
@@ -1941,12 +2190,24 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
     columns and feed the MXU). The Pallas kernels (coded padded frame,
     streaming DIA, in-kernel pfold/axpy) keep a K=1-only guard and the
     block path falls back to the equivalent XLA forms of the same
-    arithmetic."""
+    arithmetic.
+
+    ``abft=True`` builds the checksummed variant: the halo exchange runs
+    with per-round slab checksums (`_shard_exchange(abft=True)`) and the
+    body returns ``(y, exchanged operand, exchange delta, exchange
+    scale)`` — the caller (the CG builders) completes the ABFT identity
+    ``c·(A x)`` vs ``(c·A)·x`` against the staged checksum row, so a
+    graph-injected fault lands in the SAME ``q`` both the recurrence and
+    the checksum see. ``audit=True`` (with ``pfold``) adds the
+    ``aud``/``audx`` operand switch that lets the true-residual audit's
+    ``A x`` reuse this body's one SpMV call site; both flags keep the
+    Pallas pfold kernel off (ABFT-off guard with XLA fallback, the PR-3
+    K>1 precedent)."""
     import jax
     import jax.numpy as jnp
 
     plan = dA.col_plan
-    exch = _shard_exchange(plan, "set")
+    exch = _shard_exchange(plan, "set", abft=abft)
     layout = dA.row_layout
     no_max = layout.no_max
     o0, g0 = layout.o0, layout.g0
@@ -2085,11 +2346,17 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
     else:
         _axpy_in_kernel = False
 
-    if pfold and pplan is not None and dA.dia_cb is not None:
+    if (
+        pfold and pplan is not None and dA.dia_cb is not None
+        and not abft and not audit
+    ):
         from ..ops.pallas_dia import pfold_vmem_ok
 
         # same reasoning for the direction-fold variant's extra window /
-        # combined-copy / p-output VMEM
+        # combined-copy / p-output VMEM. The SDC modes (abft/audit) keep
+        # this kernel OFF: the audit's operand switch and the checksum's
+        # exchanged-operand capture both live in the XLA fold — the
+        # ABFT-off guard with XLA fallback, mirroring the K>1 precedent
         _pfold_in_kernel = pfold_vmem_ok(
             pplan, itemsize=np.dtype(dA.dia_cb.dtype).itemsize
         )
@@ -2211,8 +2478,13 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
     def _finish(full, partial_, xv, m):
         """Shared SpMV tail: halo-exchange the operand, embed the A_oo
         product in the row frame, add the boundary (A_oh) contribution.
-        Returns (y, exchanged operand)."""
-        xv = exch(xv, m["si"], m["sm"], m["ri"])
+        Returns (y, exchanged operand, exchange checksum delta, scale) —
+        the checksum pair is None unless ``abft``."""
+        if abft:
+            xv, exd, exs = exch(xv, m["si"], m["sm"], m["ri"])
+        else:
+            exd = exs = None
+            xv = exch(xv, m["si"], m["sm"], m["ri"])
         tail = xv.shape[1:]  # () or (K,) for a multi-RHS block
         if full is not None:
             y = full  # already a complete vector, pads exactly zero
@@ -2257,7 +2529,7 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
                     _ell_rowsum(m["oh_v"], m["oh_c"], xv)
                 )
             y = y.at[g0:].set(0)
-        return y, xv
+        return y, xv, exd, exs
 
     def body(xv, m, *ax):
         xacc2 = None
@@ -2275,10 +2547,12 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
             colL = dA.col_plan.layout
             cs = slice(colL.o0, colL.o0 + colL.no_max)
             xacc2 = xacc.at[cs].add(_rp(alpha * pprev[cs]))
-        y, xv = _finish(full, partial_, xv, m)
-        return (y, xacc2) if axpy else (y, xv)
+        y, xv, exd, exs = _finish(full, partial_, xv, m)
+        if axpy:
+            return y, xacc2
+        return (y, xv, exd, exs) if abft else (y, xv)
 
-    def body_pfold(rv, pv, beta, m, mvv=None):
+    def body_pfold(rv, pv, beta, m, mvv=None, aud=None, audx=None):
         """Fused-CG leading-edge fold: materialize the next search
         direction ``p = z + beta*pv`` (``z = mvv*rv`` when a diagonal
         preconditioner row is supplied, else ``rv``) INSIDE the SpMV
@@ -2289,7 +2563,14 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
         read, which XLA fuses into the operand's first touch. Note the
         halo pack depends on the folded p, so the wire no longer fully
         overlaps the A_oo compute — a surface-sized effect that the
-        fused body's saved volume sweeps dominate."""
+        fused body's saved volume sweeps dominate.
+
+        ``aud``/``audx`` (the SDC audit switch, built only under
+        ``audit``): on an audit trip the folded direction is REPLACED by
+        ``audx`` (the current iterate), so the body's one SpMV call site
+        computes ``A x`` for the true-residual cross-check while the
+        recurrence state stays frozen — no second SpMV, no extra
+        collectives in the lowered program."""
         colL = dA.col_plan.layout
         cs = slice(colL.o0, colL.o0 + colL.no_max)
         if _pfold_in_kernel and mvv is None and rv.ndim == 1:
@@ -2304,9 +2585,13 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False):
             # broadcast against the trailing axis of the owned slice
             z = _bc(mvv[cs], rv) * rv[cs] if mvv is not None else rv[cs]
             pnew = jnp.zeros_like(rv).at[cs].set(z + _rp(beta * pv[cs]))
+            if aud is not None:
+                # audit trips stream A·x through the same call site; a
+                # non-audit trip selects the folded direction bit-exactly
+                pnew = jnp.where(aud, audx, pnew)
             full, partial_ = _aoo(pnew, m)
-        y, _ = _finish(full, partial_, pnew, m)
-        return y, pnew
+        y, xpost, exd, exs = _finish(full, partial_, pnew, m)
+        return (y, pnew, xpost, exd, exs) if abft else (y, pnew)
 
     return body_pfold if pfold else body
 
@@ -2441,9 +2726,38 @@ def make_cg_fn(
     mesh = dA.backend.mesh(dA.row_layout.P)
     spec = dA.backend.parts_spec()
     none_spec = jax.sharding.PartitionSpec()
-    body_spmv = _spmv_body(dA)
+    # the SDC defense (in-graph ABFT checksums + true-residual audit +
+    # device-resident rollback ring) — None resolves to the exact
+    # pre-SDC program. The pipelined (lag-1) form is exempt this round:
+    # its in-kernel x placement has no audit/rollback generalization
+    # (docs/resilience.md).
+    sdccfg = _sdc_config(maxiter)
+    if pipelined and sdccfg is not None:
+        # say it out loud: the lowering still pays ABFT's side costs
+        # (generic exchange plan, staged checksum row) but this body
+        # runs UNDEFENDED — a user counting on the env var must know
+        import sys
+
+        print(
+            "[partitionedarrays_jl_tpu] make_cg_fn: the pipelined "
+            "(lag-1) body has no SDC defense this round — "
+            "PA_TPU_ABFT/PA_HEALTH_AUDIT_EVERY are ignored for this "
+            "program (use the standard or fused body for a defended "
+            "solve)",
+            file=sys.stderr,
+            flush=True,
+        )
+        sdccfg = None
+    abft_on = bool(sdccfg and sdccfg["abft"])
+    body_spmv = _spmv_body(dA, abft=abft_on)
     body_axpy = _spmv_body(dA, axpy=True) if pipelined else None
-    body_pfold = _spmv_body(dA, pfold=True) if fused else None
+    body_pfold = (
+        _spmv_body(
+            dA, pfold=True, abft=abft_on, audit=sdccfg is not None
+        )
+        if fused
+        else None
+    )
     no_max = dA.row_layout.no_max
     o0 = dA.row_layout.o0
     g0 = dA.row_layout.g0
@@ -2468,6 +2782,7 @@ def make_cg_fn(
         )
     pdot = _pdot_factory(o0, no_max)
     odot1, odot2 = _pdot_owned_factory(no_max)
+    dox = _pdot_extra_factory(0, no_max) if sdccfg is not None else None
     ops = _matrix_operands(dA)
     specs = jax.tree.map(lambda _: spec, ops)
     strict = strict_bits()
@@ -2489,7 +2804,10 @@ def make_cg_fn(
             mvv = mvs[0]
 
             def spmv(z):
-                y, _ = body_spmv(z, mats)
+                if abft_on:
+                    y, _, _, _ = body_spmv(z, mats)
+                else:
+                    y, _ = body_spmv(z, mats)
                 return y
 
             def apply_minv(r):
@@ -2509,6 +2827,370 @@ def make_cg_fn(
             rs0 = pdot(r, r)
             rz0 = pdot(r, z) if precond else rs0
             hist = jnp.full(H, jnp.nan, dtype=bv.dtype).at[0].set(jnp.sqrt(rs0))
+
+            if sdccfg is not None:
+                # ---- SDC-defended loop (ABFT + audit + rollback) ----
+                # Same recurrence arithmetic as the plain bodies below;
+                # on a clean run every commit-trip value is selected
+                # bit-exactly (jnp.where with a False predicate), so the
+                # trajectory is bitwise identical to sdccfg=None — the
+                # test_abft.py strict-bits pin. Three trip kinds:
+                #   commit — a real iteration (state advances),
+                #   audit  — every `ae` real iterations the ONE SpMV
+                #            call site streams A·x instead of A·p (an
+                #            operand select, so the lowered program has
+                #            the same collectives), the true residual is
+                #            cross-checked, and a passing state is
+                #            pushed onto the device-resident ring,
+                #   restore — a detection (checksum trip or failed
+                #            audit) re-selects the newest ring state:
+                #            the in-memory rollback, escalating via the
+                #            `esc` exit flag once `mrb` rollbacks are
+                #            spent.
+                ae = sdccfg["ae"]
+                R = sdccfg["R"]
+                mrb = sdccfg["mrb"]
+                fault = sdccfg["fault"]
+                trip_max = sdccfg["trip_max"]
+                cs_tol, audit_tol = _sdc_tolerances(
+                    bv.dtype, dA.row_layout.P, no_max
+                )
+                tiny = float(np.finfo(np.dtype(bv.dtype)).tiny)
+                athr2 = (
+                    audit_tol * jnp.maximum(1.0, jnp.sqrt(rs0))
+                ) ** 2
+                i32 = jnp.int32
+                slf = slice(o0, o0 + no_max)
+                false = jnp.bool_(False)
+
+                def inject(q, trip):
+                    """PA_FAULT_DEVICE: the compiled loop's chaos seam —
+                    a finite perturbation of q's first owned slot at ONE
+                    trip index (trips never replay, so it is one-shot),
+                    applied before the checksum so detection and
+                    recurrence see the same corrupted product."""
+                    if fault is None:
+                        return q
+                    hit = jnp.logical_and(
+                        trip == fault["trip"],
+                        jax.lax.axis_index("parts") == fault["part"],
+                    )
+                    bump = jnp.where(
+                        hit, fault["factor"] * (1.0 + jnp.abs(q[o0])), 0.0
+                    )
+                    return q.at[o0].add(bump.astype(q.dtype))
+
+                def cs_lanes(q, xpost, exd, exs):
+                    """The ABFT identity c·(A x) vs (c·A)·x plus the
+                    exchange-round deltas, as two reduction lanes for
+                    the dot gather (f64 accumulation when staged so)."""
+                    wv = mats["abft_w"]
+                    t = wv * xpost.astype(wv.dtype)
+                    qo = q[slf].astype(wv.dtype)
+                    delta = jnp.abs(jnp.sum(qo) - jnp.sum(t)) + jnp.abs(
+                        exd
+                    ).astype(wv.dtype)
+                    scale = (
+                        jnp.sum(jnp.abs(qo))
+                        + jnp.sum(jnp.abs(t))
+                        + exs.astype(wv.dtype)
+                    )
+                    return (
+                        delta.astype(bv.dtype),
+                        scale.astype(bv.dtype),
+                    )
+
+                def sdc_init(S0, sc0):
+                    return (
+                        jnp.stack([S0] * R),
+                        jnp.stack([sc0] * R),
+                        jnp.zeros((R,), i32),
+                        i32(0),  # since last audit
+                        i32(0),  # strike (ring slot to restore)
+                        i32(0),  # rollbacks
+                        i32(0),  # detections
+                        i32(0),  # audits
+                        false,   # escalated
+                        i32(0),  # trip
+                    )
+
+                def sdc_next(sdcst, aud, detect, cur_fn, cursc, it):
+                    """Shared carry transition: ring push on audit pass,
+                    strike/rollback bookkeeping, escalation latch. The
+                    ring shift sits behind a lax.cond so commit trips
+                    (the overwhelmingly common case) pass the R·3·W ring
+                    buffers through untouched instead of paying a
+                    full-ring select every iteration; ``cur_fn`` builds
+                    the pushed snapshot INSIDE the taken branch, so the
+                    stack never materializes on commit trips."""
+                    (ring, ringsc, ringit, since, strike, rollbacks,
+                     dets, audits, esc, trip) = sdcst
+                    exhausted = rollbacks >= mrb
+                    restore = jnp.logical_and(
+                        detect, jnp.logical_not(exhausted)
+                    )
+                    esc2 = jnp.logical_or(
+                        esc, jnp.logical_and(detect, exhausted)
+                    )
+                    apass = jnp.logical_and(aud, jnp.logical_not(detect))
+                    ring2, ringsc2, ringit2 = jax.lax.cond(
+                        apass,
+                        lambda: (
+                            jnp.concatenate(
+                                [cur_fn()[None], ring[:-1]], axis=0
+                            ),
+                            jnp.concatenate(
+                                [cursc[None], ringsc[:-1]], axis=0
+                            ),
+                            jnp.concatenate(
+                                [it[None].astype(i32), ringit[:-1]], axis=0
+                            ),
+                        ),
+                        lambda: (ring, ringsc, ringit),
+                    )
+                    since2 = jnp.where(
+                        jnp.logical_or(aud, restore), 0, since + 1
+                    )
+                    strike2 = jnp.where(
+                        restore,
+                        jnp.minimum(strike + 1, R - 1),
+                        jnp.where(apass, 0, strike),
+                    )
+                    sdc2 = (
+                        ring2, ringsc2, ringit2, since2, strike2,
+                        rollbacks + restore.astype(i32),
+                        dets + detect.astype(i32),
+                        audits + aud.astype(i32),
+                        esc2, trip + 1,
+                    )
+                    return sdc2, restore
+
+                def sdc_out(sdcst):
+                    (_r1, _r2, _r3, _s, _k, rollbacks, dets, audits,
+                     esc, trip) = sdcst
+                    return jnp.stack(
+                        [dets, rollbacks, audits, esc.astype(i32), trip]
+                    )
+
+                def cs_detect(ex_out):
+                    if not abft_on:
+                        return false
+                    delta, scale = ex_out
+                    return delta > cs_tol * (scale + tiny)
+
+                if fused:
+                    S0 = jnp.stack([xv, r, jnp.zeros_like(xv)])
+                    zero = jnp.zeros((), bv.dtype)
+                    sdc0 = sdc_init(S0, jnp.stack([rs0, rz0, zero]))
+
+                    def cond_fs(state):
+                        _S, rz_, rs_, _beta, it_, _h, sdcst = state
+                        esc_, trip_ = sdcst[8], sdcst[9]
+                        go = jnp.logical_and(
+                            jnp.sqrt(rs_)
+                            > tol * jnp.maximum(1.0, jnp.sqrt(rs0)),
+                            it_ < maxiter,
+                        )
+                        go = jnp.logical_and(go, jnp.isfinite(rs_))
+                        if precond:
+                            go = jnp.logical_and(go, rz_ != 0)
+                        go = jnp.logical_and(go, trip_ < trip_max)
+                        return jnp.logical_and(
+                            go, jnp.logical_not(esc_)
+                        )
+
+                    def step_fs(state):
+                        S, rz, rs, beta, it, hist, sdcst = state
+                        trip = sdcst[9]
+                        since = sdcst[3]
+                        aud = (since >= ae) if ae > 0 else false
+                        x, r_, p_prev = S[0], S[1], S[2]
+                        pf = body_pfold(
+                            r_, p_prev, beta, mats,
+                            mvv if precond else None,
+                            aud=aud if ae > 0 else None, audx=x,
+                        )
+                        if abft_on:
+                            q, p_, xpost, exd, exs = pf
+                            q = inject(q, trip)
+                            extras = cs_lanes(q, xpost, exd, exs)
+                        else:
+                            q, p_ = pf
+                            q = inject(q, trip)
+                            extras = ()
+                        if ae > 0:
+                            # audit trips stream d = (b - A x) - r into
+                            # BOTH dot operands (the site computes
+                            # ||d||²); lax.cond keeps the subtraction
+                            # sweeps off the commit trips entirely
+                            def _aud_ops():
+                                d = bv[slf] - q[slf] - r_[slf]
+                                return d, d
+
+                            s1a, s1b = jax.lax.cond(
+                                aud, _aud_ops,
+                                lambda: (p_[slf], q[slf]),
+                            )
+                        else:
+                            s1a, s1b = p_[slf], q[slf]
+                        pqdd, ex_out = dox(s1a, s1b, extras)
+                        cs_trip = cs_detect(ex_out)
+                        alpha = rz / pqdd
+                        xo = x[slf] + _rp(alpha * p_[slf])
+                        ro = r_[slf] + _rp(-alpha * q[slf])
+                        if precond:
+                            zo = mvv[slf] * ro
+                            rz_new, rs_new = odot2(ro, zo, ro, ro)
+                        else:
+                            rs_new = odot1(ro, ro)
+                            rz_new = rs_new
+                        beta_new = rz_new / rz
+                        audit_fail = jnp.logical_and(aud, pqdd > athr2)
+                        detect = jnp.logical_or(cs_trip, audit_fail)
+                        commit = jnp.logical_and(
+                            jnp.logical_not(aud), jnp.logical_not(detect)
+                        )
+                        sdc2, restore = sdc_next(
+                            sdcst, aud, detect, lambda: S,
+                            jnp.stack([rs, rz, beta]), it,
+                        )
+                        j = jnp.minimum(sdcst[4], R - 1)
+                        S_step = (
+                            S.at[0, slf].set(xo)
+                            .at[1, slf].set(ro)
+                            .at[2, slf].set(p_[slf])
+                        )
+                        # one 3-way branch instead of nested full-frame
+                        # selects: commit trips return the stepped state
+                        # directly, bit-exactly
+                        branch = jnp.where(
+                            commit, 0, jnp.where(restore, 2, 1)
+                        ).astype(jnp.int32)
+                        S3, rs3, rz3, beta3, it3 = jax.lax.switch(
+                            branch,
+                            [
+                                lambda: (
+                                    S_step, rs_new, rz_new, beta_new,
+                                    it + 1,
+                                ),
+                                lambda: (S, rs, rz, beta, it),
+                                lambda: (
+                                    sdcst[0][j], sdcst[1][j, 0],
+                                    sdcst[1][j, 1], sdcst[1][j, 2],
+                                    sdcst[2][j],
+                                ),
+                            ],
+                        )
+                        idx = jnp.minimum(it + 1, H - 1)
+                        hist2 = hist.at[idx].set(
+                            jnp.where(commit, jnp.sqrt(rs_new), hist[idx])
+                        )
+                        return (S3, rz3, rs3, beta3, it3, hist2, sdc2)
+
+                    S, rz, rs, beta, it, hist, sdcst = jax.lax.while_loop(
+                        cond_fs, step_fs,
+                        (S0, rz0, rs0, jnp.zeros((), bv.dtype),
+                         jnp.int32(0), hist, sdc0),
+                    )
+                    return S[0][None], rs, rs0, it, hist, sdc_out(sdcst)
+
+                sdc0 = sdc_init(
+                    jnp.stack([xv, r, p]),
+                    jnp.stack([rs0, rz0, jnp.zeros((), bv.dtype)]),
+                )
+
+                def cond_ss(state):
+                    _x, _r, _p, rz_, rs_, it_, _h, sdcst = state
+                    esc_, trip_ = sdcst[8], sdcst[9]
+                    go = jnp.logical_and(
+                        jnp.sqrt(rs_)
+                        > tol * jnp.maximum(1.0, jnp.sqrt(rs0)),
+                        it_ < maxiter,
+                    )
+                    go = jnp.logical_and(go, jnp.isfinite(rs_))
+                    if precond:
+                        go = jnp.logical_and(go, rz_ != 0)
+                    go = jnp.logical_and(go, trip_ < trip_max)
+                    return jnp.logical_and(go, jnp.logical_not(esc_))
+
+                def step_ss(state):
+                    x, r_, p_, rz, rs, it, hist, sdcst = state
+                    trip = sdcst[9]
+                    since = sdcst[3]
+                    aud = (since >= ae) if ae > 0 else false
+                    opnd = jnp.where(aud, x, p_) if ae > 0 else p_
+                    if abft_on:
+                        q, xpost, exd, exs = body_spmv(opnd, mats)
+                        q = inject(q, trip)
+                        extras = cs_lanes(q, xpost, exd, exs)
+                    else:
+                        q, _ = body_spmv(opnd, mats)
+                        q = inject(q, trip)
+                        extras = ()
+                    if ae > 0:
+                        # see step_fs: d computed only on audit trips
+                        def _aud_ops():
+                            d = bv[slf] - q[slf] - r_[slf]
+                            return d, d
+
+                        s1a, s1b = jax.lax.cond(
+                            aud, _aud_ops,
+                            lambda: (p_[slf], q[slf]),
+                        )
+                    else:
+                        s1a, s1b = p_[slf], q[slf]
+                    pqdd, ex_out = dox(s1a, s1b, extras)
+                    cs_trip = cs_detect(ex_out)
+                    alpha = rz / pqdd
+                    x2 = x.at[slf].add(_rp(alpha * p_[slf]))
+                    r2 = r_.at[slf].add(_rp(-alpha * q[slf]))
+                    z2 = apply_minv(r2)
+                    rz_new = pdot(r2, z2) if precond else None
+                    rs_new = pdot(r2, r2)
+                    if not precond:
+                        rz_new = rs_new
+                    beta = rz_new / rz
+                    p2 = p_.at[slf].set(
+                        z2[slf] + _rp(beta * p_[slf])
+                    )
+                    audit_fail = jnp.logical_and(aud, pqdd > athr2)
+                    detect = jnp.logical_or(cs_trip, audit_fail)
+                    commit = jnp.logical_and(
+                        jnp.logical_not(aud), jnp.logical_not(detect)
+                    )
+                    sdc2, restore = sdc_next(
+                        sdcst, aud, detect,
+                        lambda: jnp.stack([x, r_, p_]),
+                        jnp.stack([rs, rz, jnp.zeros((), bv.dtype)]),
+                        it,
+                    )
+                    j = jnp.minimum(sdcst[4], R - 1)
+                    branch = jnp.where(
+                        commit, 0, jnp.where(restore, 2, 1)
+                    ).astype(jnp.int32)
+                    x3, r3, p3, rs3, rz3, it3 = jax.lax.switch(
+                        branch,
+                        [
+                            lambda: (x2, r2, p2, rs_new, rz_new, it + 1),
+                            lambda: (x, r_, p_, rs, rz, it),
+                            lambda: (
+                                sdcst[0][j, 0], sdcst[0][j, 1],
+                                sdcst[0][j, 2], sdcst[1][j, 0],
+                                sdcst[1][j, 1], sdcst[2][j],
+                            ),
+                        ],
+                    )
+                    idx = jnp.minimum(it + 1, H - 1)
+                    hist2 = hist.at[idx].set(
+                        jnp.where(commit, jnp.sqrt(rs_new), hist[idx])
+                    )
+                    return (x3, r3, p3, rz3, rs3, it3, hist2, sdc2)
+
+                x, r, p, rz, rs, it, hist, sdcst = jax.lax.while_loop(
+                    cond_ss, step_ss,
+                    (xv, r, p, rz0, rs0, jnp.int32(0), hist, sdc0),
+                )
+                return x[None], rs, rs0, it, hist, sdc_out(sdcst)
 
             if fused:
                 slf = slice(o0, o0 + no_max)
@@ -2651,11 +3333,12 @@ def make_cg_fn(
             x = x.at[sl].add(_rp(alpha_prev * p_prev[sl]))
             return x[None], rs, rs0, it, hist
 
+        nouts = 5 if sdccfg is not None else 4
         return shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(spec, spec, spec, specs),
-            out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
+            out_specs=(spec,) + (none_spec,) * nouts,
             check_vma=False,
         )(b, x0, mv, m)
 
@@ -2684,6 +3367,7 @@ def make_cg_fn(
     run.jit_fn = fn
     run.operands = ops
     run.fused = bool(fused)
+    run.has_sdc = sdccfg is not None
     return run
 
 
@@ -2735,12 +3419,23 @@ def make_block_cg_fn(
     mesh = dA.backend.mesh(dA.row_layout.P)
     spec = dA.backend.parts_spec()
     none_spec = jax.sharding.PartitionSpec()
-    body_spmv = _spmv_body(dA)
-    body_pfold = _spmv_body(dA, pfold=True) if fused else None
+    # the SDC defense, K-polymorphic: checksum/audit lanes are (K,)
+    # per-column stacks riding the same gathers, detection is
+    # per-column, rollback restores the WHOLE block state (frozen
+    # columns restore to their frozen bits — re-freezing is a no-op)
+    sdccfg = _sdc_config(maxiter)
+    abft_on = bool(sdccfg and sdccfg["abft"])
+    body_spmv = _spmv_body(dA, abft=abft_on)
+    body_pfold = (
+        _spmv_body(dA, pfold=True, abft=abft_on, audit=sdccfg is not None)
+        if fused
+        else None
+    )
     no_max = dA.row_layout.no_max
     o0 = dA.row_layout.o0
     pdot = _pdot_factory(o0, no_max)
     odot1, odot2 = _pdot_owned_factory(no_max)
+    dox = _pdot_extra_factory(0, no_max) if sdccfg is not None else None
     ops = _matrix_operands(dA)
     specs = jax.tree.map(lambda _: spec, ops)
     strict = strict_bits()
@@ -2759,7 +3454,10 @@ def make_block_cg_fn(
             slf = slice(o0, o0 + no_max)
 
             def spmv(z):
-                y, _ = body_spmv(z, mats)
+                if abft_on:
+                    y, _, _, _ = body_spmv(z, mats)
+                else:
+                    y, _ = body_spmv(z, mats)
                 return y
 
             def apply_minv(r):
@@ -2798,6 +3496,367 @@ def make_block_cg_fn(
                 # column's bits never move (x + 0*p could still flip a
                 # -0.0; the select cannot)
                 return jnp.where(act, new, old)
+
+            if sdccfg is not None:
+                # ---- SDC-defended block loop (see make_cg_fn's sdc
+                # branch for the trip taxonomy) — (K,) per-column
+                # checksum/audit lanes, whole-block ring restore ----
+                ae = sdccfg["ae"]
+                R = sdccfg["R"]
+                mrb = sdccfg["mrb"]
+                fault = sdccfg["fault"]
+                trip_max = sdccfg["trip_max"]
+                cs_tol, audit_tol = _sdc_tolerances(
+                    bv.dtype, dA.row_layout.P, no_max
+                )
+                tiny = float(np.finfo(np.dtype(bv.dtype)).tiny)
+                athr2 = (
+                    audit_tol * jnp.maximum(1.0, jnp.sqrt(rs0))
+                ) ** 2  # (K,)
+                i32 = jnp.int32
+                false = jnp.bool_(False)
+
+                def inject(q, trip):
+                    if fault is None:
+                        return q
+                    hit = jnp.logical_and(
+                        trip == fault["trip"],
+                        jax.lax.axis_index("parts") == fault["part"],
+                    )
+                    bump = jnp.where(
+                        hit,
+                        fault["factor"] * (1.0 + jnp.abs(q[o0, 0])),
+                        0.0,
+                    )
+                    # column 0 of the first owned slot — one wire word,
+                    # the same entry the host hook's K-polymorphic
+                    # selection pins
+                    return q.at[o0, 0].add(bump.astype(q.dtype))
+
+                def cs_lanes(q, xpost, exd, exs):
+                    wv = mats["abft_w"][:, None]
+                    t = wv * xpost.astype(wv.dtype)
+                    qo = q[slf].astype(wv.dtype)
+                    delta = jnp.abs(
+                        jnp.sum(qo, axis=0) - jnp.sum(t, axis=0)
+                    ) + jnp.abs(exd).astype(wv.dtype)
+                    scale = (
+                        jnp.sum(jnp.abs(qo), axis=0)
+                        + jnp.sum(jnp.abs(t), axis=0)
+                        + exs.astype(wv.dtype)
+                    )
+                    return (
+                        delta.astype(bv.dtype),
+                        scale.astype(bv.dtype),
+                    )
+
+                def cs_detect(ex_out):
+                    if not abft_on:
+                        return jnp.zeros((K,), bool)
+                    delta, scale = ex_out
+                    return delta > cs_tol * (scale + tiny)
+
+                def sdc_init(S0, sc0):
+                    return (
+                        jnp.stack([S0] * R),       # (R, 3, W, K)
+                        jnp.stack([sc0] * R),      # (R, 3, K)
+                        jnp.stack([it0] * R),      # (R, K)
+                        jnp.zeros((R,), i32),      # ring global it
+                        i32(0), i32(0), i32(0), i32(0), i32(0),
+                        false, i32(0),
+                    )
+
+                def sdc_next(sdcst, aud, detect, cur_fn, cursc, itk, it):
+                    (ring, ringsc, ringitk, ringit, since, strike,
+                     rollbacks, dets, audits, esc, trip) = sdcst
+                    exhausted = rollbacks >= mrb
+                    restore = jnp.logical_and(
+                        detect, jnp.logical_not(exhausted)
+                    )
+                    esc2 = jnp.logical_or(
+                        esc, jnp.logical_and(detect, exhausted)
+                    )
+                    apass = jnp.logical_and(aud, jnp.logical_not(detect))
+
+                    def _shift(buf, new):
+                        return jnp.concatenate([new[None], buf[:-1]], axis=0)
+
+                    # lax.cond: commit trips pass the ring buffers
+                    # through untouched (no full-ring select per trip);
+                    # cur_fn builds the snapshot inside the taken branch
+                    ring2, ringsc2, ringitk2, ringit2 = jax.lax.cond(
+                        apass,
+                        lambda: (
+                            _shift(ring, cur_fn()),
+                            _shift(ringsc, cursc),
+                            _shift(ringitk, itk),
+                            _shift(ringit, it.astype(i32)),
+                        ),
+                        lambda: (ring, ringsc, ringitk, ringit),
+                    )
+                    sdc2 = (
+                        ring2, ringsc2, ringitk2, ringit2,
+                        jnp.where(jnp.logical_or(aud, restore), 0, since + 1),
+                        jnp.where(
+                            restore,
+                            jnp.minimum(strike + 1, R - 1),
+                            jnp.where(apass, 0, strike),
+                        ),
+                        rollbacks + restore.astype(i32),
+                        dets + detect.astype(i32),
+                        audits + aud.astype(i32),
+                        esc2, trip + 1,
+                    )
+                    return sdc2, restore
+
+                def sdc_out(sdcst):
+                    rollbacks, dets, audits, esc, trip = (
+                        sdcst[6], sdcst[7], sdcst[8], sdcst[9], sdcst[10]
+                    )
+                    return jnp.stack(
+                        [dets, rollbacks, audits, esc.astype(i32), trip]
+                    )
+
+                if fused:
+                    S0 = jnp.stack([xv, r, jnp.zeros_like(xv)])
+                    beta0 = jnp.zeros((K,), bv.dtype)
+                    sdc0 = sdc_init(S0, jnp.stack([rs0, rz0, beta0]))
+
+                    def cond_fs(state):
+                        _S, rz_, rs_, _beta, _itk, it_, _h, sdcst = state
+                        esc_, trip_ = sdcst[9], sdcst[10]
+                        go = jnp.logical_and(
+                            jnp.any(active(rs_, rz_)), it_ < maxiter
+                        )
+                        go = jnp.logical_and(go, trip_ < trip_max)
+                        return jnp.logical_and(
+                            go, jnp.logical_not(esc_)
+                        )
+
+                    def step_fs(state):
+                        S, rz, rs, beta, itk, it, hist, sdcst = state
+                        since, strike = sdcst[4], sdcst[5]
+                        trip = sdcst[10]
+                        aud = (since >= ae) if ae > 0 else false
+                        act = active(rs, rz)
+                        x, r_, p_prev = S[0], S[1], S[2]
+                        pf = body_pfold(
+                            r_, p_prev, beta, mats,
+                            mvv if precond else None,
+                            aud=aud if ae > 0 else None, audx=x,
+                        )
+                        if abft_on:
+                            q, p_, xpost, exd, exs = pf
+                            q = inject(q, trip)
+                            extras = cs_lanes(q, xpost, exd, exs)
+                        else:
+                            q, p_ = pf
+                            q = inject(q, trip)
+                            extras = ()
+                        if ae > 0:
+                            # audit trips stream d = (b - A x) - r into
+                            # BOTH dot operands (the site computes
+                            # ||d||²); lax.cond keeps the subtraction
+                            # sweeps off the commit trips entirely
+                            def _aud_ops():
+                                d = bv[slf] - q[slf] - r_[slf]
+                                return d, d
+
+                            s1a, s1b = jax.lax.cond(
+                                aud, _aud_ops,
+                                lambda: (p_[slf], q[slf]),
+                            )
+                        else:
+                            s1a, s1b = p_[slf], q[slf]
+                        pqdd, ex_out = dox(s1a, s1b, extras)
+                        cs_trip = cs_detect(ex_out)
+                        alpha = jnp.where(act, rz / pqdd, 0)
+                        xo = _sel(act, x[slf] + _rp(alpha * p_[slf]), x[slf])
+                        ro = _sel(
+                            act, r_[slf] + _rp(-alpha * q[slf]), r_[slf]
+                        )
+                        if precond:
+                            zo = mvv[slf][:, None] * ro
+                            rz_new, rs_new = odot2(ro, zo, ro, ro)
+                        else:
+                            rs_new = odot1(ro, ro)
+                            rz_new = rs_new
+                        audit_fail = jnp.logical_and(aud, pqdd > athr2)
+                        detect = jnp.any(
+                            jnp.logical_or(cs_trip, audit_fail)
+                        )
+                        commit = jnp.logical_and(
+                            jnp.logical_not(aud), jnp.logical_not(detect)
+                        )
+                        sdc2, restore = sdc_next(
+                            sdcst, aud, detect, lambda: S,
+                            jnp.stack([rs, rz, beta]), itk, it,
+                        )
+                        j = jnp.minimum(strike, R - 1)
+                        S_step = (
+                            S.at[0, slf].set(xo)
+                            .at[1, slf].set(ro)
+                            .at[2, slf].set(
+                                _sel(act, p_[slf], p_prev[slf])
+                            )
+                        )
+                        branch = jnp.where(
+                            commit, 0, jnp.where(restore, 2, 1)
+                        ).astype(jnp.int32)
+                        S3, rs3, rz3, beta3, itk3, it3 = jax.lax.switch(
+                            branch,
+                            [
+                                lambda: (
+                                    S_step,
+                                    _sel(act, rs_new, rs),
+                                    _sel(act, rz_new, rz),
+                                    _sel(act, rz_new / rz, beta),
+                                    itk + act.astype(jnp.int32),
+                                    it + 1,
+                                ),
+                                lambda: (S, rs, rz, beta, itk, it),
+                                lambda: (
+                                    sdcst[0][j], sdcst[1][j, 0],
+                                    sdcst[1][j, 1], sdcst[1][j, 2],
+                                    sdcst[2][j], sdcst[3][j],
+                                ),
+                            ],
+                        )
+                        idx = jnp.minimum(it + 1, H - 1)
+                        hist2 = hist.at[idx].set(
+                            jnp.where(
+                                jnp.logical_and(act, commit),
+                                jnp.sqrt(_sel(act, rs_new, rs)),
+                                hist[idx],
+                            )
+                        )
+                        return (S3, rz3, rs3, beta3, itk3, it3, hist2, sdc2)
+
+                    S, rz, rs, beta, itk, it, hist, sdcst = (
+                        jax.lax.while_loop(
+                            cond_fs, step_fs,
+                            (S0, rz0, rs0, beta0, it0, jnp.int32(0),
+                             hist, sdc0),
+                        )
+                    )
+                    return (
+                        S[0][None], rs, rs0, itk, hist, sdc_out(sdcst)
+                    )
+
+                sdc0 = sdc_init(
+                    jnp.stack([xv, r, p]),
+                    jnp.stack([rs0, rz0, jnp.zeros((K,), bv.dtype)]),
+                )
+
+                def cond_ss(state):
+                    _x, _r, _p, rz_, rs_, _itk, it_, _h, sdcst = state
+                    esc_, trip_ = sdcst[9], sdcst[10]
+                    go = jnp.logical_and(
+                        jnp.any(active(rs_, rz_)), it_ < maxiter
+                    )
+                    go = jnp.logical_and(go, trip_ < trip_max)
+                    return jnp.logical_and(go, jnp.logical_not(esc_))
+
+                def step_ss(state):
+                    x, r_, p_, rz, rs, itk, it, hist, sdcst = state
+                    since, strike = sdcst[4], sdcst[5]
+                    trip = sdcst[10]
+                    aud = (since >= ae) if ae > 0 else false
+                    act = active(rs, rz)
+                    opnd = jnp.where(aud, x, p_) if ae > 0 else p_
+                    if abft_on:
+                        q, xpost, exd, exs = body_spmv(opnd, mats)
+                        q = inject(q, trip)
+                        extras = cs_lanes(q, xpost, exd, exs)
+                    else:
+                        q, _ = body_spmv(opnd, mats)
+                        q = inject(q, trip)
+                        extras = ()
+                    if ae > 0:
+                        # see step_fs: d computed only on audit trips
+                        def _aud_ops():
+                            d = bv[slf] - q[slf] - r_[slf]
+                            return d, d
+
+                        s1a, s1b = jax.lax.cond(
+                            aud, _aud_ops,
+                            lambda: (p_[slf], q[slf]),
+                        )
+                    else:
+                        s1a, s1b = p_[slf], q[slf]
+                    pqdd, ex_out = dox(s1a, s1b, extras)
+                    cs_trip = cs_detect(ex_out)
+                    alpha = jnp.where(act, rz / pqdd, 0)
+                    x2 = x.at[slf].set(
+                        _sel(act, x[slf] + _rp(alpha * p_[slf]), x[slf])
+                    )
+                    r2 = r_.at[slf].set(
+                        _sel(act, r_[slf] + _rp(-alpha * q[slf]), r_[slf])
+                    )
+                    z2 = apply_minv(r2)
+                    rz_new = pdot(r2, z2) if precond else None
+                    rs_new = pdot(r2, r2)
+                    if not precond:
+                        rz_new = rs_new
+                    p2 = p_.at[slf].set(
+                        _sel(
+                            act,
+                            z2[slf]
+                            + _rp(
+                                jnp.where(act, rz_new / rz, 0) * p_[slf]
+                            ),
+                            p_[slf],
+                        )
+                    )
+                    audit_fail = jnp.logical_and(aud, pqdd > athr2)
+                    detect = jnp.any(jnp.logical_or(cs_trip, audit_fail))
+                    commit = jnp.logical_and(
+                        jnp.logical_not(aud), jnp.logical_not(detect)
+                    )
+                    sdc2, restore = sdc_next(
+                        sdcst, aud, detect,
+                        lambda: jnp.stack([x, r_, p_]),
+                        jnp.stack([rs, rz, jnp.zeros((K,), bv.dtype)]),
+                        itk, it,
+                    )
+                    j = jnp.minimum(strike, R - 1)
+                    branch = jnp.where(
+                        commit, 0, jnp.where(restore, 2, 1)
+                    ).astype(jnp.int32)
+                    x3, r3, p3, rs3, rz3, itk3, it3 = jax.lax.switch(
+                        branch,
+                        [
+                            lambda: (
+                                x2, r2, p2,
+                                _sel(act, rs_new, rs),
+                                _sel(act, rz_new, rz),
+                                itk + act.astype(jnp.int32),
+                                it + 1,
+                            ),
+                            lambda: (x, r_, p_, rs, rz, itk, it),
+                            lambda: (
+                                sdcst[0][j, 0], sdcst[0][j, 1],
+                                sdcst[0][j, 2], sdcst[1][j, 0],
+                                sdcst[1][j, 1], sdcst[2][j],
+                                sdcst[3][j],
+                            ),
+                        ],
+                    )
+                    idx = jnp.minimum(it + 1, H - 1)
+                    hist2 = hist.at[idx].set(
+                        jnp.where(
+                            jnp.logical_and(act, commit),
+                            jnp.sqrt(_sel(act, rs_new, rs)),
+                            hist[idx],
+                        )
+                    )
+                    return (x3, r3, p3, rz3, rs3, itk3, it3, hist2, sdc2)
+
+                x, r, p, rz, rs, itk, it, hist, sdcst = jax.lax.while_loop(
+                    cond_ss, step_ss,
+                    (xv, r, p, rz0, rs0, it0, jnp.int32(0), hist, sdc0),
+                )
+                return x[None], rs, rs0, itk, hist, sdc_out(sdcst)
 
             if fused:
                 S0 = jnp.stack([xv, r, jnp.zeros_like(xv)])
@@ -2891,11 +3950,12 @@ def make_block_cg_fn(
             )
             return x[None], rs, rs0, itk, hist
 
+        nouts = 5 if sdccfg is not None else 4
         return shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(spec, spec, spec, specs),
-            out_specs=(spec, none_spec, none_spec, none_spec, none_spec),
+            out_specs=(spec,) + (none_spec,) * nouts,
             check_vma=False,
         )(b, x0, mv, m)
 
@@ -2927,6 +3987,7 @@ def make_block_cg_fn(
     run.operands = ops
     run.fused = bool(fused)
     run.rhs_batch = K
+    run.has_sdc = sdccfg is not None
     return run
 
 
@@ -3692,6 +4753,40 @@ def tpu_chebyshev(
     )
 
 
+def _decode_sdc_outputs(name: str, sdcvec, it=None) -> dict:
+    """The ONE decode of a compiled program's SDC output lane (shared by
+    `_run_krylov` and `tpu_block_cg` so the counter contract cannot
+    diverge): returns the ``info["sdc"]`` dict, or raises the typed
+    escalation when the loop latched its flag — corruption kept firing
+    past the in-memory rollback budget, so the same
+    `SilentCorruptionError` the host loop raises escalates to
+    `solve_with_recovery`'s checkpoint tier."""
+    from .health import SilentCorruptionError
+
+    dets, rollbacks, audits, escal, trips = (
+        int(v) for v in np.asarray(sdcvec)
+    )
+    sdc_info = {
+        "detections": dets,
+        "rollbacks": rollbacks,
+        "escalations": int(bool(escal)),
+        "audit_iterations": audits,
+        "trips": trips,
+    }
+    if escal:
+        diag = {"context": name, "sdc": sdc_info}
+        if it is not None:
+            diag["iteration"] = int(it)
+        raise SilentCorruptionError(
+            f"{name}: in-graph SDC detection exhausted the rollback "
+            f"budget ({rollbacks} rollbacks, {dets} detections)"
+            + (f" at device iteration {it}" if it is not None else "")
+            + " — escalating to checkpoint restart",
+            diagnostics=diag,
+        )
+    return sdc_info
+
+
 def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg",
                 info_extra=None):
     """Shared device-Krylov driver: stage vectors in the matrix's col
@@ -3710,9 +4805,13 @@ def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg",
     dx0 = DeviceVector.from_pvector(x0, backend, dA.col_layout)
     if minv is not None:
         dmv = DeviceVector.from_pvector(minv, backend, dA.col_layout)
-        x_data, rs, rs0, it, hist = solve(db.data, dx0.data, dmv.data)
+        out = solve(db.data, dx0.data, dmv.data)
     else:
-        x_data, rs, rs0, it, hist = solve(db.data, dx0.data)
+        out = solve(db.data, dx0.data)
+    if getattr(solve, "has_sdc", False):
+        x_data, rs, rs0, it, hist, sdcvec = out
+    else:
+        (x_data, rs, rs0, it, hist), sdcvec = out, None
     x = DeviceVector(x_data, A.cols, dA.col_layout, backend).to_pvector()
     rs, rs0, it = float(rs), float(rs0), int(it)
     residuals = np.asarray(hist)[: min(it + 1, len(np.asarray(hist)))]
@@ -3720,6 +4819,12 @@ def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg",
         for i, r in enumerate(residuals[1:], start=1):
             print(f"{name} it={i} residual={r:.3e}")
     from .health import NonFiniteError, health_enabled
+
+    if sdcvec is not None:
+        info_extra = {
+            **(info_extra or {}),
+            "sdc": _decode_sdc_outputs(name, sdcvec, it=it),
+        }
 
     if health_enabled() and not (np.isfinite(rs) and np.isfinite(rs0)):
         # the compiled loop exited on its in-graph finite guard (one
@@ -3857,9 +4962,18 @@ def tpu_block_cg(
     dx0 = _block_on_cols_layout(X0, dA, with_ghosts=True)
     if minv is not None:
         dmv = DeviceVector.from_pvector(minv, backend, dA.col_layout)
-        x_data, rs, rs0, itk, hist = solve(db, dx0, dmv.data)
+        out = solve(db, dx0, dmv.data)
     else:
-        x_data, rs, rs0, itk, hist = solve(db, dx0)
+        out = solve(db, dx0)
+    if getattr(solve, "has_sdc", False):
+        x_data, rs, rs0, itk, hist, sdcvec = out
+    else:
+        (x_data, rs, rs0, itk, hist), sdcvec = out, None
+    sdc_info = (
+        _decode_sdc_outputs("block-cg", sdcvec)
+        if sdcvec is not None
+        else None
+    )
     host = fetch_global(x_data)  # (P, W, K)
     rs = np.asarray(rs, dtype=np.float64)
     rs0 = np.asarray(rs0, dtype=np.float64)
@@ -3924,6 +5038,8 @@ def tpu_block_cg(
         "rhs_batch": K,
         "cg_body": "fused" if fused else "standard",
     }
+    if sdc_info is not None:
+        info["sdc"] = sdc_info
     if floor_warned:
         info["tol_below_dtype_floor"] = True
     return xs, info
@@ -3960,9 +5076,14 @@ def _krylov_fn_for(
         # also part of _lowering_env_key, which rekeys the DeviceMatrix
         # itself on a flip)
         fused = _resolve_fused(fused, pipelined)
+    # the SDC config (audit period, budgets, tolerance overrides, the
+    # device fault clause) is resolved at build time — key it so an env
+    # flip rebuilds the program instead of serving a stale defense
+    # (pipelined programs are SDC-exempt and must not retrace on flips)
+    sdccfg = None if pipelined else _sdc_config(int(maxiter))
     key = (
         method, float(tol), int(maxiter), bool(precond), bool(pipelined),
-        bool(fused), rhs_batch,
+        bool(fused), rhs_batch, sdccfg["key"] if sdccfg else None,
     )
     if key not in dA._cg_cache:
         if method == "cg":

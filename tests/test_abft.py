@@ -1,0 +1,645 @@
+"""Silent-data-corruption (SDC) defense: in-graph ABFT checksums,
+true-residual audits, and bounded in-memory rollback (round 8).
+
+THE acceptance scenario, pinned in two halves:
+
+* **The failing-silently baseline.** A FINITE bitflip (high mantissa
+  bit) in a halo payload sails through every finiteness guard: the
+  recurrence "converges" (``converged=True``) to an answer that is
+  WRONG far beyond the solve tolerance. This test must keep passing —
+  it is the threat model, executable.
+* **The defense.** With ``PA_TPU_ABFT=1`` (and/or an audit period) the
+  same spec either SELF-HEALS — detection at the exchange checksum or
+  the true-residual audit, in-memory rollback to the newest audited
+  ring state, clean replay, final iterate BITWISE equal to the
+  fault-free run, zero disk I/O — or raises a typed
+  `SilentCorruptionError` (persistent corruption exhausts the rollback
+  budget and escalates to `solve_with_recovery`'s checkpoint tier). A
+  silently wrong iterate is never returned.
+
+Clean-path contract on the compiled (device) bodies: ABFT ON vs OFF is
+bitwise identical under strict-bits on the 4-part conformance fixture
+(standard, fused, and rhs_batch=4 block bodies), and the lowered HLO
+carries the SAME per-kind collective counts — the checksum/audit lanes
+ride the existing all_gather/ppermute payloads (`_pdot_extra_factory`,
+the widened exchange rounds, and the audit's operand select on the one
+SpMV call site).
+"""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.models import (
+    assemble_poisson,
+    cg,
+    gather_pvector,
+    jacobi_preconditioner,
+    pcg,
+    solve_with_recovery,
+)
+from partitionedarrays_jl_tpu.parallel.faults import inject_faults
+from partitionedarrays_jl_tpu.parallel.health import SilentCorruptionError
+
+
+@pytest.fixture
+def sdc_env(monkeypatch):
+    """ABFT + a short audit period, cleaned up per test."""
+
+    def set_env(abft="1", audit="6", max_rb=None, depth=None):
+        if abft is not None:
+            monkeypatch.setenv("PA_TPU_ABFT", abft)
+        if audit is not None:
+            monkeypatch.setenv("PA_HEALTH_AUDIT_EVERY", audit)
+        if max_rb is not None:
+            monkeypatch.setenv("PA_HEALTH_MAX_ROLLBACKS", max_rb)
+        if depth is not None:
+            monkeypatch.setenv("PA_HEALTH_ROLLBACK_DEPTH", depth)
+
+    return set_env
+
+
+def _setup(parts, ns=(12, 12)):
+    return assemble_poisson(parts, ns)
+
+
+# ---------------------------------------------------------------------------
+# the threat model: a finite bitflip fails SILENTLY without the defense
+# ---------------------------------------------------------------------------
+
+
+def test_bitflip_baseline_completes_silently_wrong():
+    """ABFT off (the default): a high-mantissa-bit flip in a halo
+    payload produces converged=True and an answer wrong by orders of
+    magnitude more than the solve tolerance — the failure class the
+    finiteness guards cannot see. Executable threat model: if this test
+    ever fails because the answer came back right, the baseline moved
+    and the defense tests below must be re-derived."""
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        x_clean, info_clean = cg(A, b, x0=x0, tol=1e-10)
+        assert info_clean["converged"]
+        with inject_faults("bitflip@part=1,call=20,bit=51", seed=3) as st:
+            x_bad, info_bad = cg(A, b, x0=x0, tol=1e-10)
+        assert [e["kind"] for e in st.events] == ["bitflip"]
+        assert info_bad["converged"], "recurrence converged on paper"
+        err = float(
+            np.abs(gather_pvector(x_bad) - gather_pvector(x_clean)).max()
+        )
+        assert err > 1e-7, f"corruption no longer visible (err={err})"
+        assert "sdc" not in info_bad  # defense inactive by default
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+# ---------------------------------------------------------------------------
+# the defense, host oracle: detect -> rollback -> bitwise self-heal
+# ---------------------------------------------------------------------------
+
+
+def test_exchange_checksum_self_heals_bitwise(sdc_env):
+    """One-shot bitflip with ABFT on: the slab checksum catches it AT
+    the exchange, the ring rewinds <= audit_every iterations, the clean
+    replay reproduces the fault-free run bit for bit. No disk involved
+    anywhere (no checkpoint was ever configured)."""
+    sdc_env()
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        x_clean, _ = cg(A, b, x0=x0, tol=1e-10)
+        with inject_faults("bitflip@part=1,call=20,bit=51", seed=5) as st:
+            x_rec, info = cg(A, b, x0=x0, tol=1e-10)
+        assert any(e["kind"] == "bitflip" for e in st.events)
+        assert info["converged"]
+        assert info["sdc"]["detections"] == 1
+        assert info["sdc"]["rollbacks"] == 1
+        assert info["sdc"]["escalations"] == 0
+        assert info["sdc"]["audit_iterations"] > 0
+        np.testing.assert_array_equal(
+            gather_pvector(x_clean), gather_pvector(x_rec)
+        )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_audit_only_mode_catches_drift(sdc_env):
+    """ABFT checksums OFF, audit ON: the corruption lands (no exchange
+    guard), the recurrence silently diverges from the true residual,
+    and the next ``b - A x`` audit catches the drift — same rollback,
+    same bitwise self-heal."""
+    sdc_env(abft="0", audit="6")
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        x_clean, _ = cg(A, b, x0=x0, tol=1e-10)
+        with inject_faults("bitflip@part=1,call=20,bit=51", seed=5):
+            x_rec, info = cg(A, b, x0=x0, tol=1e-10)
+        assert info["converged"]
+        assert info["sdc"]["detections"] == 1
+        assert info["sdc"]["rollbacks"] == 1
+        np.testing.assert_array_equal(
+            gather_pvector(x_clean), gather_pvector(x_rec)
+        )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_nan_slab_trips_checksum_before_finiteness(sdc_env):
+    """With ABFT on even a NaN payload is caught by the slab checksum
+    (NaN fails the comparison) and HEALS IN MEMORY — strictly better
+    than the default path, where the NaN reaches the solver state and
+    recovery means a restart."""
+    sdc_env()
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        x_clean, _ = cg(A, b, x0=x0, tol=1e-10)
+        with inject_faults("nan@part=1,call=20", seed=5):
+            x_rec, info = cg(A, b, x0=x0, tol=1e-10)
+        assert info["converged"] and info["sdc"]["rollbacks"] == 1
+        np.testing.assert_array_equal(
+            gather_pvector(x_clean), gather_pvector(x_rec)
+        )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_pcg_self_heals_bitwise(sdc_env):
+    sdc_env()
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        minv = jacobi_preconditioner(A)
+        x_clean, _ = pcg(A, b, x0=x0, minv=minv, tol=1e-10)
+        with inject_faults("bitflip@part=2,call=15,bit=50", seed=2):
+            x_rec, info = pcg(A, b, x0=x0, minv=minv, tol=1e-10)
+        assert info["converged"] and info["sdc"]["rollbacks"] == 1
+        np.testing.assert_array_equal(
+            gather_pvector(x_clean), gather_pvector(x_rec)
+        )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_persistent_corruption_escalates_typed(sdc_env):
+    """A repeating fault defeats rollback (every replay re-corrupts):
+    after PA_HEALTH_MAX_ROLLBACKS rollbacks the next detection raises
+    SilentCorruptionError carrying the counters — never a silently
+    wrong iterate."""
+    sdc_env(max_rb="2")
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        with inject_faults("bitflip@part=1,after=0,bit=51", seed=5):
+            with pytest.raises(SilentCorruptionError) as ei:
+                cg(A, b, x0=x0, tol=1e-10)
+        sdc = ei.value.diagnostics["sdc"]
+        assert sdc["rollbacks"] == 2 and sdc["escalations"] == 1
+        assert sdc["detections"] == 3
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_rollback_uses_no_disk_and_recovery_ledger(tmp_path, sdc_env):
+    """Criterion: in-memory rollback recovers from a single bitflip
+    with ZERO checkpoint I/O — the configured checkpoint directory
+    stays empty — and the solve_with_recovery ledger reports the
+    in-memory tier (rollbacks consumed, no restarts, no checkpoint
+    generations used)."""
+    sdc_env()
+    d = str(tmp_path / "ck")
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        x_clean, _ = cg(A, b, x0=x0, tol=1e-10)
+        with inject_faults("bitflip@part=1,call=20,bit=51", seed=5):
+            # every=10_000: no periodic checkpoint is ever due, so any
+            # file in `d` would have come from the recovery path
+            x_rec, info = solve_with_recovery(
+                A, b, method="cg", x0=x0, checkpoint_dir=d, every=10_000,
+                tol=1e-10,
+            )
+        assert info["converged"] and info["restarts"] == 0
+        led = info["recovery"]
+        assert led["attempts"] == 1
+        assert led["rollbacks"] == 1 and led["detections"] == 1
+        assert led["checkpoint_restarts"] == 0
+        assert not os.path.exists(os.path.join(d, "manifest.json"))
+        np.testing.assert_array_equal(
+            gather_pvector(x_clean), gather_pvector(x_rec)
+        )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_escalation_reaches_checkpoint_tier(tmp_path, sdc_env):
+    """The full ladder: persistent corruption exhausts the in-memory
+    budget, SilentCorruptionError escalates to solve_with_recovery,
+    whose restarts also fail (the fault repeats) until max_restarts —
+    the final raise is typed and the ledger records every tier."""
+    sdc_env(max_rb="1")
+    d = str(tmp_path / "ck")
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        with inject_faults("bitflip@part=1,after=0,bit=51", seed=5):
+            with pytest.raises(SilentCorruptionError):
+                solve_with_recovery(
+                    A, b, method="cg", x0=x0, checkpoint_dir=d, every=5,
+                    tol=1e-10, max_restarts=1,
+                )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_recovery_ledger_records_checkpoint_restart(tmp_path):
+    """Without the SDC layer, the classic path (NaN -> NonFiniteError ->
+    checkpoint restart) now reports itself in the ledger: one restart
+    from the exact-recurrence checkpoint, its iteration recorded."""
+    d = str(tmp_path / "ck")
+
+    def driver(parts):
+        A, b, x_exact, x0 = _setup(parts)
+        with inject_faults("nan@part=1,call=20", seed=5):
+            x, info = solve_with_recovery(
+                A, b, method="cg", x0=x0, checkpoint_dir=d, every=6,
+                tol=1e-9,
+            )
+        assert info["converged"] and info["restarts"] == 1
+        led = info["recovery"]
+        assert led["attempts"] == 2
+        assert led["checkpoint_restarts"] == 1
+        src = led["restart_sources"][0]
+        assert src["failure"] == "NonFiniteError"
+        assert src["from"] == "checkpoint_state"
+        assert src["checkpoint_iteration"] > 0
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_slab_checksums_handle_empty_and_block_slabs(sdc_env):
+    """Regression (review findings): the sender-side checksum must
+    survive (a) a part whose TRAILING slab is empty (np.add.reduceat's
+    empty-row misindexing — replaced by a cumsum) and (b) an (L, K)
+    block slab, whose K columns fold into one scalar checksum per slab
+    on both sides."""
+    from partitionedarrays_jl_tpu.parallel.collectives import (
+        _slab_checksums,
+        _verify_slab_checksums,
+    )
+    from partitionedarrays_jl_tpu.parallel.sequential import SequentialData
+    from partitionedarrays_jl_tpu.utils.table import Table
+
+    # part 0 sends [3-word slab to 1, EMPTY trailing slab to 2]; part 1
+    # sends an (L, K)=(4, 2) block slab to 0; part 2 sends nothing
+    t0 = Table(np.array([1.0, 2.0, 3.0]), np.array([0, 3, 3]))
+    t1 = Table(np.arange(8.0).reshape(4, 2), np.array([0, 4]))
+    t2 = Table(np.empty((0,)), np.array([0]))
+    snd = SequentialData([t0, t1, t2])
+    sums = _slab_checksums(snd)
+    np.testing.assert_allclose(sums[0][0], [6.0, 0.0])
+    np.testing.assert_allclose(sums[1][0], [28.0])
+    parts_snd = SequentialData(
+        [np.array([1, 2]), np.array([0]), np.empty(0, dtype=int)]
+    )
+    parts_rcv = SequentialData(
+        [np.array([1]), np.array([0]), np.array([0])]
+    )
+
+    def rcv_for(block):
+        return SequentialData(
+            [
+                Table(block, np.array([0, 4])),
+                Table(np.array([1.0, 2.0, 3.0]), np.array([0, 3])),
+                Table(np.empty((0,)), np.array([0, 0])),
+            ]
+        )
+
+    _verify_slab_checksums(
+        rcv_for(np.arange(8.0).reshape(4, 2)), parts_rcv, parts_snd,
+        sums, 1e-12,
+    )
+    # a flipped word in the block slab trips the verify
+    from partitionedarrays_jl_tpu.parallel.health import (
+        SilentCorruptionError as SCE,
+    )
+
+    with pytest.raises(SCE):
+        _verify_slab_checksums(
+            rcv_for(np.arange(8.0).reshape(4, 2) + np.eye(4, 2) * 0.5),
+            parts_rcv, parts_snd, sums, 1e-12,
+        )
+
+
+# ---------------------------------------------------------------------------
+# device backend: in-graph detection/rollback on the compiled bodies
+# ---------------------------------------------------------------------------
+
+
+def _tpu_backend(n=8):
+    import jax
+
+    try:
+        from partitionedarrays_jl_tpu.parallel.tpu import TPUBackend
+
+        return TPUBackend(devices=jax.devices()[:n])
+    except Exception as e:  # pragma: no cover - environment-dependent
+        pytest.skip(f"device mesh unavailable: {e}")
+
+
+def test_device_in_graph_rollback_self_heals(sdc_env, monkeypatch):
+    """PA_FAULT_DEVICE (the compiled loop's chaos seam) corrupts the
+    SpMV product at one trip; the in-graph checksum lanes detect it,
+    the device-resident ring re-selects the last audited state, and the
+    replayed trajectory lands bitwise on the fault-free answer — for
+    the standard AND fused bodies."""
+    backend = _tpu_backend()
+
+    def run(fault):
+        def driver(parts):
+            A, b, xe, x0 = assemble_poisson(parts, (8, 8, 8))
+            out = {}
+            for fused in (False, True):
+                x, info = cg(A, b, x0=x0, tol=1e-9, fused=fused)
+                out[fused] = (gather_pvector(x), info)
+            return out
+
+        if fault:
+            monkeypatch.setenv(
+                "PA_FAULT_DEVICE", "spmv@trip=8,part=1,factor=1e3"
+            )
+        else:
+            monkeypatch.delenv("PA_FAULT_DEVICE", raising=False)
+        return pa.prun(driver, backend, (2, 2, 2))
+
+    sdc_env(audit="5")
+    clean = run(False)
+    faulted = run(True)
+    for fused in (False, True):
+        xc, ic = clean[fused]
+        xf, inf = faulted[fused]
+        assert ic["sdc"]["detections"] == 0
+        assert inf["sdc"]["detections"] == 1
+        assert inf["sdc"]["rollbacks"] == 1
+        assert inf["converged"]
+        np.testing.assert_array_equal(xc, xf)
+
+
+def test_device_escalation_raises_typed(sdc_env, monkeypatch):
+    backend = _tpu_backend()
+    sdc_env(audit="5", max_rb="0")
+    monkeypatch.setenv("PA_FAULT_DEVICE", "spmv@trip=8,part=1,factor=1e3")
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8, 8))
+        with pytest.raises(SilentCorruptionError) as ei:
+            cg(A, b, x0=x0, tol=1e-9)
+        assert ei.value.diagnostics["sdc"]["escalations"] == 1
+        return True
+
+    assert pa.prun(driver, backend, (2, 2, 2))
+
+
+def test_device_block_in_graph_rollback(sdc_env, monkeypatch):
+    """The (…, K) block program: per-column checksum lanes, whole-block
+    ring restore — faulted block solve self-heals bitwise per column."""
+    backend = _tpu_backend()
+    sdc_env(audit="5")
+
+    def run():
+        def driver(parts):
+            A, b, xe, x0 = assemble_poisson(parts, (8, 8, 8))
+            B, X0 = [b, b.copy()], [x0, x0.copy()]
+            xs, info = cg(A, B=B, X0=X0, tol=1e-9)
+            return [gather_pvector(x) for x in xs], info
+
+        return pa.prun(driver, backend, (2, 2, 2))
+
+    clean, info_c = run()
+    monkeypatch.setenv("PA_FAULT_DEVICE", "spmv@trip=7,part=1,factor=1e3")
+    healed, info_f = run()
+    assert info_c["sdc"]["detections"] == 0
+    assert info_f["sdc"]["detections"] == 1 and info_f["sdc"]["rollbacks"] == 1
+    assert info_f["converged"]
+    for a, c in zip(clean, healed):
+        np.testing.assert_array_equal(a, c)
+
+
+# ---------------------------------------------------------------------------
+# clean-path contracts: strict-bits identity + collective parity
+# ---------------------------------------------------------------------------
+
+# the 10-gid 4-part conformance fixture (reference test_interfaces.jl:
+# 177-207, owned-first local layouts) — the asymmetric partition whose
+# ghost graph exercises the generic exchange plan
+LID_TO_GID = [
+    [0, 1, 2, 4, 6, 7],
+    [3, 4, 1, 9],
+    [5, 6, 7, 4, 3, 9],
+    [8, 9, 0, 2, 6],
+]
+LID_TO_PART = [
+    [0, 0, 0, 1, 2, 2],
+    [1, 1, 0, 3],
+    [2, 2, 2, 1, 1, 3],
+    [3, 3, 0, 0, 2],
+]
+
+
+def _fixture_spd_system(parts):
+    owner = {}
+    for p, (gids, ps) in enumerate(zip(LID_TO_GID, LID_TO_PART)):
+        for g, q in zip(gids, ps):
+            if q == p:
+                owner[g] = p
+    visible = [set(g) for g in LID_TO_GID]
+    pairs = {
+        (a, b)
+        for a in range(10)
+        for b in range(10)
+        if a != b and b in visible[owner[a]] and a in visible[owner[b]]
+    }
+
+    def triplets(p):
+        I, J, V = [], [], []
+        for g, q in zip(LID_TO_GID[p], LID_TO_PART[p]):
+            if q != p:
+                continue
+            I.append(g)
+            J.append(g)
+            V.append(40.0 + g)
+            for b in sorted(visible[p]):
+                if (g, b) in pairs:
+                    I.append(g)
+                    J.append(b)
+                    V.append(-(1.0 + (g + b) % 3))
+        return np.array(I), np.array(J), np.array(V, dtype=np.float64)
+
+    partition = pa.map_parts(
+        lambda p: pa.IndexSet(p, LID_TO_GID[p], LID_TO_PART[p]), parts
+    )
+    rows = pa.PRange(10, partition)
+    I = pa.map_parts(lambda p: triplets(p)[0], parts)
+    J = pa.map_parts(lambda p: triplets(p)[1], parts)
+    V = pa.map_parts(lambda p: triplets(p)[2], parts)
+    A = pa.PSparseMatrix.from_coo(I, J, V, rows, rows.copy(), ids="global")
+    b = pa.PVector(
+        pa.map_parts(
+            lambda i: np.where(
+                np.asarray(i.lid_to_part) == i.part,
+                np.sin(1.0 + np.asarray(i.lid_to_gid, dtype=np.float64)),
+                0.0,
+            ),
+            A.rows.partition,
+        ),
+        A.rows,
+    )
+    return A, b
+
+
+def test_strict_bits_abft_on_off_identity(monkeypatch):
+    """No fault active: under strict-bits the SDC machinery must not
+    move a single bit of the trajectory — residual history and solution
+    bitwise identical with ABFT ON vs OFF, on the standard body, the
+    fused body, and the rhs_batch=4 block body (audits DO run — they
+    are stall trips whose state re-selects bit-exactly)."""
+    monkeypatch.setenv("PA_TPU_STRICT_BITS", "1")
+    backend = _tpu_backend(4)
+
+    def run(abft, mode):
+        if abft:
+            monkeypatch.setenv("PA_TPU_ABFT", "1")
+            monkeypatch.setenv("PA_HEALTH_AUDIT_EVERY", "4")
+        else:
+            monkeypatch.delenv("PA_TPU_ABFT", raising=False)
+            monkeypatch.delenv("PA_HEALTH_AUDIT_EVERY", raising=False)
+
+        def driver(parts):
+            A, b = _fixture_spd_system(parts)
+            if mode == "block":
+                xs, info = cg(A, B=[b, b.copy(), b.copy(), b.copy()],
+                              tol=1e-12, maxiter=200)
+                return [gather_pvector(x) for x in xs], info
+            x, info = cg(
+                A, b, tol=1e-12, maxiter=200,
+                fused=(mode == "fused"),
+            )
+            return gather_pvector(x), info
+
+        return pa.prun(driver, backend, 4)
+
+    for mode in ("standard", "fused", "block"):
+        x_on, inf_on = run(True, mode)
+        x_off, inf_off = run(False, mode)
+        assert inf_on["iterations"] == inf_off["iterations"]
+        assert inf_on["iterations"] > 3
+        assert inf_on["sdc"]["detections"] == 0
+        assert inf_on["sdc"]["audit_iterations"] > 0
+        assert "sdc" not in inf_off
+        n = inf_off["iterations"] + 1
+        np.testing.assert_array_equal(
+            np.asarray(inf_on["residuals"])[:n],
+            np.asarray(inf_off["residuals"])[:n],
+        )
+        if mode == "block":
+            for a, c in zip(x_on, x_off):
+                np.testing.assert_array_equal(a, c)
+        else:
+            np.testing.assert_array_equal(x_on, x_off)
+
+
+def _collective_counts(run_fn, *args):
+    txt = run_fn.jit_fn.lower(*args).as_text()
+    return {
+        k: len(re.findall(k, txt))
+        for k in ("collective_permute", "all_gather", "all_reduce")
+    }
+
+
+def test_abft_collective_count_parity(monkeypatch):
+    """HLO A/B: the ABFT-on program must carry the SAME per-kind
+    collective counts as the ABFT-off program — detection rides widened
+    payloads (checksum lanes on the dot gather, one extra slot per
+    exchange round) and the audit reuses the loop's one SpMV via an
+    operand select, never a second exchange. Pinned with PA_TPU_BOX=0
+    on both sides so the A/B compares like plans (ABFT itself pins the
+    generic plan; see _box_exchange_enabled)."""
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        _matrix_operands,
+        device_matrix,
+        make_block_cg_fn,
+        make_cg_fn,
+    )
+
+    monkeypatch.setenv("PA_TPU_BOX", "0")
+    monkeypatch.setenv("PA_HEALTH_AUDIT_EVERY", "8")
+    backend = _tpu_backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (6, 6, 6))
+        return A, b
+
+    A, b = pa.prun(driver, backend, (2, 2, 2))
+
+    def counts(abft, fused, rhs_batch=None):
+        if abft:
+            monkeypatch.setenv("PA_TPU_ABFT", "1")
+        else:
+            monkeypatch.delenv("PA_TPU_ABFT", raising=False)
+        dA = device_matrix(A, backend)
+        ops = _matrix_operands(dA)
+        if rhs_batch:
+            fn = make_block_cg_fn(dA, 1e-9, 100, rhs_batch, fused=fused)
+            db = np.zeros(
+                (dA.col_plan.layout.P, dA.col_plan.layout.W, rhs_batch)
+            )
+            args = (db, db, db[..., 0], ops)
+        else:
+            fn = make_cg_fn(dA, 1e-9, 100, fused=fused)
+            db = np.zeros((dA.col_plan.layout.P, dA.col_plan.layout.W))
+            args = (db, db, db, ops)
+        return _collective_counts(fn, *args)
+
+    for fused in (False, True):
+        con = counts(True, fused)
+        coff = counts(False, fused)
+        assert any(coff.values())
+        assert con == coff, (fused, con, coff)
+    con = counts(True, True, rhs_batch=4)
+    coff = counts(False, True, rhs_batch=4)
+    assert con == coff, ("block", con, coff)
+
+
+def test_abft_pins_generic_exchange_plan(monkeypatch):
+    """ABFT mode keeps the generic index plan (its round checksums are
+    implemented there — same precedent as strict-bits), even on a
+    box-eligible Cartesian partition."""
+    from partitionedarrays_jl_tpu.parallel.tpu import device_matrix
+    from partitionedarrays_jl_tpu.parallel.tpu_box import BoxExchangePlan
+
+    backend = _tpu_backend()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (6, 6, 6))
+        return A
+
+    A = pa.prun(driver, backend, (2, 2, 2))
+    monkeypatch.setenv("PA_TPU_ABFT", "1")
+    dA_on = device_matrix(A, backend)
+    assert not isinstance(dA_on.col_plan, BoxExchangePlan)
+    assert dA_on.abft_w is not None
+    monkeypatch.delenv("PA_TPU_ABFT")
+    dA_off = device_matrix(A, backend)
+    assert dA_off.abft_w is None

@@ -1,0 +1,153 @@
+"""The fault-kind x detector x recovery-path matrix, executable.
+
+docs/resilience.md documents which layer catches each injected fault
+kind and what happens next; this file IS that table as tier-1 smoke
+tests — one short solve per kind, asserting the documented outcome
+(typed error, self-heal to the fault-free answer, or clean
+completion), so the matrix can never silently rot into prose.
+
+| kind       | detector                     | documented outcome        |
+|------------|------------------------------|---------------------------|
+| nan        | free scalar guard            | NonFiniteError; recovery restarts and reproduces the clean run |
+| nan + ABFT | exchange slab checksum       | SilentCorruptionError -> in-memory rollback self-heal |
+| bitflip    | (none by default)            | SILENT wrong answer — the threat model (pinned in test_abft.py) |
+| bitflip + ABFT | exchange slab checksum   | rollback self-heal, bitwise |
+| bitflip + audit | true-residual audit     | rollback self-heal, bitwise |
+| drop       | exchange deadline            | ExchangeTimeoutError, typed; survivable by restart |
+| delay      | nothing to detect            | clean completion (slow host is not an error) |
+| controller | runtime surface              | ControllerLostError; survivable by restart |
+"""
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.models import (
+    assemble_poisson,
+    cg,
+    gather_pvector,
+    solve_with_recovery,
+)
+from partitionedarrays_jl_tpu.parallel.faults import inject_faults
+from partitionedarrays_jl_tpu.parallel.health import (
+    ControllerLostError,
+    ExchangeTimeoutError,
+    NonFiniteError,
+    SilentCorruptionError,
+)
+
+
+def _run(driver):
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_matrix_nan_typed_then_recovers():
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        x_clean, _ = cg(A, b, x0=x0, tol=1e-9)
+        with inject_faults("nan@part=1,call=9", seed=1):
+            with pytest.raises(NonFiniteError):
+                cg(A, b, x0=x0, tol=1e-9)
+        with inject_faults("nan@part=1,call=9", seed=1):
+            x, info = solve_with_recovery(A, b, x0=x0, tol=1e-9)
+        assert info["converged"] and info["restarts"] == 1
+        np.testing.assert_array_equal(
+            gather_pvector(x_clean), gather_pvector(x)
+        )
+        return True
+
+    _run(driver)
+
+
+def test_matrix_nan_under_abft_heals_in_memory(monkeypatch):
+    monkeypatch.setenv("PA_TPU_ABFT", "1")
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        x_clean, _ = cg(A, b, x0=x0, tol=1e-9)
+        with inject_faults("nan@part=1,call=9", seed=1):
+            x, info = cg(A, b, x0=x0, tol=1e-9)
+        assert info["converged"] and info["sdc"]["rollbacks"] == 1
+        np.testing.assert_array_equal(
+            gather_pvector(x_clean), gather_pvector(x)
+        )
+        return True
+
+    _run(driver)
+
+
+def test_matrix_bitflip_under_abft_heals_bitwise(monkeypatch):
+    monkeypatch.setenv("PA_TPU_ABFT", "1")
+    monkeypatch.setenv("PA_HEALTH_AUDIT_EVERY", "6")
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        x_clean, _ = cg(A, b, x0=x0, tol=1e-9)
+        with inject_faults("bitflip@part=1,call=9,bit=51", seed=7) as st:
+            x, info = cg(A, b, x0=x0, tol=1e-9)
+        assert any(e["kind"] == "bitflip" for e in st.events)
+        assert info["converged"] and info["sdc"]["detections"] == 1
+        np.testing.assert_array_equal(
+            gather_pvector(x_clean), gather_pvector(x)
+        )
+        return True
+
+    _run(driver)
+
+
+def test_matrix_drop_typed_timeout():
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        with inject_faults("drop@part=2,call=5", seed=0) as st:
+            with pytest.raises(ExchangeTimeoutError) as ei:
+                cg(A, b, x0=x0, tol=1e-9)
+        assert ei.value.diagnostics["missing_parts"] == [2]
+        assert st.events[0]["kind"] == "drop"
+        return True
+
+    _run(driver)
+
+
+def test_matrix_delay_completes_clean():
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        with inject_faults("delay@call=3,seconds=0.0", seed=0) as st:
+            x, info = cg(A, b, x0=x0, tol=1e-9)
+        assert info["converged"]  # a slow host is not an error
+        assert st.events[0]["kind"] == "delay"
+        return True
+
+    _run(driver)
+
+
+def test_matrix_controller_typed_then_recovers():
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        with inject_faults("controller@call=6", seed=0):
+            with pytest.raises(ControllerLostError):
+                cg(A, b, x0=x0, tol=1e-9)
+        with inject_faults("controller@call=6", seed=0):
+            x, info = solve_with_recovery(A, b, x0=x0, tol=1e-9)
+        assert info["converged"] and info["restarts"] == 1
+        assert info["recovery"]["attempts"] == 2
+        return True
+
+    _run(driver)
+
+
+def test_matrix_never_returns_silently_wrong(monkeypatch):
+    """The bottom line of the matrix: with the defense on, a PERSISTENT
+    bitflip stream either heals or raises typed — across the whole
+    ladder it never returns a wrong iterate labelled converged."""
+    monkeypatch.setenv("PA_TPU_ABFT", "1")
+    monkeypatch.setenv("PA_HEALTH_MAX_ROLLBACKS", "1")
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        with inject_faults("bitflip@part=*,after=0,bit=51,prob=0.5", seed=9):
+            with pytest.raises(SilentCorruptionError):
+                solve_with_recovery(
+                    A, b, x0=x0, tol=1e-9, max_restarts=1
+                )
+        return True
+
+    _run(driver)

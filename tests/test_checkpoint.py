@@ -144,16 +144,120 @@ def test_pvector_sharded_roundtrip_cross_partition(tmp_path):
     import json
 
     assert len(glob.glob(os.path.join(d, "shard00003-*.npz"))) == 1
-    # a second in-place save publishes a fresh generation and removes the
-    # old shards (crash-atomicity: index.json names the live generation)
+    # a second in-place save publishes a fresh generation and RETAINS the
+    # previous one as the bit-rot fallback (KEEP_GENERATIONS=2); a third
+    # save rotates the oldest out (crash-atomicity: index.json names the
+    # retained generations, everything else is garbage)
     with open(os.path.join(d, "index.json")) as f:
         gen1 = json.load(f)["gen"]
     pa.prun(save4, pa.sequential, 4)
     with open(os.path.join(d, "index.json")) as f:
-        gen2 = json.load(f)["gen"]
+        idx2 = json.load(f)
+    gen2 = idx2["gen"]
     assert gen1 != gen2
+    assert [g["gen"] for g in idx2["generations"]] == [gen2, gen1]
     shards = glob.glob(os.path.join(d, "shard*.npz"))
-    assert len(shards) == 4 and all(f"-{gen2}." in s for s in shards)
+    assert len(shards) == 8 and all(
+        f"-{gen2}." in s or f"-{gen1}." in s for s in shards
+    )
+    pa.prun(save4, pa.sequential, 4)
+    with open(os.path.join(d, "index.json")) as f:
+        idx3 = json.load(f)
+    gen3 = idx3["gen"]
+    assert [g["gen"] for g in idx3["generations"]] == [gen3, gen2]
+    shards = glob.glob(os.path.join(d, "shard*.npz"))
+    assert len(shards) == 8 and not any(f"-{gen1}." in s for s in shards)
+    # every retained shard's CRC is committed in its generation entry
+    for g in idx3["generations"]:
+        assert set(g["shards"]) == {
+            os.path.basename(s)
+            for s in glob.glob(os.path.join(d, f"shard*-{g['gen']}.npz"))
+        }
+
+
+def test_sharded_truncated_shard_falls_back_to_previous_generation(
+    tmp_path, capsys
+):
+    """Bit-rot defense: truncate one shard of the NEWEST generation
+    mid-directory — the loader detects the CRC mismatch and falls back
+    to the previous committed generation (written before the value
+    change, so the values prove which generation was read). Rotting
+    BOTH generations raises the typed CheckpointCorruptError."""
+    import glob
+    import json
+    import os
+
+    from partitionedarrays_jl_tpu.parallel.checkpoint import (
+        CheckpointCorruptError,
+    )
+
+    d = str(tmp_path / "vshard")
+    vals = {}
+
+    def save(parts, scale):
+        rows = pa.prange(parts, 24)
+        v = pa.PVector(
+            pa.map_parts(
+                lambda i: scale * np.asarray(i.oid_to_gid, dtype=float),
+                rows.partition,
+            ),
+            rows,
+        )
+        pa.save_pvector_sharded(d, v)
+        vals[scale] = gather_pvector(v)
+        return True
+
+    def load(parts):
+        rows = pa.prange(parts, 24)
+        return gather_pvector(pa.load_pvector_sharded(d, rows))
+
+    assert pa.prun(save, pa.sequential, 4, 1.0)  # generation 1
+    assert pa.prun(save, pa.sequential, 4, 2.0)  # generation 2 (newest)
+    with open(os.path.join(d, "index.json")) as f:
+        idx = json.load(f)
+    gen2, gen1 = [g["gen"] for g in idx["generations"]]
+    # truncate one newest-generation shard (a crash/bit-rot mid-file)
+    victim = sorted(glob.glob(os.path.join(d, f"shard*-{gen2}.npz")))[1]
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) // 2)
+    out = pa.prun(load, pa.sequential, 4)
+    np.testing.assert_array_equal(out, vals[1.0])  # the FALLBACK values
+    assert "falling back" in capsys.readouterr().err
+    # rot the fallback too: no clean generation left -> typed error
+    victim1 = sorted(glob.glob(os.path.join(d, f"shard*-{gen1}.npz")))[0]
+    with open(victim1, "r+b") as f:
+        f.write(b"\x00" * 16)
+    with pytest.raises(CheckpointCorruptError):
+        pa.prun(load, pa.sequential, 4)
+
+
+def test_whole_object_checkpoint_crc_detects_rot(tmp_path):
+    """Non-sharded checkpoints record per-object CRCs in the manifest;
+    a truncated object file raises CheckpointCorruptError instead of a
+    deep np.load crash, and solve_with_recovery degrades that to a
+    scratch restart rather than dying (covered by the recovery path's
+    except clause)."""
+    from partitionedarrays_jl_tpu.parallel.checkpoint import (
+        CheckpointCorruptError,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    d = str(tmp_path / "ck")
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (6, 6))
+        save_checkpoint(d, {"x": b}, meta={"it": 3})
+        p = os.path.join(d, "x.npz")
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) - 8)
+        with pytest.raises(CheckpointCorruptError):
+            load_checkpoint(d, {"x": b.rows})
+        return True
+
+    import os
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
 
 
 def test_psparse_sharded_roundtrip_and_repartition(tmp_path):

@@ -188,3 +188,40 @@ def test_scale_curve_fused_headline_consistent_with_bench():
     )
     # the A/B leg is present wherever the fused default is the headline
     assert "cg_unfused_s_per_it" in row and "cg_fused_speedup" in row
+
+
+def test_abft_artifact_agrees_with_guard_bands():
+    """The committed ABFT clean-path artifact (round 8) and the bench
+    guard must agree: identical band bounds, the recorded
+    collective-count parity (the zero-extra-collectives claim) actually
+    TRUE with identical per-kind counts, and the overhead rows
+    self-consistent. Device-kind bands gate only records measured on
+    real TPUs — a cpu-platform record is the structural canary (its
+    note must say so), never silently passed off as the acceptance
+    number."""
+    bench_abft = _load_tool("bench_abft")
+    rec = json.load(open(os.path.join(REPO, "ABFT_BENCH.json")))
+    assert rec["methodology"] == bench_abft.METHODOLOGY
+    for key, (lo, hi, kind) in bench_abft.ABFT_BANDS.items():
+        band = rec["bands"].get(key)
+        assert band is not None, f"artifact missing band {key}"
+        assert (band["lo"], band["hi"], band["kind"]) == (lo, hi, kind), (
+            key, band,
+        )
+    par = rec["collective_parity"]
+    assert par["parity"] is True
+    assert par["counts_on"] == par["counts_off"]
+    assert any(par["counts_on"].values()), "parity probe saw no collectives"
+    for row in rec["sizes"]:
+        assert row["dofs"] == row["n"] ** 3
+        ratio = row["abft_on_s_per_it"] / row["abft_off_s_per_it"]
+        assert abs(row["overhead_ratio"] - ratio) <= 1e-3 * ratio, row
+    if rec["platform"] == "tpu":
+        ns = {row["n"] for row in rec["sizes"]}
+        assert set(bench_abft.DEVICE_SIZES) <= ns
+        assert rec["bands_ok_device"] is True
+    else:
+        # the canary must declare itself: platform recorded, device
+        # verdict left open, and the note explains the gating
+        assert rec["bands_ok_device"] is None
+        assert "real TPUs" in rec["note"]
