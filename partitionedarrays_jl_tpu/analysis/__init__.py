@@ -1,0 +1,71 @@
+"""palint — static program-contract analysis for lowered solver bodies.
+
+Three layers (docs/static_analysis.md has the full catalog and CLI
+usage; `tools/palint.py --check` is the command-line gate):
+
+* `analysis.program_report` — parse the lowered text of any compiled
+  body into a structured `ProgramReport` (per-kind collective counts
+  and payload bytes, dtype inventory, while-loop carry shapes, copy
+  and host-transfer op counts). `collective_counts` is the shared
+  successor of the three historical per-test-file helpers.
+* `analysis.contracts` — the structural invariants (ABFT collective
+  parity, K-independence, block ≤ solo, dtype closure, copy budget,
+  no-host-transfer-inside-loop) as declarative `Contract` objects
+  checked against reports over the lowering matrix
+  (`parallel.tpu.lowering_matrix`).
+* `analysis.env_lint` — AST lint proving every lowering-affecting
+  ``PA_*`` env flag is resolved by a registered cache-key site and
+  documented in docs/api.md.
+"""
+from .contracts import (  # noqa: F401
+    CONTRACTS,
+    Contract,
+    Violation,
+    check_contracts,
+    contract_by_name,
+)
+from .env_lint import (  # noqa: F401
+    NON_LOWERING,
+    EnvRead,
+    classify,
+    documented_env_names,
+    env_read_inventory,
+    key_coverage,
+    lint_env_keys,
+    lowering_reads,
+)
+from .matrix import build_reports, run_matrix  # noqa: F401
+from .program_report import (  # noqa: F401
+    COLLECTIVE_KINDS,
+    ProgramReport,
+    WhileLoop,
+    analyze,
+    analyze_text,
+    collective_counts,
+    lower_text,
+)
+
+__all__ = [
+    "COLLECTIVE_KINDS",
+    "CONTRACTS",
+    "Contract",
+    "EnvRead",
+    "NON_LOWERING",
+    "ProgramReport",
+    "Violation",
+    "WhileLoop",
+    "analyze",
+    "analyze_text",
+    "build_reports",
+    "check_contracts",
+    "classify",
+    "collective_counts",
+    "contract_by_name",
+    "documented_env_names",
+    "env_read_inventory",
+    "key_coverage",
+    "lint_env_keys",
+    "lower_text",
+    "lowering_reads",
+    "run_matrix",
+]

@@ -1,0 +1,335 @@
+"""The program-contract registry: structural invariants of the lowered
+solver bodies as declarative objects.
+
+Each `Contract` inspects the `ProgramReport`s of the lowering matrix
+(`parallel.tpu.lowering_matrix`) and returns `Violation`s. The
+invariants here are the ones the test tree used to assert ad hoc —
+PR 3's K-independence, PR 4's ABFT collective parity — plus the two
+regression canaries for bug classes this repo has actually shipped
+fixes for:
+
+* **dtype closure** (the PR 3 f64-poisoning class: an empty-receiver
+  Table exchange allocated f64 into an f32-staged GMG hierarchy) — an
+  f32-staged program must lower with NO f64 op anywhere;
+* **copy budget** (the PR 2 buffer-copy-anomaly class: XLA's while-loop
+  carry copies spiked 2–3× in the 292³–300³ window until the packed
+  (3, W) carry sidestepped them) — the compiled (optimized-HLO) body
+  may not grow its ``copy`` op count past a pinned budget.
+
+Contracts compare COUNTS and STRUCTURE, never timings — they are
+deterministic, platform-independent, and cheap enough for CI.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .program_report import (
+    COLLECTIVE_KINDS,
+    _SPMD_CUSTOM_CALLS,
+    ProgramReport,
+)
+
+#: Compiled-HLO ``copy`` op budgets per matrix case (measured on the
+#: fixed (6,6,6)/(2,2,2) probe; headroom ≈ 2× so routine XLA version
+#: drift passes but a PR 2-class regression — copies scaling with the
+#: carry count — trips loudly). Budgets exist only for the cases palint
+#: compiles; lowered-only cases have no ``copy`` ops to budget.
+COPY_BUDGETS: Dict[str, int] = {
+    "standard": 40,
+    "fused": 40,
+}
+
+
+@dataclass
+class Violation:
+    contract: str
+    cases: List[str]
+    message: str
+    expected: object = None
+    found: object = None
+
+    def __str__(self):
+        s = f"[{self.contract}] {'/'.join(self.cases)}: {self.message}"
+        if self.expected is not None or self.found is not None:
+            s += f"\n    expected: {self.expected}\n    found:    {self.found}"
+        return s
+
+
+@dataclass
+class Contract:
+    """One declarative invariant over the lowering matrix.
+
+    ``check(reports, cases)`` gets every report keyed by case name
+    (compiled-HLO reports under ``<name>__compiled``) plus the case
+    descriptors, and returns violations. A contract must SKIP silently
+    when the cases it speaks about are absent from the build (the fast
+    tier-1 subset lowers fewer cases than palint's full matrix).
+    """
+
+    name: str
+    description: str
+    check: Callable[
+        [Dict[str, ProgramReport], Dict[str, dict]], List[Violation]
+    ] = field(repr=False, default=None)
+
+
+def _counts(rep: ProgramReport) -> Dict[str, int]:
+    return {k: rep.collectives.get(k, 0) for k in COLLECTIVE_KINDS}
+
+
+def _check_sanity(reports, cases):
+    """The parser-rot guard: if the analyzer stopped seeing collectives
+    at all, every equality contract would pass vacuously — so the
+    baseline program must show a nonzero inventory and its while loop."""
+    out = []
+    rep = reports.get("standard")
+    if rep is None:
+        return out
+    if not any(_counts(rep).values()):
+        out.append(Violation(
+            "sanity", ["standard"],
+            "baseline program shows NO collectives — analyzer rot or a "
+            "broken lowering", found=_counts(rep),
+        ))
+    if rep.dialect == "stablehlo":
+        if not rep.while_loops:
+            out.append(Violation(
+                "sanity", ["standard"],
+                "baseline program shows no while loop — the CG body did "
+                "not lower as one compiled loop",
+            ))
+        elif not any(
+            f"stablehlo.{k}" in w.region_text
+            for w in rep.while_loops for k in COLLECTIVE_KINDS
+        ):
+            # region capture itself can rot (printer format drift would
+            # truncate the body and let the loop-residency contract pass
+            # vacuously) — the solve loop's body MUST show its halo/dot
+            # collectives
+            out.append(Violation(
+                "sanity", ["standard"],
+                "no collective inside any captured while region — region "
+                "capture truncated (printer drift?) or the loop lost its "
+                "halo exchange",
+            ))
+    return out
+
+
+def _check_abft_parity(reports, cases):
+    """PR 4's acceptance invariant: ABFT detection rides WIDENED
+    payloads (checksum lanes on the dot gather, one slot per exchange
+    round) — per-kind collective counts identical ON vs OFF."""
+    out = []
+    for name, case in cases.items():
+        off_name = case.get("tags", {}).get("abft_off")
+        if not off_name or name not in reports or off_name not in reports:
+            continue
+        con, coff = _counts(reports[name]), _counts(reports[off_name])
+        if con != coff:
+            out.append(Violation(
+                "abft-collective-parity", [name, off_name],
+                "ABFT-on program changes per-kind collective counts — "
+                "detection must ride existing collectives, never add one",
+                expected=coff, found=con,
+            ))
+    return out
+
+
+def _check_k_independence(reports, cases):
+    """PR 3's acceptance invariant: the block program's per-iteration
+    collective count is K-independent (dot payloads widen to (K,)/(K,2)
+    stacks on the SAME gathers; halo rounds ship (…, K) slabs)."""
+    out = []
+    by_body: Dict[str, List[str]] = {}
+    for name, case in cases.items():
+        tags = case.get("tags", {})
+        if tags.get("body") == "block" and name in reports and (
+            "plan" not in tags and "abft" not in tags
+        ):
+            by_body.setdefault(tags.get("block_of", "?"), []).append(name)
+    for body, names in by_body.items():
+        names = sorted(names, key=lambda n: cases[n]["tags"].get("K", 0))
+        if len(names) < 2:
+            continue
+        base = _counts(reports[names[0]])
+        for other in names[1:]:
+            oc = _counts(reports[other])
+            if oc != base:
+                out.append(Violation(
+                    "k-independence", [names[0], other],
+                    f"block-{body} collective counts depend on K",
+                    expected=base, found=oc,
+                ))
+    return out
+
+
+def _check_block_le_solo(reports, cases):
+    """The K=1 block program must not pay MORE collectives than the
+    solo program of the same body — widening payloads is free, extra
+    rounds are not."""
+    out = []
+    for name, case in cases.items():
+        tags = case.get("tags", {})
+        if tags.get("body") != "block" or tags.get("K") != 1:
+            continue
+        solo = tags.get("block_of")
+        if name not in reports or solo not in reports:
+            continue
+        cb, cs = _counts(reports[name]), _counts(reports[solo])
+        for kind in COLLECTIVE_KINDS:
+            if cb[kind] > cs[kind]:
+                out.append(Violation(
+                    "block-le-solo", [name, solo],
+                    f"K=1 block program pays more {kind} than the solo "
+                    f"{solo} body",
+                    expected=f"<= {cs[kind]}", found=cb[kind],
+                ))
+    return out
+
+
+def _check_fused_no_extra(reports, cases):
+    """PR 2's acceptance invariant: the fused body restructures VECTOR
+    sweeps — it must not add collectives over the standard body."""
+    out = []
+    if "standard" not in reports or "fused" not in reports:
+        return out
+    cu, cf = _counts(reports["standard"]), _counts(reports["fused"])
+    for kind in COLLECTIVE_KINDS:
+        if cf[kind] > cu[kind]:
+            out.append(Violation(
+                "fused-no-extra-collectives", ["fused", "standard"],
+                f"fused body pays more {kind} than the standard body",
+                expected=f"<= {cu[kind]}", found=cf[kind],
+            ))
+    return out
+
+
+def _check_dtype_closure(reports, cases):
+    """The PR 3 f64-poisoning canary: an f32-staged program must lower
+    CLOSED over f32 — any f64 tensor anywhere in it is exactly the
+    class of silent upcast that poisoned the f32 GMG hierarchy (an
+    empty-receiver exchange allocating in the default dtype)."""
+    out = []
+    for name, case in cases.items():
+        if case.get("tags", {}).get("staged") != "f32":
+            continue
+        for rname in (name, name + "__compiled"):
+            rep = reports.get(rname)
+            if rep is None:
+                continue
+            if "f64" in rep.float_dtypes:
+                lines = rep.f64_lines[:8]
+                out.append(Violation(
+                    "dtype-closure", [rname],
+                    "f32-staged program contains f64 ops (the PR 3 "
+                    f"poisoning class) — first hits at lines {lines}",
+                    expected="no f64 tensor in the lowering",
+                    found=f"f64 on {len(rep.f64_lines)} lines",
+                ))
+    return out
+
+
+def _check_no_host_transfer_in_loop(reports, cases):
+    """The solve loop must be device-resident: no infeed/outfeed or
+    non-SPMD custom-call inside any while region (a host round-trip per
+    iteration is a 1000× iteration-latency regression on a real TPU)."""
+    out = []
+    for name, rep in reports.items():
+        if rep.dialect != "stablehlo":
+            continue
+        for w in rep.while_loops:
+            bad = []
+            for marker in ("stablehlo.infeed", "stablehlo.outfeed"):
+                if marker in w.region_text:
+                    bad.append(marker)
+            for m in re.finditer(r"custom_call\s+@(\w+)", w.region_text):
+                if m.group(1) not in _SPMD_CUSTOM_CALLS:
+                    bad.append(f"custom_call @{m.group(1)}")
+            if bad:
+                out.append(Violation(
+                    "no-host-transfer-in-loop", [name],
+                    f"while loop at line {w.line} contains host-transfer "
+                    "ops — the solve loop must stay device-resident",
+                    expected="none", found=bad,
+                ))
+    return out
+
+
+def _check_copy_budget(reports, cases):
+    """The PR 2 buffer-copy canary: the compiled body's ``copy`` count
+    is the structural signature of XLA's while-carry copies — the
+    anomaly class that cost 2–3× in the 292³–300³ window. Budgets are
+    pinned per body with ~2× headroom; a body whose copies jump past
+    its budget regressed structurally even if today's wall-clock looks
+    fine."""
+    out = []
+    for name, budget in COPY_BUDGETS.items():
+        rep = reports.get(name + "__compiled")
+        if rep is None:
+            continue
+        if rep.copies > budget:
+            out.append(Violation(
+                "copy-budget", [name],
+                "compiled program's copy-op count blew its budget (the "
+                "PR 2 buffer-copy-anomaly canary)",
+                expected=f"<= {budget}", found=rep.copies,
+            ))
+    return out
+
+
+CONTRACTS: List[Contract] = [
+    Contract("sanity",
+             "baseline program shows collectives and a while loop "
+             "(guards the analyzer itself against parser rot)",
+             _check_sanity),
+    Contract("abft-collective-parity",
+             "per-kind collective counts identical ABFT on vs off "
+             "(detection rides widened payloads — PR 4)",
+             _check_abft_parity),
+    Contract("k-independence",
+             "block-CG per-iteration collective counts independent of K "
+             "(payloads widen, rounds don't — PR 3)",
+             _check_k_independence),
+    Contract("block-le-solo",
+             "K=1 block program pays no more collectives than the solo "
+             "body (PR 3)",
+             _check_block_le_solo),
+    Contract("fused-no-extra-collectives",
+             "fused body adds no collectives over the standard body "
+             "(PR 2)",
+             _check_fused_no_extra),
+    Contract("dtype-closure",
+             "f32-staged programs lower with zero f64 ops (the PR 3 "
+             "f64-poisoning class)",
+             _check_dtype_closure),
+    Contract("no-host-transfer-in-loop",
+             "no infeed/outfeed/non-SPMD custom-call inside any while "
+             "region",
+             _check_no_host_transfer_in_loop),
+    Contract("copy-budget",
+             "compiled copy-op count within the pinned per-body budget "
+             "(the PR 2 buffer-copy-anomaly canary)",
+             _check_copy_budget),
+]
+
+
+def contract_by_name(name: str) -> Optional[Contract]:
+    for c in CONTRACTS:
+        if c.name == name:
+            return c
+    return None
+
+
+def check_contracts(
+    reports: Dict[str, ProgramReport],
+    cases: Dict[str, dict],
+    contracts: Optional[List[Contract]] = None,
+) -> List[Violation]:
+    """Run every contract against the built reports; returns all
+    violations (empty = the lowering matrix honors its contracts)."""
+    out: List[Violation] = []
+    for c in contracts or CONTRACTS:
+        out.extend(c.check(reports, cases))
+    return out

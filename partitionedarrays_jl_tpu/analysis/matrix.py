@@ -1,0 +1,79 @@
+"""Drive the lowering matrix: build every case's program text, analyze
+it, and run the contract registry.
+
+This is the layer `tools/palint.py` and tests/test_static_analysis.py
+share. `parallel.tpu.lowering_matrix` enumerates the cases and
+`parallel.tpu.case_program_text` builds each one against the fixed
+probe system; here we turn them into `ProgramReport`s and hand the lot
+to `contracts.check_contracts`.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .contracts import COPY_BUDGETS, Violation, check_contracts
+from .program_report import ProgramReport, analyze_text
+
+
+def _default_backend():
+    import jax
+
+    from ..parallel.tpu import TPUBackend
+
+    n = min(8, len(jax.devices()))
+    return TPUBackend(devices=jax.devices()[:n])
+
+
+def build_reports(
+    backend=None,
+    fast: bool = False,
+    with_compiled: bool = False,
+    only: Optional[Iterable[str]] = None,
+    verbose=None,
+) -> Tuple[Dict[str, dict], Dict[str, ProgramReport]]:
+    """Lower (and optionally compile) the matrix, returning
+    ``(cases_by_name, reports_by_name)``. Compiled-HLO reports (the
+    copy-budget cases, `contracts.COPY_BUDGETS`) land under
+    ``<name>__compiled``. ``only`` restricts to the named cases."""
+    from ..parallel.tpu import case_program_texts, lowering_matrix
+
+    backend = backend or _default_backend()
+    cases = {c["name"]: c for c in lowering_matrix(fast=fast)}
+    if only is not None:
+        only = set(only)
+        cases = {k: v for k, v in cases.items() if k in only}
+    reports: Dict[str, ProgramReport] = {}
+    for name, case in cases.items():
+        # compiled legs: the copy-budget canaries, plus every f32-staged
+        # probe — dtype-closure checks `<name>__compiled` too, hunting
+        # f64 ops XLA introduces only during compilation (a backend
+        # upcast invisible in StableHLO, the PR 3 poisoning class)
+        compile_this = with_compiled and (
+            name in COPY_BUDGETS
+            or case.get("tags", {}).get("staged") == "f32"
+        )
+        if verbose:
+            verbose(
+                f"lowering {name} ..."
+                + (" (+ compiled copy-budget leg)" if compile_this else "")
+            )
+        stablehlo, hlo = case_program_texts(
+            backend, case, with_compiled=compile_this
+        )
+        reports[name] = analyze_text(stablehlo)
+        if compile_this:
+            reports[name + "__compiled"] = analyze_text(hlo)
+    return cases, reports
+
+
+def run_matrix(
+    backend=None,
+    fast: bool = False,
+    with_compiled: bool = False,
+    verbose=None,
+) -> Tuple[List[Violation], Dict[str, ProgramReport]]:
+    """Build reports for the matrix and check every contract."""
+    cases, reports = build_reports(
+        backend, fast=fast, with_compiled=with_compiled, verbose=verbose
+    )
+    return check_contracts(reports, cases), reports

@@ -28,6 +28,7 @@ scatter lanes so no dynamic shapes or bound checks reach the compiled code.
 """
 from __future__ import annotations
 
+import contextlib
 import itertools
 import math
 import os
@@ -669,17 +670,45 @@ class ELLFootprintError(RuntimeError):
 ELL_MAX_GATHER = int(2.5e7)
 
 
+def _ell_guard_env() -> tuple:
+    """The ONE resolution of the padded-ELL admission guard's env pair
+    (the one-helper-per-mode rule: the staging-admission site and the
+    cache-key site must never disagree): ``(mode, ceiling)`` with the
+    ceiling NORMALIZED to an int — so spelling the default explicitly
+    (``PA_TPU_ELL_MAX_GATHER=25000000`` vs ``2.5e7`` vs unset) yields
+    the same key and does not spuriously invalidate compiled-program
+    caches."""
+    mode = os.environ.get("PA_TPU_ELL_GUARD", "auto")
+    raw = os.environ.get("PA_TPU_ELL_MAX_GATHER")
+    if raw in (None, ""):
+        ceiling = ELL_MAX_GATHER
+    else:
+        try:
+            ceiling = int(float(raw))
+        except (ValueError, OverflowError):
+            # unparseable (or inf — int(float("inf")) raises
+            # OverflowError): key on the raw string (each distinct
+            # spelling still rekeys); only the ACTIVE guard site turns this
+            # into an error — with the guard disabled the knob stays
+            # ignored, as it always was
+            ceiling = raw
+    return mode, ceiling
+
+
 def _ell_guard_check(P: int, no_max: int, L_oo: int, backend) -> None:
     """Refuse (real TPU) or warn (host mesh) when the padded-ELL gather
     footprint is past the device-fault ceiling. Called by the lowering
     BEFORE the ELL arrays are built, whether ELL was auto-selected (every
     fast path declined) or forced by strict-bits mode."""
-    mode = os.environ.get("PA_TPU_ELL_GUARD", "auto")
+    mode, ceiling = _ell_guard_env()
     if mode == "0":
         return
-    ceiling = int(
-        float(os.environ.get("PA_TPU_ELL_MAX_GATHER", ELL_MAX_GATHER))
-    )
+    if isinstance(ceiling, str):
+        raise ValueError(
+            f"PA_TPU_ELL_MAX_GATHER={ceiling!r} is not a finite integer "
+            "and the ELL guard is active — fix the override or set "
+            "PA_TPU_ELL_GUARD=0"
+        )
     footprint = int(no_max) * int(L_oo)
     if footprint <= ceiling:
         return
@@ -1822,6 +1851,15 @@ def _lowering_env_key() -> tuple:
         # (c·A) joins the operand pytree, and the exchange falls back to
         # the generic index plan (see _box_exchange_enabled)
         _abft_enabled(),
+        # staging-ADMISSION guards key too (the first palint env-lint
+        # finding): the ELL footprint guard is evaluated once, at stage
+        # time — without this entry a matrix staged under a raised
+        # PA_TPU_ELL_MAX_GATHER ceiling (or a disabled guard) keeps
+        # being served from cache after the override is dropped, i.e.
+        # the exact program the guard exists to refuse. Keying the
+        # RESOLVED guard pair re-runs admission on a real flip
+        # (tests/test_static_analysis.py pins the re-guard).
+        _ell_guard_env(),
     )
 
 
@@ -5112,3 +5150,201 @@ def _b_on_cols_layout(b: PVector, dA: DeviceMatrix) -> DeviceVector:
     jax = _jax()
     data = _stage(dA.backend, stacked, layout.P)
     return DeviceVector(data, dA.cols, layout, dA.backend)
+
+
+# ---------------------------------------------------------------------------
+# the lowering matrix: palint's program enumeration (analysis/)
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def _env_overrides(env: dict):
+    """Apply env-var overrides (value ``None`` deletes) for the scope of
+    a with-block, restoring the previous state on exit. Used by the
+    lowering-matrix report hook so each case's programs are built under
+    exactly the case's mode set, whatever the ambient environment."""
+    old = {k: os.environ.get(k) for k in env}
+    try:
+        for k, v in env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+#: EVERY lowering-affecting flag, pinned to its default for matrix
+#: cases unless the case explicitly overrides — a case's program (and
+#: the contracts/copy-budgets pinned against it) must not depend on
+#: what the ambient shell happened to export. This list must stay the
+#: full lowering-affecting set the env lint classifies;
+#: tests/test_static_analysis.py pins the agreement.
+_MATRIX_BASE_ENV = {
+    "PA_TPU_ABFT": None,
+    "PA_TPU_STRICT_BITS": None,
+    "PA_HEALTH_AUDIT_EVERY": None,
+    "PA_TPU_FUSED_CG": None,
+    "PA_TPU_BOX": None,
+    "PA_FAULT_DEVICE": None,
+    "PA_TPU_ABFT_TOL": None,
+    "PA_HEALTH_AUDIT_TOL": None,
+    "PA_TPU_BSR": None,
+    "PA_TPU_SD": None,
+    "PA_TPU_CLASS_ACC": None,
+    "PA_TPU_OH_BUCKETS": None,
+    "PA_TPU_ELL_GUARD": None,
+    "PA_TPU_ELL_MAX_GATHER": None,
+    "PA_HEALTH_ROLLBACK_DEPTH": None,
+    "PA_HEALTH_MAX_ROLLBACKS": None,
+    "PA_TPU_GMG_BOX": None,
+    "PA_TPU_GMG_STENCIL": None,
+}
+
+
+def lowering_matrix(fast: bool = False):
+    """Enumerate the compiled-CG lowering variants whose structural
+    contracts palint checks (analysis/contracts.py): the CG body forms
+    (standard / fused / block rhs_batch∈{1,4}) crossed with the mode
+    axes that restructure their programs (ABFT on/off on the like-plan
+    PA_TPU_BOX=0 baseline — the same A/B discipline as
+    tests/test_abft.py — and strict-bits, which pins the unfused ELL
+    oracle).
+
+    Each case is a plain dict: ``name``, ``env`` (overrides layered on
+    `_MATRIX_BASE_ENV`), ``kwargs`` (forwarded to `make_cg_fn`),
+    ``dtype`` (probe-system dtype), and ``tags`` (the contract layer's
+    grouping labels). ``fast=True`` returns the tier-1 subset (the
+    cheap cases every CI run lowers); the full set is palint's.
+    """
+    nobox = {"PA_TPU_BOX": "0"}
+    abft = {"PA_TPU_ABFT": "1", "PA_TPU_BOX": "0"}
+    cases = [
+        dict(name="standard", env={}, kwargs={"fused": False},
+             dtype="f64", tags={"body": "standard"}),
+        dict(name="fused", env={}, kwargs={"fused": True},
+             dtype="f64", tags={"body": "fused"}),
+        dict(name="block_k1_fused", env={},
+             kwargs={"fused": True, "rhs_batch": 1},
+             dtype="f64", tags={"body": "block", "K": 1, "block_of": "fused"}),
+        dict(name="block_k4_fused", env={},
+             kwargs={"fused": True, "rhs_batch": 4},
+             dtype="f64", tags={"body": "block", "K": 4, "block_of": "fused"}),
+        dict(name="standard_nobox", env=nobox, kwargs={"fused": False},
+             dtype="f64", tags={"body": "standard", "plan": "generic"}),
+        dict(name="standard_abft", env=abft, kwargs={"fused": False},
+             dtype="f64",
+             tags={"body": "standard", "abft": True,
+                   "abft_off": "standard_nobox"}),
+        dict(name="standard_f32", env={}, kwargs={"fused": False},
+             dtype="f32", tags={"body": "standard", "staged": "f32"}),
+    ]
+    if fast:
+        return cases
+    cases += [
+        dict(name="block_k1_standard", env={},
+             kwargs={"fused": False, "rhs_batch": 1},
+             dtype="f64",
+             tags={"body": "block", "K": 1, "block_of": "standard"}),
+        dict(name="block_k4_standard", env={},
+             kwargs={"fused": False, "rhs_batch": 4},
+             dtype="f64",
+             tags={"body": "block", "K": 4, "block_of": "standard"}),
+        dict(name="fused_nobox", env=nobox, kwargs={"fused": True},
+             dtype="f64", tags={"body": "fused", "plan": "generic"}),
+        dict(name="block_k4_fused_nobox", env=nobox,
+             kwargs={"fused": True, "rhs_batch": 4},
+             dtype="f64",
+             tags={"body": "block", "K": 4, "block_of": "fused",
+                   "plan": "generic"}),
+        dict(name="fused_abft", env=abft, kwargs={"fused": True},
+             dtype="f64",
+             tags={"body": "fused", "abft": True, "abft_off": "fused_nobox"}),
+        dict(name="block_k4_fused_abft", env=abft,
+             kwargs={"fused": True, "rhs_batch": 4},
+             dtype="f64",
+             tags={"body": "block", "K": 4, "block_of": "fused",
+                   "abft": True, "abft_off": "block_k4_fused_nobox"}),
+        dict(name="strict_standard", env={"PA_TPU_STRICT_BITS": "1"},
+             kwargs={"fused": False}, dtype="f64",
+             tags={"body": "standard", "strict": True}),
+        dict(name="fused_f32", env={}, kwargs={"fused": True},
+             dtype="f32", tags={"body": "fused", "staged": "f32"}),
+    ]
+    return cases
+
+
+def _matrix_probe_system(backend: "TPUBackend", dtype: str):
+    """The small fixed probe operator every matrix case lowers: the
+    (6, 6, 6) Poisson system on a (2, 2, 2) box partition — big enough
+    that every exchange round and both dot gathers appear, small enough
+    that the full matrix lowers in seconds. Cached per (backend token,
+    dtype) — the DeviceMatrix env-rekeying happens downstream in
+    `device_matrix`, not here."""
+    from ..models import assemble_poisson
+    from .backends import prun
+
+    np_dtype = np.float32 if dtype == "f32" else np.float64
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (6, 6, 6), dtype=np_dtype)
+        return A, b
+
+    cache = getattr(backend, "_palint_probe", None)
+    if cache is None:
+        cache = backend._palint_probe = {}
+    if dtype not in cache:
+        cache[dtype] = prun(driver, backend, (2, 2, 2))
+    return cache[dtype]
+
+
+def case_program_texts(
+    backend: "TPUBackend", case: dict, with_compiled: bool = False,
+    tol: float = 1e-9, maxiter: int = 50,
+) -> Tuple[str, Optional[str]]:
+    """The lowering-matrix report hook: build ``case``'s compiled-CG
+    program against the fixed probe system ONCE and return
+    ``(stablehlo_text, hlo_text)`` — the optimized-HLO leg (where the
+    ``copy``-budget canary lives) is derived from the same `Lowered`
+    object, not a second trace; it is None unless ``with_compiled``.
+    The case's env overrides are applied around BOTH the matrix staging
+    and the program build, so the program really is the one a user
+    under that environment gets — including the `_lowering_env_key`
+    rekeying path."""
+    env = dict(_MATRIX_BASE_ENV)
+    env.update(case.get("env", {}))
+    with _env_overrides(env):
+        A, b = _matrix_probe_system(backend, case.get("dtype", "f64"))
+        dA = device_matrix(A, backend)
+        ops = _matrix_operands(dA)
+        kwargs = dict(case.get("kwargs", {}))
+        rhs_batch = kwargs.get("rhs_batch")
+        fn = make_cg_fn(dA, tol, maxiter, **kwargs)
+        L = dA.col_plan.layout
+        np_dtype = np.float32 if case.get("dtype") == "f32" else np.float64
+        if rhs_batch:
+            z = np.zeros((L.P, L.W, rhs_batch), dtype=np_dtype)
+            args = (z, z, z[..., 0], ops)
+        else:
+            z = np.zeros((L.P, L.W), dtype=np_dtype)
+            args = (z, z, z, ops)
+        low = fn.jit_fn.lower(*args)
+        compiled = low.compile().as_text() if with_compiled else None
+        return low.as_text(), compiled
+
+
+def case_program_text(
+    backend: "TPUBackend", case: dict, compiled: bool = False,
+    tol: float = 1e-9, maxiter: int = 50,
+) -> str:
+    """One dialect of `case_program_texts` (StableHLO by default,
+    optimized HLO with ``compiled=True``)."""
+    stablehlo, hlo = case_program_texts(
+        backend, case, with_compiled=compiled, tol=tol, maxiter=maxiter
+    )
+    return hlo if compiled else stablehlo

@@ -26,8 +26,6 @@ the widened exchange rounds, and the audit's operand select on the one
 SpMV call site).
 """
 import os
-import re
-
 import numpy as np
 import pytest
 
@@ -560,12 +558,11 @@ def test_strict_bits_abft_on_off_identity(monkeypatch):
             np.testing.assert_array_equal(x_on, x_off)
 
 
-def _collective_counts(run_fn, *args):
-    txt = run_fn.jit_fn.lower(*args).as_text()
-    return {
-        k: len(re.findall(k, txt))
-        for k in ("collective_permute", "all_gather", "all_reduce")
-    }
+# the shared analyzer (one definition for the whole test tree — this
+# file used to carry a private regex copy; analysis.collective_counts
+# keeps the identical raw-substring semantics, pinned by
+# tests/test_static_analysis.py against a committed fixture)
+from partitionedarrays_jl_tpu.analysis import collective_counts  # noqa: E402
 
 
 def test_abft_collective_count_parity(monkeypatch):
@@ -610,7 +607,7 @@ def test_abft_collective_count_parity(monkeypatch):
             fn = make_cg_fn(dA, 1e-9, 100, fused=fused)
             db = np.zeros((dA.col_plan.layout.P, dA.col_plan.layout.W))
             args = (db, db, db, ops)
-        return _collective_counts(fn, *args)
+        return collective_counts(fn, *args)
 
     for fused in (False, True):
         con = counts(True, fused)

@@ -20,8 +20,6 @@ The block program's three contracts, each pinned here:
   with K separate SpMVs (bitwise under strict-bits, where the ELL path
   is the oracle).
 """
-import re
-
 import numpy as np
 import pytest
 
@@ -349,12 +347,11 @@ def test_fused_env_default_applies_to_block(monkeypatch):
 # ---------------------------------------------------------------------------
 
 
-def _collective_counts(run_fn, *args):
-    txt = run_fn.jit_fn.lower(*args).as_text()
-    return {
-        k: len(re.findall(k, txt))
-        for k in ("collective_permute", "all_gather", "all_reduce")
-    }
+# the shared analyzer (one definition for the whole test tree — this
+# file used to carry a private regex copy; analysis.collective_counts
+# keeps the identical raw-substring semantics, pinned by
+# tests/test_static_analysis.py against a committed fixture)
+from partitionedarrays_jl_tpu.analysis import collective_counts  # noqa: E402
 
 
 @pytest.mark.parametrize("fused", [False, True])
@@ -387,7 +384,7 @@ def test_block_collective_count_k_independent(fused, precond):
             dA, tol=1e-9, maxiter=50, fused=fused, precond=precond,
             rhs_batch=K,
         )
-        counts[K] = _collective_counts(
+        counts[K] = collective_counts(
             fn, db, dx0, db[..., 0] if mv is None else mv, ops
         )
     assert any(counts[1].values()), "no collectives found at all"
@@ -418,8 +415,8 @@ def test_block_matches_solo_collective_counts():
     for fused in (False, True):
         blk = make_cg_fn(dA, tol=1e-9, maxiter=50, fused=fused, rhs_batch=1)
         solo = make_cg_fn(dA, tol=1e-9, maxiter=50, fused=fused)
-        cb = _collective_counts(blk, db1, dx01, db1[..., 0], ops)
-        cs = _collective_counts(solo, db.data, dx0.data, db.data, ops)
+        cb = collective_counts(blk, db1, dx01, db1[..., 0], ops)
+        cs = collective_counts(solo, db.data, dx0.data, db.data, ops)
         for kind in cs:
             assert cb[kind] <= cs[kind], (fused, kind, cb, cs)
 

@@ -30,6 +30,7 @@ DOC_FILES = [
     "docs/api.md",
     "docs/migration.md",
     "docs/resilience.md",
+    "docs/static_analysis.md",
 ]
 
 #: Claims proven wrong by shipped code: these exact phrases must never
@@ -225,3 +226,58 @@ def test_abft_artifact_agrees_with_guard_bands():
         # verdict left open, and the note explains the gating
         assert rec["bands_ok_device"] is None
         assert "real TPUs" in rec["note"]
+
+
+def test_env_var_table_agrees_with_source_both_directions():
+    """docs/api.md's '## Environment variables' table is the exhaustive
+    env-flag surface, machine-checked against the package's actual
+    reads (analysis.env_lint AST inventory) in BOTH directions: a flag
+    the source reads but the table omits is an undocumented knob; a row
+    the source no longer reads is a ghost. (The same invariant gates
+    tools/palint.py --check; this copy keeps the doc-consistency suite
+    self-contained.)"""
+    from partitionedarrays_jl_tpu.analysis import (
+        documented_env_names,
+        env_read_inventory,
+    )
+
+    documented = documented_env_names(os.path.join(REPO, "docs", "api.md"))
+    read = {r.name for r in env_read_inventory()}
+    assert documented, "docs/api.md lost its '## Environment variables' table"
+    assert read - documented == set(), (
+        f"flags read in the package but undocumented: {read - documented}"
+    )
+    assert documented - read == set(), (
+        f"ghost rows documenting flags never read: {documented - read}"
+    )
+
+
+def test_env_table_lowering_rows_name_their_key_site():
+    """Every table row classed `lowering` must name the key site the
+    lint actually resolves it through — the docs may not claim a
+    coverage the AST cannot see."""
+    from partitionedarrays_jl_tpu.analysis import key_coverage
+    from partitionedarrays_jl_tpu.analysis.env_lint import (
+        classify,
+        env_table_rows,
+    )
+
+    cov = key_coverage()
+    cls = classify()
+    rows = env_table_rows(os.path.join(REPO, "docs", "api.md"))
+    # parser-rot guard: a table reformat that breaks the shared row
+    # extraction must fail here, not silently skip the invariants below
+    assert len(rows) >= len(cls), (len(rows), len(cls))
+    for name, rest in rows:
+        entry = cls.get(name)
+        # a ghost row (flag never read) is the both-directions test's
+        # finding — skip here so each failure stays self-explanatory
+        if entry is None:
+            continue
+        if entry["class"] == "lowering":
+            assert name in cov, f"{name} documented lowering but unkeyed"
+            assert f"`{cov[name]}`" in rest, (
+                f"row for {name} must name its key site `{cov[name]}`"
+            )
+        else:
+            assert "| lowering |" not in rest, name

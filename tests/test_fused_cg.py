@@ -20,8 +20,6 @@ The fusion's three contracts, each pinned here:
   has_pfold); validated on CPU through the Pallas interpreter exactly
   like the other padded-frame tests.
 """
-import re
-
 import numpy as np
 import pytest
 
@@ -223,12 +221,11 @@ def test_strict_bits_default_resolves_to_standard_body(monkeypatch):
 # ---------------------------------------------------------------------------
 
 
-def _collective_counts(run_fn, *args):
-    txt = run_fn.jit_fn.lower(*args).as_text()
-    return {
-        k: len(re.findall(k, txt))
-        for k in ("collective_permute", "all_gather", "all_reduce")
-    }
+# the shared analyzer (one definition for the whole test tree — this
+# file used to carry a private regex copy; analysis.collective_counts
+# keeps the identical raw-substring semantics, pinned by
+# tests/test_static_analysis.py against a committed fixture)
+from partitionedarrays_jl_tpu.analysis import collective_counts  # noqa: E402
 
 
 def test_fused_body_no_extra_collectives():
@@ -253,8 +250,8 @@ def test_fused_body_no_extra_collectives():
     ops = _matrix_operands(dA)
     fused = make_cg_fn(dA, tol=1e-9, maxiter=100, fused=True)
     unfused = make_cg_fn(dA, tol=1e-9, maxiter=100, fused=False)
-    cf = _collective_counts(fused, db.data, dx0.data, db.data, ops)
-    cu = _collective_counts(unfused, db.data, dx0.data, db.data, ops)
+    cf = collective_counts(fused, db.data, dx0.data, db.data, ops)
+    cu = collective_counts(unfused, db.data, dx0.data, db.data, ops)
     assert any(cu.values()), "unfused program shows no collectives at all"
     for kind in cu:
         assert cf[kind] <= cu[kind], (kind, cf, cu)
@@ -281,8 +278,8 @@ def test_fused_pcg_fewer_gathers_than_standard():
     ops = _matrix_operands(dA)
     fused = make_cg_fn(dA, tol=1e-9, maxiter=100, precond=True, fused=True)
     unfused = make_cg_fn(dA, tol=1e-9, maxiter=100, precond=True, fused=False)
-    cf = _collective_counts(fused, db.data, dx0.data, db.data, ops)
-    cu = _collective_counts(unfused, db.data, dx0.data, db.data, ops)
+    cf = collective_counts(fused, db.data, dx0.data, db.data, ops)
+    cu = collective_counts(unfused, db.data, dx0.data, db.data, ops)
     assert cf["all_gather"] < cu["all_gather"], (cf, cu)
 
 

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""palint — the static program-contract gate.
+
+Checks two things and exits nonzero if either fails:
+
+1. **Program contracts** (`analysis.contracts`): lower the compiled-CG
+   lowering matrix (`parallel.tpu.lowering_matrix` — standard / fused /
+   block K∈{1,4} × ABFT on-off × strict-bits, plus the f32-staged
+   dtype-closure probes) against the fixed (6,6,6)/(2,2,2) probe system
+   and check every registered contract: ABFT per-kind collective
+   parity, K-independence, block ≤ solo, fused adds no collectives,
+   dtype closure, no host transfer inside the loop, and the compiled
+   copy budget (the PR 2 canary — needs ``--compile``, on by default).
+2. **Env-key lint** (`analysis.env_lint`): every ``PA_*`` env read in
+   the package inventoried; every lowering-affecting one must be
+   resolved by a registered cache-key site (`_lowering_env_key` /
+   `_gmg_env_key` / `_sdc_config`) and documented in docs/api.md's
+   environment table (both directions).
+
+Usage:
+    python tools/palint.py --check            # the full gate (CI)
+    python tools/palint.py --check --fast     # tier-1 subset
+    python tools/palint.py --report           # per-case inventories
+    python tools/palint.py --check --no-compile --skip-lint
+
+Always runs on the CPU host mesh (8 virtual devices), even when real
+accelerators are visible — the contracts count STRUCTURE, which is
+identical across platforms, and forcing CPU keeps the gate fast and
+runnable anywhere.
+"""
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _setup_jax():
+    # plain assignment + config.update, NOT setdefault: the dev image's
+    # sitecustomize exports JAX_PLATFORMS=axon (the real-TPU tunnel) and
+    # pre-imports jax, so env vars alone are too late — same pattern as
+    # tests/conftest.py. The contracts count structure, which is
+    # identical on the virtual CPU mesh.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_ENABLE_X64"] = "true"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    return jax
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="run the full gate (contracts + env lint)")
+    ap.add_argument("--report", action="store_true",
+                    help="print per-case program inventories")
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 subset of the lowering matrix")
+    ap.add_argument("--no-compile", action="store_true",
+                    help="skip the compiled-HLO copy-budget cases")
+    ap.add_argument("--skip-matrix", action="store_true",
+                    help="env lint only")
+    ap.add_argument("--skip-lint", action="store_true",
+                    help="contract matrix only")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    if not (args.check or args.report):
+        ap.print_help()
+        return 2
+
+    failed = False
+
+    from partitionedarrays_jl_tpu.analysis import env_lint
+
+    if not args.skip_lint:
+        violations = env_lint.lint_env_keys()
+        cls = env_lint.classify()
+        n_low = sum(1 for e in cls.values() if e["class"] == "lowering")
+        print(
+            f"env lint: {len(cls)} PA_* flags inventoried, {n_low} "
+            "lowering-affecting, all key-covered"
+            if not violations
+            else f"env lint: {len(violations)} violation(s)"
+        )
+        for v in violations:
+            print(f"  LINT: {v}")
+            failed = True
+        if args.verbose and not violations:
+            for name, e in sorted(cls.items()):
+                keyed = e["keyed_by"] or "-"
+                print(f"  {name:32s} {e['class']:9s} keyed_by={keyed}")
+
+    if not args.skip_matrix:
+        _setup_jax()
+        from partitionedarrays_jl_tpu.analysis import (
+            build_reports,
+            check_contracts,
+        )
+
+        log = (lambda m: print(f"  {m}")) if args.verbose else None
+        cases, reports = build_reports(
+            fast=args.fast,
+            with_compiled=not args.no_compile,
+            verbose=log,
+        )
+        if args.report or args.verbose:
+            for name in sorted(reports):
+                print(f"  {name:28s} {reports[name].summary()}")
+        violations = check_contracts(reports, cases)
+        print(
+            f"contracts: {len(cases)} cases lowered"
+            + ("" if args.no_compile else " (+ compiled copy-budget legs)")
+            + (
+                ", all contracts hold"
+                if not violations
+                else f", {len(violations)} VIOLATION(S)"
+            )
+        )
+        for v in violations:
+            print(f"  CONTRACT: {v}")
+            failed = True
+
+    if args.check:
+        print("palint:", "FAILED" if failed else "OK")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
