@@ -14,6 +14,7 @@ Import convention::
     import partitionedarrays_jl_tpu as pa
 """
 
+from . import telemetry  # noqa: F401
 from .models import *  # noqa: F401,F403
 from .models import __all__ as _models_all
 from .ops import *  # noqa: F401,F403
@@ -25,4 +26,7 @@ from .utils import __all__ as _utils_all
 
 __version__ = "0.1.0"
 
-__all__ = list(_parallel_all) + list(_utils_all) + list(_ops_all) + list(_models_all)
+__all__ = (
+    list(_parallel_all) + list(_utils_all) + list(_ops_all)
+    + list(_models_all) + ["telemetry"]
+)

@@ -257,6 +257,34 @@ def _check_no_host_transfer_in_loop(reports, cases):
     return out
 
 
+def _check_runtime_reconciliation(reports, cases):
+    """The telemetry tentpole's contract: a finished solve's runtime
+    comms accounting (``setup + per_iteration x iterations``, built
+    from the PLAN objects — telemetry.comms.cg_comms_profile) must
+    equal, per collective kind in both ops and payload bytes, what the
+    lowered program statically implies (collectives inside the solve's
+    while region are per-iteration, the rest setup). Cases carry their
+    measured accounting under ``runtime_comms`` when the matrix was
+    built with runtime probes (`analysis.matrix.build_reports(
+    with_runtime=True)`); absent probes, the contract skips silently
+    like every other."""
+    from ..telemetry.comms import reconcile
+
+    out = []
+    for name, case in cases.items():
+        comms = case.get("runtime_comms")
+        rep = reports.get(name)
+        if comms is None or rep is None or rep.dialect != "stablehlo":
+            continue
+        for msg in reconcile(rep, comms):
+            out.append(Violation(
+                "static-measured-reconciliation", [name],
+                "runtime comms accounting disagrees with the lowered "
+                "program: " + msg,
+            ))
+    return out
+
+
 def _check_copy_budget(reports, cases):
     """The PR 2 buffer-copy canary: the compiled body's ``copy`` count
     is the structural signature of XLA's while-carry copies — the
@@ -312,6 +340,11 @@ CONTRACTS: List[Contract] = [
              "compiled copy-op count within the pinned per-body budget "
              "(the PR 2 buffer-copy-anomaly canary)",
              _check_copy_budget),
+    Contract("static-measured-reconciliation",
+             "runtime comms accounting (plan-model x iterations) equals "
+             "the lowered program's static per-kind collective ops and "
+             "bytes (the patrace tentpole)",
+             _check_runtime_reconciliation),
 ]
 
 

@@ -57,8 +57,13 @@ ENV_PREFIX = "PA_"
 #: (every DeviceMatrix-derived cache includes it); `_gmg_env_key` wraps
 #: it for the GMG/LOBPCG staging caches; `_sdc_config` builds the
 #: compiled-program cache-key fragment for the SDC defense
-#: (`_krylov_fn_for` keys on ``sdccfg["key"]``).
-KEY_SITES = ("_lowering_env_key", "_gmg_env_key", "_sdc_config")
+#: (`_krylov_fn_for` keys on ``sdccfg["key"]``); `_trace_config`
+#: resolves the telemetry α/β trace-ring depth (`_krylov_fn_for` folds
+#: its value into the program key — a flipped PA_TRACE_ITERS rebuilds
+#: the program instead of serving one with the wrong carry).
+KEY_SITES = (
+    "_lowering_env_key", "_gmg_env_key", "_sdc_config", "_trace_config",
+)
 
 #: Staging/tracing entrypoints: the roots of the reachability pass.
 #: Anything these (transitively, by identifier) call runs at trace or
@@ -149,6 +154,21 @@ NON_LOWERING: Dict[str, str] = {
     ),
     "PA_FAULT_SEED": (
         "host wire chaos injection seed — same path as PA_FAULT_SPEC"
+    ),
+    "PA_METRICS": (
+        "telemetry kill switch — gates host-side SolveRecord/event "
+        "bookkeeping only; compiled programs are built identically "
+        "either way (the device-visible knob is PA_TRACE_ITERS, which "
+        "IS keyed via _trace_config)"
+    ),
+    "PA_METRICS_DIR": (
+        "telemetry record persistence directory — where finished "
+        "SolveRecord JSONs land on the host, never part of a staged "
+        "program"
+    ),
+    "PA_METRICS_HISTORY": (
+        "depth of the host-side in-memory ring of finished "
+        "SolveRecords — pure host bookkeeping"
     ),
 }
 

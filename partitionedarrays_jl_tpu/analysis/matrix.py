@@ -28,14 +28,24 @@ def build_reports(
     backend=None,
     fast: bool = False,
     with_compiled: bool = False,
+    with_runtime: bool = False,
     only: Optional[Iterable[str]] = None,
     verbose=None,
 ) -> Tuple[Dict[str, dict], Dict[str, ProgramReport]]:
     """Lower (and optionally compile) the matrix, returning
     ``(cases_by_name, reports_by_name)``. Compiled-HLO reports (the
     copy-budget cases, `contracts.COPY_BUDGETS`) land under
-    ``<name>__compiled``. ``only`` restricts to the named cases."""
-    from ..parallel.tpu import case_program_texts, lowering_matrix
+    ``<name>__compiled``. ``with_runtime`` additionally RUNS each
+    case's program against the probe system and stashes the finished
+    solve's telemetry comms accounting under
+    ``cases[name]["runtime_comms"]`` — the measured half the
+    ``static-measured-reconciliation`` contract checks. ``only``
+    restricts to the named cases."""
+    from ..parallel.tpu import (
+        case_probe_solve,
+        case_program_texts,
+        lowering_matrix,
+    )
 
     backend = backend or _default_backend()
     cases = {c["name"]: c for c in lowering_matrix(fast=fast)}
@@ -63,6 +73,10 @@ def build_reports(
         reports[name] = analyze_text(stablehlo)
         if compile_this:
             reports[name + "__compiled"] = analyze_text(hlo)
+        if with_runtime:
+            if verbose:
+                verbose(f"probe-solving {name} ...")
+            case["runtime_comms"] = case_probe_solve(backend, case).comms
     return cases, reports
 
 
@@ -70,10 +84,12 @@ def run_matrix(
     backend=None,
     fast: bool = False,
     with_compiled: bool = False,
+    with_runtime: bool = False,
     verbose=None,
 ) -> Tuple[List[Violation], Dict[str, ProgramReport]]:
     """Build reports for the matrix and check every contract."""
     cases, reports = build_reports(
-        backend, fast=fast, with_compiled=with_compiled, verbose=verbose
+        backend, fast=fast, with_compiled=with_compiled,
+        with_runtime=with_runtime, verbose=verbose,
     )
     return check_contracts(reports, cases), reports

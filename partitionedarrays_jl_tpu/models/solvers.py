@@ -193,9 +193,14 @@ class _SDCGuard:
         """Handle a detection: restore the ring state ``strike`` slots
         back, or escalate once the budget is spent. Returns
         ``(vectors, meta, history)`` for the loop to reinstate."""
+        from .. import telemetry
         from ..parallel.health import SilentCorruptionError
 
         self.counters["detections"] += 1
+        telemetry.emit_event(
+            "sdc_detection", label=self.name, iteration=int(it),
+            detector=getattr(e, "diagnostics", {}).get("detector"),
+        )
         exhausted = self.counters["rollbacks"] >= self.max_rb
         st = (
             self.ring.restore(self.strike)
@@ -204,6 +209,10 @@ class _SDCGuard:
         )
         if st is None:
             self.counters["escalations"] += 1
+            telemetry.emit_event(
+                "sdc_escalation", label=self.name, iteration=int(it),
+                rollbacks=self.counters["rollbacks"],
+            )
             diag = dict(getattr(e, "diagnostics", {}))
             diag["sdc"] = dict(self.counters)
             diag["iteration"] = int(it)
@@ -217,6 +226,11 @@ class _SDCGuard:
         self.counters["rollbacks"] += 1
         self.strike += 1
         vecs, meta = st
+        telemetry.emit_event(
+            "sdc_rollback", label=self.name, iteration=int(it),
+            restored_iteration=int(meta.get("it", 0)),
+            strike=self.strike,
+        )
         return vecs, meta, list(meta["history"])
 
     def info_extra(self) -> dict:
@@ -321,6 +335,23 @@ def cg(
             A, b, x0=x0, tol=tol, maxiter=maxiter, verbose=verbose,
             pipelined=pipelined, fused=fused,
         )
+    from .. import telemetry
+
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    with telemetry.solve_scope(
+        "cg", backend="host", tol=float(tol), maxiter=int(maxiter),
+        resumed=_resume_state is not None,
+    ) as rec:
+        x, info = _cg_host_loop(
+            A, b, x0, tol, maxiter, verbose, checkpoint, _resume_state
+        )
+        return x, rec.finish(info)
+
+
+def _cg_host_loop(A, b, x0, tol, maxiter, verbose, checkpoint, _resume_state):
+    """The host (sequential-backend) CG recurrence — the semantics
+    oracle the compiled bodies are pinned against. Factored out of `cg`
+    so the telemetry solve scope wraps it without touching the loop."""
     from ..parallel.health import (
         SilentCorruptionError,
         SolverBreakdownError,
@@ -330,7 +361,6 @@ def cg(
         stagnation_raises,
     )
 
-    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
     floor_warned = warn_tol_below_floor(tol, b.dtype, name="cg")
 
     if _resume_state is not None:
@@ -1418,6 +1448,26 @@ def pcg(
                 minv=minv, fused=fused,
             )
 
+    from .. import telemetry
+
+    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
+    with telemetry.solve_scope(
+        "pcg", backend="host", tol=float(tol), maxiter=int(maxiter),
+        resumed=_resume_state is not None,
+        preconditioner="callable" if apply_minv else "diagonal",
+    ) as rec:
+        x, info = _pcg_host_loop(
+            A, b, x0, minv, apply_minv, tol, maxiter, verbose,
+            checkpoint, _resume_state,
+        )
+        return x, rec.finish(info)
+
+
+def _pcg_host_loop(
+    A, b, x0, minv, apply_minv, tol, maxiter, verbose, checkpoint,
+    _resume_state,
+):
+    """The host PCG recurrence (see `_cg_host_loop`)."""
     from ..parallel.health import (
         SilentCorruptionError,
         SolverBreakdownError,
@@ -1427,7 +1477,6 @@ def pcg(
         stagnation_raises,
     )
 
-    maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
     floor_warned = warn_tol_below_floor(tol, b.dtype, name="pcg")
 
     z = PVector.full(0.0, A.cols, dtype=b.dtype)
@@ -2166,6 +2215,8 @@ def solve_with_recovery(
     from ..parallel.health import SolverHealthError
     from ..parallel.tpu import TPUBackend
 
+    from .. import telemetry
+
     check(
         method in ("cg", "pcg"), "solve_with_recovery: method is 'cg' or 'pcg'"
     )
@@ -2174,11 +2225,34 @@ def solve_with_recovery(
         if checkpoint_dir is not None
         else None
     )
-    if isinstance(b.values.backend, TPUBackend):
-        return _solve_with_recovery_chunked(
-            A, b, method, ckpt, every, max_restarts, minv, x0, tol,
-            maxiter, verbose,
-        )
+    with telemetry.solve_scope(
+        "solve_with_recovery", method=method, tol=float(tol),
+        max_restarts=int(max_restarts),
+        checkpointing=checkpoint_dir is not None,
+    ) as rec:
+        if isinstance(b.values.backend, TPUBackend):
+            x, info = _solve_with_recovery_chunked(
+                A, b, method, ckpt, every, max_restarts, minv, x0, tol,
+                maxiter, verbose,
+            )
+        else:
+            x, info = _solve_with_recovery_host(
+                A, b, method, ckpt, max_restarts, minv, x0, tol,
+                maxiter, verbose,
+            )
+        return x, rec.finish(info)
+
+
+def _solve_with_recovery_host(
+    A, b, method, ckpt, max_restarts, minv, x0, tol, maxiter, verbose
+):
+    """The host-backend recovery loop (exact-recurrence checkpoint
+    restarts) — see `solve_with_recovery` for the contract."""
+    import sys
+
+    from .. import telemetry
+    from ..parallel.checkpoint import load_solver_state
+    from ..parallel.health import SolverHealthError
 
     restarts = 0
     failures = []
@@ -2262,6 +2336,10 @@ def solve_with_recovery(
                         )
                         ledger["checkpoint_restarts"] += 1
             ledger["restart_sources"].append(source)
+            telemetry.emit_event(
+                "restart", label=type(e).__name__, attempt=restarts,
+                **source,
+            )
             print(
                 f"[partitionedarrays_jl_tpu] {method}: "
                 f"{type(e).__name__}: {e} — restart {restarts}/"
@@ -2344,6 +2422,12 @@ def _solve_with_recovery_chunked(
                     source["checkpoint_iteration"] = done
                     ledger["checkpoint_restarts"] += 1
             ledger["restart_sources"].append(source)
+            from .. import telemetry as _telemetry
+
+            _telemetry.emit_event(
+                "restart", label=type(e).__name__, attempt=restarts,
+                **source,
+            )
             print(
                 f"[partitionedarrays_jl_tpu] {method} (chunked): "
                 f"{type(e).__name__}: {e} — restart {restarts}/{max_restarts}",

@@ -673,6 +673,13 @@ class SolverCheckpointer:
         self.wait()  # one writer at a time; surfaces a prior failure
         objs = {k: v.copy() for k, v in vectors.items()}
         meta = _json_safe_meta(meta)
+        from ..telemetry import emit_event
+
+        emit_event(
+            "checkpoint_save", label=str(meta.get("method", "")),
+            iteration=meta.get("it"), directory=self.directory,
+            vectors=sorted(objs), async_write=self.async_write,
+        )
         if self.async_write:
             t = threading.Thread(
                 target=self._write, args=(objs, meta), daemon=True,
@@ -733,4 +740,12 @@ def load_solver_state(
     then restarts from scratch instead of failing."""
     if not os.path.isfile(os.path.join(directory, "manifest.json")):
         return None
-    return load_checkpoint(directory, ranges)
+    st = load_checkpoint(directory, ranges)
+    from ..telemetry import emit_event
+
+    meta = st.get("meta", {}) if isinstance(st, dict) else {}
+    emit_event(
+        "checkpoint_restore", label=str(meta.get("method", "")),
+        iteration=meta.get("it"), directory=str(directory),
+    )
+    return st

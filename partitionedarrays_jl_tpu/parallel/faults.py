@@ -176,6 +176,13 @@ class FaultState:
 
     def record(self, **ev) -> None:
         self.events.append(ev)
+        # bridge into the telemetry event log: every injected fault is
+        # visible in the active SolveRecord(s), so the chaos matrix can
+        # assert kind + recovery path from ONE structured source
+        from ..telemetry import emit_event
+
+        details = {k: v for k, v in ev.items() if k != "kind"}
+        emit_event("fault_injected", label=ev.get("kind", ""), **details)
 
 
 _lock = threading.Lock()

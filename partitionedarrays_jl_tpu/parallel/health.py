@@ -116,6 +116,17 @@ class SolverHealthError(RuntimeError):
     def __init__(self, message: str, diagnostics: Optional[dict] = None):
         super().__init__(message)
         self.diagnostics = dict(diagnostics or {})
+        # telemetry: every typed health failure is an event in the
+        # active SolveRecord(s) — construction is the one choke point
+        # all guards funnel through (emit_event never raises)
+        from ..telemetry import emit_event
+
+        emit_event(
+            "health_error", label=type(self).__name__,
+            iteration=self.diagnostics.get("iteration"),
+            context=self.diagnostics.get("context"),
+            message=str(message)[:500],
+        )
 
 
 class NonFiniteError(SolverHealthError):

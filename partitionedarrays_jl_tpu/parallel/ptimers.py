@@ -50,14 +50,25 @@ class PTimer:
         self.parts = get_part_ids(parts)
         self.verbose = verbose
         self.timings = {}  # name -> PData of seconds
+        #: machine-readable span log (telemetry bridge): one entry per
+        #: toc, with absolute wall start, duration, and the measured
+        #: cost of the preceding `tic(barrier=True)` drain — the
+        #: barrier is a real, otherwise-invisible line item.
+        self.spans = []  # [{"name", "t0", "dur", "barrier_s"}]
         self._t0: Optional[float] = None
+        self._t0_wall: Optional[float] = None
+        self._barrier_s: float = 0.0
         self._current: Optional[str] = None
 
     # -- reference API: tic!/toc! ---------------------------------------
     def tic(self, barrier: bool = True) -> "PTimer":
+        self._barrier_s = 0.0
         if barrier:
+            b0 = time.perf_counter()
             _device_barrier(self.parts.backend)
+            self._barrier_s = time.perf_counter() - b0
         self._t0 = time.perf_counter()
+        self._t0_wall = time.time()
         return self
 
     def toc(self, name: str) -> "PTimer":
@@ -65,6 +76,14 @@ class PTimer:
         _device_barrier(self.parts.backend)
         dt = time.perf_counter() - self._t0
         self.timings[name] = map_parts(lambda _p: dt, self.parts)
+        self.spans.append(
+            {
+                "name": name,
+                "t0": self._t0_wall,
+                "dur": dt,
+                "barrier_s": self._barrier_s,
+            }
+        )
         self._t0 = None
         if self.verbose and i_am_main(self.parts):
             print(f"[ptimer] {name}: {dt:.6f} s")
@@ -108,8 +127,11 @@ class PTimer:
             out[name] = stats.get_part(0)
         return out
 
-    def print_timer(self) -> None:
-        """Max-sorted section table, printed on MAIN only."""
+    def print_timer(self, json_path: Optional[str] = None) -> None:
+        """Max-sorted section table, printed on MAIN only. With
+        ``json_path`` the machine-readable form (`data_json`) is also
+        written there — the same stats plus the span log, so the table
+        is never the only record of a measurement."""
         if not i_am_main(self.parts):
             return
         data = self.data
@@ -122,6 +144,54 @@ class PTimer:
                 f"{name.ljust(namew)}  {st['max']:>12.6f}  {st['min']:>12.6f}  "
                 f"{st['avg']:>12.6f}"
             )
+        if json_path is not None:
+            import json
+
+            with open(json_path, "w", encoding="utf-8") as f:
+                json.dump(self.data_json(), f, indent=1, sort_keys=True)
+
+    # -- telemetry bridge ------------------------------------------------
+    def data_json(self) -> dict:
+        """Machine-readable export: the (min, max, avg) stats plus the
+        raw span log (absolute wall starts, durations, barrier costs)."""
+        return {
+            "schema_version": 1,
+            "sections": {k: dict(v) for k, v in self.data.items()},
+            "spans": [dict(s) for s in self.spans],
+        }
+
+    def trace_events(self, pid: int = 2, tid: int = 0) -> list:
+        """Chrome-trace spans of every section — and of every nonzero
+        `tic(barrier=True)` drain, as its own ``<name>:tic_barrier``
+        span immediately preceding the section. Feed to
+        `telemetry.chrome_trace(timers=[t])` so PTimer sections land on
+        the same Perfetto timeline as the solver records."""
+        out = []
+        for s in self.spans:
+            if s["barrier_s"] > 0.0:
+                out.append(
+                    {
+                        "name": f"{s['name']}:tic_barrier",
+                        "ph": "X",
+                        "ts": (s["t0"] - s["barrier_s"]) * 1e6,
+                        "dur": s["barrier_s"] * 1e6,
+                        "pid": pid,
+                        "tid": tid,
+                        "cat": "ptimer.barrier",
+                    }
+                )
+            out.append(
+                {
+                    "name": s["name"],
+                    "ph": "X",
+                    "ts": s["t0"] * 1e6,
+                    "dur": s["dur"] * 1e6,
+                    "pid": pid,
+                    "tid": tid,
+                    "cat": "ptimer",
+                }
+            )
+        return out
 
     def __repr__(self):
         return f"PTimer(sections={list(self.timings)})"
@@ -137,5 +207,5 @@ def toc(t: PTimer, name: str) -> PTimer:
     return t.toc(name)
 
 
-def print_timer(t: PTimer) -> None:
-    return t.print_timer()
+def print_timer(t: PTimer, json_path: Optional[str] = None) -> None:
+    return t.print_timer(json_path=json_path)

@@ -582,6 +582,29 @@ def _resolve_fused(fused, pipelined: bool) -> bool:
     return bool(fused)
 
 
+def _trace_config() -> int:
+    """The ONE resolution of the device α/β trace-ring depth
+    (``PA_TRACE_ITERS``, default 0 = off). A nonzero depth adds a
+    ``(depth, 2)`` ring to the compiled CG while-carry — alpha/beta per
+    committed iteration, downloaded once at solve exit — so the flag is
+    LOWERING-affecting and this helper is a registered env-key site
+    (analysis.env_lint.KEY_SITES): `_krylov_fn_for` folds its value
+    into the compiled-program cache key and `make_cg_fn` resolves the
+    depth through this same function, so the traced program and its
+    cache key can never disagree. Depth 0 builds the exact
+    pre-telemetry program (the HLO-identity pin in
+    tests/test_telemetry.py). The ring carries NO collectives: scalars
+    already replicated by the existing dot gathers are written into a
+    replicated carry."""
+    try:
+        v = int(os.environ.get("PA_TRACE_ITERS", "0") or "0")
+    except ValueError:
+        raise ValueError(
+            "PA_TRACE_ITERS must be an integer trace depth (iterations)"
+        )
+    return max(0, v)
+
+
 def _sdc_config(maxiter: int) -> Optional[dict]:
     """Build-time resolution of the in-graph SDC defense for the
     compiled CG bodies — None when inactive (``PA_TPU_ABFT`` off and no
@@ -1874,9 +1897,29 @@ def device_matrix(A: PSparseMatrix, backend: TPUBackend) -> DeviceMatrix:
     # keyed by the backend's stable token (an id() key could be recycled
     # after GC and hand back buffers staged for a dead backend) plus
     # every lowering-affecting env mode
+    from .. import telemetry
+
     key = (backend._token,) + _lowering_env_key()
     if key not in A._device:
+        # stale_rekey: this matrix WAS staged on THIS backend before,
+        # under a different lowering env key — the flip re-runs staging
+        # admission (the palint bug class, now a measurable counter).
+        # First staging onto a new backend is a plain miss regardless
+        # of what other backends hold.
+        rekeyed = any(k[0] == backend._token for k in A._device)
+        action = "stale_rekey" if rekeyed else "miss"
+        telemetry.bump(f"lowering_cache.{action}")
+        telemetry.emit_event(
+            "compile_cache", label=f"lowering_{action}", cache="lowering",
+            action=action,
+        )
         A._device[key] = DeviceMatrix(A, backend)
+    else:
+        telemetry.bump("lowering_cache.hit")
+        telemetry.emit_event(
+            "compile_cache", label="lowering_hit", cache="lowering",
+            action="hit",
+        )
     return A._device[key]
 
 
@@ -2787,6 +2830,13 @@ def make_cg_fn(
         )
         sdccfg = None
     abft_on = bool(sdccfg and sdccfg["abft"])
+    # device α/β trace ring (PA_TRACE_ITERS, telemetry): a (Ht, 2)
+    # replicated carry written on committed iterations only — no new
+    # collectives (alpha/beta are scalars the dot gathers already
+    # replicated). Depth 0 (the default) leaves the traced program
+    # byte-identical to the pre-telemetry one; the pipelined body is
+    # trace-exempt (the same precedent as its SDC exemption).
+    Ht = 0 if pipelined else int(min(_trace_config(), maxiter))
     body_spmv = _spmv_body(dA, abft=abft_on)
     body_axpy = _spmv_body(dA, axpy=True) if pipelined else None
     body_pfold = (
@@ -3022,7 +3072,8 @@ def make_cg_fn(
                     sdc0 = sdc_init(S0, jnp.stack([rs0, rz0, zero]))
 
                     def cond_fs(state):
-                        _S, rz_, rs_, _beta, it_, _h, sdcst = state
+                        _S, rz_, rs_, _beta, it_ = state[:5]
+                        sdcst = state[6]
                         esc_, trip_ = sdcst[8], sdcst[9]
                         go = jnp.logical_and(
                             jnp.sqrt(rs_)
@@ -3038,7 +3089,11 @@ def make_cg_fn(
                         )
 
                     def step_fs(state):
-                        S, rz, rs, beta, it, hist, sdcst = state
+                        if Ht:
+                            S, rz, rs, beta, it, hist, sdcst, ab = state
+                        else:
+                            S, rz, rs, beta, it, hist, sdcst = state
+                            ab = None
                         trip = sdcst[9]
                         since = sdcst[3]
                         aud = (since >= ae) if ae > 0 else false
@@ -3123,14 +3178,30 @@ def make_cg_fn(
                         hist2 = hist.at[idx].set(
                             jnp.where(commit, jnp.sqrt(rs_new), hist[idx])
                         )
-                        return (S3, rz3, rs3, beta3, it3, hist2, sdc2)
+                        out = (S3, rz3, rs3, beta3, it3, hist2, sdc2)
+                        if Ht:
+                            # α/β of real iteration `it`, committed trips
+                            # only (audit/restore trips change no state);
+                            # true ring — keeps the LAST Ht iterations
+                            ti = it % Ht
+                            out = out + (ab.at[ti].set(jnp.where(
+                                commit, jnp.stack([alpha, beta_new]),
+                                ab[ti],
+                            )),)
+                        return out
 
-                    S, rz, rs, beta, it, hist, sdcst = jax.lax.while_loop(
-                        cond_fs, step_fs,
-                        (S0, rz0, rs0, jnp.zeros((), bv.dtype),
-                         jnp.int32(0), hist, sdc0),
+                    init_fs = (S0, rz0, rs0, jnp.zeros((), bv.dtype),
+                               jnp.int32(0), hist, sdc0)
+                    if Ht:
+                        init_fs = init_fs + (
+                            jnp.zeros((Ht, 2), dtype=bv.dtype),
+                        )
+                    fin = jax.lax.while_loop(cond_fs, step_fs, init_fs)
+                    S, rs, it, hist, sdcst = (
+                        fin[0], fin[2], fin[4], fin[5], fin[6]
                     )
-                    return S[0][None], rs, rs0, it, hist, sdc_out(sdcst)
+                    out = (S[0][None], rs, rs0, it, hist, sdc_out(sdcst))
+                    return out + ((fin[7],) if Ht else ())
 
                 sdc0 = sdc_init(
                     jnp.stack([xv, r, p]),
@@ -3138,7 +3209,8 @@ def make_cg_fn(
                 )
 
                 def cond_ss(state):
-                    _x, _r, _p, rz_, rs_, it_, _h, sdcst = state
+                    _x, _r, _p, rz_, rs_, it_ = state[:6]
+                    sdcst = state[7]
                     esc_, trip_ = sdcst[8], sdcst[9]
                     go = jnp.logical_and(
                         jnp.sqrt(rs_)
@@ -3152,7 +3224,11 @@ def make_cg_fn(
                     return jnp.logical_and(go, jnp.logical_not(esc_))
 
                 def step_ss(state):
-                    x, r_, p_, rz, rs, it, hist, sdcst = state
+                    if Ht:
+                        x, r_, p_, rz, rs, it, hist, sdcst, ab = state
+                    else:
+                        x, r_, p_, rz, rs, it, hist, sdcst = state
+                        ab = None
                     trip = sdcst[9]
                     since = sdcst[3]
                     aud = (since >= ae) if ae > 0 else false
@@ -3222,13 +3298,25 @@ def make_cg_fn(
                     hist2 = hist.at[idx].set(
                         jnp.where(commit, jnp.sqrt(rs_new), hist[idx])
                     )
-                    return (x3, r3, p3, rz3, rs3, it3, hist2, sdc2)
+                    out = (x3, r3, p3, rz3, rs3, it3, hist2, sdc2)
+                    if Ht:
+                        ti = it % Ht
+                        out = out + (ab.at[ti].set(jnp.where(
+                            commit, jnp.stack([alpha, beta]), ab[ti],
+                        )),)
+                    return out
 
-                x, r, p, rz, rs, it, hist, sdcst = jax.lax.while_loop(
-                    cond_ss, step_ss,
-                    (xv, r, p, rz0, rs0, jnp.int32(0), hist, sdc0),
+                init_ss = (xv, r, p, rz0, rs0, jnp.int32(0), hist, sdc0)
+                if Ht:
+                    init_ss = init_ss + (
+                        jnp.zeros((Ht, 2), dtype=bv.dtype),
+                    )
+                fin = jax.lax.while_loop(cond_ss, step_ss, init_ss)
+                x, rs, it, hist, sdcst = (
+                    fin[0], fin[4], fin[5], fin[6], fin[7]
                 )
-                return x[None], rs, rs0, it, hist, sdc_out(sdcst)
+                out = (x[None], rs, rs0, it, hist, sdc_out(sdcst))
+                return out + ((fin[8],) if Ht else ())
 
             if fused:
                 slf = slice(o0, o0 + no_max)
@@ -3242,7 +3330,7 @@ def make_cg_fn(
                 zero = jnp.zeros((), bv.dtype)
 
                 def cond_fused(state):
-                    _S, rz, rs, _beta, it, _h = state
+                    _S, rz, rs, _beta, it = state[:5]
                     go = jnp.logical_and(
                         jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0)),
                         it < maxiter,
@@ -3254,7 +3342,11 @@ def make_cg_fn(
                     return go
 
                 def step_fused(state):
-                    S, rz, rs, beta, it, hist = state
+                    if Ht:
+                        S, rz, rs, beta, it, hist, ab = state
+                    else:
+                        S, rz, rs, beta, it, hist = state
+                        ab = None
                     x, r_, p_prev = S[0], S[1], S[2]
                     # (b) direction fold rides the SpMV pass itself
                     q, p = body_pfold(
@@ -3282,16 +3374,23 @@ def make_cg_fn(
                     hist2 = hist.at[jnp.minimum(it + 1, H - 1)].set(
                         jnp.sqrt(rs_new)
                     )
-                    return (S2, rz_new, rs_new, beta_new, it + 1, hist2)
+                    out = (S2, rz_new, rs_new, beta_new, it + 1, hist2)
+                    if Ht:
+                        out = out + (ab.at[it % Ht].set(
+                            jnp.stack([alpha, beta_new])
+                        ),)
+                    return out
 
-                S, rz, rs, beta, it, hist = jax.lax.while_loop(
-                    cond_fused, step_fused,
-                    (S0, rz0, rs0, zero, jnp.int32(0), hist),
-                )
-                return S[0][None], rs, rs0, it, hist
+                init_f = (S0, rz0, rs0, zero, jnp.int32(0), hist)
+                if Ht:
+                    init_f = init_f + (jnp.zeros((Ht, 2), dtype=bv.dtype),)
+                fin = jax.lax.while_loop(cond_fused, step_fused, init_f)
+                S, rs, it, hist = fin[0], fin[2], fin[4], fin[5]
+                out = (S[0][None], rs, rs0, it, hist)
+                return out + ((fin[6],) if Ht else ())
 
             def cond(state):
-                _x, _r, _p, rz, rs, it, _h = state
+                _x, _r, _p, rz, rs, it = state[:6]
                 go = jnp.logical_and(
                     jnp.sqrt(rs) > tol * jnp.maximum(1.0, jnp.sqrt(rs0)),
                     it < maxiter,
@@ -3310,7 +3409,11 @@ def make_cg_fn(
                 return go
 
             def step(state):
-                x, r, p, rz, rs, it, hist = state
+                if Ht:
+                    x, r, p, rz, rs, it, hist, ab = state
+                else:
+                    x, r, p, rz, rs, it, hist = state
+                    ab = None
                 q = spmv(p)
                 pq = pdot(p, q)
                 alpha = rz / pq
@@ -3326,13 +3429,21 @@ def make_cg_fn(
                     z[o0 : o0 + no_max] + _rp(beta * p[o0 : o0 + no_max])
                 )
                 hist = hist.at[jnp.minimum(it + 1, H - 1)].set(jnp.sqrt(rs_new))
-                return (x, r, p, rz_new, rs_new, it + 1, hist)
+                out = (x, r, p, rz_new, rs_new, it + 1, hist)
+                if Ht:
+                    out = out + (ab.at[it % Ht].set(
+                        jnp.stack([alpha, beta])
+                    ),)
+                return out
 
             if not pipelined:
-                x, r, p, rz, rs, it, hist = jax.lax.while_loop(
-                    cond, step, (xv, r, p, rz0, rs0, jnp.int32(0), hist)
-                )
-                return x[None], rs, rs0, it, hist
+                init_s = (xv, r, p, rz0, rs0, jnp.int32(0), hist)
+                if Ht:
+                    init_s = init_s + (jnp.zeros((Ht, 2), dtype=bv.dtype),)
+                fin = jax.lax.while_loop(cond, step, init_s)
+                x, rs, it, hist = fin[0], fin[4], fin[5], fin[6]
+                out = (x[None], rs, rs0, it, hist)
+                return out + ((fin[7],) if Ht else ())
 
             sl = slice(o0, o0 + no_max)
 
@@ -3371,7 +3482,7 @@ def make_cg_fn(
             x = x.at[sl].add(_rp(alpha_prev * p_prev[sl]))
             return x[None], rs, rs0, it, hist
 
-        nouts = 5 if sdccfg is not None else 4
+        nouts = 4 + (1 if sdccfg is not None else 0) + (1 if Ht else 0)
         return shard_map(
             shard_fn,
             mesh=mesh,
@@ -3406,6 +3517,14 @@ def make_cg_fn(
     run.operands = ops
     run.fused = bool(fused)
     run.has_sdc = sdccfg is not None
+    run.trace_iters = Ht
+    # the plan-level collective inventory of this body (telemetry.comms)
+    # — the measured half of the static-vs-measured accounting
+    run.comms_kwargs = dict(
+        precond=bool(precond), pipelined=bool(pipelined),
+        fused=bool(fused), rhs_batch=None,
+        sdc=sdccfg is not None, abft=abft_on,
+    )
     return run
 
 
@@ -3463,6 +3582,12 @@ def make_block_cg_fn(
     # columns restore to their frozen bits — re-freezing is a no-op)
     sdccfg = _sdc_config(maxiter)
     abft_on = bool(sdccfg and sdccfg["abft"])
+    # block α/β trace ring: an (Ht, 2, K) replicated carry, committed
+    # iterations only. The SDC-defended block loop is trace-exempt this
+    # round (its per-column freeze/rollback bookkeeping has no committed
+    # α/β slot per trip) — same precedent as the pipelined body's SDC
+    # exemption, noted in docs/observability.md.
+    Ht = 0 if sdccfg is not None else int(min(_trace_config(), maxiter))
     body_spmv = _spmv_body(dA, abft=abft_on)
     body_pfold = (
         _spmv_body(dA, pfold=True, abft=abft_on, audit=sdccfg is not None)
@@ -3901,13 +4026,17 @@ def make_block_cg_fn(
                 beta0 = jnp.zeros((K,), bv.dtype)
 
                 def cond_f(state):
-                    _S, rz, rs, _beta, _itk, it, _h = state
+                    _S, rz, rs, _beta, _itk, it = state[:6]
                     return jnp.logical_and(
                         jnp.any(active(rs, rz)), it < maxiter
                     )
 
                 def step_f(state):
-                    S, rz, rs, beta, itk, it, hist = state
+                    if Ht:
+                        S, rz, rs, beta, itk, it, hist, ab = state
+                    else:
+                        S, rz, rs, beta, itk, it, hist = state
+                        ab = None
                     act = active(rs, rz)
                     x, r_, p_prev = S[0], S[1], S[2]
                     q, p = body_pfold(
@@ -3936,22 +4065,35 @@ def make_block_cg_fn(
                     hist2 = hist.at[idx].set(
                         _sel(act, jnp.sqrt(rs2), hist[idx])
                     )
-                    return (S2, rz2, rs2, beta2, itk2, it + 1, hist2)
+                    out = (S2, rz2, rs2, beta2, itk2, it + 1, hist2)
+                    if Ht:
+                        out = out + (ab.at[it % Ht].set(
+                            jnp.stack([alpha, beta2])
+                        ),)
+                    return out
 
-                S, rz, rs, beta, itk, it, hist = jax.lax.while_loop(
-                    cond_f, step_f,
-                    (S0, rz0, rs0, beta0, it0, jnp.int32(0), hist),
-                )
-                return S[0][None], rs, rs0, itk, hist
+                init_f = (S0, rz0, rs0, beta0, it0, jnp.int32(0), hist)
+                if Ht:
+                    init_f = init_f + (
+                        jnp.zeros((Ht, 2, K), dtype=bv.dtype),
+                    )
+                fin = jax.lax.while_loop(cond_f, step_f, init_f)
+                S, rs, itk, hist = fin[0], fin[2], fin[4], fin[6]
+                out = (S[0][None], rs, rs0, itk, hist)
+                return out + ((fin[7],) if Ht else ())
 
             def cond(state):
-                _x, _r, _p, rz, rs, _itk, it, _h = state
+                _x, _r, _p, rz, rs, _itk, it = state[:7]
                 return jnp.logical_and(
                     jnp.any(active(rs, rz)), it < maxiter
                 )
 
             def step(state):
-                x, r_, p_, rz, rs, itk, it, hist = state
+                if Ht:
+                    x, r_, p_, rz, rs, itk, it, hist, ab = state
+                else:
+                    x, r_, p_, rz, rs, itk, it, hist = state
+                    ab = None
                 act = active(rs, rz)
                 q = spmv(p_)
                 pq = pdot(p_, q)
@@ -3967,12 +4109,9 @@ def make_block_cg_fn(
                 rs_new = pdot(r2, r2)
                 if not precond:
                     rz_new = rs_new
+                beta_b = jnp.where(act, rz_new / rz, 0)
                 p2 = p_.at[slf].set(
-                    _sel(
-                        act,
-                        z[slf] + _rp(jnp.where(act, rz_new / rz, 0) * p_[slf]),
-                        p_[slf],
-                    )
+                    _sel(act, z[slf] + _rp(beta_b * p_[slf]), p_[slf])
                 )
                 rz2 = _sel(act, rz_new, rz)
                 rs2 = _sel(act, rs_new, rs)
@@ -3981,14 +4120,22 @@ def make_block_cg_fn(
                 hist2 = hist.at[idx].set(
                     _sel(act, jnp.sqrt(rs2), hist[idx])
                 )
-                return (x2, r2, p2, rz2, rs2, itk2, it + 1, hist2)
+                out = (x2, r2, p2, rz2, rs2, itk2, it + 1, hist2)
+                if Ht:
+                    out = out + (ab.at[it % Ht].set(
+                        jnp.stack([alpha, beta_b])
+                    ),)
+                return out
 
-            x, r, p, rz, rs, itk, it, hist = jax.lax.while_loop(
-                cond, step, (xv, r, p, rz0, rs0, it0, jnp.int32(0), hist)
-            )
-            return x[None], rs, rs0, itk, hist
+            init_s = (xv, r, p, rz0, rs0, it0, jnp.int32(0), hist)
+            if Ht:
+                init_s = init_s + (jnp.zeros((Ht, 2, K), dtype=bv.dtype),)
+            fin = jax.lax.while_loop(cond, step, init_s)
+            x, rs, itk, hist = fin[0], fin[4], fin[5], fin[7]
+            out = (x[None], rs, rs0, itk, hist)
+            return out + ((fin[8],) if Ht else ())
 
-        nouts = 5 if sdccfg is not None else 4
+        nouts = 4 + (1 if sdccfg is not None else 0) + (1 if Ht else 0)
         return shard_map(
             shard_fn,
             mesh=mesh,
@@ -4026,6 +4173,11 @@ def make_block_cg_fn(
     run.fused = bool(fused)
     run.rhs_batch = K
     run.has_sdc = sdccfg is not None
+    run.trace_iters = Ht
+    run.comms_kwargs = dict(
+        precond=bool(precond), pipelined=False, fused=bool(fused),
+        rhs_batch=K, sdc=sdccfg is not None, abft=abft_on,
+    )
     return run
 
 
@@ -4811,6 +4963,22 @@ def _decode_sdc_outputs(name: str, sdcvec, it=None) -> dict:
         "audit_iterations": audits,
         "trips": trips,
     }
+    if dets or rollbacks or escal:
+        # the compiled loop only reports counters (its detections fired
+        # in-graph); surface them as one structured event so no device
+        # recovery is silent in the record's event log
+        from .. import telemetry
+
+        telemetry.emit_event(
+            "sdc_detection", label=name,
+            iteration=None if it is None else int(it), **sdc_info,
+        )
+        if rollbacks:
+            telemetry.emit_event(
+                "sdc_rollback", label=name,
+                iteration=None if it is None else int(it),
+                rollbacks=rollbacks,
+            )
     if escal:
         diag = {"context": name, "sdc": sdc_info}
         if it is not None:
@@ -4833,26 +5001,65 @@ def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg",
     `residuals` has iterations+1 entries (capped at the compiled history
     length); ``info_extra`` keys (e.g. the CG body variant) merge into
     it."""
+    from .. import telemetry
     from ..utils.helpers import krylov_info, warn_tol_below_floor
 
     backend = b.values.backend
     floor_warned = warn_tol_below_floor(tol, b.dtype, name=name)
-    dA = device_matrix(A, backend)
-    x0 = x0 if x0 is not None else PVector.full(0.0, A.cols, dtype=b.dtype)
-    db = _b_on_cols_layout(b, dA)
-    dx0 = DeviceVector.from_pvector(x0, backend, dA.col_layout)
-    if minv is not None:
-        dmv = DeviceVector.from_pvector(minv, backend, dA.col_layout)
-        out = solve(db.data, dx0.data, dmv.data)
-    else:
-        out = solve(db.data, dx0.data)
+    rec = telemetry.current_record()
+    with telemetry.annotate(f"pa:{name}:stage"):
+        dA = device_matrix(A, backend)
+        x0 = x0 if x0 is not None else PVector.full(
+            0.0, A.cols, dtype=b.dtype
+        )
+        db = _b_on_cols_layout(b, dA)
+        dx0 = DeviceVector.from_pvector(x0, backend, dA.col_layout)
+        dmv = (
+            DeviceVector.from_pvector(minv, backend, dA.col_layout)
+            if minv is not None
+            else None
+        )
+    with telemetry.annotate(f"pa:{name}:solve"):
+        if dmv is not None:
+            out = solve(db.data, dx0.data, dmv.data)
+        else:
+            out = solve(db.data, dx0.data)
+    out = list(out)
+    x_data, rs, rs0, it, hist = out[:5]
+    k = 5
+    sdcvec = None
     if getattr(solve, "has_sdc", False):
-        x_data, rs, rs0, it, hist, sdcvec = out
-    else:
-        (x_data, rs, rs0, it, hist), sdcvec = out, None
+        sdcvec = out[k]
+        k += 1
+    trace_n = int(getattr(solve, "trace_iters", 0))
+    ab = out[k] if trace_n else None
     x = DeviceVector(x_data, A.cols, dA.col_layout, backend).to_pvector()
     rs, rs0, it = float(rs), float(rs0), int(it)
     residuals = np.asarray(hist)[: min(it + 1, len(np.asarray(hist)))]
+    if rec is not None and rec.enabled:
+        # attach BEFORE the typed-raise paths below: an aborted record
+        # still carries its trace and comms accounting for post-mortems
+        if ab is not None:
+            abh = np.asarray(ab)
+            n = min(it, trace_n)
+            if it > trace_n:
+                # true ring: the buffer holds the LAST trace_n committed
+                # iterations, rotated — unroll so entry j is absolute
+                # iteration trace_start + j
+                abh = np.roll(abh, -(it % trace_n), axis=0)
+                rec.trace_start = it - trace_n
+            rec.alpha = [float(v) for v in abh[:n, 0]]
+            rec.beta = [float(v) for v in abh[:n, 1]]
+        ck = getattr(solve, "comms_kwargs", None)
+        if ck is not None:
+            profile = telemetry.cg_comms_profile(dA, b.dtype, **ck)
+            # the SDC-defended loop pays its per-iteration collectives
+            # on EVERY while trip (commit, audit, restore alike) — the
+            # wire accounting counts trips, not committed iterations
+            comm_it = (
+                int(np.asarray(sdcvec)[4]) if sdcvec is not None else it
+            )
+            rec.comms = telemetry.observed_comms(profile, comm_it)
     if verbose:
         for i, r in enumerate(residuals[1:], start=1):
             print(f"{name} it={i} residual={r:.3e}")
@@ -4914,21 +5121,29 @@ def tpu_cg(
     ``PA_TPU_FUSED_CG``, ON outside strict-bits) selects the fused
     streaming body with the packed (3, W) carry (see `make_cg_fn`). The
     info dict records which body ran under ``cg_body``."""
+    from .. import telemetry
+
     backend = b.values.backend
     check(isinstance(backend, TPUBackend), "tpu_cg needs a TPU-backend PVector")
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
-    dA = device_matrix(A, backend)
     fused = _resolve_fused(fused, pipelined)
-    solve = _krylov_fn_for(
-        dA, "cg", tol, maxiter, precond=minv is not None,
-        pipelined=pipelined, fused=fused,
-    )
     body = "pipelined" if pipelined else ("fused" if fused else "standard")
-    return _run_krylov(
-        A, b, x0, tol, verbose, solve, minv=minv,
-        name="pcg" if minv is not None else "cg",
-        info_extra={"cg_body": body},
-    )
+    name = "pcg" if minv is not None else "cg"
+    with telemetry.solve_scope(
+        name, backend="tpu", tol=float(tol), maxiter=int(maxiter),
+        cg_body=body, dtype=str(np.dtype(b.dtype)),
+        env_key=_lowering_env_key(),
+    ) as rec:
+        dA = device_matrix(A, backend)
+        solve = _krylov_fn_for(
+            dA, "cg", tol, maxiter, precond=minv is not None,
+            pipelined=pipelined, fused=fused,
+        )
+        x, info = _run_krylov(
+            A, b, x0, tol, verbose, solve, minv=minv, name=name,
+            info_extra={"cg_body": body},
+        )
+        return x, rec.finish(info)
 
 
 def _block_on_cols_layout(Bs, dA: DeviceMatrix, with_ghosts: bool = False):
@@ -4971,8 +5186,7 @@ def tpu_block_cg(
     per-column krylov info each (iterations, residual history, status —
     each column's trajectory is its solo `tpu_cg` trajectory); the
     top-level fields aggregate (worst column)."""
-    from ..utils.helpers import krylov_info, warn_tol_below_floor
-    from .multihost import fetch_global
+    from .. import telemetry
 
     B = list(B)
     K = len(B)
@@ -4983,30 +5197,103 @@ def tpu_block_cg(
         "tpu_block_cg needs TPU-backend PVectors",
     )
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
-    dA = device_matrix(A, backend)
     fused = _resolve_fused(fused, False)
-    solve = _krylov_fn_for(
-        dA, "cg", tol, maxiter, precond=minv is not None, fused=fused,
-        rhs_batch=K,
-    )
     dt = np.result_type(*[b.dtype for b in B])
-    floor_warned = warn_tol_below_floor(tol, dt, name="block-cg")
-    db = _block_on_cols_layout(B, dA)
-    if X0 is None:
-        X0 = [PVector.full(0.0, A.cols, dtype=dt) for _ in range(K)]
-    else:
-        X0 = list(X0)
-        check(len(X0) == K, "tpu_block_cg: X0 must hold one start per RHS")
-    dx0 = _block_on_cols_layout(X0, dA, with_ghosts=True)
-    if minv is not None:
-        dmv = DeviceVector.from_pvector(minv, backend, dA.col_layout)
-        out = solve(db, dx0, dmv.data)
-    else:
-        out = solve(db, dx0)
+    name = "block-pcg" if minv is not None else "block-cg"
+    with telemetry.solve_scope(
+        name, backend="tpu", tol=float(tol), maxiter=int(maxiter),
+        rhs_batch=K, cg_body="fused" if fused else "standard",
+        dtype=str(np.dtype(dt)), env_key=_lowering_env_key(),
+    ) as rec:
+        xs, info = _tpu_block_cg_impl(
+            A, B, X0, tol, maxiter, verbose, minv, fused, K, backend,
+            dt, name, rec,
+        )
+        return xs, rec.finish(info)
+
+
+def _tpu_block_cg_impl(
+    A, B, X0, tol, maxiter, verbose, minv, fused, K, backend, dt, name,
+    rec,
+):
+    from .. import telemetry
+    from ..utils.helpers import krylov_info, warn_tol_below_floor
+    from .multihost import fetch_global
+
+    with telemetry.annotate(f"pa:{name}:stage"):
+        dA = device_matrix(A, backend)
+        solve = _krylov_fn_for(
+            dA, "cg", tol, maxiter, precond=minv is not None, fused=fused,
+            rhs_batch=K,
+        )
+        floor_warned = warn_tol_below_floor(tol, dt, name="block-cg")
+        db = _block_on_cols_layout(B, dA)
+        if X0 is None:
+            X0 = [PVector.full(0.0, A.cols, dtype=dt) for _ in range(K)]
+        else:
+            X0 = list(X0)
+            check(
+                len(X0) == K, "tpu_block_cg: X0 must hold one start per RHS"
+            )
+        dx0 = _block_on_cols_layout(X0, dA, with_ghosts=True)
+        dmv = (
+            DeviceVector.from_pvector(minv, backend, dA.col_layout)
+            if minv is not None
+            else None
+        )
+    with telemetry.annotate(f"pa:{name}:solve"):
+        if dmv is not None:
+            out = solve(db, dx0, dmv.data)
+        else:
+            out = solve(db, dx0)
+    out = list(out)
+    x_data, rs, rs0, itk, hist = out[:5]
+    k_out = 5
+    sdcvec = None
     if getattr(solve, "has_sdc", False):
-        x_data, rs, rs0, itk, hist, sdcvec = out
-    else:
-        (x_data, rs, rs0, itk, hist), sdcvec = out, None
+        sdcvec = out[k_out]
+        k_out += 1
+    trace_n = int(getattr(solve, "trace_iters", 0))
+    ab = out[k_out] if trace_n else None
+    if rec is not None and rec.enabled:
+        trips = (
+            int(np.asarray(sdcvec)[4])
+            if sdcvec is not None
+            else int(np.asarray(itk).max())
+        )
+        if ab is not None:
+            abh = np.asarray(ab)  # (Ht, 2, K)
+            # ring slots are indexed by the GLOBAL trip counter, which
+            # equals the slowest column's committed count
+            itks = np.asarray(itk).astype(int).ravel()
+            itmax = int(itks.max())
+            n = min(itmax, trace_n)
+            if itmax > trace_n:
+                abh = np.roll(abh, -(itmax % trace_n), axis=0)
+                rec.trace_start = itmax - trace_n
+            # per-column traces: alpha[k]/beta[k] is column k's list;
+            # entries on trips AFTER column k converged are the frozen
+            # α=0/stale-β selects, not recurrence values — masked None
+            rec.alpha = [
+                [
+                    float(abh[j, 0, k])
+                    if rec.trace_start + j < itks[k] else None
+                    for j in range(n)
+                ]
+                for k in range(K)
+            ]
+            rec.beta = [
+                [
+                    float(abh[j, 1, k])
+                    if rec.trace_start + j < itks[k] else None
+                    for j in range(n)
+                ]
+                for k in range(K)
+            ]
+        ck = getattr(solve, "comms_kwargs", None)
+        if ck is not None:
+            profile = telemetry.cg_comms_profile(dA, dt, **ck)
+            rec.comms = telemetry.observed_comms(profile, trips)
     sdc_info = (
         _decode_sdc_outputs("block-cg", sdcvec)
         if sdcvec is not None
@@ -5094,14 +5381,25 @@ def tpu_bicgstab(
 ) -> Tuple[PVector, dict]:
     """Device BiCGStab (nonsymmetric Krylov), one compiled program;
     ``minv`` is an optional inverse-diagonal RIGHT preconditioner."""
+    from .. import telemetry
+
     backend = b.values.backend
     check(
         isinstance(backend, TPUBackend), "tpu_bicgstab needs a TPU-backend PVector"
     )
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
-    dA = device_matrix(A, backend)
-    solve = _krylov_fn_for(dA, "bicgstab", tol, maxiter, precond=minv is not None)
-    return _run_krylov(A, b, x0, tol, verbose, solve, minv=minv, name="bicgstab")
+    with telemetry.solve_scope(
+        "bicgstab", backend="tpu", tol=float(tol), maxiter=int(maxiter),
+        dtype=str(np.dtype(b.dtype)), env_key=_lowering_env_key(),
+    ) as rec:
+        dA = device_matrix(A, backend)
+        solve = _krylov_fn_for(
+            dA, "bicgstab", tol, maxiter, precond=minv is not None
+        )
+        x, info = _run_krylov(
+            A, b, x0, tol, verbose, solve, minv=minv, name="bicgstab"
+        )
+        return x, rec.finish(info)
 
 
 def _krylov_fn_for(
@@ -5119,11 +5417,32 @@ def _krylov_fn_for(
     # flip rebuilds the program instead of serving a stale defense
     # (pipelined programs are SDC-exempt and must not retrace on flips)
     sdccfg = None if pipelined else _sdc_config(int(maxiter))
+    # the trace-ring depth changes the traced program (an extra carry),
+    # so it joins the key through the same helper make_cg_fn resolves
+    # it with (_trace_config — a registered env-key site). Key the
+    # EFFECTIVE depth, mirroring the builders' clamps: the pipelined
+    # body, the SDC-defended block body, and bicgstab have no ring, and
+    # depth saturates at maxiter — a PA_TRACE_ITERS flip must not
+    # rebuild a program the flip cannot reach.
+    if method != "cg" or pipelined or (
+        rhs_batch is not None and sdccfg is not None
+    ):
+        trace_ht = 0
+    else:
+        trace_ht = int(min(_trace_config(), int(maxiter)))
     key = (
         method, float(tol), int(maxiter), bool(precond), bool(pipelined),
         bool(fused), rhs_batch, sdccfg["key"] if sdccfg else None,
+        trace_ht,
     )
+    from .. import telemetry
+
     if key not in dA._cg_cache:
+        telemetry.bump("program_cache.miss")
+        telemetry.emit_event(
+            "compile_cache", label="program_miss", cache="program",
+            action="miss", method=method,
+        )
         if method == "cg":
             dA._cg_cache[key] = make_cg_fn(
                 dA, tol, maxiter, precond=precond, pipelined=pipelined,
@@ -5133,6 +5452,12 @@ def _krylov_fn_for(
             dA._cg_cache[key] = make_bicgstab_fn(
                 dA, tol, maxiter, precond=precond
             )
+    else:
+        telemetry.bump("program_cache.hit")
+        telemetry.emit_event(
+            "compile_cache", label="program_hit", cache="program",
+            action="hit", method=method,
+        )
     return dA._cg_cache[key]
 
 
@@ -5204,6 +5529,7 @@ _MATRIX_BASE_ENV = {
     "PA_HEALTH_MAX_ROLLBACKS": None,
     "PA_TPU_GMG_BOX": None,
     "PA_TPU_GMG_STENCIL": None,
+    "PA_TRACE_ITERS": None,
 }
 
 
@@ -5283,9 +5609,11 @@ def _matrix_probe_system(backend: "TPUBackend", dtype: str):
     """The small fixed probe operator every matrix case lowers: the
     (6, 6, 6) Poisson system on a (2, 2, 2) box partition — big enough
     that every exchange round and both dot gathers appear, small enough
-    that the full matrix lowers in seconds. Cached per (backend token,
-    dtype) — the DeviceMatrix env-rekeying happens downstream in
-    `device_matrix`, not here."""
+    that the full matrix lowers in seconds. Returns ``(A, b, x0)`` (the
+    Dirichlet start vector — the probe system needs its boundary lift;
+    a zero start diverges). Cached per (backend token, dtype) — the
+    DeviceMatrix env-rekeying happens downstream in `device_matrix`,
+    not here."""
     from ..models import assemble_poisson
     from .backends import prun
 
@@ -5293,7 +5621,7 @@ def _matrix_probe_system(backend: "TPUBackend", dtype: str):
 
     def driver(parts):
         A, b, xe, x0 = assemble_poisson(parts, (6, 6, 6), dtype=np_dtype)
-        return A, b
+        return A, b, x0
 
     cache = getattr(backend, "_palint_probe", None)
     if cache is None:
@@ -5319,7 +5647,7 @@ def case_program_texts(
     env = dict(_MATRIX_BASE_ENV)
     env.update(case.get("env", {}))
     with _env_overrides(env):
-        A, b = _matrix_probe_system(backend, case.get("dtype", "f64"))
+        A, b, _x0 = _matrix_probe_system(backend, case.get("dtype", "f64"))
         dA = device_matrix(A, backend)
         ops = _matrix_operands(dA)
         kwargs = dict(case.get("kwargs", {}))
@@ -5336,6 +5664,46 @@ def case_program_texts(
         low = fn.jit_fn.lower(*args)
         compiled = low.compile().as_text() if with_compiled else None
         return low.as_text(), compiled
+
+
+def case_probe_solve(
+    backend: "TPUBackend", case: dict, tol: Optional[float] = None,
+    maxiter: int = 50,
+):
+    """Run ``case``'s compiled-CG program against the fixed probe
+    system under the case's pinned env and return the finished
+    telemetry `SolveRecord` — the MEASURED half of the
+    static-vs-measured comms reconciliation contract
+    (analysis.contracts: ``static-measured-reconciliation``). The
+    solve goes through the public drivers (`tpu_cg` /
+    `tpu_block_cg`), so the record's comms accounting is exactly what
+    a user's solve would report."""
+    from .. import telemetry
+
+    env = dict(_MATRIX_BASE_ENV)
+    env.update(case.get("env", {}))
+    with _env_overrides(env):
+        A, b, x0 = _matrix_probe_system(backend, case.get("dtype", "f64"))
+        kwargs = dict(case.get("kwargs", {}))
+        rhs_batch = kwargs.pop("rhs_batch", None)
+        if tol is None:
+            # stay above the f32 resolution floor so the probe solve
+            # converges quietly in either dtype
+            tol = 1e-4 if case.get("dtype") == "f32" else 1e-9
+        if rhs_batch:
+            _, info = tpu_block_cg(
+                A, [b] * rhs_batch, X0=[x0] * rhs_batch, tol=tol,
+                maxiter=maxiter, **kwargs,
+            )
+        else:
+            _, info = tpu_cg(A, b, x0=x0, tol=tol, maxiter=maxiter, **kwargs)
+    rec = getattr(info, "record", None)
+    check(
+        rec is not None and rec.comms is not None,
+        "case_probe_solve: the probe solve produced no telemetry comms "
+        "accounting (PA_METRICS=0 in the ambient environment?)",
+    )
+    return rec
 
 
 def case_program_text(

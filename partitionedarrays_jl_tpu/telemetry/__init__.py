@@ -1,0 +1,102 @@
+"""patrace — runtime solver telemetry (the observability subsystem).
+
+Four layers, each importable on its own (docs/observability.md has the
+full catalog; `tools/patrace.py` is the CLI):
+
+* `telemetry.record` — typed `SolveRecord`s replacing the ad-hoc info
+  plumbing: config snapshot (lowering env key + ``PA_*`` env), residual
+  and α/β trajectories, the structured event log (health guards, fault
+  injections, SDC detections/rollbacks, checkpoint save/restore,
+  compile-cache hit/miss/stale, recovery restarts). The legacy ``info``
+  dict remains the return contract (`InfoDict` — a dict subclass with
+  the record at ``info.record``).
+* `telemetry.metrics` — process-wide named counters (cache hit/miss/
+  stale-rekey, persistent-XLA-cache bridge, event tallies).
+* `telemetry.comms` — static-vs-measured comms accounting: the
+  plan-level collective inventory of each compiled CG body, reconciled
+  against the lowered program's per-iteration/setup split (the palint
+  runtime contract).
+* `telemetry.trace` / `telemetry.artifacts` — Chrome-trace/Perfetto
+  export of records + PTimer sections, and the shared schema-versioned
+  bench-artifact writer.
+
+Hard contract (same discipline as ABFT): telemetry OFF is HLO-identical
+to the pre-telemetry programs; telemetry ON adds ZERO collectives — the
+α/β trace ring rides the while-loop carry (``PA_TRACE_ITERS``, a keyed
+lowering flag), everything else is host-side.
+"""
+from .artifacts import ARTIFACT_SCHEMA_VERSION, stamp, write  # noqa: F401
+from .comms import (  # noqa: F401
+    COMM_KINDS,
+    cg_comms_profile,
+    expected_from_report,
+    observed_comms,
+    reconcile,
+)
+from .metrics import (  # noqa: F401
+    bump,
+    install_jax_cache_listeners,
+)
+from .metrics import get as counter  # noqa: F401
+from .metrics import reset as reset_counters  # noqa: F401
+from .metrics import snapshot as counters  # noqa: F401
+from .record import (  # noqa: F401
+    RECORD_SCHEMA_VERSION,
+    InfoDict,
+    SolveRecord,
+    TelemetryEvent,
+    begin_record,
+    clear_history,
+    current_record,
+    emit_event,
+    last_record,
+    list_persisted_records,
+    load_record,
+    metrics_dir,
+    record_history,
+    solve_scope,
+    telemetry_enabled,
+)
+from .trace import (  # noqa: F401
+    TRACE_SCHEMA_VERSION,
+    annotate,
+    chrome_trace,
+    record_trace_events,
+    write_chrome_trace,
+)
+
+__all__ = [
+    "ARTIFACT_SCHEMA_VERSION",
+    "COMM_KINDS",
+    "InfoDict",
+    "RECORD_SCHEMA_VERSION",
+    "SolveRecord",
+    "TRACE_SCHEMA_VERSION",
+    "TelemetryEvent",
+    "annotate",
+    "begin_record",
+    "bump",
+    "cg_comms_profile",
+    "chrome_trace",
+    "clear_history",
+    "counter",
+    "counters",
+    "current_record",
+    "emit_event",
+    "expected_from_report",
+    "install_jax_cache_listeners",
+    "last_record",
+    "list_persisted_records",
+    "load_record",
+    "metrics_dir",
+    "observed_comms",
+    "reconcile",
+    "record_history",
+    "record_trace_events",
+    "reset_counters",
+    "solve_scope",
+    "stamp",
+    "telemetry_enabled",
+    "write",
+    "write_chrome_trace",
+]

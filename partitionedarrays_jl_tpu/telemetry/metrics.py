@@ -1,0 +1,120 @@
+"""Process-wide metrics registry: named monotonic counters.
+
+The observability layer's cheapest tier — plain host-side integers, no
+device work, no collectives, no I/O. Everything that used to be
+invisible bookkeeping (compile-cache hits, lowering restagings, solver
+events) bumps a counter here, and tests assert on the counters instead
+of on wall-clock proxies (the `tests/test_compile_cache.py` rewrite:
+the old "compile-time floor" assertions were flaky exactly because they
+inferred cache behavior from timing).
+
+Counter namespaces in use:
+
+* ``lowering_cache.{hit,miss,stale_rekey}`` — `device_matrix`'s
+  per-matrix staging cache. ``stale_rekey`` counts misses on a matrix
+  that WAS staged before under a different `_lowering_env_key` (an env
+  flip re-ran staging admission — the palint bug class, now measurable).
+* ``program_cache.{hit,miss}`` — `_krylov_fn_for`'s compiled-program
+  cache on a DeviceMatrix.
+* ``persistent_cache.{hit,miss}`` — JAX's on-disk XLA executable cache,
+  bridged from ``jax.monitoring`` events (best-effort: the event names
+  are jax-internal; a rename degrades to counters stuck at 0, never an
+  error).
+* ``events.<kind>`` — one bump per telemetry event emitted
+  (`telemetry.record.emit_event`).
+
+All reads are dynamic; `reset()` exists for tests. Counters are always
+on (they are a dict increment); the record/event layer's ``PA_METRICS``
+kill switch does not gate them.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = [
+    "bump",
+    "get",
+    "snapshot",
+    "reset",
+    "install_jax_cache_listeners",
+]
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+
+
+def bump(name: str, n: int = 1) -> int:
+    """Increment counter ``name`` by ``n`` and return the new value."""
+    with _lock:
+        v = _counters.get(name, 0) + int(n)
+        _counters[name] = v
+        return v
+
+
+def get(name: str) -> int:
+    return _counters.get(name, 0)
+
+
+def snapshot(prefix: Optional[str] = None) -> Dict[str, int]:
+    """A copy of the current counters (optionally filtered by prefix)."""
+    with _lock:
+        if prefix is None:
+            return dict(_counters)
+        return {k: v for k, v in _counters.items() if k.startswith(prefix)}
+
+
+def reset(prefix: Optional[str] = None) -> None:
+    """Zero the registry (tests); with ``prefix``, only that namespace."""
+    with _lock:
+        if prefix is None:
+            _counters.clear()
+        else:
+            for k in [k for k in _counters if k.startswith(prefix)]:
+                del _counters[k]
+
+
+_jax_listeners_attempted = False
+_jax_listeners_installed = False
+
+#: jax.monitoring event names -> our counters. `cache_hits` arrives via
+#: `record_event`; `cache_misses` via `record_event_duration_secs` (the
+#: miss carries its compile duration). Observed stable across the jax
+#: versions this repo has run on; treated as best-effort regardless.
+_JAX_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "persistent_cache.hit",
+    "/jax/compilation_cache/cache_misses": "persistent_cache.miss",
+}
+
+
+def install_jax_cache_listeners() -> bool:
+    """Bridge JAX's persistent-compilation-cache monitoring events into
+    ``persistent_cache.{hit,miss}``. Idempotent; returns whether the
+    listeners are (now) installed. Never raises — a jax that renamed
+    its monitoring hooks just leaves the counters at zero."""
+    global _jax_listeners_attempted, _jax_listeners_installed
+    if _jax_listeners_attempted:
+        return _jax_listeners_installed
+    # one attempt ever: a partial failure (first listener registered,
+    # second raises) must not leave a retry path that registers the
+    # first listener again and double-counts every hit
+    _jax_listeners_attempted = True
+    try:
+        import jax.monitoring as jm
+
+        def _on_event(event: str, **kw) -> None:
+            name = _JAX_EVENT_COUNTERS.get(event)
+            if name:
+                bump(name)
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            name = _JAX_EVENT_COUNTERS.get(event)
+            if name:
+                bump(name)
+
+        jm.register_event_listener(_on_event)
+        jm.register_event_duration_secs_listener(_on_duration)
+        _jax_listeners_installed = True
+    except Exception:
+        return False
+    return True

@@ -1,0 +1,131 @@
+"""Chrome-trace / Perfetto export: one timeline for solver records and
+PTimer sections.
+
+The exported file is the plain Chrome ``traceEvents`` JSON (load it at
+``chrome://tracing`` or https://ui.perfetto.dev): every `SolveRecord`
+becomes one complete span (``ph: "X"``) carrying its config in args,
+each of its telemetry events an instant (``ph: "i"``) at the event's
+offset inside the span, and every `PTimer` section a span on its own
+track — including the ``barrier`` cost of ``tic(barrier=True)``, which
+is a real, otherwise-invisible line item (it drains the device FIFOs).
+
+All timestamps are absolute wall-clock microseconds (records carry
+``started_at``; PTimer spans record their own epoch starts), so records
+and timer sections from the same process land on one coherent timeline.
+
+`annotate` is the in-process bridge to ``jax.profiler``: a context
+manager that wraps ``jax.profiler.TraceAnnotation`` when profiling is
+available (spans then ALSO appear in captured XLA profiles) and
+degrades to a no-op otherwise — staging/compile/solve phases are
+annotated with it in the solver drivers.
+"""
+from __future__ import annotations
+
+import json
+from contextlib import contextmanager
+from typing import Iterable, List, Optional
+
+__all__ = [
+    "TRACE_SCHEMA_VERSION",
+    "annotate",
+    "chrome_trace",
+    "record_trace_events",
+    "write_chrome_trace",
+]
+
+TRACE_SCHEMA_VERSION = 1
+
+
+@contextmanager
+def annotate(name: str):
+    """``with annotate("pa:solve"): ...`` — a `jax.profiler`
+    TraceAnnotation when jax is importable (so the span shows up inside
+    captured device profiles), a no-op otherwise. Never raises."""
+    ctx = None
+    try:
+        from jax.profiler import TraceAnnotation
+
+        ctx = TraceAnnotation(name)
+        ctx.__enter__()
+    except Exception:
+        ctx = None
+    try:
+        yield
+    finally:
+        if ctx is not None:
+            try:
+                ctx.__exit__(None, None, None)
+            except Exception:
+                pass
+
+
+def record_trace_events(rec, tid: int = 0) -> List[dict]:
+    """Chrome events of one `SolveRecord`: the solve span plus one
+    instant per telemetry event."""
+    d = rec.as_dict() if hasattr(rec, "as_dict") else dict(rec)
+    t0_us = float(d.get("started_at") or 0.0) * 1e6
+    dur_us = float(d.get("wall_s") or 0.0) * 1e6
+    out = [
+        {
+            "name": f"solve:{d.get('solver')}",
+            "ph": "X",
+            "ts": t0_us,
+            "dur": max(dur_us, 1.0),
+            "pid": 1,
+            "tid": tid,
+            "cat": "solve",
+            "args": {
+                "solver": d.get("solver"),
+                "iterations": d.get("iterations"),
+                "status": d.get("status"),
+                "config": d.get("config"),
+                "comms": d.get("comms"),
+            },
+        }
+    ]
+    for ev in d.get("events") or []:
+        out.append(
+            {
+                "name": f"{ev['kind']}:{ev.get('label') or ''}".rstrip(":"),
+                "ph": "i",
+                "s": "t",
+                "ts": t0_us + float(ev.get("t") or 0.0) * 1e6,
+                "pid": 1,
+                "tid": tid,
+                "cat": "event",
+                "args": {
+                    "iteration": ev.get("iteration"),
+                    **(ev.get("details") or {}),
+                },
+            }
+        )
+    return out
+
+
+def chrome_trace(
+    records: Optional[Iterable] = None, timers: Optional[Iterable] = None
+) -> dict:
+    """The full Chrome-trace object for a set of records and PTimers
+    (each timer contributes `PTimer.trace_events` spans)."""
+    events: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": 1,
+         "args": {"name": "partitionedarrays_jl_tpu solves"}},
+        {"name": "process_name", "ph": "M", "pid": 2,
+         "args": {"name": "partitionedarrays_jl_tpu ptimers"}},
+    ]
+    for tid, rec in enumerate(records or []):
+        events.extend(record_trace_events(rec, tid=tid))
+    for timer in timers or []:
+        events.extend(timer.trace_events(pid=2))
+    return {
+        "displayTimeUnit": "ms",
+        "metadata": {"schema_version": TRACE_SCHEMA_VERSION,
+                     "generated_by": "partitionedarrays_jl_tpu.telemetry"},
+        "traceEvents": events,
+    }
+
+
+def write_chrome_trace(path: str, records=None, timers=None) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(chrome_trace(records=records, timers=timers), f, indent=1)
+    return path

@@ -57,6 +57,13 @@ def enable_compilation_cache(path: str | None = None) -> str:
     global _enabled_dir
     import jax
 
+    # bridge jax's cache-hit/miss monitoring events into the telemetry
+    # counters (persistent_cache.{hit,miss}) — the deterministic signal
+    # tests/test_compile_cache.py asserts on instead of wall-clock
+    from ..telemetry import install_jax_cache_listeners
+
+    install_jax_cache_listeners()
+
     if path is None:
         path = _DEFAULT_DIR
     path = os.path.abspath(os.path.expanduser(path))

@@ -16,11 +16,18 @@ completion), so the matrix can never silently rot into prose.
 | drop       | exchange deadline            | ExchangeTimeoutError, typed; survivable by restart |
 | delay      | nothing to detect            | clean completion (slow host is not an error) |
 | controller | runtime surface              | ControllerLostError; survivable by restart |
+
+Round 9 (patrace): each case ALSO asserts its telemetry story — the
+injected fault, the detector that fired, and the recovery path taken
+all appear as structured events in the solve's `SolveRecord`
+(``info.record``, or the aborted record in the history ring for the
+typed-raise paths). No recovery may be silent in the event log.
 """
 import numpy as np
 import pytest
 
 import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu import telemetry
 from partitionedarrays_jl_tpu.models import (
     assemble_poisson,
     cg,
@@ -40,6 +47,14 @@ def _run(driver):
     assert pa.prun(driver, pa.sequential, (2, 2))
 
 
+def _has_event(rec, kind, label=None):
+    """Does the record log an event of ``kind`` (and ``label``)?"""
+    return any(
+        e.kind == kind and (label is None or e.label == label)
+        for e in rec.events
+    )
+
+
 def test_matrix_nan_typed_then_recovers():
     def driver(parts):
         A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
@@ -47,9 +62,23 @@ def test_matrix_nan_typed_then_recovers():
         with inject_faults("nan@part=1,call=9", seed=1):
             with pytest.raises(NonFiniteError):
                 cg(A, b, x0=x0, tol=1e-9)
+        # the aborted solve's record survives with the whole story:
+        # the injected fault, the detector, and the abort itself
+        aborted = telemetry.last_record("cg")
+        assert aborted.status == "raised"
+        assert _has_event(aborted, "fault_injected", "nan")
+        assert _has_event(aborted, "health_error", "NonFiniteError")
         with inject_faults("nan@part=1,call=9", seed=1):
             x, info = solve_with_recovery(A, b, x0=x0, tol=1e-9)
         assert info["converged"] and info["restarts"] == 1
+        # the recovery record logs the fault, the detector, AND the
+        # recovery path taken (restart) — nothing healed silently
+        rec = info.record
+        assert _has_event(rec, "fault_injected", "nan")
+        assert _has_event(rec, "health_error", "NonFiniteError")
+        restarts = [e for e in rec.events if e.kind == "restart"]
+        assert len(restarts) == 1
+        assert restarts[0].label == "NonFiniteError"
         np.testing.assert_array_equal(
             gather_pvector(x_clean), gather_pvector(x)
         )
@@ -67,6 +96,13 @@ def test_matrix_nan_under_abft_heals_in_memory(monkeypatch):
         with inject_faults("nan@part=1,call=9", seed=1):
             x, info = cg(A, b, x0=x0, tol=1e-9)
         assert info["converged"] and info["sdc"]["rollbacks"] == 1
+        # in-memory self-heal, but NOT silent: the record logs the
+        # fault, the detection, and the rollback (with its iteration)
+        rec = info.record
+        assert _has_event(rec, "fault_injected", "nan")
+        rolls = [e for e in rec.events if e.kind == "sdc_rollback"]
+        assert _has_event(rec, "sdc_detection") and len(rolls) == 1
+        assert rolls[0].iteration is not None
         np.testing.assert_array_equal(
             gather_pvector(x_clean), gather_pvector(x)
         )
@@ -86,6 +122,14 @@ def test_matrix_bitflip_under_abft_heals_bitwise(monkeypatch):
             x, info = cg(A, b, x0=x0, tol=1e-9)
         assert any(e["kind"] == "bitflip" for e in st.events)
         assert info["converged"] and info["sdc"]["detections"] == 1
+        # event completeness: fault kind + detection + rollback, with
+        # the iteration the recovery rewound to
+        rec = info.record
+        assert _has_event(rec, "fault_injected", "bitflip")
+        assert _has_event(rec, "sdc_detection", "cg")
+        rolls = [e for e in rec.events if e.kind == "sdc_rollback"]
+        assert len(rolls) == 1
+        assert "restored_iteration" in rolls[0].details
         np.testing.assert_array_equal(
             gather_pvector(x_clean), gather_pvector(x)
         )
@@ -102,6 +146,10 @@ def test_matrix_drop_typed_timeout():
                 cg(A, b, x0=x0, tol=1e-9)
         assert ei.value.diagnostics["missing_parts"] == [2]
         assert st.events[0]["kind"] == "drop"
+        aborted = telemetry.last_record("cg")
+        assert aborted.status == "raised"
+        assert _has_event(aborted, "fault_injected", "drop")
+        assert _has_event(aborted, "health_error", "ExchangeTimeoutError")
         return True
 
     _run(driver)
@@ -114,6 +162,13 @@ def test_matrix_delay_completes_clean():
             x, info = cg(A, b, x0=x0, tol=1e-9)
         assert info["converged"]  # a slow host is not an error
         assert st.events[0]["kind"] == "delay"
+        # the record shows the injection AND that nothing needed to
+        # recover: no detector fired, no recovery path was taken
+        rec = info.record
+        assert _has_event(rec, "fault_injected", "delay")
+        for kind in ("health_error", "sdc_detection", "sdc_rollback",
+                     "restart"):
+            assert not _has_event(rec, kind), kind
         return True
 
     _run(driver)
@@ -129,6 +184,10 @@ def test_matrix_controller_typed_then_recovers():
             x, info = solve_with_recovery(A, b, x0=x0, tol=1e-9)
         assert info["converged"] and info["restarts"] == 1
         assert info["recovery"]["attempts"] == 2
+        rec = info.record
+        assert _has_event(rec, "fault_injected", "controller")
+        assert _has_event(rec, "health_error", "ControllerLostError")
+        assert _has_event(rec, "restart", "ControllerLostError")
         return True
 
     _run(driver)
@@ -148,6 +207,16 @@ def test_matrix_never_returns_silently_wrong(monkeypatch):
                 solve_with_recovery(
                     A, b, x0=x0, tol=1e-9, max_restarts=1
                 )
+        # even the give-up path is fully narrated: the aborted outer
+        # record carries the detections, the exhausted rollbacks, the
+        # escalation, and the abort marker
+        aborted = telemetry.last_record("solve_with_recovery")
+        assert aborted.status == "raised"
+        assert aborted.error["type"] == "SilentCorruptionError"
+        assert _has_event(aborted, "fault_injected", "bitflip")
+        assert _has_event(aborted, "sdc_detection")
+        assert _has_event(aborted, "sdc_escalation")
+        assert _has_event(aborted, "solve_aborted", "SilentCorruptionError")
         return True
 
     _run(driver)

@@ -7,6 +7,12 @@ IDENTICAL iterates (the disk-served executable is the same program, not
 a recompile drift). Both are cheap to pin on the CPU mesh; the timing
 claim itself lives in SCALE_BENCH.json (first_solve_cold_s /
 first_solve_warm_s) measured on the real chip.
+
+Round 9 (patrace): cache behavior is asserted on the telemetry
+COUNTERS (``persistent_cache.{hit,miss}`` bridged from jax.monitoring,
+``lowering_cache.{hit,miss,stale_rekey}`` / ``program_cache.{hit,miss}``
+from the package's own caches) — a deterministic signal, unlike the
+wall-clock compile-time floors such assertions used to lean on.
 """
 import os
 
@@ -38,6 +44,7 @@ def test_enable_populates_dir_and_warm_rebuild_matches(tmp_path):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
         backend = TPUBackend(devices=jax.devices()[:8])
+        from partitionedarrays_jl_tpu import telemetry
 
         def driver(parts):
             Ah, bh, xe, x0 = assemble_poisson(
@@ -49,11 +56,22 @@ def test_enable_populates_dir_and_warm_rebuild_matches(tmp_path):
                 pa.PVector.full(0.0, Ah.cols, dtype=np.float64),
                 backend, dA.col_layout,
             )
+            base = telemetry.counters("persistent_cache")
             solve = make_cg_fn(dA, tol=1e-10, maxiter=500)
             out = solve(db.data, dx0.data, None)
             x_cold = np.asarray(out[0])
             it_cold = int(out[3])
             assert it_cold > 0
+            # cold compile against the fresh cache dir: misses only —
+            # the counters are the deterministic signal (no wall-clock)
+            cold = telemetry.counters("persistent_cache")
+            assert (
+                cold.get("persistent_cache.miss", 0)
+                > base.get("persistent_cache.miss", 0)
+            )
+            assert cold.get("persistent_cache.hit", 0) == base.get(
+                "persistent_cache.hit", 0
+            )
 
             # warm rebuild: executables dropped, program rebuilt — the
             # persistent cache serves the XLA executable from disk
@@ -62,6 +80,11 @@ def test_enable_populates_dir_and_warm_rebuild_matches(tmp_path):
             out2 = solve2(db.data, dx0.data, None)
             assert int(out2[3]) == it_cold
             np.testing.assert_array_equal(np.asarray(out2[0]), x_cold)
+            warm = telemetry.counters("persistent_cache")
+            assert (
+                warm.get("persistent_cache.hit", 0)
+                > cold.get("persistent_cache.hit", 0)
+            ), "warm rebuild did not hit the persistent cache"
             return True
 
         assert pa.prun(driver, backend, (2, 2, 2))
@@ -82,6 +105,53 @@ def test_enable_populates_dir_and_warm_rebuild_matches(tmp_path):
         jax.config.update(
             "jax_persistent_cache_min_compile_time_secs", prev_secs
         )
+
+
+def test_lowering_and_program_cache_counters(monkeypatch):
+    """The package's own two caches are observable: `device_matrix`'s
+    per-matrix staging cache bumps ``lowering_cache.{hit,miss,
+    stale_rekey}`` (stale_rekey = a matrix staged before under a
+    DIFFERENT `_lowering_env_key` — an env flip re-ran staging
+    admission, the palint bug class, now a measurable counter) and
+    `_krylov_fn_for` bumps ``program_cache.{hit,miss}``."""
+    from partitionedarrays_jl_tpu import telemetry
+    from partitionedarrays_jl_tpu.parallel.tpu import _krylov_fn_for
+
+    backend = TPUBackend(devices=jax.devices()[:4])
+
+    def delta(after, before, name):
+        return after.get(name, 0) - before.get(name, 0)
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        t0 = telemetry.counters("lowering_cache")
+        dA = device_matrix(A, backend)
+        assert device_matrix(A, backend) is dA
+        t1 = telemetry.counters("lowering_cache")
+        assert delta(t1, t0, "lowering_cache.miss") == 1
+        assert delta(t1, t0, "lowering_cache.hit") == 1
+        assert delta(t1, t0, "lowering_cache.stale_rekey") == 0
+
+        # a lowering-env flip re-keys: staging admission re-runs,
+        # visibly (PA_TPU_ABFT is in _lowering_env_key; PA_TRACE_ITERS
+        # would NOT trip this — it keys the compiled program, not the
+        # staging cache)
+        monkeypatch.setenv("PA_TPU_ABFT", "1")
+        device_matrix(A, backend)
+        t2 = telemetry.counters("lowering_cache")
+        assert delta(t2, t1, "lowering_cache.stale_rekey") == 1
+        assert delta(t2, t1, "lowering_cache.miss") == 0
+        monkeypatch.delenv("PA_TPU_ABFT")
+
+        p0 = telemetry.counters("program_cache")
+        solve = _krylov_fn_for(dA, "cg", 1e-9, 50)
+        assert _krylov_fn_for(dA, "cg", 1e-9, 50) is solve
+        p1 = telemetry.counters("program_cache")
+        assert delta(p1, p0, "program_cache.miss") == 1
+        assert delta(p1, p0, "program_cache.hit") == 1
+        return True
+
+    assert pa.prun(driver, backend, (2, 2))
 
 
 def test_env_var_hook(monkeypatch, tmp_path):

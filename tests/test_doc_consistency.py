@@ -31,6 +31,7 @@ DOC_FILES = [
     "docs/migration.md",
     "docs/resilience.md",
     "docs/static_analysis.md",
+    "docs/observability.md",
 ]
 
 #: Claims proven wrong by shipped code: these exact phrases must never
@@ -281,3 +282,72 @@ def test_env_table_lowering_rows_name_their_key_site():
             )
         else:
             assert "| lowering |" not in rest, name
+
+
+def test_obs_artifact_agrees_with_guard_bands():
+    """The committed telemetry-overhead artifact (round 9) and the
+    bench guard must agree: identical band bounds, the recorded
+    HLO-identity and collective-parity probes actually TRUE (telemetry
+    off is the pre-telemetry program; the trace ring adds zero
+    collectives), and the overhead rows self-consistent. Device-kind
+    bands gate only records measured on real TPUs — a cpu-platform
+    record is the structural canary (its note must say so)."""
+    bench_obs = _load_tool("bench_obs")
+    rec = json.load(open(os.path.join(REPO, "OBS_BENCH.json")))
+    assert rec["methodology"] == bench_obs.METHODOLOGY
+    assert rec["trace_depth"] == bench_obs.TRACE_DEPTH
+    for key, (lo, hi, kind) in bench_obs.OBS_BANDS.items():
+        band = rec["bands"].get(key)
+        assert band is not None, f"artifact missing band {key}"
+        assert (band["lo"], band["hi"], band["kind"]) == (lo, hi, kind), (
+            key, band,
+        )
+    ident = rec["identity"]
+    assert ident["hlo_identity"] is True
+    assert ident["parity"] is True
+    assert ident["counts_on"] == ident["counts_off"]
+    assert any(ident["counts_on"].values()), "probe saw no collectives"
+    for row in rec["sizes"]:
+        assert row["dofs"] == row["n"] ** 3
+        ratio = row["trace_on_s_per_it"] / row["trace_off_s_per_it"]
+        assert abs(row["overhead_ratio"] - ratio) <= 1e-3 * ratio, row
+    if rec["platform"] == "tpu":
+        ns = {row["n"] for row in rec["sizes"]}
+        assert set(bench_obs.DEVICE_SIZES) <= ns
+        assert rec["bands_ok_device"] is True
+    else:
+        assert rec["bands_ok_device"] is None
+        assert "real TPUs" in rec["note"]
+
+
+def test_every_committed_bench_artifact_is_schema_versioned():
+    """Every committed ``*_BENCH.json`` carries the FULL shared artifact
+    envelope (telemetry.artifacts): ``schema_version``, the generating
+    tool, the accelerator ``platform``, and the ``pa_env`` snapshot —
+    everything the writer unconditionally stamps. An artifact written
+    around the shared writer (or hand-stamped with only the two
+    eyeball-able keys) fails here, keeping the schema claim in
+    docs/observability.md enforceable."""
+    from partitionedarrays_jl_tpu.telemetry import ARTIFACT_SCHEMA_VERSION
+
+    paths = sorted(
+        f for f in os.listdir(REPO) if f.endswith("_BENCH.json")
+    )
+    assert paths, "no committed *_BENCH.json artifacts found"
+    for name in paths:
+        rec = json.load(open(os.path.join(REPO, name)))
+        assert rec.get("schema_version") == ARTIFACT_SCHEMA_VERSION, (
+            f"{name} missing/mismatched schema_version "
+            f"(want {ARTIFACT_SCHEMA_VERSION}, "
+            f"got {rec.get('schema_version')!r})"
+        )
+        assert rec.get("generated_by"), (
+            f"{name} must name its generating tool"
+        )
+        assert rec.get("platform"), (
+            f"{name} must record the platform it was measured on"
+        )
+        assert isinstance(rec.get("pa_env"), dict), (
+            f"{name} must carry the PA_* environment snapshot "
+            "(the writer stamps it unconditionally — empty is fine)"
+        )

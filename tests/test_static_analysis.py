@@ -246,6 +246,7 @@ EXPECTED_LOWERING_FLAGS = {
     "PA_TPU_OH_BUCKETS",
     "PA_TPU_SD",
     "PA_TPU_STRICT_BITS",
+    "PA_TRACE_ITERS",
 }
 
 
@@ -284,6 +285,7 @@ def test_key_coverage_resolves_through_helpers():
     assert cov["PA_TPU_GMG_BOX"] == "_gmg_env_key"
     assert cov["PA_HEALTH_AUDIT_EVERY"] == "_sdc_config"
     assert cov["PA_FAULT_DEVICE"] == "_sdc_config"
+    assert cov["PA_TRACE_ITERS"] == "_trace_config"
     assert EXPECTED_LOWERING_FLAGS <= set(cov)
 
 
@@ -501,7 +503,7 @@ def test_lowering_matrix_enumerator_well_formed():
     assert any(c["tags"].get("staged") == "f32" for c in fast)
 
 
-def _run_matrix(fast):
+def _run_matrix(fast, with_runtime=False):
     import jax
 
     from partitionedarrays_jl_tpu.analysis import run_matrix
@@ -509,7 +511,7 @@ def _run_matrix(fast):
 
     backend = TPUBackend(devices=jax.devices()[:8])
     violations, reports = run_matrix(
-        backend, fast=fast, with_compiled=True
+        backend, fast=fast, with_compiled=True, with_runtime=with_runtime
     )
     assert not violations, "\n".join(str(v) for v in violations)
     # the matrix really lowered: baseline cases present with inventories
@@ -533,8 +535,12 @@ def test_fast_matrix_contracts_hold():
 @pytest.mark.slow
 def test_full_matrix_contracts_hold():
     """The full matrix `tools/palint.py --check` gates on (adds both
-    block bodies, the nobox/ABFT fused pairs, strict-bits, fused f32)."""
-    reports = _run_matrix(fast=False)
+    block bodies, the nobox/ABFT fused pairs, strict-bits, fused f32).
+    ``with_runtime`` probe-solves every case so the
+    static-measured-reconciliation contract (the patrace tentpole's
+    acceptance criterion) is checked across ALL 15 cases — the fast
+    probe legs live in tests/test_telemetry.py."""
+    reports = _run_matrix(fast=False, with_runtime=True)
     assert "strict_standard" in reports
 
 

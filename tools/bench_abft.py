@@ -239,17 +239,13 @@ def main():
             "vary with host load"
         ),
     }
-    out = json.dumps(rec, indent=1, sort_keys=True)
-    if dry:
-        print(out)
-        return
+    from partitionedarrays_jl_tpu.telemetry import artifacts
+
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "ABFT_BENCH.json",
     )
-    with open(path, "w") as f:
-        f.write(out + "\n")
-    print(f"[bench_abft] wrote {path}")
+    artifacts.write(path, rec, tool="bench_abft", dry_run=dry)
 
 
 if __name__ == "__main__":

@@ -80,13 +80,21 @@ def main():
             per_it.append((t1 - t0) / (K1 - K0))
         return float(np.median(per_it))
 
+    rec = {"n": n, "dofs": n ** 3, "dtype": "float32",
+           "flops_per_spmv": int(flops), "bodies": {}}
+
     dt = measure()
+    rec["bodies"]["standard"] = {"s_per_it": round(dt, 9)}
     print(
         f"cg_per_iteration_us={dt * 1e6:.1f} "
         f"spmv_equiv_gflops={flops / dt / 1e9:.1f} "
         f"(n={n}^3, f32, one chip; includes 2 dots + 3 axpys + halo no-op)"
     )
     dtf = measure(fused=True)
+    rec["bodies"]["fused"] = {
+        "s_per_it": round(dtf, 9),
+        "speedup_vs_standard": round(dt / dtf, 4),
+    }
     print(
         f"fused_cg_per_iteration_us={dtf * 1e6:.1f} "
         f"spmv_equiv_gflops={flops / dtf / 1e9:.1f} "
@@ -94,6 +102,10 @@ def main():
         "(packed-carry fused body, PA_TPU_FUSED_CG default)"
     )
     dtp = measure(pipelined=True)
+    rec["bodies"]["pipelined"] = {
+        "s_per_it": round(dtp, 9),
+        "speedup_vs_standard": round(dt / dtp, 4),
+    }
     print(
         f"pipelined_cg_per_iteration_us={dtp * 1e6:.1f} "
         f"spmv_equiv_gflops={flops / dtp / 1e9:.1f} "
@@ -140,17 +152,32 @@ def main():
             return float(statistics.median(per_it))
 
         base = None
+        rec["block"] = {}
         for K in ks:
             t_it = measure_block(K)
             per_rhs = t_it / K
             if K == 1:
                 base = per_rhs
             speed = f" per_rhs_speedup_vs_k1={base / per_rhs:.3f}x" if base else ""
+            rec["block"][f"K{K}"] = {
+                "s_per_it": round(t_it, 9),
+                "s_per_rhs_it": round(per_rhs, 9),
+            }
             print(
                 f"block_cg_K{K}_per_iteration_us={t_it * 1e6:.1f} "
                 f"per_rhs_us={per_rhs * 1e6:.1f}{speed} "
                 f"(rhs block, operator streamed once per {K} columns)"
             )
+
+    # optional artifact: the probe numbers above as one schema-versioned
+    # record through the shared writer (--out PATH or PA_BENCH_CG_OUT)
+    out_path = os.environ.get("PA_BENCH_CG_OUT", "")
+    if "--out" in argv and argv.index("--out") + 1 < len(argv):
+        out_path = argv[argv.index("--out") + 1]
+    if out_path:
+        from partitionedarrays_jl_tpu.telemetry import artifacts
+
+        artifacts.write(out_path, rec, tool="bench_cg")
 
 
 if __name__ == "__main__":

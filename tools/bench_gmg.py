@@ -179,14 +179,19 @@ def main():
         "GMG_BENCH.json",
     )
     # merge per mode so the periodic and dirichlet records coexist
+    from partitionedarrays_jl_tpu.telemetry import artifacts
+
     try:
         with open(out_path) as f:
             all_rec = json.load(f)
     except Exception:
         all_rec = {}
     all_rec[rec["mode"]] = rec
-    with open(out_path, "w") as f:
-        json.dump(all_rec, f, indent=1, sort_keys=True)
+    # the envelope may predate this run (merged artifact): refresh the
+    # fields that describe THIS write, keep the per-mode records
+    all_rec.pop("platform", None)
+    all_rec.pop("pa_env", None)
+    artifacts.write(out_path, all_rec, tool="bench_gmg", echo=False)
     print(json.dumps(rec))
 
 

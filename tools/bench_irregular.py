@@ -290,6 +290,8 @@ def main():
             "IRREGULAR_BENCH.json",
         ),
     )
+    from partitionedarrays_jl_tpu.telemetry import artifacts
+
     backend = TPUBackend(devices=jax.devices()[:1])
     rows = []
     rec = {"methodology": METHODOLOGY, "sizes": rows}
@@ -319,16 +321,15 @@ def main():
             }
             r["in_band"] = bool(lo <= r["sd_gflops"] <= hi)
         rows.append(r)
-        with open(out_path, "w") as f:
-            json.dump(rec, f, indent=1, sort_keys=True)
+        artifacts.write(out_path, rec, tool="bench_irregular", echo=False)
         jax.clear_caches()
     try:
         ab = oh_bucket_ab(min(sizes), backend, jax, pa)
         if ab is not None:
             rec["oh_bucket_ab"] = ab
             print(json.dumps({"oh_bucket_ab": ab}), flush=True)
-            with open(out_path, "w") as f:
-                json.dump(rec, f, indent=1, sort_keys=True)
+            artifacts.write(out_path, rec, tool="bench_irregular",
+                            echo=False)
     except Exception as e:  # the A/B must never mask the primary rows
         print(f"oh-bucket A/B failed: {type(e).__name__}: {e}", file=sys.stderr)
     head = rows[0]

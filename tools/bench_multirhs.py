@@ -231,18 +231,15 @@ def main():
         ok = ok and (in_band or kind != "device")
     rec["bands_ok_device"] = ok
 
-    out = json.dumps(rec, indent=1, sort_keys=True)
-    if dry:
-        print(out)
-        return
+    from partitionedarrays_jl_tpu.telemetry import artifacts
+
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "MULTIRHS_BENCH.json",
     )
-    with open(path, "w") as f:
-        f.write(out + "\n")
-    print(f"wrote {path}")
-    print(out)
+    rec = artifacts.write(path, rec, tool="bench_multirhs", dry_run=dry)
+    if not dry:
+        print(json.dumps(rec, indent=1, sort_keys=True))
 
 
 if __name__ == "__main__":

@@ -336,9 +336,10 @@ def main():
         return True
 
     def _flush():
+        from partitionedarrays_jl_tpu.telemetry import artifacts
+
         annotate_bands(rec)
-        with open(out_path, "w") as f:
-            json.dump(rec, f, indent=1, sort_keys=True)
+        artifacts.write(out_path, rec, tool="bench_scale", echo=False)
 
     pa.prun(driver, backend, (1, 1, 1))
     _flush()
@@ -387,8 +388,9 @@ def curve():
     }
 
     def _flush():
-        with open(out_path, "w") as f:
-            json.dump(rec, f, indent=1, sort_keys=True)
+        from partitionedarrays_jl_tpu.telemetry import artifacts
+
+        artifacts.write(out_path, rec, tool="bench_scale", echo=False)
 
     for n in sizes:
         dofs = n**3

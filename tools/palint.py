@@ -66,6 +66,9 @@ def main(argv=None):
                     help="tier-1 subset of the lowering matrix")
     ap.add_argument("--no-compile", action="store_true",
                     help="skip the compiled-HLO copy-budget cases")
+    ap.add_argument("--no-runtime", action="store_true",
+                    help="skip the probe solves behind the "
+                         "static-measured comms reconciliation contract")
     ap.add_argument("--skip-matrix", action="store_true",
                     help="env lint only")
     ap.add_argument("--skip-lint", action="store_true",
@@ -109,6 +112,7 @@ def main(argv=None):
         cases, reports = build_reports(
             fast=args.fast,
             with_compiled=not args.no_compile,
+            with_runtime=not args.no_runtime,
             verbose=log,
         )
         if args.report or args.verbose:
@@ -118,6 +122,8 @@ def main(argv=None):
         print(
             f"contracts: {len(cases)} cases lowered"
             + ("" if args.no_compile else " (+ compiled copy-budget legs)")
+            + ("" if args.no_runtime
+               else " (+ runtime comms-reconciliation probes)")
             + (
                 ", all contracts hold"
                 if not violations

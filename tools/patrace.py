@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""patrace — inspect runtime solver telemetry (SolveRecords).
+
+Reads the schema-versioned record JSONs the telemetry layer persists
+(set ``PA_METRICS_DIR=<dir>`` before the run; every finished or aborted
+solve writes one record there) and answers the questions an operator
+asks after the fact:
+
+* ``--last``       summarize the newest record: solver, config, status,
+                   iterations, residual head/tail, the event log (fault
+                   injections, health errors, SDC detections/rollbacks,
+                   checkpoint saves/restores, restarts), and the
+                   static-vs-measured comms accounting.
+* ``--list``       one line per persisted record, oldest first.
+* ``--trace OUT``  export the newest ``--n`` records (default 8) as one
+                   Chrome-trace/Perfetto JSON — load at
+                   https://ui.perfetto.dev or chrome://tracing.
+* ``--diff-static`` run the static-vs-measured comms reconciliation
+                   over the lowering matrix (probe solves on the CPU
+                   mesh — the same check `tools/palint.py --check`
+                   gates on) and print the per-case verdict. ``--full``
+                   widens the fast subset to all 15 cases.
+
+Usage:
+    PA_METRICS_DIR=/tmp/rec python your_solve.py
+    python tools/patrace.py --last --dir /tmp/rec
+    python tools/patrace.py --trace trace.json --dir /tmp/rec
+    python tools/patrace.py --diff-static
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _records_dir(args):
+    d = args.dir or os.environ.get("PA_METRICS_DIR")
+    if not d:
+        print(
+            "patrace: no record directory — pass --dir or set "
+            "PA_METRICS_DIR (records persist only when it was set for "
+            "the run)",
+            file=sys.stderr,
+        )
+        return None
+    return d
+
+
+def _load_all(d):
+    from partitionedarrays_jl_tpu.telemetry import (
+        RECORD_SCHEMA_VERSION,
+        list_persisted_records,
+        load_record,
+    )
+
+    out = []
+    for path in list_persisted_records(d):
+        try:
+            rec = load_record(path)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"patrace: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+            continue
+        if rec.get("schema_version", 0) > RECORD_SCHEMA_VERSION:
+            print(
+                f"patrace: {os.path.basename(path)} has newer "
+                f"schema_version {rec.get('schema_version')} (this tool "
+                f"speaks {RECORD_SCHEMA_VERSION}) — fields may be "
+                "missing from the summary",
+                file=sys.stderr,
+            )
+        out.append((path, rec))
+    return out
+
+
+def _fmt_events(rec):
+    lines = []
+    for ev in rec.get("events") or []:
+        it = ev.get("iteration")
+        at = f" it={it}" if it is not None else ""
+        label = ev.get("label") or ""
+        details = ev.get("details") or {}
+        extra = ", ".join(
+            f"{k}={v}" for k, v in sorted(details.items())
+            if k not in ("message",)
+        )
+        lines.append(
+            f"    [{ev.get('t', 0.0):9.4f}s] {ev.get('kind')}"
+            f"{':' + label if label else ''}{at}"
+            + (f"  ({extra})" if extra else "")
+        )
+    return lines
+
+
+def _summarize(path, rec):
+    print(f"record: {os.path.basename(path)}")
+    print(
+        f"  solver={rec.get('solver')} status={rec.get('status')} "
+        f"converged={rec.get('converged')} iterations={rec.get('iterations')} "
+        f"wall={rec.get('wall_s') if rec.get('wall_s') is None else round(rec['wall_s'], 4)}s"
+    )
+    cfg = rec.get("config") or {}
+    shown = {k: v for k, v in cfg.items() if k != "pa_env"}
+    print(f"  config: {json.dumps(shown, sort_keys=True, default=str)}")
+    res = rec.get("residuals") or []
+    if res:
+        head = ", ".join(f"{v:.3e}" for v in res[:3])
+        tail = ", ".join(f"{v:.3e}" for v in res[-2:])
+        print(f"  residuals[{len(res)}]: {head} ... {tail}")
+    alpha = rec.get("alpha")
+    if alpha:
+        if isinstance(alpha[0], list):  # block solve: per-column lists
+            shape = f"{len(alpha)} columns x {len(alpha[0])} entries"
+            n = len(alpha[0])
+        else:
+            shape = f"{len(alpha)} entries"
+            n = len(alpha)
+        start = rec.get("trace_start") or 0
+        window = f", iterations {start}..{start + n - 1}" if start else ""
+        print(f"  alpha/beta trace: {shape} (PA_TRACE_ITERS ring{window})")
+    err = rec.get("error")
+    if err:
+        print(f"  error: {err.get('type')}: {err.get('message')}")
+    comms = rec.get("comms")
+    if comms:
+        print(f"  comms (iterations={comms.get('iterations')}):")
+        for kind, v in sorted((comms.get("observed") or {}).items()):
+            if v.get("ops"):
+                per = (comms.get("per_iteration") or {}).get(kind, {})
+                print(
+                    f"    {kind}: {v['ops']} ops, {v['bytes']} B "
+                    f"({per.get('ops', 0)} ops/it, "
+                    f"{per.get('bytes', 0)} B/it per device)"
+                )
+    events = rec.get("events") or []
+    print(f"  events [{len(events)}]:")
+    for line in _fmt_events(rec):
+        print(line)
+
+
+def _diff_static(full: bool) -> int:
+    # CPU mesh setup — same pattern as tools/palint.py: the dev image
+    # may pre-import jax on another platform, so update the config too
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_ENABLE_X64"] = "true"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from partitionedarrays_jl_tpu.analysis import build_reports
+    from partitionedarrays_jl_tpu.telemetry import reconcile
+
+    cases, reports = build_reports(fast=not full, with_runtime=True)
+    failed = False
+    for name, case in sorted(cases.items()):
+        comms = case.get("runtime_comms")
+        rep = reports.get(name)
+        if comms is None or rep is None:
+            continue
+        mismatches = reconcile(rep, comms)
+        verdict = "OK" if not mismatches else "MISMATCH"
+        print(
+            f"  {name:26s} it={comms.get('iterations', '?'):>3} "
+            f"static-vs-measured: {verdict}"
+        )
+        for m in mismatches:
+            print(f"      {m}")
+            failed = True
+    print("patrace --diff-static:", "FAILED" if failed else "OK")
+    return 1 if failed else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", help="record directory (default: PA_METRICS_DIR)")
+    ap.add_argument("--last", action="store_true",
+                    help="summarize the newest record")
+    ap.add_argument("--list", action="store_true", dest="list_",
+                    help="list persisted records")
+    ap.add_argument("--json", action="store_true",
+                    help="with --last: dump the raw record JSON")
+    ap.add_argument("--trace", metavar="OUT",
+                    help="write newest --n records as Chrome-trace JSON")
+    ap.add_argument("--n", type=int, default=8,
+                    help="record count for --trace (default 8)")
+    ap.add_argument("--diff-static", action="store_true",
+                    help="probe-solve the lowering matrix and reconcile "
+                         "measured comms against the lowered programs")
+    ap.add_argument("--full", action="store_true",
+                    help="with --diff-static: all 15 matrix cases")
+    args = ap.parse_args(argv)
+
+    if args.diff_static:
+        return _diff_static(args.full)
+
+    if not (args.last or args.list_ or args.trace):
+        ap.print_help()
+        return 2
+
+    d = _records_dir(args)
+    if d is None:
+        return 2
+    recs = _load_all(d)
+    if not recs:
+        print(f"patrace: no records under {d}", file=sys.stderr)
+        return 1
+
+    if args.list_:
+        for path, rec in recs:
+            print(
+                f"{os.path.basename(path)}  {rec.get('solver'):>20s}  "
+                f"status={rec.get('status')}  it={rec.get('iterations')}  "
+                f"events={len(rec.get('events') or [])}"
+            )
+    if args.last:
+        path, rec = recs[-1]
+        if args.json:
+            print(json.dumps(rec, indent=1, sort_keys=True))
+        else:
+            _summarize(path, rec)
+    if args.trace:
+        from partitionedarrays_jl_tpu.telemetry import write_chrome_trace
+
+        newest = [rec for _, rec in recs[-max(1, args.n):]]
+        write_chrome_trace(args.trace, records=newest)
+        print(f"wrote {args.trace} ({len(newest)} records)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
