@@ -15,6 +15,8 @@ Import convention::
 """
 
 from . import telemetry  # noqa: F401
+from . import service  # noqa: F401
+from .service import AdmissionRejected, SolveService  # noqa: F401
 from .models import *  # noqa: F401,F403
 from .models import __all__ as _models_all
 from .ops import *  # noqa: F401,F403
@@ -28,5 +30,6 @@ __version__ = "0.1.0"
 
 __all__ = (
     list(_parallel_all) + list(_utils_all) + list(_ops_all)
-    + list(_models_all) + ["telemetry"]
+    + list(_models_all)
+    + ["telemetry", "service", "SolveService", "AdmissionRejected"]
 )

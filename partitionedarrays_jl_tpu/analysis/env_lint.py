@@ -147,6 +147,28 @@ NON_LOWERING: Dict[str, str] = {
     "PA_RETRY_BACKOFF": (
         "host I/O / init retry policy — never part of a staged program"
     ),
+    "PA_RETRY_JITTER": (
+        "host retry-delay jitter seed (decorrelated backoff) — shapes "
+        "WHEN a retry happens, never what a program stages"
+    ),
+    "PA_SERVE_QUEUE_DEPTH": (
+        "solve-service admission bound — host-side queueing policy; "
+        "compiled programs are keyed by (tol, maxiter, K) regardless"
+    ),
+    "PA_SERVE_KMAX": (
+        "solve-service slab-width bound — selects WHICH cached block "
+        "program (rhs_batch=K) runs, each keyed by its own K through "
+        "_krylov_fn_for; never alters a staged program"
+    ),
+    "PA_SERVE_CHUNK": (
+        "solve-service chunk length for deadline enforcement — the "
+        "chunk is passed as the block solve's maxiter argument (an "
+        "explicit program parameter, keyed), not a hidden staging input"
+    ),
+    "PA_SERVE_RETRIES": (
+        "solve-service solo-retry budget for ejected columns — "
+        "host-side recovery policy, outside compiled programs"
+    ),
     "PA_FAULT_SPEC": (
         "host wire chaos injection — corrupts exchange payloads at run "
         "time on the host path (parallel/faults.py); the compiled-loop "

@@ -42,21 +42,65 @@ def _final_true_rel(A, x, b, rel_est, rs0_norm, tol, force=False):
     return float(r.norm()) / max(1.0, rs0_norm)
 
 
-def _host_block_solve(solve_one, B, X0):
+def _host_block_solve(solve_one, B, X0, column_errors="raise"):
     """Host multi-RHS driver: each column runs the SOLO loop — by
     definition the per-column oracle semantics the device block program
     (`tpu.tpu_block_cg`) reproduces. Returns the same ``(xs, info)``
     contract: per-column infos under ``columns``, worst-column
-    aggregates at top level."""
+    aggregates at top level.
+
+    ``column_errors="report"`` is the oracle of the device verdict
+    export: a column whose solo loop raises a `SolverHealthError` is
+    CONTAINED — its slot gets a failed-column info (and the error under
+    ``column_health``) while every later column still runs. The default
+    ``"raise"`` propagates the first column failure unchanged (the
+    pre-service contract)."""
+    from ..parallel.health import SolverHealthError
+
     K = len(B)
     check(K >= 1, "block solve: B must hold at least one right-hand side")
     X0 = list(X0) if X0 is not None else [None] * K
     check(len(X0) == K, "block solve: X0 must hold one start per RHS")
-    xs, columns = [], []
-    for bk, x0k in zip(B, X0):
-        x, inf = solve_one(bk, x0k)
+    xs, columns, health = [], [], []
+    for k, (bk, x0k) in enumerate(zip(B, X0)):
+        try:
+            x, inf = solve_one(bk, x0k)
+        except SolverHealthError as e:
+            if column_errors != "report":
+                raise
+            from .. import telemetry
+
+            telemetry.emit_event(
+                "column_verdict", label="block-host", columns=[k],
+                error=type(e).__name__,
+            )
+            xs.append(x0k.copy() if x0k is not None else None)
+            columns.append(
+                {
+                    "iterations": 0,
+                    "residuals": [],
+                    "converged": False,
+                    "status": type(e).__name__,
+                }
+            )
+            health.append(
+                {
+                    "status": type(e).__name__,
+                    "converged": False,
+                    "iterations": 0,
+                    "error": e,
+                }
+            )
+            continue
         xs.append(x)
         columns.append(inf)
+        health.append(
+            {
+                "status": "ok",
+                "converged": bool(inf["converged"]),
+                "iterations": int(inf["iterations"]),
+            }
+        )
     # unconverged columns dominate the aggregate (see tpu_block_cg: the
     # top-level status must never read 'converged' when converged=False)
     bad_cols = [k for k in range(K) if not columns[k]["converged"]]
@@ -72,16 +116,22 @@ def _host_block_solve(solve_one, B, X0):
         "converged": not bad_cols,
         "status": columns[worst]["status"],
         "columns": columns,
+        "column_health": health,
         "rhs_batch": K,
         "cg_body": "host",
     }
     return xs, info
 
 
-def _check_block_args(name, b, x0, B, checkpoint, _resume_state):
+def _check_block_args(name, b, x0, B, checkpoint, _resume_state,
+                      column_errors="raise"):
     """Validate the multi-RHS call shape; returns B as a list (so an
     empty or generator B fails HERE with the friendly message, not at a
     downstream ``B[0]``)."""
+    check(
+        column_errors in ("raise", "report"),
+        f"{name}: column_errors is 'raise' or 'report'",
+    )
     check(
         b is None and x0 is None,
         f"{name}: pass b/x0 OR the multi-RHS block B/X0, not both",
@@ -250,6 +300,7 @@ def cg(
     _resume_state: Optional[dict] = None,
     B=None,
     X0=None,
+    column_errors: str = "raise",
 ) -> Tuple[PVector, dict]:
     """Conjugate gradients for SPD `A`. The start vector lives on
     ``A.cols`` — the PRange carrying the column ghost layer — mirroring the
@@ -267,7 +318,10 @@ def cg(
     solves (bitwise under strict-bits). On the host backend the columns
     simply run the solo loop in sequence — the semantics oracle. Returns
     ``(xs, info)`` with a list of K solutions and per-column infos under
-    ``info["columns"]``.
+    ``info["columns"]``. ``column_errors="report"`` (block solves only)
+    contains column-local failures instead of raising: per-column
+    verdicts land under ``info["column_health"]`` — the blast-radius
+    contract the solve service (`pa.service.SolveService`) builds on.
 
     Deterministic: all reductions are fixed-order part folds; the residual
     history is reproducible bit-for-bit for a given backend, and on the TPU
@@ -304,7 +358,9 @@ def cg(
     from ..parallel.tpu import TPUBackend, tpu_block_cg, tpu_cg
 
     if B is not None:
-        B = _check_block_args("cg", b, x0, B, checkpoint, _resume_state)
+        B = _check_block_args(
+            "cg", b, x0, B, checkpoint, _resume_state, column_errors
+        )
         if pipelined:
             raise ValueError(
                 "cg: the pipelined (lag-1) form is single-RHS only — "
@@ -313,13 +369,13 @@ def cg(
         if isinstance(B[0].values.backend, TPUBackend):
             return tpu_block_cg(
                 A, B, X0=X0, tol=tol, maxiter=maxiter, verbose=verbose,
-                fused=fused,
+                fused=fused, column_errors=column_errors,
             )
         return _host_block_solve(
             lambda bk, x0k: cg(
                 A, bk, x0=x0k, tol=tol, maxiter=maxiter, verbose=verbose
             ),
-            B, X0,
+            B, X0, column_errors=column_errors,
         )
     check(b is not None, "cg: a right-hand side b (or a block B) is required")
     if isinstance(b.values.backend, TPUBackend):
@@ -1359,6 +1415,7 @@ def pcg(
     _resume_state: Optional[dict] = None,
     B=None,
     X0=None,
+    column_errors: str = "raise",
 ) -> Tuple[PVector, dict]:
     """Preconditioned CG. ``minv`` is either an inverse-diagonal PVector
     over A.cols (defaults to `jacobi_preconditioner(A)`) or a *callable*
@@ -1393,14 +1450,16 @@ def pcg(
         minv = jacobi_preconditioner(A)
     apply_minv = callable(minv)
     if B is not None:
-        B = _check_block_args("pcg", b, x0, B, checkpoint, _resume_state)
+        B = _check_block_args(
+            "pcg", b, x0, B, checkpoint, _resume_state, column_errors
+        )
         if (
             isinstance(B[0].values.backend, TPUBackend)
             and not apply_minv
         ):
             return tpu_block_cg(
                 A, B, X0=X0, tol=tol, maxiter=maxiter, verbose=verbose,
-                minv=minv, fused=fused,
+                minv=minv, fused=fused, column_errors=column_errors,
             )
         # forward `fused` so the solo path's contracts hold per column —
         # in particular a GMG hierarchy with an explicit fused flag must
@@ -1411,7 +1470,7 @@ def pcg(
                 A, bk, x0=x0k, minv=minv, tol=tol, maxiter=maxiter,
                 verbose=verbose, fused=fused,
             ),
-            B, X0,
+            B, X0, column_errors=column_errors,
         )
     check(b is not None, "pcg: a right-hand side b (or a block B) is required")
     if isinstance(b.values.backend, TPUBackend):

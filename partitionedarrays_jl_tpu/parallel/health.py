@@ -40,6 +40,11 @@ Env knobs (all read dynamically so tests can toggle them):
   residual must improve below FACTOR x the previous best.
 * ``PA_RETRY_ATTEMPTS`` (default 3) / ``PA_RETRY_BACKOFF`` (default
   0.5, seconds, doubling, capped at 30) — `retry_with_backoff` defaults.
+  ``PA_RETRY_BACKOFF=0`` (or ``backoff=0``) is honored as a true
+  zero-sleep policy.
+* ``PA_RETRY_JITTER`` (default off) — nonzero integer seed enables
+  seeded decorrelated retry jitter (delay ~ U[backoff, 3·previous],
+  capped), so co-failing ranks/requests don't retry in lockstep.
 
 Silent-corruption (SDC) defense knobs — the layer that catches what the
 finiteness guards cannot (a FINITE bitflip sails straight through
@@ -78,6 +83,7 @@ __all__ = [
     "SolverBreakdownError",
     "SolverStagnationError",
     "ExchangeTimeoutError",
+    "SolveDeadlineError",
     "ControllerLostError",
     "SilentCorruptionError",
     "health_enabled",
@@ -147,6 +153,19 @@ class ExchangeTimeoutError(SolverHealthError):
     """A neighbor's contribution never arrived within the exchange
     deadline (real runs: a slow/failed host; chaos runs: a `drop`
     fault clause). ``diagnostics["missing_parts"]`` names the senders."""
+
+
+class SolveDeadlineError(SolverHealthError):
+    """A solve request's wall-clock deadline expired. Raised by the
+    solve service (`service.SolveService`) at a chunk boundary — the
+    compiled program cannot stop mid-loop, so deadlines are enforced
+    between ``PA_SERVE_CHUNK``-iteration chunks; ``diagnostics``
+    carries the request id, the deadline, and the iterations completed
+    when it expired. In the `SolverHealthError` family so recovery
+    drivers and the event log treat it like every other typed
+    failure — but `solve_with_recovery` restarts would be pointless
+    (the clock, not the solver, failed), so the service fails the
+    request instead of retrying it."""
 
 
 class ControllerLostError(SolverHealthError):
@@ -389,6 +408,19 @@ def _default_backoff() -> float:
     return float(os.environ.get("PA_RETRY_BACKOFF", "0.5"))
 
 
+def _default_jitter_seed() -> Optional[int]:
+    """``PA_RETRY_JITTER``: unset/empty/``0`` = no jitter (the classic
+    deterministic doubling); any other integer = decorrelated jitter
+    seeded by that value. Seeded, not wall-clock-random: tests and
+    reproducibility-minded operators get the same delay sequence per
+    (seed, failure count), while distinct seeds (one per rank/request)
+    decorrelate the retry storms."""
+    v = os.environ.get("PA_RETRY_JITTER", "")
+    if not v or v == "0":
+        return None
+    return int(v)
+
+
 def retry_with_backoff(
     fn: Callable,
     *,
@@ -398,20 +430,46 @@ def retry_with_backoff(
     exceptions: Tuple[Type[BaseException], ...] = (OSError,),
     describe: str = "operation",
     sleep: Callable[[float], None] = time.sleep,
+    jitter_seed: Optional[int] = None,
+    give_up: Optional[Callable[[], bool]] = None,
 ):
     """Call ``fn()`` up to ``attempts`` times, sleeping ``backoff`` then
     doubling (capped at ``max_backoff``) between tries; only the listed
     ``exceptions`` are treated as transient. The last failure re-raises
     unchanged. Each retry prints one stderr line (operators watching a
-    cluster come up need to see the wait, not a silent hang)."""
+    cluster come up need to see the wait, not a silent hang).
+
+    ``backoff=0`` is a true zero-sleep policy: every delay stays 0.0
+    (callers asking for no backoff — tests, in-process service retries
+    with their own pacing — must not inherit a hidden 0.1 s floor).
+
+    ``jitter_seed`` (default: resolved from ``PA_RETRY_JITTER``)
+    switches the schedule to seeded DECORRELATED jitter — each delay
+    drawn uniformly from [backoff, 3·previous] (capped) — so co-failing
+    ranks/requests sharing a flaky dependency spread their retries
+    instead of hammering it in lockstep.
+
+    ``give_up`` — optional predicate checked after each failure: when
+    it returns True the remaining attempts are abandoned and the
+    failure re-raises immediately (the solve service passes its
+    deadline test here, so a deterministically-failing request cannot
+    keep retrying past its deadline)."""
     attempts = attempts if attempts is not None else _default_attempts()
     backoff = backoff if backoff is not None else _default_backoff()
-    delay = max(0.0, float(backoff))
+    if jitter_seed is None:
+        jitter_seed = _default_jitter_seed()
+    rng = (
+        np.random.default_rng(jitter_seed)
+        if jitter_seed is not None
+        else None
+    )
+    base = max(0.0, float(backoff))
+    delay = base
     for attempt in range(1, attempts + 1):
         try:
             return fn()
         except exceptions as e:
-            if attempt >= attempts:
+            if attempt >= attempts or (give_up is not None and give_up()):
                 raise
             print(
                 f"[partitionedarrays_jl_tpu] {describe} failed "
@@ -421,4 +479,9 @@ def retry_with_backoff(
                 flush=True,
             )
             sleep(delay)
-            delay = min(max_backoff, delay * 2 if delay > 0 else 0.1)
+            if rng is not None:
+                delay = min(
+                    max_backoff, float(rng.uniform(base, max(base, delay * 3)))
+                )
+            else:
+                delay = min(max_backoff, delay * 2)

@@ -5176,6 +5176,7 @@ def tpu_block_cg(
     verbose: bool = False,
     minv: Optional[PVector] = None,
     fused: Optional[bool] = None,
+    column_errors: str = "raise",
 ) -> Tuple[list, dict]:
     """Device block (multi-RHS) CG: solve ``A x_k = b_k`` for every
     right-hand side in ``B`` (a sequence of PVectors over ``A.rows``) as
@@ -5185,9 +5186,24 @@ def tpu_block_cg(
     PVectors and an info dict whose ``columns`` entry holds one
     per-column krylov info each (iterations, residual history, status —
     each column's trajectory is its solo `tpu_cg` trajectory); the
-    top-level fields aggregate (worst column)."""
+    top-level fields aggregate (worst column).
+
+    ``column_errors`` selects the per-column health contract:
+    ``"raise"`` (default) raises `NonFiniteError` naming the poisoned
+    columns — the single-caller semantics every pre-service test pins;
+    ``"report"`` never raises for a column-local failure and instead
+    exports per-column VERDICTS under ``info["column_health"]`` (one
+    ``{"status", "converged", "iterations"}`` dict per column, status
+    ``"ok"`` or ``"nonfinite"``) — the containment contract the solve
+    service reads at its chunk boundaries to eject exactly the poisoned
+    columns while the frozen-select block program has already let every
+    other column finish bitwise equal to its solo solve."""
     from .. import telemetry
 
+    check(
+        column_errors in ("raise", "report"),
+        "tpu_block_cg: column_errors is 'raise' or 'report'",
+    )
     B = list(B)
     K = len(B)
     check(K >= 1, "tpu_block_cg: B must hold at least one right-hand side")
@@ -5207,14 +5223,14 @@ def tpu_block_cg(
     ) as rec:
         xs, info = _tpu_block_cg_impl(
             A, B, X0, tol, maxiter, verbose, minv, fused, K, backend,
-            dt, name, rec,
+            dt, name, rec, column_errors=column_errors,
         )
         return xs, rec.finish(info)
 
 
 def _tpu_block_cg_impl(
     A, B, X0, tol, maxiter, verbose, minv, fused, K, backend, dt, name,
-    rec,
+    rec, column_errors="raise",
 ):
     from .. import telemetry
     from ..utils.helpers import krylov_info, warn_tol_below_floor
@@ -5329,20 +5345,48 @@ def _tpu_block_cg_impl(
         )
     from .health import NonFiniteError, health_enabled
 
-    bad = [k for k in range(K) if not np.isfinite(rs[k])]
-    if health_enabled() and bad:
-        raise NonFiniteError(
-            f"{name}: non-finite residual in column(s) {bad} — those "
-            "columns' solver state was NaN/Inf-poisoned (each froze one "
-            "iteration after the poison entered; the other columns "
-            "completed normally)",
-            diagnostics={
-                "context": name,
-                "columns": bad,
-                "iterations": [int(itk[k]) for k in bad],
-                "rs": [float(rs[k]) for k in bad],
-            },
-        )
+    # per-column verdict export: the service's chunk-boundary contract
+    # (status is per column, so ONE poisoned request never forces its
+    # co-batched neighbors onto an error path). PA_HEALTH_CHECKS=0
+    # disables the verdict along with the guards — matching the host
+    # oracle, where no SolverHealthError fires (and so no verdict is
+    # recorded) with health off — so the two per-column exports never
+    # disagree.
+    bad = (
+        [k for k in range(K) if not np.isfinite(rs[k])]
+        if health_enabled()
+        else []
+    )
+    column_health = [
+        {
+            "status": "nonfinite" if k in bad else "ok",
+            "converged": bool(columns[k]["converged"]),
+            "iterations": int(itk[k]),
+        }
+        for k in range(K)
+    ]
+    if bad:
+        if column_errors == "report":
+            for k in bad:
+                columns[k]["status"] = "nonfinite"
+                columns[k]["converged"] = False
+            telemetry.emit_event(
+                "column_verdict", label=name, columns=bad,
+                iterations=[int(itk[k]) for k in bad],
+            )
+        else:
+            raise NonFiniteError(
+                f"{name}: non-finite residual in column(s) {bad} — those "
+                "columns' solver state was NaN/Inf-poisoned (each froze one "
+                "iteration after the poison entered; the other columns "
+                "completed normally)",
+                diagnostics={
+                    "context": name,
+                    "columns": bad,
+                    "iterations": [int(itk[k]) for k in bad],
+                    "rs": [float(rs[k]) for k in bad],
+                },
+            )
     # the aggregate's "worst" column: an UNCONVERGED column wins over a
     # merely-slow converged one (a broken-down column frozen at 3
     # iterations must not let argmax(iterations) stamp the aggregate
@@ -5360,6 +5404,7 @@ def _tpu_block_cg_impl(
         "converged": not bad_cols,
         "status": columns[worst]["status"],
         "columns": columns,
+        "column_health": column_health,
         "rhs_batch": K,
         "cg_body": "fused" if fused else "standard",
     }

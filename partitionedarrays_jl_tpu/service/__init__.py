@@ -1,0 +1,61 @@
+"""pasolve — the fault-isolating multi-tenant solve service.
+
+The production front door over the block-CG path (ROADMAP item 1): a
+long-lived in-process service (`SolveService`) that accepts many
+concurrent solve requests against ONE operator (same ``A``, different
+``b``, per-request tol/maxiter/deadline), queues them under bounded
+admission control (`AdmissionRejected` backpressure instead of
+unbounded buffering), coalesces compatible requests into (P, W, K)
+slabs for the compiled block program (``make_cg_fn(rhs_batch=K)`` —
+PR 3 made the per-iteration collectives K-independent, so batching K
+requests is nearly free on the wire), and re-batches ragged leftovers
+at chunk boundaries.
+
+The robustness core is per-request isolation inside a shared slab: a
+coalesced slab shares one compiled program, and without containment a
+single NaN-poisoned ``b`` would abort all K requests. The service
+instead reads the per-column verdicts the block solve exports
+(``column_errors="report"`` — the freeze-on-convergence selects already
+keep a poisoned column's bits from contaminating its neighbors), ejects
+exactly the failed columns at the next chunk boundary (failed, or
+retried solo via `retry_with_backoff` / `solve_with_recovery`), and
+lets every co-batched request finish BITWISE equal to its solo solve
+(strict-bits; pinned in tests/test_service.py).
+
+Modules:
+
+* `service.request`  — `SolveRequest`: the queued unit, its lifecycle
+  states, and the future-style result/error surface.
+* `service.admission` — bounded-queue admission control, the typed
+  `AdmissionRejected`, and the ``PA_SERVE_*`` knob readers.
+* `service.batcher`  — slab coalescing: FIFO grouping by compatibility
+  key (tol, maxiter, dtype) up to ``PA_SERVE_KMAX`` columns.
+* `service.service`  — `SolveService` itself: submit/drain/shutdown,
+  chunked deadlines (`SolveDeadlineError`), ejection + solo retry,
+  checkpointing drain, telemetry events.
+"""
+from .admission import (  # noqa: F401
+    AdmissionController,
+    AdmissionRejected,
+    chunk_iters,
+    default_retries,
+    queue_depth,
+    slab_kmax,
+)
+from .batcher import compat_key, next_slab, top_up  # noqa: F401
+from .request import SolveRequest  # noqa: F401
+from .service import SolveService  # noqa: F401
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "SolveRequest",
+    "SolveService",
+    "compat_key",
+    "next_slab",
+    "top_up",
+    "queue_depth",
+    "slab_kmax",
+    "chunk_iters",
+    "default_retries",
+]

@@ -1,0 +1,69 @@
+"""Slab coalescing: which queued requests may share one compiled block
+program.
+
+The block program bakes ``tol`` and ``maxiter`` into the compiled body
+(`make_cg_fn(rhs_batch=K)` closes over both), and a (P, W, K) slab has
+one dtype — so the COMPATIBILITY KEY is exactly ``(tol, maxiter,
+dtype)``: requests agreeing on all three may ride one slab; anything
+else must wait for its own. Coalescing is FIFO-anchored: the oldest
+queued request fixes the key, then up to ``kmax`` FIFO-ordered
+compatible requests join it (incompatible ones keep their queue
+position for a later slab — no starvation: every slab removes the
+current queue head). A slab narrower than ``kmax`` is a RAGGED
+leftover and runs anyway — `_krylov_fn_for` caches the compiled
+program per K, and the service tops ragged slabs back up with newly
+admitted compatible requests at chunk boundaries.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+__all__ = ["compat_key", "next_slab", "top_up"]
+
+
+def compat_key(req) -> Tuple[float, object, str]:
+    """The slab-compatibility key of a request: requests coalesce iff
+    their keys are equal (see module docstring for why exactly these
+    three)."""
+    return (
+        float(req.tol),
+        None if req.maxiter is None else int(req.maxiter),
+        str(np.dtype(req.b.dtype)),
+    )
+
+
+def next_slab(queue: List, kmax: int) -> List:
+    """Pop the next slab off ``queue`` (mutated in place): the FIFO
+    head plus up to ``kmax - 1`` later compatible requests, queue order
+    preserved. Empty queue -> empty slab."""
+    if not queue:
+        return []
+    key = compat_key(queue[0])
+    picked, kept = [], []
+    for req in queue:
+        if len(picked) < int(kmax) and compat_key(req) == key:
+            picked.append(req)
+        else:
+            kept.append(req)
+    queue[:] = kept
+    return picked
+
+
+def top_up(queue: List, slab: List, kmax: int) -> List:
+    """Re-batching at a chunk boundary: move queued requests compatible
+    with the (non-empty) running ``slab`` into it, up to ``kmax`` total
+    columns. Returns the requests added (already removed from
+    ``queue``)."""
+    if not slab or len(slab) >= int(kmax) or not queue:
+        return []
+    key = compat_key(slab[0])
+    added, kept = [], []
+    for req in queue:
+        if len(slab) + len(added) < int(kmax) and compat_key(req) == key:
+            added.append(req)
+        else:
+            kept.append(req)
+    queue[:] = kept
+    return added

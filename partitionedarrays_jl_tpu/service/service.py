@@ -1,0 +1,607 @@
+"""`SolveService` — the long-lived multi-tenant request layer itself.
+
+One service instance serves ONE operator (the multi-tenant axis is
+requests, not matrices: the compiled block program, the device-resident
+operator, and the `_lowering_env_key`-keyed caches are all per-``A``).
+Lifecycle of a request:
+
+1. **submit** — admission control (`service.admission`): bounded queue
+   + draining check, typed `AdmissionRejected` backpressure. Admitted
+   requests get a `SolveRecord` and a ``request_queued`` event.
+2. **coalesce** — `service.batcher.next_slab` groups FIFO-compatible
+   requests (same tol/maxiter/dtype) into one (P, W, K) slab, K ≤
+   ``PA_SERVE_KMAX``; ragged leftovers run as-is and are topped back up
+   with compatible late arrivals at chunk boundaries.
+3. **solve** — one ``cg``/``pcg`` block call with
+   ``column_errors="report"``: on the TPU backend that is ONE compiled
+   program from the `_lowering_env_key`-keyed program cache (palint
+   guarantees key soundness), with the per-iteration collective count
+   K-independent; the host backend runs the solo-loop oracle. The
+   service adds ZERO per-iteration work — containment rides the block
+   body's existing per-column freeze selects (HLO-pinned in
+   tests/test_service.py).
+4. **verdict** — at each chunk boundary the per-column verdicts are
+   read: converged columns resolve, poisoned columns are EJECTED
+   (failed, or retried solo via `retry_with_backoff`; with a service
+   ``checkpoint_dir`` the solo path is `solve_with_recovery`, the
+   checkpoint-tier fault boundary), expired deadlines fail typed
+   (`SolveDeadlineError`), everyone else continues into the next chunk.
+   Slabs with no deadline run UNCHUNKED — a single compiled solve, so
+   co-batched survivors finish bitwise equal to their solo solves
+   (strict-bits).
+5. **drain/shutdown** — `shutdown(drain=True)` refuses new admissions
+   and finishes the queue; ``drain=False`` additionally stops at the
+   next chunk boundary, checkpointing in-flight iterates (resumable by
+   resubmitting from the loaded iterate) and suspending never-started
+   requests.
+
+Drive the service synchronously (``step()`` / ``drain()`` — what tests
+and batch jobs want) or start the background worker thread
+(``start()``) for a live server; `tools/paserve.py` is the CLI harness.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ..utils.helpers import check
+from .admission import (
+    AdmissionController,
+    chunk_iters,
+    default_retries,
+    slab_kmax,
+)
+from .batcher import compat_key, next_slab, top_up
+from .request import SolveRequest
+
+__all__ = ["SolveService"]
+
+
+class SolveService:
+    """A long-lived in-process solve service over one operator ``A``
+    (see module docstring for the request lifecycle).
+
+    Parameters: ``minv`` — optional shared preconditioner (diagonal
+    PVector or callable; slabs then run ``pcg``); ``kmax`` /
+    ``queue_depth`` / ``chunk`` / ``retries`` — per-instance overrides
+    of the ``PA_SERVE_*`` env defaults; ``retry_backoff`` — the solo
+    retry backoff seconds (default 0.0: in-process retries pace
+    themselves, honor the true-zero policy); ``checkpoint_dir`` — when
+    set, solo retries run under `solve_with_recovery` rooted there and
+    a non-drain shutdown checkpoints in-flight iterates there;
+    ``clock`` / ``sleep`` — injectable time sources (tests use fake
+    ones; deadlines are measured in ``clock`` units from submission).
+    """
+
+    def __init__(
+        self,
+        A,
+        minv=None,
+        kmax: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+        chunk: Optional[int] = None,
+        retries: Optional[int] = None,
+        retry_backoff: float = 0.0,
+        checkpoint_dir: Optional[str] = None,
+        clock: Optional[Callable[[], float]] = None,
+        sleep: Optional[Callable[[float], None]] = None,
+    ):
+        self.A = A
+        self.minv = minv
+        self.kmax = slab_kmax() if kmax is None else max(1, int(kmax))
+        self.chunk = chunk_iters() if chunk is None else max(1, int(chunk))
+        self.retries = (
+            default_retries() if retries is None else max(0, int(retries))
+        )
+        self.retry_backoff = max(0.0, float(retry_backoff))
+        self.checkpoint_dir = checkpoint_dir
+        self.clock = clock if clock is not None else time.monotonic
+        self._sleep = sleep if sleep is not None else time.sleep
+        self.admission = AdmissionController(queue_depth)
+        self._queue: list = []
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._draining = False
+        self._stop = False
+        self._worker: Optional[threading.Thread] = None
+        self._next_id = 0
+        self.stats = {
+            "admitted": 0,
+            "rejected": 0,
+            "slabs": 0,
+            "completed": 0,
+            "failed": 0,
+            "ejected": 0,
+            "retried_solo": 0,
+            "deadline_expired": 0,
+            "checkpointed": 0,
+            "suspended": 0,
+        }
+
+    # ------------------------------------------------------------------
+    # the front door
+    # ------------------------------------------------------------------
+
+    def submit(
+        self,
+        b,
+        x0=None,
+        tol: float = 1e-8,
+        maxiter: Optional[int] = None,
+        deadline: Optional[float] = None,
+        retries: Optional[int] = None,
+        tag: str = "",
+    ) -> SolveRequest:
+        """Admit one request (or raise `AdmissionRejected`); returns the
+        request, which doubles as the result handle. ``deadline`` is a
+        relative wall-clock budget in seconds (service clock units)."""
+        from .. import telemetry
+
+        check(tol > 0.0, "service: tol must be positive")
+        check(
+            maxiter is None or int(maxiter) >= 1,
+            "service: maxiter must be >= 1",
+        )
+        check(
+            deadline is None or float(deadline) > 0.0,
+            "service: deadline must be positive seconds",
+        )
+        with self._lock:
+            tag = tag or f"req-{self._next_id}"
+            try:
+                self.admission.admit(len(self._queue), self._draining, tag)
+            except Exception:
+                self.stats["rejected"] += 1
+                raise
+            req = SolveRequest(
+                self._next_id, b, x0=x0, tol=tol, maxiter=maxiter,
+                deadline=deadline,
+                retries=self.retries if retries is None else int(retries),
+                tag=tag,
+            )
+            self._next_id += 1
+            req.submitted_at = self.clock()
+            req.record = telemetry.begin_record(
+                "service-request", request=req.tag, tol=float(tol),
+                maxiter=maxiter, deadline=deadline,
+            )
+            self.stats["admitted"] += 1
+            telemetry.emit_event(
+                "request_queued", label=req.tag, tol=float(tol),
+                deadline=deadline, queued=len(self._queue) + 1,
+            )
+            self._queue.append(req)
+            self._cv.notify_all()
+            return req
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # ------------------------------------------------------------------
+    # synchronous drivers
+    # ------------------------------------------------------------------
+
+    def step(self) -> int:
+        """Coalesce and run ONE slab; returns the number of requests it
+        terminated (0 = queue empty)."""
+        with self._lock:
+            slab = next_slab(self._queue, self.kmax)
+        if not slab:
+            return 0
+        return self._run_slab(slab)
+
+    def drain(self) -> None:
+        """Run slabs until the queue is empty."""
+        while self.step():
+            pass
+
+    # ------------------------------------------------------------------
+    # the worker thread (live-server mode)
+    # ------------------------------------------------------------------
+
+    def start(self) -> "SolveService":
+        """Start the background worker; returns self. Synchronous
+        ``step``/``drain`` must not race it — pick one driving mode."""
+        check(
+            self._worker is None or not self._worker.is_alive(),
+            "service: worker already running",
+        )
+        self._stop = False
+        self._worker = threading.Thread(
+            target=self._work, daemon=True, name="pa-solve-service"
+        )
+        self._worker.start()
+        return self
+
+    def _work(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue and not self._stop and not (
+                    self._draining
+                ):
+                    self._cv.wait(timeout=0.05)
+                if self._stop or (self._draining and not self._queue):
+                    return
+                slab = next_slab(self._queue, self.kmax)
+            if slab:
+                self._run_slab(slab)
+
+    def shutdown(self, drain: bool = True) -> dict:
+        """Refuse new admissions; ``drain=True`` finishes every queued
+        request first, ``drain=False`` stops at the next chunk boundary
+        (checkpointing in-flight iterates when the service has a
+        ``checkpoint_dir``) and SUSPENDS never-started requests.
+        Returns a snapshot of ``stats``."""
+        from .. import telemetry
+
+        with self._lock:
+            self._draining = True
+            if not drain:
+                self._stop = True
+            self._cv.notify_all()
+        worker = self._worker
+        if worker is not None and worker.is_alive():
+            worker.join()
+        if drain:
+            self.drain()
+        else:
+            with self._lock:
+                leftover, self._queue = list(self._queue), []
+            for req in leftover:
+                self._suspend(req)
+        telemetry.emit_event(
+            "service_shutdown", label="drain" if drain else "stop",
+            **{k: v for k, v in self.stats.items()},
+        )
+        return dict(self.stats)
+
+    # ------------------------------------------------------------------
+    # slab execution
+    # ------------------------------------------------------------------
+
+    def _block_solve(self, B, X0, tol, maxiter):
+        from ..models.solvers import cg, pcg
+
+        if self.minv is not None:
+            return pcg(
+                self.A, B=B, X0=X0, minv=self.minv, tol=tol,
+                maxiter=maxiter, column_errors="report",
+            )
+        return cg(
+            self.A, B=B, X0=X0, tol=tol, maxiter=maxiter,
+            column_errors="report",
+        )
+
+    def _run_slab(self, slab) -> int:
+        from .. import telemetry
+        from ..parallel.pvector import PVector
+
+        key = compat_key(slab[0])
+        tol, key_maxiter, _ = key
+        budget = (
+            key_maxiter
+            if key_maxiter is not None
+            else 4 * self.A.rows.ngids
+        )
+        self.stats["slabs"] += 1
+        telemetry.emit_event(
+            "slab_formed", label=f"K={len(slab)}",
+            requests=[r.tag for r in slab], tol=tol, maxiter=key_maxiter,
+        )
+        active = list(slab)
+        X = {r.id: r.x0 for r in active}
+        for r in active:
+            r._set_state("running")
+        # deadline-free slabs run UNCHUNKED: one compiled solve, which
+        # is the bitwise-containment mode (chunk continuation restarts
+        # conjugacy — a different trajectory, and worth it only for
+        # deadline enforcement). Chunk verdicts are re-derived against
+        # the request's ORIGINAL convergence target (`_chunk_verdict`):
+        # each chunk is a fresh cg call whose relative test would
+        # otherwise re-baseline to the chunk-start residual.
+        chunked = any(r.deadline is not None for r in active)
+        targets: dict = {}
+        done = 0
+        while active:
+            remaining = min(budget - r.iterations for r in active)
+            step = min(self.chunk, remaining) if chunked else remaining
+            X0 = [X[r.id] for r in active]
+            if any(x is not None for x in X0):
+                X0 = [
+                    x
+                    if x is not None
+                    else PVector.full(0.0, self.A.cols, dtype=r.b.dtype)
+                    for x, r in zip(X0, active)
+                ]
+            else:
+                X0 = None
+            xs, info = self._block_solve(
+                [r.b for r in active], X0, tol, max(1, step)
+            )
+            now = self.clock()
+            still = []
+            for k, r in enumerate(active):
+                col = info["columns"][k]
+                verdict = info["column_health"][k]
+                r.iterations += int(col["iterations"])
+                if chunked:
+                    col = self._chunk_verdict(r, col, tol, targets)
+                if verdict["status"] != "ok":
+                    self._eject(r, verdict, now)
+                    done += 1
+                elif col["converged"]:
+                    self._finish(r, xs[k], col)
+                    done += 1
+                elif (
+                    r.deadline is not None
+                    and now - r.submitted_at > r.deadline
+                ):
+                    self._expire(r, now)
+                    done += 1
+                elif r.iterations >= budget or int(col["iterations"]) == 0:
+                    # budget exhausted, or the chunk made no progress
+                    # (a frozen breakdown column, a stalled host loop):
+                    # terminal — the solver contract is a returned
+                    # converged=False info, not an error, and spinning
+                    # on a frozen column forever is not an option
+                    self._finish(r, xs[k], col)
+                    done += 1
+                else:
+                    X[r.id] = xs[k]
+                    still.append(r)
+            active = still
+            if not active:
+                break
+            if self._stop:
+                # non-drain shutdown: checkpoint the in-flight iterates
+                # at this chunk boundary and stop
+                for r in active:
+                    self._checkpoint(r, X[r.id])
+                    done += 1
+                break
+            # re-batch ragged leftovers: compatible late arrivals join
+            # the running slab at the chunk boundary
+            with self._lock:
+                added = top_up(self._queue, active, self.kmax)
+            for r in added:
+                r._set_state("running")
+                X[r.id] = r.x0
+            if added:
+                telemetry.emit_event(
+                    "slab_formed", label=f"K={len(active) + len(added)}",
+                    requests=[r.tag for r in active + added],
+                    tol=tol, maxiter=key_maxiter, topped_up=True,
+                )
+            active = active + added
+        return done
+
+    def _chunk_verdict(self, req, col, tol, targets):
+        """Chunk continuation must NOT re-baseline the convergence
+        criterion: each chunk is a fresh ``cg`` call whose relative
+        test runs against the CHUNK-start residual, which re-baselines
+        the request's contract as the solve progresses (usually
+        tightening it — burning extra iterations against the deadline —
+        and, when a chunk boundary lands on a residual spike, loosening
+        it into a false ``converged``). The request's true target is
+        fixed at its FIRST chunk — ``tol·max(1, ‖r0‖)`` with ``r0 =
+        b − A·x0`` of the original start — and every chunk's converged
+        flag is re-derived against that target here."""
+        hist = [float(v) for v in col.get("residuals", [])]
+        if not hist:
+            return col
+        if req.id not in targets:
+            targets[req.id] = tol * max(1.0, hist[0])
+        converged = hist[-1] <= targets[req.id]
+        if bool(col.get("converged")) == converged:
+            return col
+        col = dict(col)
+        col["converged"] = converged
+        # keep the _host_block_solve invariant: status never reads
+        # 'converged' while converged is False (and vice versa)
+        col["status"] = "converged" if converged else "maxiter"
+        return col
+
+    # ------------------------------------------------------------------
+    # per-request terminal transitions
+    # ------------------------------------------------------------------
+
+    def _finish(self, req, x, col_info, via: Optional[str] = None) -> None:
+        from .. import telemetry
+
+        info = dict(col_info)
+        info["iterations"] = req.iterations
+        info["request_id"] = req.id
+        if via:
+            info["resolved_via"] = via
+        telemetry.emit_event(
+            "request_done", label=req.tag,
+            iteration=req.iterations,
+            converged=bool(info.get("converged")),
+            status=str(info.get("status")), via=via,
+        )
+        self.stats["completed"] += 1
+        req._resolve(x, req.record.finish(info))
+
+    def _fail(self, req, error) -> None:
+        from .. import telemetry
+
+        telemetry.emit_event(
+            "request_failed", label=req.tag, iteration=req.iterations,
+            error=type(error).__name__,
+        )
+        self.stats["failed"] += 1
+        req.record.finish_error(error)
+        req._fail(error)
+
+    def _expire(self, req, now: float) -> None:
+        from ..parallel.health import SolveDeadlineError
+        from .. import telemetry
+
+        telemetry.emit_event(
+            "deadline_expired", label=req.tag, iteration=req.iterations,
+            deadline=req.deadline, elapsed=now - req.submitted_at,
+        )
+        self.stats["deadline_expired"] += 1
+        self._fail(
+            req,
+            SolveDeadlineError(
+                f"request {req.tag}: deadline of {req.deadline}s expired "
+                f"after {now - req.submitted_at:.3f}s at the chunk "
+                f"boundary ({req.iterations} iterations completed)",
+                diagnostics={
+                    "context": "service",
+                    "request": req.tag,
+                    "deadline_s": req.deadline,
+                    "elapsed_s": now - req.submitted_at,
+                    "iteration": req.iterations,
+                },
+            ),
+        )
+
+    def _eject(self, req, verdict, now: float) -> None:
+        """A column the slab's verdict export flagged: fail it typed,
+        or retry it SOLO (`retry_with_backoff`; `solve_with_recovery`
+        when the service checkpoints) — its co-batched neighbors never
+        see any of this."""
+        from ..parallel.health import (
+            NonFiniteError,
+            SolverHealthError,
+            retry_with_backoff,
+        )
+        from .. import telemetry
+
+        telemetry.emit_event(
+            "column_ejected", label=str(verdict.get("status")),
+            iteration=req.iterations, request=req.tag,
+        )
+        self.stats["ejected"] += 1
+        error = verdict.get("error")
+        if error is None:
+            error = NonFiniteError(
+                f"request {req.tag}: ejected from its slab with verdict "
+                f"{verdict.get('status')!r} after {req.iterations} "
+                "iterations (co-batched requests were unaffected)",
+                diagnostics={
+                    "context": "service",
+                    "request": req.tag,
+                    "verdict": dict(
+                        (k, v) for k, v in verdict.items() if k != "error"
+                    ),
+                },
+            )
+        expired = (
+            req.deadline is not None
+            and now - req.submitted_at > req.deadline
+        )
+        if req.retries <= 0 or expired:
+            self._fail(req, error)
+            return
+        try:
+            if self.checkpoint_dir is not None:
+                # solve_with_recovery owns the WHOLE retry budget (its
+                # checkpoint-tier restarts ARE the attempts) — wrapping
+                # it in retry_with_backoff would multiply the budgets
+                # into retries × (1 + restarts) full solves
+                x, info = self._solo(req)
+            else:
+                x, info = retry_with_backoff(
+                    lambda: self._solo(req),
+                    attempts=req.retries,
+                    backoff=self.retry_backoff,
+                    exceptions=(SolverHealthError,),
+                    describe=f"solve-service {req.tag} solo retry",
+                    sleep=self._sleep,
+                    give_up=(
+                        (
+                            lambda: self.clock() - req.submitted_at
+                            > req.deadline
+                        )
+                        if req.deadline is not None
+                        else None
+                    ),
+                )
+        except SolverHealthError as e:
+            self._fail(req, e)
+            return
+        self.stats["retried_solo"] += 1
+        req.iterations += int(info["iterations"])
+        self._finish(req, x, info, via="solo_retry")
+
+    def _solo(self, req):
+        """One solo attempt for an ejected request: the per-request
+        fault boundary. With a service ``checkpoint_dir`` this is
+        `solve_with_recovery` carrying the request's ENTIRE retry
+        budget as checkpoint-tier restarts (``req.retries`` solver
+        invocations total — the caller must not wrap it in another
+        retry loop); without one it is a bare solo solve (the caller's
+        `retry_with_backoff` provides the attempts)."""
+        from ..models.solvers import cg, pcg, solve_with_recovery
+
+        if self.checkpoint_dir is not None:
+            return solve_with_recovery(
+                self.A, req.b,
+                method="pcg" if self.minv is not None else "cg",
+                checkpoint_dir=os.path.join(
+                    self.checkpoint_dir, f"req-{req.id}"
+                ),
+                every=self.chunk, max_restarts=max(0, req.retries - 1),
+                minv=self.minv, x0=req.x0, tol=req.tol,
+                maxiter=req.maxiter,
+            )
+        if self.minv is not None:
+            return pcg(
+                self.A, req.b, x0=req.x0, minv=self.minv, tol=req.tol,
+                maxiter=req.maxiter,
+            )
+        return cg(
+            self.A, req.b, x0=req.x0, tol=req.tol, maxiter=req.maxiter
+        )
+
+    def _checkpoint(self, req, x) -> None:
+        from .. import telemetry
+
+        if x is None or self.checkpoint_dir is None:
+            self._suspend(req)
+            return
+        from ..parallel.checkpoint import SolverCheckpointer
+
+        d = os.path.join(self.checkpoint_dir, f"req-{req.id}")
+        ck = SolverCheckpointer(d, every=1, async_write=False)
+        ck.save_state(
+            {"x": x},
+            {
+                "method": "pcg" if self.minv is not None else "cg",
+                "it": req.iterations, "tol": req.tol,
+                "request": req.tag,
+            },
+        )
+        ck.wait()
+        req.checkpoint_path = d
+        telemetry.emit_event(
+            "request_checkpointed", label=req.tag,
+            iteration=req.iterations, directory=d,
+        )
+        self.stats["checkpointed"] += 1
+        req.record.finish(
+            {"status": "checkpointed", "iterations": req.iterations}
+        )
+        req._set_state("checkpointed")
+
+    def _suspend(self, req) -> None:
+        from .. import telemetry
+
+        telemetry.emit_event(
+            "request_suspended", label=req.tag, iteration=req.iterations
+        )
+        self.stats["suspended"] += 1
+        req.record.finish({"status": "suspended"})
+        req._set_state("suspended")
+
+    def __repr__(self):
+        return (
+            f"SolveService(pending={self.pending()}, kmax={self.kmax}, "
+            f"chunk={self.chunk}, stats={self.stats})"
+        )

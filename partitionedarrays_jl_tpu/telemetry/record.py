@@ -21,6 +21,16 @@ on `finish` (success) or by the `solve_scope` context manager on an
 exception (the aborted record still lands in the history ring with its
 events: that is what `tools/patrace.py` post-mortems read).
 
+The solve service (`service.SolveService`) extends the same machinery
+to the REQUEST level: every admitted request opens a
+``"service-request"`` record that stays active from admission to its
+terminal state, so queue/slab/ejection events
+(``request_queued``, ``slab_formed``, ``column_verdict``,
+``column_ejected``, ``deadline_expired``, ``request_done`` /
+``request_failed`` / ``request_checkpointed`` / ``request_suspended``)
+AND the slab solves' own nested records' events all land in it —
+docs/service.md has the catalog.
+
 Env knobs (all host-side; none can change a compiled program):
 
 * ``PA_METRICS`` (default ``1``) — kill switch for record keeping and

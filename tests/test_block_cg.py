@@ -312,6 +312,62 @@ def test_strict_bits_block_per_column_identity(fused, monkeypatch):
         )
 
 
+@pytest.mark.parametrize("K", [3, 5])
+def test_strict_bits_ragged_odd_widths_k3_k5(K, monkeypatch):
+    """Ragged parity at ODD/PRIME slab widths — the shapes the solve
+    service's re-batching actually produces (a K=8 slab that lost
+    ejected/converged columns re-runs at K=3, 5, ...). Same contract as
+    the K=2 pin above: per-column BITWISE identity against the K=1
+    oracle under strict-bits on the 4-part conformance fixture, with
+    per-column freeze points (no residuals logged past a column's
+    freeze)."""
+    monkeypatch.setenv("PA_TPU_STRICT_BITS", "1")
+    backend = _backend(4)
+
+    def driver(parts):
+        A, b = _fixture_spd_system(parts)
+        B = [b]
+        for j in range(1, K):
+            # distinct roughness per column: solo counts differ (the
+            # ragged point), deterministically
+            B.append(
+                pa.PVector(
+                    pa.map_parts(
+                        lambda i, j=j: np.where(
+                            np.asarray(i.lid_to_part) == i.part,
+                            np.cos(
+                                2.0 + (j + 2.0)
+                                * np.asarray(i.lid_to_gid, dtype=np.float64)
+                            ),
+                            0.0,
+                        ),
+                        A.rows.partition,
+                    ),
+                    A.rows,
+                )
+            )
+        return A, B
+
+    A, B = pa.prun(driver, backend, 4)
+    xs, binfo = tpu_block_cg(A, B, tol=1e-10, maxiter=200)
+    assert binfo["rhs_batch"] == K
+    its = binfo["iterations_per_column"]
+    assert len(set(its)) > 1, f"block is not ragged: {its}"
+    for k, bk in enumerate(B):
+        xk, sinfo = tpu_cg(A, bk, tol=1e-10, maxiter=200)
+        assert its[k] == sinfo["iterations"], (k, its, sinfo["iterations"])
+        np.testing.assert_array_equal(
+            gather_pvector(xs[k]), gather_pvector(xk)
+        )
+        n = sinfo["iterations"] + 1
+        np.testing.assert_array_equal(
+            np.asarray(binfo["columns"][k]["residuals"])[:n],
+            np.asarray(sinfo["residuals"])[:n],
+        )
+        # freeze-on-convergence: nothing logged past the freeze point
+        assert len(np.asarray(binfo["columns"][k]["residuals"])) == n
+
+
 # ---------------------------------------------------------------------------
 # fused × batched interaction under the env default
 # ---------------------------------------------------------------------------

@@ -22,6 +22,16 @@ injected fault, the detector that fired, and the recovery path taken
 all appear as structured events in the solve's `SolveRecord`
 (``info.record``, or the aborted record in the history ring for the
 typed-raise paths). No recovery may be silent in the event log.
+
+Round 10 (pasolve): the solve service adds request-level rows to the
+matrix — faults and overload hitting the MULTI-TENANT layer, each with
+its documented outcome and event trail:
+
+| condition               | detector            | documented outcome   |
+|-------------------------|---------------------|----------------------|
+| queue over depth bound  | admission control   | AdmissionRejected (typed backpressure) + admission_rejected event |
+| deadline past at chunk boundary | service clock | SolveDeadlineError + deadline_expired/health_error events; co-batched requests unaffected |
+| poisoned column in a shared slab | per-column verdict export | that request ejected + typed NonFiniteError; co-batched requests complete clean (column_verdict/column_ejected/request_failed events) |
 """
 import numpy as np
 import pytest
@@ -188,6 +198,110 @@ def test_matrix_controller_typed_then_recovers():
         assert _has_event(rec, "fault_injected", "controller")
         assert _has_event(rec, "health_error", "ControllerLostError")
         assert _has_event(rec, "restart", "ControllerLostError")
+        return True
+
+    _run(driver)
+
+
+def test_matrix_service_admission_rejected():
+    """Service row 1: overload hits the bounded queue — the documented
+    outcome is TYPED backpressure (AdmissionRejected with machine-
+    readable diagnostics), never unbounded buffering or a silent drop,
+    and the rejection is an event (the counter always ticks)."""
+    from partitionedarrays_jl_tpu.service import (
+        AdmissionRejected,
+        SolveService,
+    )
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A, queue_depth=1)
+        held = svc.submit(b, x0=x0, tol=1e-9, tag="held")
+        before = telemetry.counter("events.admission_rejected")
+        with pytest.raises(AdmissionRejected) as ei:
+            svc.submit(b, x0=x0, tol=1e-9, tag="over")
+        assert ei.value.diagnostics["reason"] == "queue_full"
+        assert telemetry.counter("events.admission_rejected") == before + 1
+        # the queued request is untouched by the rejection
+        svc.drain()
+        assert held.result()[1]["converged"]
+        return True
+
+    _run(driver)
+
+
+def test_matrix_service_deadline_expiry():
+    """Service row 2: a request's deadline passes at a chunk boundary —
+    typed SolveDeadlineError (in the SolverHealthError family, so the
+    health_error event fires) with the full story in the request's
+    record; the co-batched deadline-free request completes."""
+    from partitionedarrays_jl_tpu.parallel.health import SolveDeadlineError
+    from partitionedarrays_jl_tpu.service import SolveService
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        t = {"now": 0.0}
+
+        def clock():
+            t["now"] += 1.0
+            return t["now"]
+
+        svc = SolveService(A, kmax=2, chunk=4, clock=clock)
+        rd = svc.submit(b, x0=x0, tol=1e-9, deadline=0.5, tag="tight")
+        rf = svc.submit(b, x0=x0, tol=1e-9, tag="free")
+        svc.drain()
+        with pytest.raises(SolveDeadlineError):
+            rd.result()
+        assert rf.result()[1]["converged"]
+        rec = rd.record
+        assert rec.status == "raised"
+        assert _has_event(rec, "deadline_expired", "tight")
+        assert _has_event(rec, "health_error", "SolveDeadlineError")
+        assert _has_event(rec, "request_failed", "tight")
+        return True
+
+    _run(driver)
+
+
+def test_matrix_service_poisoned_column_ejection():
+    """Service row 3: a NaN-poisoned b shares a slab with clean
+    requests — the poisoned request is ejected with a typed
+    NonFiniteError and its event trail, the co-batched requests
+    complete equal to their clean solo solves, and nothing heals
+    silently."""
+    from partitionedarrays_jl_tpu.service import SolveService
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        x_clean, _ = cg(A, b, x0=x0, tol=1e-9)
+        bad = b.copy()
+
+        def poison(i, vals):
+            if int(i.part) == 0:
+                np.asarray(vals)[0] = np.nan
+
+        pa.map_parts(poison, bad.rows.partition, bad.values)
+        svc = SolveService(A, kmax=3, retries=0)
+        h_good = svc.submit(b, x0=x0, tol=1e-9, tag="good")
+        h_bad = svc.submit(bad, x0=x0, tol=1e-9, tag="bad")
+        h_good2 = svc.submit(b, x0=x0, tol=1e-9, tag="good2")
+        svc.drain()
+        assert svc.stats["slabs"] == 1  # one shared slab
+        with pytest.raises(NonFiniteError):
+            h_bad.result()
+        for h in (h_good, h_good2):
+            x, info = h.result()
+            assert info["converged"]
+            np.testing.assert_array_equal(
+                gather_pvector(x), gather_pvector(x_clean)
+            )
+        rec = h_bad.record
+        assert rec.status == "raised"
+        assert _has_event(rec, "column_verdict")
+        assert _has_event(rec, "column_ejected")
+        assert _has_event(rec, "request_failed", "bad")
+        # the clean requests' records show no failure of their own
+        assert not _has_event(h_good.record, "request_failed", "good")
         return True
 
     _run(driver)

@@ -381,6 +381,91 @@ def test_retry_with_backoff():
     assert len(boom) == 1
 
 
+def test_retry_backoff_zero_is_true_zero():
+    """backoff=0 means NO sleeping, ever — the second delay used to
+    silently become 0.1 s via the doubling bootstrap, so callers asking
+    for no backoff (tests, in-process service retries) still slept."""
+    sleeps = []
+    with pytest.raises(OSError):
+        retry_with_backoff(
+            lambda: (_ for _ in ()).throw(OSError("hard")),
+            attempts=4, backoff=0.0, sleep=sleeps.append,
+        )
+    assert sleeps == [0.0, 0.0, 0.0]
+
+
+def test_retry_give_up_abandons_remaining_attempts():
+    """``give_up`` (the solve service's deadline hook): once the
+    predicate trips, the remaining attempts are abandoned and the last
+    failure re-raises immediately — no sleep, no further calls."""
+    calls, sleeps = [], []
+
+    def failing():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_with_backoff(
+            failing, attempts=10, backoff=0.0, sleep=sleeps.append,
+            give_up=lambda: len(calls) >= 3,
+        )
+    assert len(calls) == 3  # not 10: give_up cut the budget
+    assert sleeps == [0.0, 0.0]  # and never slept after the trip
+    # a never-true predicate changes nothing
+    calls.clear(), sleeps.clear()
+    with pytest.raises(OSError):
+        retry_with_backoff(
+            failing, attempts=2, backoff=0.0, sleep=sleeps.append,
+            give_up=lambda: False,
+        )
+    assert len(calls) == 2
+
+
+def test_retry_jitter_seeded_and_decorrelated(monkeypatch):
+    """PA_RETRY_JITTER (or jitter_seed=): seeded decorrelated jitter —
+    delays are drawn from U[base, 3*previous] (capped), reproducible
+    per seed, different across seeds (co-failing ranks spread out),
+    and OFF by default (the classic deterministic doubling)."""
+
+    def always_fail():
+        raise OSError("transient")
+
+    def delays(**kw):
+        sleeps = []
+        with pytest.raises(OSError):
+            retry_with_backoff(
+                always_fail, attempts=5, backoff=0.25,
+                sleep=sleeps.append, **kw,
+            )
+        return sleeps
+
+    # off by default: deterministic doubling
+    assert delays() == [0.25, 0.5, 1.0, 2.0]
+    a = delays(jitter_seed=7)
+    b = delays(jitter_seed=7)
+    c = delays(jitter_seed=8)
+    assert a == b, "same seed must reproduce the same delay sequence"
+    assert a != c, "distinct seeds must decorrelate"
+    assert a[0] == 0.25  # the first delay is the base either way
+    prev = a[0]
+    for d in a[1:]:
+        assert 0.25 <= d <= max(0.25, 3 * prev) + 1e-12, (a,)
+        prev = d
+    # the env knob is the same switch (value = seed)
+    monkeypatch.setenv("PA_RETRY_JITTER", "7")
+    assert delays() == a
+    monkeypatch.setenv("PA_RETRY_JITTER", "0")
+    assert delays() == [0.25, 0.5, 1.0, 2.0]
+    # jitter composes with the true-zero policy: base 0 stays 0
+    monkeypatch.setenv("PA_RETRY_JITTER", "3")
+    sleeps = []
+    with pytest.raises(OSError):
+        retry_with_backoff(
+            always_fail, attempts=3, backoff=0.0, sleep=sleeps.append,
+        )
+    assert sleeps == [0.0, 0.0]
+
+
 def test_multihost_init_retries_explicit_spec(monkeypatch):
     """An explicit cluster spec retries RuntimeError (coordinator not up
     yet) with backoff before failing; a bad-value spec fails fast."""

@@ -1,0 +1,741 @@
+"""pasolve — the fault-isolating multi-tenant solve service
+(`partitionedarrays_jl_tpu.service`).
+
+The four contracts pinned here:
+
+* **Admission** — bounded queue + typed `AdmissionRejected`
+  backpressure (never unbounded buffering, never a silent drop), and a
+  draining service refuses new work.
+* **Coalescing** — FIFO slabs of compatible requests (same
+  tol/maxiter/dtype) up to ``PA_SERVE_KMAX``, ragged leftovers run
+  as-is, late-arriving compatible requests top a chunked slab back up.
+* **Containment** — THE tentpole pin: an injected fault hitting exactly
+  one request in a K=4 slab fails/retries that request with its typed
+  error and full event trail, while every co-batched request completes
+  with a trajectory BITWISE equal to its solo solve (strict-bits,
+  4-part conformance fixture); and the service consumes the IDENTICAL
+  compiled program as the bare block body (program-cache hit — zero
+  extra collectives by construction, with the K-independence HLO pin
+  re-run through service-shaped parameters).
+* **Deadlines / lifecycle** — per-request deadlines enforced at chunk
+  boundaries as typed `SolveDeadlineError`; drain/shutdown refuses
+  admissions, checkpoints in-flight iterates, suspends never-started
+  requests.
+
+Budget note: everything host-path runs on the sequential backend
+(tiny 8x8 Poisson, milliseconds); only the containment + parity tests
+compile device programs, on the tiny 4-part fixture.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu import telemetry
+from partitionedarrays_jl_tpu.models import assemble_poisson, gather_pvector
+from partitionedarrays_jl_tpu.parallel.faults import inject_faults
+from partitionedarrays_jl_tpu.parallel.health import (
+    NonFiniteError,
+    SolveDeadlineError,
+)
+from partitionedarrays_jl_tpu.service import (
+    AdmissionRejected,
+    SolveService,
+    compat_key,
+    next_slab,
+    top_up,
+)
+
+from test_fused_cg import _fixture_spd_system
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(driver):
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def _has_event(rec, kind, label=None):
+    return any(
+        e.kind == kind and (label is None or e.label == label)
+        for e in rec.events
+    )
+
+
+class FakeClock:
+    """Deterministic service clock: every reading advances by ``dt``."""
+
+    def __init__(self, dt=1.0):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self):
+        self.t += self.dt
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+def test_admission_backpressure_typed_and_counted():
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A, queue_depth=2)
+        svc.submit(b, x0=x0, tag="a")
+        svc.submit(b, x0=x0, tag="b")
+        before = telemetry.counter("events.admission_rejected")
+        with pytest.raises(AdmissionRejected) as ei:
+            svc.submit(b, x0=x0, tag="c")
+        assert ei.value.diagnostics["reason"] == "queue_full"
+        assert ei.value.diagnostics["queued"] == 2
+        assert ei.value.diagnostics["depth"] == 2
+        assert telemetry.counter("events.admission_rejected") == before + 1
+        assert svc.stats["rejected"] == 1
+        # draining the queue frees capacity again
+        svc.drain()
+        svc.submit(b, x0=x0, tag="c2")
+        svc.drain()
+        assert svc.stats["admitted"] == 3
+        return True
+
+    _run(driver)
+
+
+def test_admission_validates_request_shape():
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A)
+        with pytest.raises(Exception, match="tol"):
+            svc.submit(b, tol=0.0)
+        with pytest.raises(Exception, match="deadline"):
+            svc.submit(b, deadline=-1.0)
+        with pytest.raises(Exception, match="maxiter"):
+            svc.submit(b, maxiter=0)
+        return True
+
+    _run(driver)
+
+
+# ---------------------------------------------------------------------------
+# slab coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_coalescing_rules_and_ragged_leftovers():
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A, kmax=4, queue_depth=16)
+        hs = [
+            svc.submit(b, x0=x0, tol=1e-9, tag=f"t{i}") for i in range(5)
+        ]
+        other = svc.submit(b, x0=x0, tol=1e-6, tag="loose")
+        # FIFO anchor: first slab is the four oldest tol=1e-9 requests
+        # (the tol=1e-6 request keeps its place for its own slab), then
+        # the ragged leftover, then the incompatible one
+        assert svc.step() == 4
+        assert [h.state for h in hs[:4]] == ["done"] * 4
+        assert hs[4].state == "queued" and other.state == "queued"
+        svc.drain()
+        assert svc.stats["slabs"] == 3
+        assert all(h.result()[1]["converged"] for h in hs)
+        assert other.result()[1]["converged"]
+        # per-request record: queue + slab + done events all present
+        rec = hs[0].record
+        assert _has_event(rec, "request_queued", "t0")
+        assert _has_event(rec, "slab_formed", "K=4")
+        assert _has_event(rec, "request_done", "t0")
+        return True
+
+    _run(driver)
+
+
+def test_batcher_unit_fifo_and_top_up():
+    class R:
+        def __init__(self, tol, maxiter=100, dtype="float64"):
+            self.tol, self.maxiter = tol, maxiter
+
+            class B:
+                pass
+
+            self.b = B()
+            self.b.dtype = np.dtype(dtype)
+
+    q = [R(1e-8), R(1e-8), R(1e-6), R(1e-8), R(1e-8)]
+    slab = next_slab(q, kmax=3)
+    assert [r.tol for r in slab] == [1e-8] * 3
+    assert [r.tol for r in q] == [1e-6, 1e-8]
+    assert compat_key(slab[0]) == (1e-8, 100, "float64")
+    added = top_up(q, slab, kmax=5)
+    assert [r.tol for r in added] == [1e-8]
+    assert [r.tol for r in q] == [1e-6]
+    # dtype splits slabs too: an f32 request cannot share an f64 slab
+    q2 = [R(1e-8), R(1e-8, dtype="float32")]
+    assert len(next_slab(q2, kmax=4)) == 1 and len(q2) == 1
+
+
+# ---------------------------------------------------------------------------
+# containment: ejection + solo retry on the host oracle
+# ---------------------------------------------------------------------------
+
+
+def test_transient_fault_ejects_then_solo_retry_heals():
+    """A one-shot wire fault poisons ONE column of a host slab: that
+    column is ejected and retried SOLO (the fault does not refire), the
+    co-batched column never notices, and both end bitwise equal to the
+    clean solves — with the whole story in the event log."""
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        x_clean, _ = pa.cg(A, b, x0=x0, tol=1e-9)
+        svc = SolveService(A, kmax=2, retries=1, retry_backoff=0.0)
+        # call=5 lands inside the FIRST column's solo loop (the host
+        # slab runs columns in sequence, ~14 exchanges each)
+        with inject_faults("nan@part=1,call=5", seed=1):
+            r0 = svc.submit(b, x0=x0, tol=1e-9, tag="poisoned")
+            r1 = svc.submit(b, x0=x0, tol=1e-9, tag="clean")
+            svc.drain()
+        assert r0.state == "done" and r1.state == "done"
+        x0_, i0 = r0.result()
+        x1_, i1 = r1.result()
+        assert i0["resolved_via"] == "solo_retry"
+        assert i0["converged"] and i1["converged"]
+        np.testing.assert_array_equal(
+            gather_pvector(x0_), gather_pvector(x_clean)
+        )
+        np.testing.assert_array_equal(
+            gather_pvector(x1_), gather_pvector(x_clean)
+        )
+        assert svc.stats["ejected"] == 1
+        assert svc.stats["retried_solo"] == 1
+        rec = r0.record
+        assert _has_event(rec, "fault_injected", "nan")
+        assert _has_event(rec, "column_verdict", "block-host")
+        assert _has_event(rec, "column_ejected", "NonFiniteError")
+        assert _has_event(rec, "request_done", "poisoned")
+        # the clean request's record shows NO recovery of its own
+        assert not _has_event(r1.record, "request_failed")
+        return True
+
+    _run(driver)
+
+
+def test_persistent_fault_fails_typed_after_retries():
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        bad = b.copy()
+
+        def poison(i, vals):
+            if int(i.part) == 0:
+                np.asarray(vals)[0] = np.nan
+
+        pa.map_parts(poison, bad.rows.partition, bad.values)
+        svc = SolveService(A, kmax=3, retries=1, retry_backoff=0.0)
+        rb = svc.submit(bad, x0=x0, tol=1e-9, tag="bad")
+        rg = svc.submit(b, x0=x0, tol=1e-9, tag="good")
+        svc.drain()
+        assert rg.result()[1]["converged"]
+        assert rb.state == "failed"
+        with pytest.raises(NonFiniteError):
+            rb.result()
+        assert isinstance(rb.error, NonFiniteError)
+        assert svc.stats["failed"] == 1 and svc.stats["ejected"] == 1
+        # failed request's record is finalized as an aborted record
+        # with the trail: ejection, then the typed failure
+        assert rb.record.status == "raised"
+        assert _has_event(rb.record, "column_ejected")
+        assert _has_event(rb.record, "request_failed", "bad")
+        return True
+
+    _run(driver)
+
+
+def test_solo_retry_budget_not_multiplied(tmp_path):
+    """With a service ``checkpoint_dir`` the solo path is
+    `solve_with_recovery`, which owns the WHOLE retry budget as
+    checkpoint-tier restarts: ``retries`` solver invocations total. It
+    used to be wrapped in `retry_with_backoff` ON TOP of its own
+    ``max_restarts``, multiplying the budgets into retries × (1 +
+    restarts) full solves of a deterministically-failing request."""
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        bad = b.copy()
+
+        def poison(i, vals):
+            if int(i.part) == 0:
+                np.asarray(vals)[0] = np.nan
+
+        pa.map_parts(poison, bad.rows.partition, bad.values)
+        before = telemetry.counter("events.health_error")
+        svc = SolveService(
+            A, kmax=2, retries=2, retry_backoff=0.0,
+            checkpoint_dir=str(tmp_path / "ck"),
+        )
+        rb = svc.submit(bad, x0=x0, tol=1e-9, tag="bad")
+        svc.drain()
+        assert rb.state == "failed"
+        # one detection in the slab + exactly ``retries`` solo
+        # attempts — the multiplied budget fired 2×(1+2)=6 solo solves
+        attempts = telemetry.counter("events.health_error") - before
+        assert attempts == 1 + 2, attempts
+        return True
+
+    _run(driver)
+
+
+def test_solo_retry_stops_at_deadline():
+    """A deadline-carrying request cannot keep retrying solo past its
+    deadline: the service passes its deadline test as
+    `retry_with_backoff`'s ``give_up`` hook, so once the clock runs out
+    the remaining attempts are abandoned and the request fails typed."""
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        bad = b.copy()
+
+        def poison(i, vals):
+            if int(i.part) == 0:
+                np.asarray(vals)[0] = np.nan
+
+        pa.map_parts(poison, bad.rows.partition, bad.values)
+        before = telemetry.counter("events.health_error")
+        # every clock reading advances 1s: the generous-looking
+        # deadline is over after the first solo attempt's readings
+        svc = SolveService(
+            A, kmax=2, retries=8, retry_backoff=0.0,
+            clock=FakeClock(dt=1.0),
+        )
+        rb = svc.submit(bad, x0=x0, tol=1e-9, deadline=4.0, tag="bad")
+        svc.drain()
+        assert rb.state == "failed"
+        with pytest.raises(NonFiniteError):
+            rb.result()
+        attempts = telemetry.counter("events.health_error") - before
+        assert attempts < 1 + 8, (
+            f"give_up did not cut the retry budget: {attempts} "
+            "health errors for a request whose deadline expired"
+        )
+        return True
+
+    _run(driver)
+
+
+# ---------------------------------------------------------------------------
+# deadlines at chunk boundaries
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expires_typed_at_chunk_boundary():
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        clock = FakeClock(dt=1.0)
+        svc = SolveService(A, kmax=2, chunk=4, clock=clock)
+        rd = svc.submit(b, x0=x0, tol=1e-9, deadline=0.5, tag="tight")
+        rf = svc.submit(b, x0=x0, tol=1e-9, tag="free")
+        svc.drain()
+        assert rd.state == "failed"
+        with pytest.raises(SolveDeadlineError) as ei:
+            rd.result()
+        d = ei.value.diagnostics
+        assert d["request"] == "tight" and d["deadline_s"] == 0.5
+        assert d["iteration"] == rd.iterations > 0
+        # the co-batched request without a deadline completes
+        assert rf.result()[1]["converged"]
+        assert svc.stats["deadline_expired"] == 1
+        rec = rd.record
+        assert _has_event(rec, "deadline_expired", "tight")
+        assert _has_event(rec, "health_error", "SolveDeadlineError")
+        # a generous deadline does NOT expire
+        clock2 = FakeClock(dt=0.001)
+        svc2 = SolveService(A, kmax=2, chunk=4, clock=clock2)
+        ok = svc2.submit(b, x0=x0, tol=1e-9, deadline=60.0, tag="roomy")
+        svc2.drain()
+        assert ok.result()[1]["converged"]
+        assert svc2.stats["deadline_expired"] == 0
+        return True
+
+    _run(driver)
+
+
+def test_chunked_solve_keeps_original_convergence_target():
+    """Chunk continuation must not re-baseline the convergence
+    criterion: each chunk is a fresh cg call whose relative test runs
+    against the CHUNK-start residual, so on a large-norm system the
+    effective threshold used to tighten from tol·‖r0‖ toward absolute
+    tol as chunks progressed — burning extra iterations against the
+    deadline and over-solving past the request's contract. The target
+    is now fixed at the request's first chunk."""
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        # a large-norm variant (scale b AND x0: the system is linear,
+        # so the Dirichlet rows stay consistent) — ‖r0‖ ≈ 9e4 makes the
+        # relative target tol·‖r0‖ five orders looser than absolute tol
+        big, bx0 = b.copy(), x0.copy()
+
+        def _scale(iset, vals):
+            np.asarray(vals)[...] *= 1e4
+
+        pa.map_parts(_scale, big.rows.partition, big.values)
+        pa.map_parts(_scale, bx0.rows.partition, bx0.values)
+        tol = 1e-9
+        from partitionedarrays_jl_tpu.models.solvers import cg
+
+        _, solo = cg(A, big, x0=bx0, tol=tol)
+        assert solo["converged"]
+        r0 = float(np.asarray(solo["residuals"])[0])
+        target = tol * max(1.0, r0)
+        assert target > 100 * tol  # the regression needs a loose target
+
+        # multi-chunk (chunk < solo iterations): the request must stop
+        # at the first boundary meeting ITS OWN target — converged with
+        # a residual under tol·‖r0‖ but NOT over-solved to absolute tol
+        # (the re-baselined criterion drove it there before the fix)
+        clock = FakeClock(dt=0.001)
+        svc = SolveService(A, kmax=2, chunk=10, clock=clock)
+        h = svc.submit(big, x0=bx0, tol=tol, deadline=1e6, tag="big")
+        svc.drain()
+        _, inf = h.result()
+        res_end = float(np.asarray(inf["residuals"])[-1])
+        assert inf["converged"] and inf["status"] == "converged"
+        assert res_end <= target  # the verdict is honest
+        assert res_end > 10 * tol, (
+            "chunked solve over-solved to the re-baselined absolute "
+            f"tolerance ({res_end:.3e}) instead of stopping at the "
+            f"request's target ({target:.3e})"
+        )
+        # single-chunk (chunk ≥ solo iterations): identical to solo
+        svc2 = SolveService(
+            A, kmax=2, chunk=25, clock=FakeClock(dt=0.001)
+        )
+        h2 = svc2.submit(big, x0=bx0, tol=tol, deadline=1e6, tag="one")
+        svc2.drain()
+        _, inf2 = h2.result()
+        assert inf2["converged"]
+        assert h2.iterations == solo["iterations"]
+        return True
+
+    _run(driver)
+
+
+def test_chunk_boundary_top_up_rebatches_late_arrivals():
+    """A chunked slab tops itself back up with compatible requests that
+    arrived after it started — the re-batching leg of coalescing."""
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A, kmax=4, chunk=3, clock=FakeClock(0.001))
+        r1 = svc.submit(b, x0=x0, tol=1e-9, deadline=99.0, tag="early")
+        # a late compatible request lands in the queue mid-slab: inject
+        # it by hooking the clock (called once per chunk boundary)
+        state = {"n": 0, "late": None}
+        base = svc.clock
+
+        def clock():
+            state["n"] += 1
+            if state["n"] == 1 and state["late"] is None:
+                state["late"] = svc.submit(
+                    b, x0=x0, tol=1e-9, deadline=99.0, tag="late"
+                )
+            return base()
+
+        svc.clock = clock
+        svc.drain()
+        late = state["late"]
+        assert r1.result()[1]["converged"]
+        assert late is not None and late.result()[1]["converged"]
+        # the late request rode the SAME slab (no second slab formed
+        # for it): one initial slab_formed plus one topped_up event
+        assert svc.stats["slabs"] == 1
+        assert any(
+            e.kind == "slab_formed" and e.details.get("topped_up")
+            for e in late.record.events
+        )
+        return True
+
+    _run(driver)
+
+
+# ---------------------------------------------------------------------------
+# drain / shutdown lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_shutdown_drains_then_refuses():
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A)
+        h = svc.submit(b, x0=x0, tol=1e-9)
+        stats = svc.shutdown(drain=True)
+        assert h.result()[1]["converged"]
+        assert stats["completed"] == 1
+        with pytest.raises(AdmissionRejected) as ei:
+            svc.submit(b, x0=x0)
+        assert ei.value.diagnostics["reason"] == "draining"
+        return True
+
+    _run(driver)
+
+
+def test_nondrain_shutdown_checkpoints_inflight_and_suspends(tmp_path):
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        ckdir = str(tmp_path / "svc-ck")
+        svc = SolveService(
+            A, kmax=1, chunk=4, checkpoint_dir=ckdir,
+            clock=FakeClock(0.001),
+        )
+        # deadline makes the slab chunked, so the stop flag is seen at
+        # the first chunk boundary with a real in-flight iterate
+        r1 = svc.submit(b, x0=x0, tol=1e-12, deadline=99.0, tag="infl")
+        r2 = svc.submit(b, x0=x0, tol=1e-9, tag="queued")
+        svc._stop = True  # what shutdown(drain=False) sets mid-run
+        assert svc.step() == 1  # the slab stops at its first boundary
+        assert r1.state == "checkpointed"
+        assert r1.iterations == 4 and r1.checkpoint_path
+        with pytest.raises(RuntimeError, match="checkpointed"):
+            r1.result()
+        # the checkpointed iterate is loadable and resumable
+        from partitionedarrays_jl_tpu.models.solvers import (
+            _solver_state_ranges,
+        )
+        from partitionedarrays_jl_tpu.parallel.checkpoint import (
+            load_solver_state,
+        )
+
+        st = load_solver_state(
+            r1.checkpoint_path, _solver_state_ranges(A, b)
+        )
+        assert int(st["meta"]["it"]) == 4
+        svc2 = SolveService(A)
+        done = svc2.submit(b, x0=st["x"], tol=1e-9, tag="resumed")
+        svc2.drain()
+        assert done.result()[1]["converged"]
+        # shutdown suspends the never-started request
+        stats = svc.shutdown(drain=False)
+        assert r2.state == "suspended" and stats["suspended"] == 1
+        with pytest.raises(RuntimeError, match="resubmit"):
+            r2.result()
+        assert _has_event(r1.record, "request_checkpointed", "infl")
+        assert _has_event(r2.record, "request_suspended", "queued")
+        return True
+
+    _run(driver)
+
+
+def test_worker_thread_smoke():
+    """The live-server mode: background worker drains submissions; a
+    draining shutdown joins it and finishes the queue."""
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A, kmax=2).start()
+        hs = [svc.submit(b, x0=x0, tol=1e-9) for _ in range(3)]
+        stats = svc.shutdown(drain=True)
+        assert stats["completed"] == 3
+        assert all(h.result()[1]["converged"] for h in hs)
+        return True
+
+    _run(driver)
+
+
+# ---------------------------------------------------------------------------
+# the paserve CLI
+# ---------------------------------------------------------------------------
+
+
+def test_paserve_cli_smoke(tmp_path, capsys):
+    """The CLI harness end to end, in-process (a subprocess would
+    re-import jax and burn tier-1 budget — the patrace precedent):
+    a poisoned request fails typed, the rest complete, exit 0."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "paserve_cli", os.path.join(REPO, "tools", "paserve.py")
+    )
+    paserve = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(paserve)
+    out_json = str(tmp_path / "serve.json")
+    rc = paserve.main(
+        [
+            "--grid", "8", "8", "--requests", "4", "--kmax", "2",
+            "--poison", "1", "--summary-json", out_json,
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "paserve: OK" in out
+    assert "NonFiniteError" in out
+    summary = json.load(open(out_json))
+    assert summary["ok"] is True
+    assert summary["stats"]["ejected"] == 1
+    assert summary["stats"]["admitted"] == 4
+    states = [r["state"] for r in summary["requests"]]
+    assert states == ["done", "failed", "done", "done"]
+
+
+# ---------------------------------------------------------------------------
+# the tentpole pin: bitwise containment in a shared compiled slab
+# ---------------------------------------------------------------------------
+
+
+def test_containment_bitwise_strict_bits_k4(monkeypatch):
+    """One NaN-poisoned request in a K=4 compiled slab (strict-bits,
+    4-part conformance fixture): the poisoned request fails with its
+    typed error and full event trail; every co-batched request
+    completes with a trajectory BITWISE equal to its solo solve."""
+    monkeypatch.setenv("PA_TPU_STRICT_BITS", "1")
+    import jax
+
+    backend = pa.TPUBackend(devices=jax.devices()[:4])
+
+    def driver(parts):
+        A, b = _fixture_spd_system(parts)
+        variants = []
+        for j, f in enumerate((1.0, 0.5, 2.0)):
+            bj = b.copy()
+
+            def _scale(iset, vals, s=f):
+                np.asarray(vals)[...] *= s
+
+            pa.map_parts(_scale, bj.rows.partition, bj.values)
+            variants.append(bj)
+        bad = b.copy()
+
+        def poison(i, vals):
+            if int(i.part) == 1:
+                np.asarray(vals)[0] = np.nan
+
+        pa.map_parts(poison, bad.rows.partition, bad.values)
+        return A, variants, bad
+
+    A, variants, bad = pa.prun(driver, backend, 4)
+    svc = SolveService(A, kmax=4, retries=0)
+    hs = [
+        svc.submit(bk, tol=1e-10, maxiter=200, tag=f"v{k}")
+        for k, bk in enumerate(variants)
+    ]
+    hbad = svc.submit(bad, tol=1e-10, maxiter=200, tag="poisoned")
+    svc.drain()
+    assert svc.stats["slabs"] == 1  # ONE K=4 compiled slab
+    # the poisoned request: typed failure + full event trail
+    assert hbad.state == "failed"
+    with pytest.raises(NonFiniteError):
+        hbad.result()
+    assert _has_event(hbad.record, "column_verdict")
+    assert _has_event(hbad.record, "column_ejected", "nonfinite")
+    assert _has_event(hbad.record, "request_failed", "poisoned")
+    # every co-batched request: bitwise equal to its solo solve
+    from partitionedarrays_jl_tpu.parallel.tpu import tpu_cg
+
+    for k, (h, bk) in enumerate(zip(hs, variants)):
+        x, info = h.result()
+        x_solo, i_solo = tpu_cg(A, bk, tol=1e-10, maxiter=200)
+        assert info["converged"] and i_solo["converged"]
+        assert info["iterations"] == i_solo["iterations"], k
+        np.testing.assert_array_equal(
+            gather_pvector(x), gather_pvector(x_solo)
+        )
+        n = i_solo["iterations"] + 1
+        np.testing.assert_array_equal(
+            np.asarray(info["residuals"])[:n],
+            np.asarray(i_solo["residuals"])[:n],
+        )
+
+
+def test_device_verdict_disabled_with_health_checks_off(monkeypatch):
+    """PA_HEALTH_CHECKS=0 disables the device per-column verdict along
+    with the guards: `column_health` must agree with the per-column
+    infos (it used to flag 'nonfinite' while `columns` kept the plain
+    solver outcome) and match the host oracle, where no
+    SolverHealthError fires with health off."""
+    import jax
+
+    from partitionedarrays_jl_tpu.parallel.tpu import tpu_block_cg
+
+    backend = pa.TPUBackend(devices=jax.devices()[:4])
+
+    def driver(parts):
+        A, b = _fixture_spd_system(parts)
+        bad = b.copy()
+
+        def poison(i, vals):
+            if int(i.part) == 1:
+                np.asarray(vals)[0] = np.nan
+
+        pa.map_parts(poison, bad.rows.partition, bad.values)
+        return A, b, bad
+
+    A, b, bad = pa.prun(driver, backend, 4)
+    # health ON: BOTH exports flag the poisoned column
+    _, info = tpu_block_cg(
+        A, [b, bad], tol=1e-8, maxiter=8, column_errors="report"
+    )
+    assert info["column_health"][1]["status"] == "nonfinite"
+    assert info["columns"][1]["status"] == "nonfinite"
+    # health OFF (host-side flag, same compiled program): no verdict
+    # anywhere — the two per-column exports still agree
+    monkeypatch.setenv("PA_HEALTH_CHECKS", "0")
+    _, info = tpu_block_cg(
+        A, [b, bad], tol=1e-8, maxiter=8, column_errors="report"
+    )
+    for col, verdict in zip(info["columns"], info["column_health"]):
+        assert verdict["status"] == "ok"
+        assert col["status"] != "nonfinite"
+
+
+def test_service_consumes_bare_block_program(monkeypatch):
+    """Zero extra collectives, pinned structurally: the service's slab
+    solve consumes the SAME cached compiled program as a bare
+    `tpu_block_cg` of the same shape (program-cache hit, byte-identical
+    HLO), and the per-iteration collective count of that program is
+    K-independent through service-shaped parameters."""
+    import jax
+
+    from partitionedarrays_jl_tpu.analysis import collective_counts
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        _block_on_cols_layout,
+        _matrix_operands,
+        device_matrix,
+        make_cg_fn,
+        tpu_block_cg,
+    )
+
+    backend = pa.TPUBackend(devices=jax.devices()[:4])
+
+    def driver(parts):
+        A, b = _fixture_spd_system(parts)
+        return A, b
+
+    A, b = pa.prun(driver, backend, 4)
+    B = [b.copy() for _ in range(4)]
+    # bare block body first: builds and caches the compiled program
+    tpu_block_cg(A, B, tol=1e-8, maxiter=50)
+    hits = telemetry.counter("program_cache.hit")
+    svc = SolveService(A, kmax=4)
+    hs = [svc.submit(bk, tol=1e-8, maxiter=50) for bk in B]
+    svc.drain()
+    for h in hs:
+        h.result()
+    assert telemetry.counter("program_cache.hit") > hits, (
+        "the service must reuse the bare block body's compiled program"
+    )
+    # and that program's per-iteration collective count is K-independent
+    # (the HLO A/B of test_block_cg, re-run at the service's shape)
+    dA = device_matrix(A, backend)
+    ops = _matrix_operands(dA)
+    counts = {}
+    for K in (1, 4):
+        db = _block_on_cols_layout([b] * K, dA)
+        dx0 = _block_on_cols_layout(
+            [pa.PVector.full(0.0, A.cols) for _ in range(K)],
+            dA, with_ghosts=True,
+        )
+        fn = make_cg_fn(dA, tol=1e-8, maxiter=50, rhs_batch=K)
+        counts[K] = collective_counts(fn, db, dx0, db[..., 0], ops)
+    assert any(counts[1].values())
+    assert counts[1] == counts[4], counts

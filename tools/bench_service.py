@@ -1,0 +1,211 @@
+"""Solve-service throughput bench -> SERVICE_BENCH.json.
+
+Two legs, honestly separated:
+
+* **measured service rows** — requests/s THROUGH the service (submit K
+  compatible requests, drain: admission + coalescing + the compiled
+  block slab + result plumbing) vs K sequential solo solves, at
+  K ∈ {1, 4, 8, 16}, fixed trip count (tol far below the dtype floor
+  keeps every column active to maxiter, the same trick as the multirhs
+  protocol). These rows measure what the SERVICE adds on THIS platform
+  — dispatch, batching, verdict reads — and on a CPU host they are an
+  overhead canary, not a device throughput claim.
+* **inherited device bands** — the per-RHS speedup the slab itself
+  delivers is a property of the compiled block program, which the
+  service feeds UNCHANGED (tests/test_service.py pins HLO collective
+  parity against the bare block body, and the service adds zero
+  per-iteration work). The acceptance number therefore inherits from
+  the committed MULTIRHS_BENCH.json device record — the K=8 ≥ 1.5×
+  floor IS the ROADMAP item-1 / round-7 acceptance floor — and
+  `tests/test_doc_consistency.py` asserts the inherited values equal
+  the MULTIRHS record's measured values (cross-artifact traceability),
+  so this artifact can never silently drift from its source.
+
+``--dry-run`` prints without writing; ``--n`` overrides the local
+measurement size (smoke).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+#: Guard bands for the committed artifact. The measured values are the
+#: INHERITED MULTIRHS per-RHS speedups (see module docstring); the K=8
+#: floor of 1.5 is the acceptance criterion. Bounds match
+#: tools/bench_multirhs.py MULTIRHS_BANDS by construction.
+SERVICE_BANDS = {
+    "per_rhs_gain_k8": (1.5, 2.2, "device"),
+    "per_rhs_gain_k16": (1.55, 2.4, "device"),
+}
+
+METHODOLOGY = "v1-service"
+
+KS = (1, 4, 8, 16)
+
+#: Fixed trip count for the local requests/s legs.
+TRIPS = 40
+
+
+def _service_leg(pa, A, x0, bs, tol, maxiter, kmax):
+    """One drained service run over ``bs``; returns wall seconds."""
+    from partitionedarrays_jl_tpu.service import SolveService
+
+    svc = SolveService(A, kmax=kmax)
+    t0 = time.perf_counter()
+    handles = [
+        svc.submit(b, x0=x0, tol=tol, maxiter=maxiter) for b in bs
+    ]
+    svc.drain()
+    wall = time.perf_counter() - t0
+    for h in handles:
+        h.result()  # surface any failure loudly
+    return wall
+
+
+def _solo_leg(pa, A, x0, bs, tol, maxiter):
+    from partitionedarrays_jl_tpu.parallel.tpu import tpu_cg
+
+    t0 = time.perf_counter()
+    for b in bs:
+        tpu_cg(A, b, x0=x0, tol=tol, maxiter=maxiter)
+    return time.perf_counter() - t0
+
+
+def measure_rows(pa, A, x0, rhs_pool, tol, maxiter, reps=3):
+    rows = []
+    for K in KS:
+        bs = [rhs_pool[i % len(rhs_pool)] for i in range(K)]
+        # warm both legs (compile), then median of reps
+        _service_leg(pa, A, x0, bs, tol, maxiter, kmax=K)
+        _solo_leg(pa, A, x0, bs, tol, maxiter)
+        service = sorted(
+            _service_leg(pa, A, x0, bs, tol, maxiter, kmax=K)
+            for _ in range(reps)
+        )[reps // 2]
+        solo = sorted(
+            _solo_leg(pa, A, x0, bs, tol, maxiter) for _ in range(reps)
+        )[reps // 2]
+        rows.append(
+            {
+                "K": K,
+                "service_wall_s": round(service, 9),
+                "solo_wall_s": round(solo, 9),
+                "service_requests_per_s": round(K / service, 6),
+                "solo_requests_per_s": round(K / solo, 6),
+                "service_vs_solo": round(solo / service, 3),
+            }
+        )
+    return rows
+
+
+def main():
+    import importlib.util
+
+    import jax
+
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu.parallel.tpu import TPUBackend
+
+    argv = sys.argv[1:]
+    dry = "--dry-run" in argv
+    n = int(os.environ.get("PA_BENCH_N", "48"))
+    if "--n" in argv:
+        n = int(argv[argv.index("--n") + 1])
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_multirhs", os.path.join(REPO, "tools", "bench_multirhs.py")
+    )
+    bm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bm)
+
+    backend = TPUBackend(devices=jax.devices()[:1])
+    A = pa.prun(
+        lambda parts: bm.assemble_varcoef_poisson(
+            parts, (n, n, n), pa, np.float32
+        ),
+        backend, (1, 1, 1),
+    )
+
+    def _rhs(seed):
+        from partitionedarrays_jl_tpu.parallel.pvector import _write_owned
+
+        v = pa.PVector.full(0.0, A.cols, dtype=np.float32)
+
+        def fill(i, vals):
+            rng = np.random.default_rng(seed + int(i.part))
+            _write_owned(
+                i, vals,
+                rng.standard_normal(i.num_oids).astype(np.float32),
+            )
+
+        pa.map_parts(fill, v.rows.partition, v.values)
+        return v
+
+    rhs_pool = [_rhs(s) for s in range(4)]
+    # tol far below the f32 floor: every column stays active to maxiter,
+    # so both legs run exactly TRIPS iterations per request
+    rows = measure_rows(pa, A, None, rhs_pool, 1e-300, TRIPS)
+
+    mr = json.load(open(os.path.join(REPO, "MULTIRHS_BENCH.json")))
+    mr_by_k = {r["K"]: r for r in mr["curve"]}
+    inherited = {
+        "per_rhs_gain_k8": mr_by_k[8]["per_rhs_speedup_vs_k1"],
+        "per_rhs_gain_k16": mr_by_k[16]["per_rhs_speedup_vs_k1"],
+        "source": "MULTIRHS_BENCH.json",
+        "note": (
+            "the service feeds the identical compiled block program "
+            "(make_cg_fn(rhs_batch=K)) the multirhs record measured — "
+            "tests/test_service.py pins HLO collective parity against "
+            "the bare block body and the service adds zero "
+            "per-iteration work, so the slab's per-RHS speedup is "
+            "inherited, not re-measured; the service rows above "
+            "measure what the service layer itself adds on this "
+            "platform"
+        ),
+    }
+
+    rec = {
+        "methodology": METHODOLOGY,
+        "protocol": (
+            "service rows: requests/s through a drained SolveService "
+            f"(admission + coalescing + block slab) vs {len(KS)} x K "
+            "sequential solo solves, fixed trips (tol below the dtype "
+            f"floor, maxiter={TRIPS}), warmed, median-of-3; device "
+            "per-RHS bands inherited from MULTIRHS_BENCH.json (see "
+            "inherited.note)"
+        ),
+        "n": n,
+        "dofs": n ** 3,
+        "dtype": "float32",
+        "trips": TRIPS,
+        "ks": list(KS),
+        "service_rows": rows,
+        "inherited": inherited,
+        "bands": {},
+    }
+    ok = True
+    for key, (lo, hi, kind) in SERVICE_BANDS.items():
+        v = inherited[key]
+        in_band = lo <= v <= hi
+        rec["bands"][key] = {
+            "lo": lo, "hi": hi, "measured": v, "in_band": in_band,
+            "kind": kind,
+        }
+        ok = ok and (in_band or kind != "device")
+    rec["bands_ok_device"] = ok
+
+    from partitionedarrays_jl_tpu.telemetry import artifacts
+
+    path = os.path.join(REPO, "SERVICE_BENCH.json")
+    artifacts.write(path, rec, tool="bench_service", dry_run=dry)
+
+
+if __name__ == "__main__":
+    main()
