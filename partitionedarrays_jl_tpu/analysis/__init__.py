@@ -16,6 +16,14 @@ usage; `tools/palint.py --check` is the command-line gate):
 * `analysis.env_lint` — AST lint proving every lowering-affecting
   ``PA_*`` env flag is resolved by a registered cache-key site and
   documented in docs/api.md.
+* `analysis.plan_verifier` — paplan: static soundness verification of
+  the exchange PLANS programs are lowered from (host Exchanger,
+  generic index plan, box slice plan): send/recv symmetry, ghost-write
+  race freedom, sparsity coverage, dead slots, ppermute-round
+  validity. ``PA_PLAN_VERIFY=1`` gates construction.
+* `analysis.memory_report` — static per-case memory footprints (carry
+  / plan / operand / peak bytes) and the pinned ``memory-budget``
+  contracts; the committed ``MEMORY_FOOTPRINT.json`` admission table.
 """
 from .contracts import (  # noqa: F401
     CONTRACTS,
@@ -35,6 +43,20 @@ from .env_lint import (  # noqa: F401
     lowering_reads,
 )
 from .matrix import build_reports, run_matrix  # noqa: F401
+from .memory_report import (  # noqa: F401
+    MEMORY_BUDGETS,
+    MEMORY_SCHEMA_VERSION,
+    footprint_table,
+)
+from .plan_verifier import (  # noqa: F401
+    PLAN_CHECKS,
+    PlanDefect,
+    canonical_exchange_fingerprint,
+    plan_fingerprint,
+    plans_equal,
+    referenced_ghosts,
+    verify_plan,
+)
 from .program_report import (  # noqa: F401
     COLLECTIVE_KINDS,
     ProgramReport,
@@ -50,22 +72,32 @@ __all__ = [
     "CONTRACTS",
     "Contract",
     "EnvRead",
+    "MEMORY_BUDGETS",
+    "MEMORY_SCHEMA_VERSION",
     "NON_LOWERING",
+    "PLAN_CHECKS",
+    "PlanDefect",
     "ProgramReport",
     "Violation",
     "WhileLoop",
     "analyze",
     "analyze_text",
     "build_reports",
+    "canonical_exchange_fingerprint",
     "check_contracts",
     "classify",
     "collective_counts",
     "contract_by_name",
     "documented_env_names",
     "env_read_inventory",
+    "footprint_table",
     "key_coverage",
     "lint_env_keys",
     "lower_text",
     "lowering_reads",
+    "plan_fingerprint",
+    "plans_equal",
+    "referenced_ghosts",
     "run_matrix",
+    "verify_plan",
 ]

@@ -285,6 +285,71 @@ def _check_runtime_reconciliation(reports, cases):
     return out
 
 
+def _check_plan_soundness(reports, cases):
+    """The paplan tentpole's contract: every plan a case's program is
+    lowered from — the host column `Exchanger` plus the device plan
+    (box under the default env, generic under the nobox/strict/ABFT
+    envs) — must verify SOUND against the probe operator's sparsity
+    (analysis.plan_verifier: symmetry, ghost-race, coverage,
+    dead-slot, rounds). Cases carry their verification results under
+    ``plan_audit`` when the matrix was built with plan audits
+    (`analysis.matrix.build_reports(with_plans=True)`); absent audits,
+    the contract skips silently like every other."""
+    out = []
+    for name, case in cases.items():
+        audit = case.get("plan_audit")
+        if audit is None:
+            continue
+        for plan_name, defects in sorted(audit["plans"].items()):
+            if defects:
+                first = defects[0]
+                out.append(Violation(
+                    "plan-soundness", [name],
+                    f"{plan_name} plan fails static soundness "
+                    f"verification ({len(defects)} defect(s)); first: "
+                    f"[{first['check']}] {first['message']}",
+                    expected="no plan defects",
+                    found=[f"[{d['check']}] part {d['part']}"
+                           for d in defects[:6]],
+                ))
+    return out
+
+
+def _check_memory_budget(reports, cases):
+    """The memory tentpole's contract: each case's STATIC peak
+    footprint (analysis.memory_report — compiled buffer assignment
+    where a compiled leg exists, conservative shape-sum otherwise)
+    stays under its pinned probe-scale budget, and every matrix case
+    HAS a pinned budget (a new case without one fails loudly, the
+    same discipline the env lint applies to new flags). Skips
+    silently when footprints were not attached
+    (`build_reports(with_memory=True)`)."""
+    from .memory_report import MEMORY_BUDGETS
+
+    out = []
+    for name, case in cases.items():
+        fp = case.get("memory")
+        if fp is None:
+            continue
+        budget = MEMORY_BUDGETS.get(name)
+        if budget is None:
+            out.append(Violation(
+                "memory-budget", [name],
+                "matrix case has no pinned static-memory budget — add "
+                "it to analysis.memory_report.MEMORY_BUDGETS and "
+                "regenerate MEMORY_FOOTPRINT.json",
+                expected="a MEMORY_BUDGETS entry", found=None,
+            ))
+        elif fp["peak_bytes"] > budget:
+            out.append(Violation(
+                "memory-budget", [name],
+                "static peak footprint blew its pinned budget (source: "
+                f"{fp['peak_source']})",
+                expected=f"<= {budget} B", found=f"{fp['peak_bytes']} B",
+            ))
+    return out
+
+
 def _check_copy_budget(reports, cases):
     """The PR 2 buffer-copy canary: the compiled body's ``copy`` count
     is the structural signature of XLA's while-carry copies — the
@@ -345,6 +410,16 @@ CONTRACTS: List[Contract] = [
              "the lowered program's static per-kind collective ops and "
              "bytes (the patrace tentpole)",
              _check_runtime_reconciliation),
+    Contract("plan-soundness",
+             "every plan a case lowers from (host Exchanger + device "
+             "box/generic plan) verifies statically sound against the "
+             "probe operator's sparsity (the paplan tentpole)",
+             _check_plan_soundness),
+    Contract("memory-budget",
+             "per-case static peak footprint (compiled buffer "
+             "assignment or conservative shape-sum) within its pinned "
+             "budget; every case budgeted (the paplan tentpole)",
+             _check_memory_budget),
 ]
 
 

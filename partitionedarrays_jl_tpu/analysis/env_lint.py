@@ -169,6 +169,12 @@ NON_LOWERING: Dict[str, str] = {
         "solve-service solo-retry budget for ejected columns — "
         "host-side recovery policy, outside compiled programs"
     ),
+    "PA_PLAN_VERIFY": (
+        "construction-time plan-soundness gate (analysis.plan_verifier "
+        "at the three plan build sites) — the verifier raises the typed "
+        "PlanSoundnessError or passes; it never changes which plan is "
+        "built or what a program stages from it"
+    ),
     "PA_FAULT_SPEC": (
         "host wire chaos injection — corrupts exchange payloads at run "
         "time on the host path (parallel/faults.py); the compiled-loop "

@@ -29,6 +29,8 @@ def build_reports(
     fast: bool = False,
     with_compiled: bool = False,
     with_runtime: bool = False,
+    with_plans: bool = False,
+    with_memory: bool = False,
     only: Optional[Iterable[str]] = None,
     verbose=None,
 ) -> Tuple[Dict[str, dict], Dict[str, ProgramReport]]:
@@ -39,8 +41,14 @@ def build_reports(
     case's program against the probe system and stashes the finished
     solve's telemetry comms accounting under
     ``cases[name]["runtime_comms"]`` — the measured half the
-    ``static-measured-reconciliation`` contract checks. ``only``
-    restricts to the named cases."""
+    ``static-measured-reconciliation`` contract checks. ``with_plans``
+    statically verifies every plan each case lowers from
+    (`analysis.plan_verifier.audit_case` →
+    ``cases[name]["plan_audit"]``, the ``plan-soundness`` contract's
+    input); ``with_memory`` derives each case's static footprint
+    (`analysis.memory_report` → ``cases[name]["memory"]``, the
+    ``memory-budget`` contract's input). ``only`` restricts to the
+    named cases."""
     from ..parallel.tpu import (
         case_probe_solve,
         case_program_texts,
@@ -67,16 +75,28 @@ def build_reports(
                 f"lowering {name} ..."
                 + (" (+ compiled copy-budget leg)" if compile_this else "")
             )
-        stablehlo, hlo = case_program_texts(
+        stablehlo, hlo, mem = case_program_texts(
             backend, case, with_compiled=compile_this
         )
         reports[name] = analyze_text(stablehlo)
         if compile_this:
             reports[name + "__compiled"] = analyze_text(hlo)
+            if mem is not None:
+                case["memory_stats"] = mem
         if with_runtime:
             if verbose:
                 verbose(f"probe-solving {name} ...")
             case["runtime_comms"] = case_probe_solve(backend, case).comms
+        if with_plans:
+            from .plan_verifier import audit_case
+
+            if verbose:
+                verbose(f"plan audit {name} ...")
+            case["plan_audit"] = audit_case(backend, case)
+    if with_memory:
+        from .memory_report import attach_footprints
+
+        attach_footprints(backend, cases, reports, verbose=verbose)
     return cases, reports
 
 
@@ -85,11 +105,14 @@ def run_matrix(
     fast: bool = False,
     with_compiled: bool = False,
     with_runtime: bool = False,
+    with_plans: bool = False,
+    with_memory: bool = False,
     verbose=None,
 ) -> Tuple[List[Violation], Dict[str, ProgramReport]]:
     """Build reports for the matrix and check every contract."""
     cases, reports = build_reports(
         backend, fast=fast, with_compiled=with_compiled,
-        with_runtime=with_runtime, verbose=verbose,
+        with_runtime=with_runtime, with_plans=with_plans,
+        with_memory=with_memory, verbose=verbose,
     )
     return check_contracts(reports, cases), reports

@@ -134,7 +134,21 @@ class Exchanger:
             return Table(lids.astype(INDEX_DTYPE), gtable.ptrs)
 
         lids_snd = map_parts(_to_lids, partition, gids_snd)
-        return cls(parts_rcv, parts_snd, lids_rcv, lids_snd)
+        ex = cls(parts_rcv, parts_snd, lids_rcv, lids_snd)
+        from ..analysis.plan_verifier import plan_verify_enabled
+
+        if plan_verify_enabled():
+            # opt-in construction-time soundness gate (PA_PLAN_VERIFY=1):
+            # symmetry / ghost-race / coverage defects raise the typed
+            # PlanSoundnessError HERE, before the plan is ever executed
+            # or lowered; off by default so construction pays nothing
+            from ..analysis.plan_verifier import check_plan
+
+            check_plan(
+                ex, parts=partition.part_values(),
+                context="Exchanger.from_partition",
+            )
+        return ex
 
     @classmethod
     def empty(cls, parts: AbstractPData) -> "Exchanger":

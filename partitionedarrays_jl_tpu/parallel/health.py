@@ -86,6 +86,7 @@ __all__ = [
     "SolveDeadlineError",
     "ControllerLostError",
     "SilentCorruptionError",
+    "PlanSoundnessError",
     "health_enabled",
     "exchange_validation_enabled",
     "stagnation_raises",
@@ -184,6 +185,19 @@ class SilentCorruptionError(SolverHealthError):
     case ``diagnostics["sdc"]`` carries the detection/rollback counters.
     Subclasses `SolverHealthError`, so `solve_with_recovery` escalates
     it to a checkpoint restart."""
+
+
+class PlanSoundnessError(SolverHealthError):
+    """A constructed exchange plan failed static soundness
+    verification (``PA_PLAN_VERIFY=1`` — analysis.plan_verifier): an
+    overlapping ghost slot, a dropped/uncovered slot, asymmetric edge
+    counts, a self-send round, or a dead slot. Raised at the plan
+    BUILD site, before any program is lowered from the plan — the
+    static complement of the runtime ABFT/health detectors, which
+    would only see the wrong answer or the hang the malformed plan
+    produces. ``diagnostics["defects"]`` carries the failing check
+    names with part/slot detail; ``diagnostics["checks"]`` the check
+    classes that fired."""
 
 
 # ---------------------------------------------------------------------------

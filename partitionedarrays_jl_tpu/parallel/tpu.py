@@ -560,6 +560,17 @@ def _box_exchange_enabled() -> bool:
     )
 
 
+def _plan_verify_enabled() -> bool:
+    """One-helper-per-mode indirection for ``PA_PLAN_VERIFY`` (the
+    literal read lives in `analysis.plan_verifier.plan_verify_enabled`
+    so the build-site gate and the CLI resolve it identically). A
+    validation toggle: the verifier raises or passes, it never changes
+    which plan is built or what stages."""
+    from ..analysis.plan_verifier import plan_verify_enabled
+
+    return plan_verify_enabled()
+
+
 def _fused_cg_enabled() -> bool:
     """The fused streaming CG body (packed (k, W) carry, one-sweep
     x/r updates + shared-gather dot partials, direction fold riding the
@@ -787,9 +798,18 @@ def device_exchange_plan(rows: PRange, padded: bool = False):
     key = (padded, layout.box_info is not None)
     if key not in cache:
         if layout.box_info is not None:
-            cache[key] = BoxExchangePlan(layout, layout.box_info)
+            plan = BoxExchangePlan(layout, layout.box_info)
         else:
-            cache[key] = DeviceExchangePlan(rows.exchanger, layout)
+            plan = DeviceExchangePlan(rows.exchanger, layout)
+        if _plan_verify_enabled():
+            # opt-in construction-time soundness gate (PA_PLAN_VERIFY=1):
+            # a malformed plan raises the typed PlanSoundnessError HERE,
+            # before any program is lowered from it — zero cost when off,
+            # and never mutates the plan (analysis.plan_verifier)
+            from ..analysis.plan_verifier import check_plan
+
+            check_plan(plan, context="device_exchange_plan")
+        cache[key] = plan
     return cache[key]
 
 
@@ -5679,16 +5699,20 @@ def _matrix_probe_system(backend: "TPUBackend", dtype: str):
 def case_program_texts(
     backend: "TPUBackend", case: dict, with_compiled: bool = False,
     tol: float = 1e-9, maxiter: int = 50,
-) -> Tuple[str, Optional[str]]:
+) -> Tuple[str, Optional[str], Optional[dict]]:
     """The lowering-matrix report hook: build ``case``'s compiled-CG
     program against the fixed probe system ONCE and return
-    ``(stablehlo_text, hlo_text)`` — the optimized-HLO leg (where the
-    ``copy``-budget canary lives) is derived from the same `Lowered`
-    object, not a second trace; it is None unless ``with_compiled``.
-    The case's env overrides are applied around BOTH the matrix staging
-    and the program build, so the program really is the one a user
-    under that environment gets — including the `_lowering_env_key`
-    rekeying path."""
+    ``(stablehlo_text, hlo_text, memory_stats)`` — the optimized-HLO
+    leg (where the ``copy``-budget canary lives) is derived from the
+    same `Lowered` object, not a second trace; it and the memory stats
+    are None unless ``with_compiled``. ``memory_stats`` is the
+    compiled program's XLA buffer assignment
+    (``compile().memory_analysis()`` — argument/output/temp bytes, the
+    static-peak input of `analysis.memory_report`), or None where the
+    runtime does not expose it. The case's env overrides are applied
+    around BOTH the matrix staging and the program build, so the
+    program really is the one a user under that environment gets —
+    including the `_lowering_env_key` rekeying path."""
     env = dict(_MATRIX_BASE_ENV)
     env.update(case.get("env", {}))
     with _env_overrides(env):
@@ -5707,8 +5731,22 @@ def case_program_texts(
             z = np.zeros((L.P, L.W), dtype=np_dtype)
             args = (z, z, z, ops)
         low = fn.jit_fn.lower(*args)
-        compiled = low.compile().as_text() if with_compiled else None
-        return low.as_text(), compiled
+        if not with_compiled:
+            return low.as_text(), None, None
+        compiled = low.compile()
+        mem = None
+        try:
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                mem = {
+                    "argument_bytes": int(ma.argument_size_in_bytes),
+                    "output_bytes": int(ma.output_size_in_bytes),
+                    "temp_bytes": int(ma.temp_size_in_bytes),
+                    "alias_bytes": int(ma.alias_size_in_bytes),
+                }
+        except Exception:
+            mem = None  # older runtimes: memory_report falls back
+        return low.as_text(), compiled.as_text(), mem
 
 
 def case_probe_solve(
@@ -5757,7 +5795,7 @@ def case_program_text(
 ) -> str:
     """One dialect of `case_program_texts` (StableHLO by default,
     optimized HLO with ``compiled=True``)."""
-    stablehlo, hlo = case_program_texts(
+    stablehlo, hlo, _mem = case_program_texts(
         backend, case, with_compiled=compiled, tol=tol, maxiter=maxiter
     )
     return hlo if compiled else stablehlo

@@ -32,6 +32,14 @@ its documented outcome and event trail:
 | queue over depth bound  | admission control   | AdmissionRejected (typed backpressure) + admission_rejected event |
 | deadline past at chunk boundary | service clock | SolveDeadlineError + deadline_expired/health_error events; co-batched requests unaffected |
 | poisoned column in a shared slab | per-column verdict export | that request ejected + typed NonFiniteError; co-batched requests complete clean (column_verdict/column_ejected/request_failed events) |
+
+Round 11 (paplan): a corrupted *plan* (mutated slot indices — not wire
+data) is a fault class every runtime row above is blind to until the
+wrong answer lands; with ``PA_PLAN_VERIFY=1`` it is caught STATICALLY:
+
+| condition               | detector            | documented outcome   |
+|-------------------------|---------------------|----------------------|
+| corrupted exchange plan | static plan verifier at the build site | PlanSoundnessError (typed, with check + part/slot diagnostics) + plan_defect/health_error events, BEFORE any solve runs |
 """
 import numpy as np
 import pytest
@@ -302,6 +310,47 @@ def test_matrix_service_poisoned_column_ejection():
         assert _has_event(rec, "request_failed", "bad")
         # the clean requests' records show no failure of their own
         assert not _has_event(h_good.record, "request_failed", "good")
+        return True
+
+    _run(driver)
+
+
+def test_matrix_corrupted_plan_caught_statically(monkeypatch):
+    """paplan row: a corrupted exchange PLAN — mutated slot indices,
+    the class every runtime detector above would only see as a wrong
+    answer or a hang — is refused at the plan BUILD site under
+    ``PA_PLAN_VERIFY=1``: typed `PlanSoundnessError` with the failing
+    check and part/slot diagnostics, the ``plan_defect`` event
+    emitted, and NO solve ever started."""
+    from partitionedarrays_jl_tpu.parallel.health import PlanSoundnessError
+    from partitionedarrays_jl_tpu.parallel.tpu import device_exchange_plan
+
+    monkeypatch.setenv("PA_PLAN_VERIFY", "1")
+    monkeypatch.setenv("PA_TPU_BOX", "0")  # the generic plan reads lids
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        rows = A.cols
+        # corrupt the host plan in place: an overlapping ghost slot
+        ex = rows.exchanger
+        t = next(t for t in ex.lids_rcv.part_values() if len(t.data) >= 2)
+        t.data[1] = t.data[0]
+        before = telemetry.counter("events.plan_defect")
+        health_before = telemetry.counter("events.health_error")
+        last = telemetry.last_record()
+        with pytest.raises(PlanSoundnessError) as ei:
+            device_exchange_plan(rows)
+        assert "ghost-race" in ei.value.diagnostics["checks"]
+        d = ei.value.diagnostics["defects"][0]
+        assert d["part"] is not None and d["check"] == "ghost-race"
+        # the static catch is narrated (one plan_defect event per
+        # failing check class + the health_error every typed failure
+        # emits) and happened BEFORE any solve — no new SolveRecord
+        assert telemetry.counter("events.plan_defect") == (
+            before + len(ei.value.diagnostics["checks"])
+        )
+        assert telemetry.counter("events.health_error") == health_before + 1
+        assert telemetry.last_record() is last
         return True
 
     _run(driver)

@@ -363,6 +363,69 @@ def test_obs_artifact_agrees_with_guard_bands():
         assert "real TPUs" in rec["note"]
 
 
+def test_memory_footprint_artifact_agrees_with_budgets():
+    """The committed static-memory footprint table (the paplan
+    tentpole's admission-budget artifact, written by
+    ``tools/palint.py --write-memory``) and the ``memory-budget``
+    contract's pinned budgets must agree: identical budget tables
+    (artifact == analysis.memory_report.MEMORY_BUDGETS), one row per
+    FULL-matrix case, every recorded peak inside its budget, and the
+    rows internally consistent (a compiled-leg peak comes from the
+    buffer assignment, everything else from the conservative
+    shape-sum)."""
+    from partitionedarrays_jl_tpu.analysis import memory_report
+    from partitionedarrays_jl_tpu.parallel.tpu import lowering_matrix
+
+    rec = json.load(open(os.path.join(REPO, "MEMORY_FOOTPRINT.json")))
+    assert rec["memory_schema_version"] == (
+        memory_report.MEMORY_SCHEMA_VERSION
+    )
+    assert rec["budgets"] == {
+        k: v for k, v in memory_report.MEMORY_BUDGETS.items()
+    }, "artifact budgets drifted from MEMORY_BUDGETS — regenerate with "\
+       "tools/palint.py --write-memory"
+    names = {c["name"] for c in lowering_matrix(fast=False)}
+    assert set(rec["cases"]) == names, (
+        f"+{set(rec['cases']) - names} -{names - set(rec['cases'])}"
+    )
+    for name, fp in rec["cases"].items():
+        budget = rec["budgets"][name]
+        assert 0 < fp["peak_bytes"] <= budget, (name, fp, budget)
+        assert fp["carry_bytes"] > 0, (name, "solve case must carry state")
+        assert fp["plan_bytes"] > 0 and fp["operand_bytes"] > 0, (name, fp)
+        assert fp["peak_source"] in ("hlo-buffer-assignment", "shape-sum")
+        if fp["peak_source"] == "shape-sum":
+            assert fp["peak_bytes"] == (
+                fp["operand_bytes"] + 2 * fp["carry_bytes"]
+            ), (name, fp)
+    # the shared artifact envelope (telemetry.artifacts)
+    assert rec.get("schema_version") and rec.get("generated_by")
+    assert rec.get("platform") and isinstance(rec.get("pa_env"), dict)
+
+
+def test_repro_artifacts_carry_the_shared_envelope():
+    """tools/bench_repro.py writes through the shared schema-versioned
+    artifact writer — the committed ``docs/repro_r*.json`` records must
+    carry the full envelope like every ``*_BENCH.json`` (round-11
+    port of the two straggler bench tools)."""
+    paths = sorted(
+        f for f in os.listdir(os.path.join(REPO, "docs"))
+        if re.fullmatch(r"repro_r\d+\.json", f)
+    )
+    assert paths, "no committed repro records found"
+    for name in paths:
+        rec = json.load(open(os.path.join(REPO, "docs", name)))
+        assert rec.get("schema_version"), name
+        assert rec.get("generated_by") == "bench_repro", name
+        assert rec.get("platform"), name
+        assert isinstance(rec.get("pa_env"), dict), name
+        # the record body the study documents is still intact
+        assert rec["reps"] == len(rec["halo"]) == len(rec["spmv"]), name
+        for k in ("halo", "halo_host_oracle", "spmv"):
+            s = rec[k + "_stats"]
+            assert s["min"] <= s["median"] <= s["max"], (name, k)
+
+
 def test_every_committed_bench_artifact_is_schema_versioned():
     """Every committed ``*_BENCH.json`` carries the FULL shared artifact
     envelope (telemetry.artifacts): ``schema_version``, the generating

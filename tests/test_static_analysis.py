@@ -506,30 +506,62 @@ def test_lowering_matrix_enumerator_well_formed():
 def _run_matrix(fast, with_runtime=False):
     import jax
 
-    from partitionedarrays_jl_tpu.analysis import run_matrix
+    from partitionedarrays_jl_tpu.analysis import build_reports
+    from partitionedarrays_jl_tpu.analysis import check_contracts as check
     from partitionedarrays_jl_tpu.parallel.tpu import TPUBackend
 
     backend = TPUBackend(devices=jax.devices()[:8])
-    violations, reports = run_matrix(
-        backend, fast=fast, with_compiled=True, with_runtime=with_runtime
+    cases, reports = build_reports(
+        backend, fast=fast, with_compiled=True, with_runtime=with_runtime,
+        with_plans=True, with_memory=True,
     )
+    violations = check(reports, cases)
     assert not violations, "\n".join(str(v) for v in violations)
     # the matrix really lowered: baseline cases present with inventories
     assert reports["standard"].collective_count_total > 0
     assert reports["standard__compiled"].copies <= COPY_BUDGETS["standard"]
-    return reports
+    return cases, reports
 
 
 def test_fast_matrix_contracts_hold():
     """Tier-1: the fast subset of the lowering matrix honors every
     contract (standard/fused/block-K1/K4, the ABFT parity pair, the f32
-    dtype-closure probe, plus both compiled copy-budget legs)."""
-    reports = _run_matrix(fast=True)
+    dtype-closure probe, both compiled copy-budget legs, the per-case
+    plan-soundness audits, and the static memory budgets)."""
+    cases, reports = _run_matrix(fast=True)
     # dtype-closure's compiled leg is live, not dead code: the f32-
     # staged probe gets a compiled-HLO report too, so an f64 op XLA
     # introduces only during compilation would still trip the contract
     assert "standard_f32__compiled" in reports
     assert "f64" not in reports["standard_f32__compiled"].float_dtypes
+    # the plan audits are live: default-env cases verified the BOX
+    # plan, the nobox/ABFT cases the GENERIC plan, all with zero
+    # defects and the host exchanger alongside
+    kinds = {cases[n]["plan_audit"]["kind"] for n in cases}
+    assert kinds == {"device-box", "device-generic"}
+    for n in cases:
+        audit = cases[n]["plan_audit"]
+        assert audit["n_defects"] == 0, (n, audit)
+        assert "host-exchanger" in audit["plans"]
+    # the memory footprints are live, and the compiled cases' peaks
+    # really came from the XLA buffer assignment
+    for n in ("standard", "fused", "standard_f32"):
+        assert cases[n]["memory"]["peak_source"] == "hlo-buffer-assignment"
+    assert cases["standard_nobox"]["memory"]["peak_source"] == "shape-sum"
+    # and the committed artifact matches what this build measured for
+    # the deterministic shape-sum fields (regenerate with
+    # tools/palint.py --write-memory when a lowering legitimately
+    # changes its footprint)
+    import json
+
+    committed = json.load(
+        open(os.path.join(REPO, "MEMORY_FOOTPRINT.json"))
+    )["cases"]
+    for n in cases:
+        fp = cases[n]["memory"]
+        assert committed[n]["carry_bytes"] == fp["carry_bytes"], n
+        assert committed[n]["plan_bytes"] == fp["plan_bytes"], n
+        assert committed[n]["operand_bytes"] == fp["operand_bytes"], n
 
 
 @pytest.mark.slow
@@ -539,9 +571,65 @@ def test_full_matrix_contracts_hold():
     ``with_runtime`` probe-solves every case so the
     static-measured-reconciliation contract (the patrace tentpole's
     acceptance criterion) is checked across ALL 15 cases — the fast
-    probe legs live in tests/test_telemetry.py."""
-    reports = _run_matrix(fast=False, with_runtime=True)
+    probe legs live in tests/test_telemetry.py. Plan audits and memory
+    budgets ride along over the full case set."""
+    cases, reports = _run_matrix(fast=False, with_runtime=True)
     assert "strict_standard" in reports
+    assert all(c["plan_audit"]["n_defects"] == 0 for c in cases.values())
+
+
+# ---------------------------------------------------------------------------
+# negative tests: the two paplan contracts catch seeded regressions
+# (verifier-level negatives live in tests/test_plan_verifier.py)
+# ---------------------------------------------------------------------------
+
+
+def test_plan_soundness_contract_catches_seeded_audit_defect():
+    """A case whose plan audit reports ANY defect must trip the
+    plan-soundness contract; a clean audit must not."""
+    clean = {"name": "probe", "tags": {}, "plan_audit": {
+        "kind": "device-box",
+        "plans": {"host-exchanger": [], "device-box": []},
+        "n_defects": 0,
+    }}
+    ok = check_contracts({}, {"probe": clean})
+    assert not [v for v in ok if v.contract == "plan-soundness"]
+    seeded = {"name": "probe", "tags": {}, "plan_audit": {
+        "kind": "device-box",
+        "plans": {"host-exchanger": [], "device-box": [{
+            "check": "ghost-race", "plan": "device-box", "part": 2,
+            "message": "overlapping segment slot", "details": {},
+        }]},
+        "n_defects": 1,
+    }}
+    bad = check_contracts({}, {"probe": seeded})
+    hits = [v for v in bad if v.contract == "plan-soundness"]
+    assert hits and "ghost-race" in hits[0].message
+
+
+def test_memory_budget_contract_catches_growth_and_missing_budget(
+    monkeypatch,
+):
+    """A footprint past its pinned budget must trip memory-budget; at
+    the budget it must not; and a matrix case with NO pinned budget
+    fails loudly (the new-case discipline)."""
+    from partitionedarrays_jl_tpu.analysis.memory_report import (
+        MEMORY_BUDGETS,
+    )
+
+    fp = {"carry_bytes": 100, "plan_bytes": 10, "operand_bytes": 300,
+          "peak_bytes": 500, "peak_source": "shape-sum"}
+    case = {"name": "probe", "tags": {}, "memory": dict(fp)}
+    monkeypatch.setitem(MEMORY_BUDGETS, "probe", 499)
+    bad = check_contracts({}, {"probe": case})
+    assert [v for v in bad if v.contract == "memory-budget"]
+    monkeypatch.setitem(MEMORY_BUDGETS, "probe", 500)
+    ok = check_contracts({}, {"probe": case})
+    assert not [v for v in ok if v.contract == "memory-budget"]
+    unbudgeted = {"name": "newcase", "tags": {}, "memory": dict(fp)}
+    bad = check_contracts({}, {"newcase": unbudgeted})
+    hits = [v for v in bad if v.contract == "memory-budget"]
+    assert hits and "no pinned" in hits[0].message
 
 
 # ---------------------------------------------------------------------------
@@ -550,9 +638,11 @@ def test_full_matrix_contracts_hold():
 
 
 def test_palint_cli_lint_only_green():
+    # lint-only leg stays jax-free and fast; the plan-soundness leg's
+    # CLI path is exercised in-process by tests/test_plan_verifier.py
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "palint.py"),
-         "--check", "--skip-matrix"],
+         "--check", "--skip-matrix", "--skip-plans"],
         capture_output=True, text=True, timeout=240,
     )
     assert out.returncode == 0, out.stdout + out.stderr
@@ -573,5 +663,5 @@ def test_palint_cli_exits_nonzero_on_violation(monkeypatch):
         env_lint.NON_LOWERING, "PA_TPU_NEVER_READ",
         "a stale exemption the lint must flag as no longer read",
     )
-    rc = palint.main(["--check", "--skip-matrix"])
+    rc = palint.main(["--check", "--skip-matrix", "--skip-plans"])
     assert rc == 1

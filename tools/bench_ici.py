@@ -6,12 +6,17 @@ and the measurement path, NOT interconnect bandwidth. On a machine with
 a real TPU slice, `python bench.py` runs the same legs automatically
 with ``fabric="ici"``.
 
-    python tools/bench_ici.py          # 64^3, 8 virtual CPU devices
+The legs land in ``ICI_BENCH.json`` through the shared schema-versioned
+artifact writer (`telemetry.artifacts` — the same envelope every other
+committed ``*_BENCH.json`` carries and tests/test_doc_consistency.py
+checks); ``--dry-run`` prints the record without committing.
+
+    python tools/bench_ici.py            # 64^3, 8 virtual CPU devices
     PA_ICI_N=96 python tools/bench_ici.py
+    python tools/bench_ici.py --dry-run
 """
 from __future__ import annotations
 
-import json
 import os
 import sys
 
@@ -21,7 +26,8 @@ os.environ["XLA_FLAGS"] = (
     + " --xla_force_host_platform_device_count=8"
 ).strip()
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 import jax  # noqa: E402
 
@@ -30,13 +36,32 @@ jax.config.update("jax_platforms", "cpu")
 
 def main():
     import partitionedarrays_jl_tpu as pa
-    from bench import bench_ici
+    from partitionedarrays_jl_tpu.telemetry import artifacts
+    import bench
 
+    dry = "--dry-run" in sys.argv[1:]
     n = int(os.environ.get("PA_ICI_N", "64"))
     devs = jax.devices()
     assert len(devs) == 8 and devs[0].platform == "cpu", devs
-    for rec in bench_ici(n, devs, pa, "virtual-cpu"):
-        print(json.dumps(rec), flush=True)
+    legs = bench.bench_ici(n, devs, pa, "virtual-cpu")
+    rec = {
+        "methodology": bench.METHODOLOGY,
+        "n": n,
+        "dofs": n ** 3,
+        "fabric": "virtual-cpu",
+        "devices": 8,
+        "legs": legs,
+        "note": (
+            "virtual-cpu fabric: validates the multi-device ppermute "
+            "halo/CG kernels and the measurement path, not interconnect "
+            "bandwidth — real-slice records come from `python bench.py` "
+            "with fabric='ici' (ROADMAP item 3)"
+        ),
+    }
+    artifacts.write(
+        os.path.join(REPO, "ICI_BENCH.json"), rec, tool="bench_ici",
+        dry_run=dry,
+    )
 
 
 if __name__ == "__main__":

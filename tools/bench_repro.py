@@ -6,6 +6,12 @@ value/ratio swing (11.1 GB/s / 137x in docs vs 20.3 GB/s / 65.4x in
 BENCH_r03) can be attributed to the device numerator or the host-oracle
 denominator.
 
+The committed record (``docs/repro_r5.json`` by default) goes through
+the shared schema-versioned artifact writer (`telemetry.artifacts`),
+the same envelope every committed bench artifact carries;
+tests/test_doc_consistency.py checks it. ``--dry-run`` prints without
+committing.
+
     python tools/bench_repro.py          # 5 reps each, ~10 min on chip
     PA_REPRO_REPS=8 python tools/bench_repro.py
 """
@@ -61,10 +67,14 @@ def main():
             "spread_pct": round(100 * (max(v) - min(v)) / statistics.median(v), 1),
         }
     print(json.dumps(out, indent=1), flush=True)
+    from partitionedarrays_jl_tpu.telemetry import artifacts
+
     name = os.environ.get("PA_REPRO_NAME", "repro_r5.json")
-    with open(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "docs", name), "w") as f:
-        json.dump(out, f, indent=1)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", name)
+    artifacts.write(
+        path, out, tool="bench_repro", dry_run="--dry-run" in sys.argv[1:]
+    )
 
 
 if __name__ == "__main__":
