@@ -189,6 +189,18 @@ NON_LOWERING: Dict[str, str] = {
         "either way (the device-visible knob is PA_TRACE_ITERS, which "
         "IS keyed via _trace_config)"
     ),
+    "PA_MON": (
+        "metric-registry instrumentation switch — gates host-side "
+        "histogram/gauge recording and throughput-model updates in the "
+        "solve service; never part of a staged program (the service "
+        "slab stays a program-cache hit against the bare block body "
+        "either way — tests/test_pamon.py)"
+    ),
+    "PA_MON_EWMA": (
+        "EWMA smoothing factor of the host-side online throughput "
+        "model (telemetry/throughput.py) — shapes a measured-cost "
+        "table, never a staged program"
+    ),
     "PA_METRICS_DIR": (
         "telemetry record persistence directory — where finished "
         "SolveRecord JSONs land on the host, never part of a staged "

@@ -33,6 +33,16 @@ Modules:
 * `service.service`  — `SolveService` itself: submit/drain/shutdown,
   chunked deadlines (`SolveDeadlineError`), ejection + solo retry,
   checkpointing drain, telemetry events.
+
+Observability (round 12 — docs/observability.md): the service is
+instrumented end-to-end against `telemetry.registry` — lifecycle
+latency histograms (queue-wait / slab-wait / solve / total), queue and
+slab-utilization gauges, admission/ejection/deadline counters,
+per-tolerance-class SLO attainment — and every finished slab chunk
+feeds the online per-RHS throughput model (`telemetry.throughput`),
+the measured curve the adaptive-K policy reads. ``PA_MON=0`` silences
+the histogram/gauge layer; the compiled programs are identical either
+way (tests/test_pamon.py pins it).
 """
 from .admission import (  # noqa: F401
     AdmissionController,
@@ -42,7 +52,12 @@ from .admission import (  # noqa: F401
     queue_depth,
     slab_kmax,
 )
-from .batcher import compat_key, next_slab, top_up  # noqa: F401
+from .batcher import (  # noqa: F401
+    compat_key,
+    next_slab,
+    queue_compat_profile,
+    top_up,
+)
 from .request import SolveRequest  # noqa: F401
 from .service import SolveService  # noqa: F401
 
@@ -53,6 +68,7 @@ __all__ = [
     "SolveService",
     "compat_key",
     "next_slab",
+    "queue_compat_profile",
     "top_up",
     "queue_depth",
     "slab_kmax",

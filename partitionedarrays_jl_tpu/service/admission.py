@@ -53,7 +53,12 @@ class AdmissionRejected(RuntimeError):
         super().__init__(message)
         self.diagnostics = dict(diagnostics or {})
         from ..telemetry import emit_event
+        from ..telemetry.registry import registry
 
+        # the rejection counter is always-on (pamon's overload signal:
+        # rejected/admitted is the shed-load rate) — the event below
+        # additionally ticks events.admission_rejected
+        registry().counter("service.rejected").inc()
         emit_event(
             "admission_rejected",
             label=str(self.diagnostics.get("reason", "")),

@@ -20,7 +20,7 @@ from typing import List, Tuple
 
 import numpy as np
 
-__all__ = ["compat_key", "next_slab", "top_up"]
+__all__ = ["compat_key", "next_slab", "top_up", "queue_compat_profile"]
 
 
 def compat_key(req) -> Tuple[float, object, str]:
@@ -49,6 +49,31 @@ def next_slab(queue: List, kmax: int) -> List:
             kept.append(req)
     queue[:] = kept
     return picked
+
+
+def queue_compat_profile(queue: List) -> List[dict]:
+    """The coalescing view of a queue: one row per compatibility key,
+    FIFO-ordered by each key's oldest request, with the count of
+    requests that could ride one slab. A fragmented profile (many keys,
+    small counts) means the batcher cannot amortize — the signal
+    `SolveService.queue_profile` exposes to pamon/paserve operators."""
+    order: List[Tuple[float, object, str]] = []
+    counts: dict = {}
+    for req in queue:
+        key = compat_key(req)
+        if key not in counts:
+            counts[key] = 0
+            order.append(key)
+        counts[key] += 1
+    return [
+        {
+            "tol": key[0],
+            "maxiter": key[1],
+            "dtype": key[2],
+            "requests": counts[key],
+        }
+        for key in order
+    ]
 
 
 def top_up(queue: List, slab: List, kmax: int) -> List:

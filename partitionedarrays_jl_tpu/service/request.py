@@ -59,6 +59,11 @@ class SolveRequest:
         self.tag = tag or f"req-{rid}"
         self.state = "queued"
         self.submitted_at: float = 0.0  # stamped by the service
+        #: Service-clock reading at the terminal transition (None while
+        #: queued/running) — submitted_at..finished_at is the request's
+        #: total latency, the `service.total_s` histogram's unit of
+        #: account and the span `tools/patrace.py --service` renders.
+        self.finished_at: Optional[float] = None
         self.iterations = 0  # committed across chunks
         self.record = None  # SolveRecord, opened by the service
         self.checkpoint_path: Optional[str] = None

@@ -46,6 +46,9 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..telemetry.registry import monitoring_enabled, registry
+from ..telemetry.throughput import model as throughput_model
+from ..telemetry.throughput import operator_fingerprint
 from ..utils.helpers import check
 from .admission import (
     AdmissionController,
@@ -57,6 +60,13 @@ from .batcher import compat_key, next_slab, top_up
 from .request import SolveRequest
 
 __all__ = ["SolveService"]
+
+
+def _tol_class(tol: float) -> str:
+    """The SLO tolerance class of a request: its convergence target in
+    one-significant-digit scientific form (1e-08, 1e-06, ...) — the
+    label `service.slo.*` attainment is accounted per."""
+    return f"{float(tol):.0e}"
 
 
 class SolveService:
@@ -100,6 +110,9 @@ class SolveService:
         self.clock = clock if clock is not None else time.monotonic
         self._sleep = sleep if sleep is not None else time.sleep
         self.admission = AdmissionController(queue_depth)
+        #: Structural operator identity: the throughput-model key this
+        #: service's finished slabs report their measured s_per_it under.
+        self.fingerprint = operator_fingerprint(A)
         self._queue: list = []
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
@@ -168,11 +181,16 @@ class SolveService:
                 maxiter=maxiter, deadline=deadline,
             )
             self.stats["admitted"] += 1
+            registry().counter("service.admitted").inc()
             telemetry.emit_event(
                 "request_queued", label=req.tag, tol=float(tol),
                 deadline=deadline, queued=len(self._queue) + 1,
             )
             self._queue.append(req)
+            if monitoring_enabled():
+                registry().gauge("service.queue_depth").set(
+                    len(self._queue)
+                )
             self._cv.notify_all()
             return req
 
@@ -180,15 +198,32 @@ class SolveService:
         with self._lock:
             return len(self._queue)
 
+    def queue_profile(self) -> list:
+        """Per-compat-key composition of the current queue (see
+        `batcher.queue_compat_profile`) — the coalescing-efficiency
+        view `tools/pamon.py`/`tools/paserve.py` render."""
+        from .batcher import queue_compat_profile
+
+        with self._lock:
+            return queue_compat_profile(self._queue)
+
     # ------------------------------------------------------------------
     # synchronous drivers
     # ------------------------------------------------------------------
+
+    def _pop_slab(self) -> list:
+        """`next_slab` plus the queue-depth gauge update (callers hold
+        ``self._lock``)."""
+        slab = next_slab(self._queue, self.kmax)
+        if slab and monitoring_enabled():
+            registry().gauge("service.queue_depth").set(len(self._queue))
+        return slab
 
     def step(self) -> int:
         """Coalesce and run ONE slab; returns the number of requests it
         terminated (0 = queue empty)."""
         with self._lock:
-            slab = next_slab(self._queue, self.kmax)
+            slab = self._pop_slab()
         if not slab:
             return 0
         return self._run_slab(slab)
@@ -225,7 +260,7 @@ class SolveService:
                     self._cv.wait(timeout=0.05)
                 if self._stop or (self._draining and not self._queue):
                     return
-                slab = next_slab(self._queue, self.kmax)
+                slab = self._pop_slab()
             if slab:
                 self._run_slab(slab)
 
@@ -277,7 +312,6 @@ class SolveService:
 
     def _run_slab(self, slab) -> int:
         from .. import telemetry
-        from ..parallel.pvector import PVector
 
         key = compat_key(slab[0])
         tol, key_maxiter, _ = key
@@ -287,6 +321,21 @@ class SolveService:
             else 4 * self.A.rows.ngids
         )
         self.stats["slabs"] += 1
+        reg = registry()
+        slabs = reg.counter("service.slabs").inc()
+        ragged = reg.counter_value("service.slabs_ragged")
+        if len(slab) < self.kmax:
+            ragged = reg.counter("service.slabs_ragged").inc()
+        mon = monitoring_enabled()
+        formed = self.clock()
+        if mon:
+            reg.gauge("service.slab_utilization").set(
+                len(slab) / self.kmax
+            )
+            reg.gauge("service.ragged_fraction").set(ragged / slabs)
+            qw = reg.histogram("service.queue_wait_s")
+            for r in slab:
+                qw.observe(max(0.0, formed - r.submitted_at))
         telemetry.emit_event(
             "slab_formed", label=f"K={len(slab)}",
             requests=[r.tag for r in slab], tol=tol, maxiter=key_maxiter,
@@ -305,6 +354,25 @@ class SolveService:
         chunked = any(r.deadline is not None for r in active)
         targets: dict = {}
         done = 0
+        first_dispatch = True
+        if mon:
+            reg.gauge("service.inflight_slabs").inc()
+        try:
+            done = self._slab_loop(
+                active, X, tol, key, budget, chunked, targets,
+                formed, first_dispatch, mon, reg, done,
+            )
+        finally:
+            if mon:
+                reg.gauge("service.inflight_slabs").dec()
+        return done
+
+    def _slab_loop(self, active, X, tol, key, budget, chunked, targets,
+                   formed, first_dispatch, mon, reg, done):
+        from .. import telemetry
+        from ..parallel.pvector import PVector
+
+        _, key_maxiter, key_dtype = key
         while active:
             remaining = min(budget - r.iterations for r in active)
             step = min(self.chunk, remaining) if chunked else remaining
@@ -318,9 +386,29 @@ class SolveService:
                 ]
             else:
                 X0 = None
+            if mon and first_dispatch:
+                reg.histogram("service.slab_wait_s").observe(
+                    max(0.0, self.clock() - formed)
+                )
+            first_dispatch = False
+            t_solve = time.perf_counter()
             xs, info = self._block_solve(
                 [r.b for r in active], X0, tol, max(1, step)
             )
+            solve_wall = time.perf_counter() - t_solve
+            trips = max(
+                (int(c["iterations"]) for c in info["columns"]),
+                default=0,
+            )
+            if mon:
+                reg.histogram("service.solve_s").observe(solve_wall)
+                if trips > 0:
+                    # the adaptive-K input: measured s_per_it at THIS
+                    # slab width, EWMAed into the throughput model
+                    throughput_model().observe_slab(
+                        self.fingerprint, key_dtype, len(active),
+                        solve_wall / trips, trips,
+                    )
             now = self.clock()
             still = []
             for k, r in enumerate(active):
@@ -366,10 +454,20 @@ class SolveService:
             # the running slab at the chunk boundary
             with self._lock:
                 added = top_up(self._queue, active, self.kmax)
+                if added and mon:
+                    reg.gauge("service.queue_depth").set(len(self._queue))
             for r in added:
                 r._set_state("running")
                 X[r.id] = r.x0
             if added:
+                if mon:
+                    join = self.clock()
+                    qw = reg.histogram("service.queue_wait_s")
+                    for r in added:
+                        qw.observe(max(0.0, join - r.submitted_at))
+                    reg.gauge("service.slab_utilization").set(
+                        (len(active) + len(added)) / self.kmax
+                    )
                 telemetry.emit_event(
                     "slab_formed", label=f"K={len(active) + len(added)}",
                     requests=[r.tag for r in active + added],
@@ -408,6 +506,33 @@ class SolveService:
     # per-request terminal transitions
     # ------------------------------------------------------------------
 
+    def _slo_account(self, req, succeeded: bool) -> None:
+        """Terminal-state SLO bookkeeping: the total-latency histogram
+        for every request, plus — for deadline-carrying requests — the
+        per-tolerance-class attainment counters and the deadline-slack
+        histogram (slack clamps at 0 for missed deadlines so the
+        distribution stays nonnegative; the miss itself is the
+        requests-vs-hits counter gap). The attainment COUNTERS are
+        always on like every other counter; ``PA_MON`` gates only the
+        two histograms here."""
+        req.finished_at = self.clock()
+        reg = registry()
+        elapsed = max(0.0, req.finished_at - req.submitted_at)
+        slack = None
+        if req.deadline is not None:
+            labels = {"tol_class": _tol_class(req.tol)}
+            reg.counter("service.slo.requests", labels=labels).inc()
+            slack = req.deadline - elapsed
+            if succeeded and slack >= 0.0:
+                reg.counter("service.slo.hits", labels=labels).inc()
+        if not monitoring_enabled():
+            return
+        reg.histogram("service.total_s").observe(elapsed)
+        if slack is not None:
+            reg.histogram("service.deadline_slack_s").observe(
+                max(0.0, slack)
+            )
+
     def _finish(self, req, x, col_info, via: Optional[str] = None) -> None:
         from .. import telemetry
 
@@ -423,6 +548,8 @@ class SolveService:
             status=str(info.get("status")), via=via,
         )
         self.stats["completed"] += 1
+        registry().counter("service.completed").inc()
+        self._slo_account(req, succeeded=True)
         req._resolve(x, req.record.finish(info))
 
     def _fail(self, req, error) -> None:
@@ -433,6 +560,8 @@ class SolveService:
             error=type(error).__name__,
         )
         self.stats["failed"] += 1
+        registry().counter("service.failed").inc()
+        self._slo_account(req, succeeded=False)
         req.record.finish_error(error)
         req._fail(error)
 
@@ -445,6 +574,7 @@ class SolveService:
             deadline=req.deadline, elapsed=now - req.submitted_at,
         )
         self.stats["deadline_expired"] += 1
+        registry().counter("service.deadline_expired").inc()
         self._fail(
             req,
             SolveDeadlineError(
@@ -478,6 +608,7 @@ class SolveService:
             iteration=req.iterations, request=req.tag,
         )
         self.stats["ejected"] += 1
+        registry().counter("service.ejected").inc()
         error = verdict.get("error")
         if error is None:
             error = NonFiniteError(
@@ -527,6 +658,7 @@ class SolveService:
             self._fail(req, e)
             return
         self.stats["retried_solo"] += 1
+        registry().counter("service.retried_solo").inc()
         req.iterations += int(info["iterations"])
         self._finish(req, x, info, via="solo_retry")
 
@@ -585,6 +717,8 @@ class SolveService:
             iteration=req.iterations, directory=d,
         )
         self.stats["checkpointed"] += 1
+        registry().counter("service.checkpointed").inc()
+        req.finished_at = self.clock()
         req.record.finish(
             {"status": "checkpointed", "iterations": req.iterations}
         )
@@ -597,6 +731,8 @@ class SolveService:
             "request_suspended", label=req.tag, iteration=req.iterations
         )
         self.stats["suspended"] += 1
+        registry().counter("service.suspended").inc()
+        req.finished_at = self.clock()
         req.record.finish({"status": "suspended"})
         req._set_state("suspended")
 

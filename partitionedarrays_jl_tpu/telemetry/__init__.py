@@ -26,6 +26,27 @@ to the pre-telemetry programs; telemetry ON adds ZERO collectives — the
 lowering flag), everything else is host-side.
 """
 from .artifacts import ARTIFACT_SCHEMA_VERSION, stamp, write  # noqa: F401
+from .histogram import (  # noqa: F401
+    HISTOGRAM_SCHEMA_VERSION,
+    LatencyHistogram,
+    apply_delta,
+)
+from .registry import (  # noqa: F401
+    CATALOG,
+    REGISTRY_SCHEMA_VERSION,
+    MetricSpec,
+    Registry,
+    mon_ewma,
+    monitoring_enabled,
+    registry,
+)
+from .throughput import (  # noqa: F401
+    THROUGHPUT_SCHEMA_VERSION,
+    ThroughputModel,
+    operator_fingerprint,
+    reset_model,
+)
+from .throughput import model as throughput_model  # noqa: F401
 from .comms import (  # noqa: F401
     COMM_KINDS,
     cg_comms_profile,
@@ -67,13 +88,22 @@ from .trace import (  # noqa: F401
 
 __all__ = [
     "ARTIFACT_SCHEMA_VERSION",
+    "CATALOG",
     "COMM_KINDS",
+    "HISTOGRAM_SCHEMA_VERSION",
     "InfoDict",
+    "LatencyHistogram",
+    "MetricSpec",
     "RECORD_SCHEMA_VERSION",
+    "REGISTRY_SCHEMA_VERSION",
+    "Registry",
     "SolveRecord",
+    "THROUGHPUT_SCHEMA_VERSION",
     "TRACE_SCHEMA_VERSION",
     "TelemetryEvent",
+    "ThroughputModel",
     "annotate",
+    "apply_delta",
     "begin_record",
     "bump",
     "cg_comms_profile",
@@ -89,14 +119,20 @@ __all__ = [
     "list_persisted_records",
     "load_record",
     "metrics_dir",
+    "mon_ewma",
+    "monitoring_enabled",
     "observed_comms",
+    "operator_fingerprint",
     "reconcile",
     "record_history",
     "record_trace_events",
+    "registry",
     "reset_counters",
+    "reset_model",
     "solve_scope",
     "stamp",
     "telemetry_enabled",
+    "throughput_model",
     "write",
     "write_chrome_trace",
 ]

@@ -1,36 +1,34 @@
-"""Process-wide metrics registry: named monotonic counters.
+"""Process-wide counters — the PR 6 compat surface of the typed
+registry.
 
-The observability layer's cheapest tier — plain host-side integers, no
-device work, no collectives, no I/O. Everything that used to be
-invisible bookkeeping (compile-cache hits, lowering restagings, solver
-events) bumps a counter here, and tests assert on the counters instead
-of on wall-clock proxies (the `tests/test_compile_cache.py` rewrite:
-the old "compile-time floor" assertions were flaky exactly because they
-inferred cache behavior from timing).
+PR 9 (pamon) replaced this module's private counter dict with
+`telemetry.registry.Registry` (typed counters/gauges/histograms behind
+ONE shared lock); the functions here keep their exact PR 6 signatures
+and semantics so every existing call site and test holds:
 
-Counter namespaces in use:
+* ``bump``/``get``/``snapshot``/``reset`` operate on the registry's
+  COUNTERS (``snapshot`` returns the flat name->int dict it always
+  did; labeled counters are out of scope of this view — read them via
+  ``registry().snapshot()``).
+* Counters are always on (a guarded int increment); the ``PA_METRICS``
+  kill switch gates the record/event layer only, and the new ``PA_MON``
+  switch gates only the histogram/gauge instrumentation — neither
+  reaches these.
+* The thread-safety fix rides along: counter increments, the record
+  history ring (record.py), and the service worker's metric updates
+  all serialize on `registry().lock` — previously this module and
+  record.py carried separate locks and the per-record event lists were
+  appended without one (hammer-tested in tests/test_pamon.py).
 
-* ``lowering_cache.{hit,miss,stale_rekey}`` — `device_matrix`'s
-  per-matrix staging cache. ``stale_rekey`` counts misses on a matrix
-  that WAS staged before under a different `_lowering_env_key` (an env
-  flip re-ran staging admission — the palint bug class, now measurable).
-* ``program_cache.{hit,miss}`` — `_krylov_fn_for`'s compiled-program
-  cache on a DeviceMatrix.
-* ``persistent_cache.{hit,miss}`` — JAX's on-disk XLA executable cache,
-  bridged from ``jax.monitoring`` events (best-effort: the event names
-  are jax-internal; a rename degrades to counters stuck at 0, never an
-  error).
-* ``events.<kind>`` — one bump per telemetry event emitted
-  (`telemetry.record.emit_event`).
-
-All reads are dynamic; `reset()` exists for tests. Counters are always
-on (they are a dict increment); the record/event layer's ``PA_METRICS``
-kill switch does not gate them.
+Counter namespaces in use: see `telemetry.registry.CATALOG` (the
+reviewed metric surface, machine-checked against the
+docs/observability.md catalog table).
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional
+
+from .registry import registry
 
 __all__ = [
     "bump",
@@ -40,38 +38,28 @@ __all__ = [
     "install_jax_cache_listeners",
 ]
 
-_lock = threading.Lock()
-_counters: Dict[str, int] = {}
-
 
 def bump(name: str, n: int = 1) -> int:
     """Increment counter ``name`` by ``n`` and return the new value."""
-    with _lock:
-        v = _counters.get(name, 0) + int(n)
-        _counters[name] = v
-        return v
+    return registry().counter(name).inc(n)
 
 
 def get(name: str) -> int:
-    return _counters.get(name, 0)
+    return registry().counter_value(name)
 
 
 def snapshot(prefix: Optional[str] = None) -> Dict[str, int]:
-    """A copy of the current counters (optionally filtered by prefix)."""
-    with _lock:
-        if prefix is None:
-            return dict(_counters)
-        return {k: v for k, v in _counters.items() if k.startswith(prefix)}
+    """A copy of the current (unlabeled) counters, optionally filtered
+    by prefix — the flat PR 6 view."""
+    snap = registry().snapshot(prefix)
+    return {k: v for k, v in snap["counters"].items() if "{" not in k}
 
 
 def reset(prefix: Optional[str] = None) -> None:
-    """Zero the registry (tests); with ``prefix``, only that namespace."""
-    with _lock:
-        if prefix is None:
-            _counters.clear()
-        else:
-            for k in [k for k in _counters if k.startswith(prefix)]:
-                del _counters[k]
+    """Zero the registry (tests); with ``prefix``, only that namespace.
+    Resets EVERY metric kind under the prefix, not just counters — the
+    PR 6 semantics generalized."""
+    registry().reset(prefix)
 
 
 _jax_listeners_attempted = False

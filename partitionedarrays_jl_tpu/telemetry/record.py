@@ -44,13 +44,13 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from . import metrics
+from .registry import registry
 
 __all__ = [
     "RECORD_SCHEMA_VERSION",
@@ -190,15 +190,24 @@ class SolveRecord:
     # -- event log -------------------------------------------------------
     def event(self, kind: str, label: str = "",
               iteration: Optional[int] = None, **details) -> None:
+        # enabled is immutable after construction: keep the inert-record
+        # path free (no allocation, no clock read, no lock — the
+        # PA_METRICS=0 contract)
         if not self.enabled or self.finished:
             return
-        self.events.append(
-            TelemetryEvent(
-                kind=kind, label=label,
-                iteration=None if iteration is None else int(iteration),
-                t=time.perf_counter() - self._t0, details=details,
-            )
+        ev = TelemetryEvent(
+            kind=kind, label=label,
+            iteration=None if iteration is None else int(iteration),
+            t=time.perf_counter() - self._t0, details=details,
         )
+        # append under the shared registry lock: the service worker and
+        # the submitting thread both emit into the same active records
+        # (finished re-checked — a race with finish() must not append
+        # to a retired record)
+        with registry().lock:
+            if self.finished:
+                return
+            self.events.append(ev)
 
     def events_of(self, kind: str) -> List[TelemetryEvent]:
         return [e for e in self.events if e.kind == kind]
@@ -278,7 +287,13 @@ class SolveRecord:
 # active-record stack + finished-record ring
 # ---------------------------------------------------------------------------
 
-_lock = threading.Lock()
+#: The stack and ring share the REGISTRY lock (an RLock): the service
+#: background worker mutates counters, records, and the ring from its
+#: thread while the submitting thread does the same — one lock means
+#: one ordering (the PR 9 thread-safety satellite; hammer-tested in
+#: tests/test_pamon.py). Previously this module carried its own lock
+#: and `SolveRecord.event` appended with none at all.
+_lock = registry().lock
 _stack: List[SolveRecord] = []
 _history: List[SolveRecord] = []
 _seq = 0

@@ -1,0 +1,438 @@
+"""The typed process-wide metric registry (pamon's data plane).
+
+PR 6 left the process with ONE metric type — the ad-hoc counter dict in
+`telemetry.metrics` — and the solve service (PR 7) runs blind: no queue
+depth, no latency distributions, no SLO accounting. This module is the
+typed successor: counters (monotonic), gauges (set/inc/dec), and
+histograms (`telemetry.histogram.LatencyHistogram` — fixed buckets,
+mergeable, deterministic), all behind ONE lock, with JSON and
+Prometheus-text exporters and a declared CATALOG that
+docs/observability.md's metric table is machine-checked against
+(tests/test_doc_consistency.py).
+
+Design rules:
+
+* **One lock.** `Registry.lock` serializes every mutation — counters,
+  gauges, histogram observations, AND the telemetry history ring in
+  `record.py` (which used to carry its own lock; the service background
+  worker mutates both from its thread, so they share this one —
+  hammer-tested in tests/test_pamon.py).
+* **Counters are always on** (a guarded int increment): the PA 6
+  contract that tests assert cache behavior on counters holds under
+  every env. The richer instrumentation — histograms/gauges bumped by
+  the service hot path — is gated by ``PA_MON`` (default on; `0` turns
+  the observe/set calls into no-ops at the call sites). ``PA_METRICS``
+  keeps its PR 6 meaning untouched: it kills the RECORD/EVENT layer
+  only, never the registry.
+* **Declared metrics.** Everything the package itself bumps is declared
+  in `CATALOG` (name -> kind/unit/labels/where/desc). Undeclared names
+  still work (tests, ad-hoc probes) but are invisible to the doc
+  check — the catalog is the reviewed metric surface.
+* **Zero device impact.** Nothing here can reach a traced program:
+  the registry is host-side Python; the overhead pin (service slab is
+  a program-cache HIT with the registry fully enabled) lives in
+  tests/test_pamon.py, and the measured metrics-on/off throughput
+  marginal is banded in SERVICE_BENCH.json.
+
+Env knobs (host-side, NON_LOWERING-exempt with reasons):
+
+* ``PA_MON`` (default ``1``) — service/solver instrumentation switch:
+  `0` stops histogram/gauge recording and throughput-model updates
+  (counters and the PR 6 record layer are unaffected).
+* ``PA_MON_EWMA`` (default ``0.25``) — EWMA smoothing factor of the
+  online throughput model (`telemetry.throughput`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+from .histogram import LatencyHistogram
+
+__all__ = [
+    "REGISTRY_SCHEMA_VERSION",
+    "CATALOG",
+    "MetricSpec",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "registry",
+    "monitoring_enabled",
+    "mon_ewma",
+]
+
+REGISTRY_SCHEMA_VERSION = 1
+
+
+def monitoring_enabled() -> bool:
+    """The PA_MON switch: gates histogram/gauge instrumentation and
+    throughput-model updates (NOT counters, NOT the record layer)."""
+    return os.environ.get("PA_MON", "1") != "0"
+
+
+def mon_ewma() -> float:
+    """PA_MON_EWMA in (0, 1]; out-of-range or unparsable -> 0.25."""
+    try:
+        v = float(os.environ.get("PA_MON_EWMA", "0.25") or "0.25")
+    except ValueError:
+        return 0.25
+    return v if 0.0 < v <= 1.0 else 0.25
+
+
+class MetricSpec:
+    """One catalog row: the reviewed identity of a declared metric."""
+
+    __slots__ = ("name", "kind", "unit", "labels", "where", "desc")
+
+    def __init__(self, name: str, kind: str, unit: str, where: str,
+                 desc: str, labels: Tuple[str, ...] = ()):
+        assert kind in ("counter", "gauge", "histogram"), kind
+        self.name = name
+        self.kind = kind
+        self.unit = unit
+        self.labels = tuple(labels)
+        self.where = where
+        self.desc = desc
+
+
+def _spec(name, kind, unit, where, desc, labels=()):
+    return MetricSpec(name, kind, unit, where, desc, labels)
+
+
+#: The reviewed metric surface. docs/observability.md's catalog table is
+#: machine-checked against THIS dict both directions
+#: (tests/test_doc_consistency.py) — add the doc row when you add an
+#: entry. ``events.*`` is the one wildcard family (one counter per
+#: telemetry event kind; the kinds are docs/observability.md's event
+#: catalog).
+CATALOG: Dict[str, MetricSpec] = {
+    s.name: s
+    for s in [
+        # -- PR 6 cache/event counters (absorbed from metrics.py) -----
+        _spec("lowering_cache.hit", "counter", "1",
+              "parallel/tpu.py:device_matrix",
+              "per-matrix staging cache hit"),
+        _spec("lowering_cache.miss", "counter", "1",
+              "parallel/tpu.py:device_matrix",
+              "first staging of a matrix onto a backend"),
+        _spec("lowering_cache.stale_rekey", "counter", "1",
+              "parallel/tpu.py:device_matrix",
+              "staging re-run because a lowering env flag flipped"),
+        _spec("program_cache.hit", "counter", "1",
+              "parallel/tpu.py:_krylov_fn_for",
+              "compiled-program cache hit on a DeviceMatrix"),
+        _spec("program_cache.miss", "counter", "1",
+              "parallel/tpu.py:_krylov_fn_for",
+              "compiled-program cache miss (build + compile)"),
+        _spec("persistent_cache.hit", "counter", "1",
+              "telemetry/metrics.py:install_jax_cache_listeners",
+              "JAX on-disk XLA executable cache hit (jax.monitoring)"),
+        _spec("persistent_cache.miss", "counter", "1",
+              "telemetry/metrics.py:install_jax_cache_listeners",
+              "JAX on-disk XLA executable cache miss"),
+        _spec("events.*", "counter", "1",
+              "telemetry/record.py:emit_event",
+              "one counter per telemetry event kind emitted"),
+        # -- service lifecycle counters -------------------------------
+        _spec("service.admitted", "counter", "1",
+              "service/service.py:submit",
+              "requests admitted past the bounded queue"),
+        _spec("service.rejected", "counter", "1",
+              "service/admission.py:AdmissionRejected",
+              "typed admission backpressure (queue_full or draining)"),
+        _spec("service.completed", "counter", "1",
+              "service/service.py:_finish",
+              "requests resolved with a result"),
+        _spec("service.failed", "counter", "1",
+              "service/service.py:_fail",
+              "requests terminated with a typed error"),
+        _spec("service.ejected", "counter", "1",
+              "service/service.py:_eject",
+              "poisoned columns ejected from a shared slab"),
+        _spec("service.retried_solo", "counter", "1",
+              "service/service.py:_eject",
+              "ejected requests healed by a solo retry"),
+        _spec("service.deadline_expired", "counter", "1",
+              "service/service.py:_expire",
+              "requests failed typed at a chunk boundary past deadline"),
+        _spec("service.checkpointed", "counter", "1",
+              "service/service.py:_checkpoint",
+              "in-flight iterates checkpointed by a non-drain shutdown"),
+        _spec("service.suspended", "counter", "1",
+              "service/service.py:_suspend",
+              "never-started requests suspended by a non-drain shutdown"),
+        _spec("service.slabs", "counter", "1",
+              "service/service.py:_run_slab",
+              "slabs formed (top-up re-formations extend an existing "
+              "slab and are not re-counted)"),
+        _spec("service.slabs_ragged", "counter", "1",
+              "service/service.py:_run_slab",
+              "slabs narrower than kmax (ragged leftovers)"),
+        # -- service gauges (PA_MON-gated) ----------------------------
+        _spec("service.queue_depth", "gauge", "requests",
+              "service/service.py:submit/_pop_slab",
+              "queued requests right now"),
+        _spec("service.inflight_slabs", "gauge", "slabs",
+              "service/service.py:_run_slab",
+              "slabs currently executing"),
+        _spec("service.slab_utilization", "gauge", "fraction",
+              "service/service.py:_run_slab",
+              "K-used / kmax of the most recent slab"),
+        _spec("service.ragged_fraction", "gauge", "fraction",
+              "service/service.py:_run_slab",
+              "cumulative slabs_ragged / slabs"),
+        # -- service latency histograms (PA_MON-gated) ----------------
+        _spec("service.queue_wait_s", "histogram", "s",
+              "service/service.py:_run_slab",
+              "submit -> slab formation wait per request"),
+        _spec("service.slab_wait_s", "histogram", "s",
+              "service/service.py:_run_slab",
+              "slab formation -> block-solve dispatch per slab"),
+        _spec("service.solve_s", "histogram", "s",
+              "service/service.py:_run_slab",
+              "block-solve wall per slab chunk"),
+        _spec("service.total_s", "histogram", "s",
+              "service/service.py:_finish/_fail",
+              "submit -> terminal state per request"),
+        _spec("service.deadline_slack_s", "histogram", "s",
+              "service/service.py:_slo_account",
+              "deadline minus elapsed at terminal state (met deadlines; "
+              "clamped at 0 for missed ones)"),
+        # -- SLO accounting (labeled by tolerance class) --------------
+        _spec("service.slo.requests", "counter", "1",
+              "service/service.py:_slo_account",
+              "deadline-carrying requests reaching a terminal state",
+              labels=("tol_class",)),
+        _spec("service.slo.hits", "counter", "1",
+              "service/service.py:_slo_account",
+              "deadline-carrying requests that finished within deadline",
+              labels=("tol_class",)),
+    ]
+}
+
+
+def _labels_key(labels: Optional[dict]) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic named counter (one label set)."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.value = 0
+
+    def inc(self, n: int = 1) -> int:
+        with self._lock:
+            self.value += int(n)
+            return self.value
+
+
+class Gauge:
+    """Last-value gauge with inc/dec."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> float:
+        with self._lock:
+            self.value += float(n)
+            return self.value
+
+    def dec(self, n: float = 1.0) -> float:
+        return self.inc(-n)
+
+
+class Histogram:
+    """A registry-held `LatencyHistogram` (shared lock)."""
+
+    __slots__ = ("_lock", "hist")
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.hist = LatencyHistogram()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.hist.observe(v)
+
+    @property
+    def count(self) -> int:
+        return self.hist.total
+
+    def quantile(self, q: float):
+        with self._lock:
+            return self.hist.quantile(q)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return self.hist.snapshot()
+
+
+class Registry:
+    """The typed metric registry (see module docstring). Metrics are
+    created on first touch; a declared name must be touched with its
+    declared kind (a `lowering_cache.hit` gauge is a bug, not a new
+    metric)."""
+
+    def __init__(self):
+        #: THE lock: every registry mutation AND the telemetry history
+        #: ring (record.py) serialize on it.
+        self.lock = threading.RLock()
+        self._metrics: Dict[Tuple[str, tuple], object] = {}
+
+    # -- creation / access ----------------------------------------------
+    def _get(self, name: str, labels: Optional[dict], cls):
+        kind = {Counter: "counter", Gauge: "gauge",
+                Histogram: "histogram"}[cls]
+        spec = CATALOG.get(name) or (
+            CATALOG.get("events.*") if name.startswith("events.") else None
+        )
+        if spec is not None and spec.kind != kind:
+            raise TypeError(
+                f"metric {name!r} is declared a {spec.kind}, not a {kind}"
+            )
+        key = (name, _labels_key(labels))
+        with self.lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._metrics[key] = cls(self.lock)
+            return m
+
+    def counter(self, name: str, labels: Optional[dict] = None) -> Counter:
+        return self._get(name, labels, Counter)
+
+    def gauge(self, name: str, labels: Optional[dict] = None) -> Gauge:
+        return self._get(name, labels, Gauge)
+
+    def histogram(self, name: str,
+                  labels: Optional[dict] = None) -> Histogram:
+        return self._get(name, labels, Histogram)
+
+    # -- reading ---------------------------------------------------------
+    def counter_value(self, name: str,
+                      labels: Optional[dict] = None) -> int:
+        m = self._metrics.get((name, _labels_key(labels)))
+        return m.value if isinstance(m, Counter) else 0
+
+    def snapshot(self, prefix: Optional[str] = None) -> dict:
+        """One JSON-safe dict of everything (optionally name-filtered):
+        the exchange format `tools/pamon.py` renders and `--watch`
+        diffs. Deterministic ordering, no wall-clock fields."""
+        with self.lock:
+            items = sorted(
+                (k, m) for k, m in self._metrics.items()
+                if prefix is None or k[0].startswith(prefix)
+            )
+            out: dict = {
+                "registry_schema_version": REGISTRY_SCHEMA_VERSION,
+                "counters": {},
+                "gauges": {},
+                "histograms": {},
+            }
+            for (name, lk), m in items:
+                full = name if not lk else (
+                    name + "{" + ",".join(f"{k}={v}" for k, v in lk) + "}"
+                )
+                if isinstance(m, Counter):
+                    out["counters"][full] = m.value
+                elif isinstance(m, Gauge):
+                    out["gauges"][full] = m.value
+                else:
+                    out["histograms"][full] = m.hist.snapshot()
+            return out
+
+    def to_json(self, prefix: Optional[str] = None) -> str:
+        return json.dumps(self.snapshot(prefix), sort_keys=True, indent=1)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition: dotted names become
+        ``pa_``-prefixed underscore names; histograms render cumulative
+        ``le`` buckets + ``_sum``/``_count`` per convention."""
+        from .histogram import BUCKET_BOUNDS
+
+        lines = []
+        typed = set()
+
+        def pname(name):
+            return "pa_" + name.replace(".", "_").replace("*", "all")
+
+        def plabels(lk, extra=None):
+            parts = [f'{k}="{v}"' for k, v in lk]
+            if extra:
+                parts.append(extra)
+            return "{" + ",".join(parts) + "}" if parts else ""
+
+        # render UNDER the lock: a histogram observed mid-scrape must
+        # not emit le-buckets disagreeing with its _count/_sum (the
+        # torn-read class the one-lock contract exists to close)
+        with self.lock:
+            for (name, lk), m in sorted(self._metrics.items()):
+                pn = pname(name)
+                kind = (
+                    "counter" if isinstance(m, Counter)
+                    else "gauge" if isinstance(m, Gauge)
+                    else "histogram"
+                )
+                if pn not in typed:
+                    spec = CATALOG.get(name)
+                    if spec is not None:
+                        lines.append(f"# HELP {pn} {spec.desc}")
+                    lines.append(f"# TYPE {pn} {kind}")
+                    typed.add(pn)
+                if isinstance(m, Counter):
+                    lines.append(f"{pn}{plabels(lk)} {m.value}")
+                elif isinstance(m, Gauge):
+                    lines.append(f"{pn}{plabels(lk)} {m.value:g}")
+                else:
+                    cum = 0
+                    for i, edge in enumerate(BUCKET_BOUNDS):
+                        cum += m.hist.counts[i]
+                        le = 'le="%g"' % edge
+                        lines.append(
+                            f"{pn}_bucket{plabels(lk, le)} {cum}"
+                        )
+                    cum += m.hist.counts[len(BUCKET_BOUNDS)]
+                    inf = 'le="+Inf"'
+                    lines.append(f"{pn}_bucket{plabels(lk, inf)} {cum}")
+                    lines.append(f"{pn}_sum{plabels(lk)} {m.hist.sum:g}")
+                    lines.append(
+                        f"{pn}_count{plabels(lk)} {m.hist.total}"
+                    )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    # -- maintenance -----------------------------------------------------
+    def reset(self, prefix: Optional[str] = None) -> None:
+        with self.lock:
+            if prefix is None:
+                self._metrics.clear()
+            else:
+                for k in [k for k in self._metrics
+                          if k[0].startswith(prefix)]:
+                    del self._metrics[k]
+
+    def names(self) -> Iterable[str]:
+        with self.lock:
+            return sorted({k[0] for k in self._metrics})
+
+
+#: THE process-wide registry instance.
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    return _REGISTRY

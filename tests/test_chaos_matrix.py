@@ -33,6 +33,13 @@ its documented outcome and event trail:
 | deadline past at chunk boundary | service clock | SolveDeadlineError + deadline_expired/health_error events; co-batched requests unaffected |
 | poisoned column in a shared slab | per-column verdict export | that request ejected + typed NonFiniteError; co-batched requests complete clean (column_verdict/column_ejected/request_failed events) |
 
+Round 12 (pamon): each service row ALSO asserts its METRIC deltas —
+the registry counters and histogram counts the incident must move
+(rejection/expiry/ejection counters, total-latency and SLO
+accounting), so the event log and the metrics plane can never
+silently drift apart: an incident that narrates but does not count
+(or counts but does not narrate) fails here.
+
 Round 11 (paplan): a corrupted *plan* (mutated slot indices — not wire
 data) is a fault class every runtime row above is blind to until the
 wrong answer lands; with ``PA_PLAN_VERIFY=1`` it is caught STATICALLY:
@@ -71,6 +78,25 @@ def _has_event(rec, kind, label=None):
         e.kind == kind and (label is None or e.label == label)
         for e in rec.events
     )
+
+
+def _metric_state(*names):
+    """Counter values + histogram counts before an incident (the
+    service rows assert exact DELTAS against this, not absolutes — the
+    registry is process-wide and other tests feed it)."""
+    reg = telemetry.registry()
+    out = {}
+    for name in names:
+        if name.endswith("_s"):
+            out[name] = reg.histogram(name).count
+        elif "{" in name:
+            base, cls = name.split("{", 1)
+            out[name] = reg.counter(
+                base, labels={"tol_class": cls.rstrip("}")}
+            ).value
+        else:
+            out[name] = telemetry.counter(name)
+    return out
 
 
 def test_matrix_nan_typed_then_recovers():
@@ -226,13 +252,23 @@ def test_matrix_service_admission_rejected():
         svc = SolveService(A, queue_depth=1)
         held = svc.submit(b, x0=x0, tol=1e-9, tag="held")
         before = telemetry.counter("events.admission_rejected")
+        m0 = _metric_state("service.rejected", "service.admitted",
+                           "service.completed")
         with pytest.raises(AdmissionRejected) as ei:
             svc.submit(b, x0=x0, tol=1e-9, tag="over")
         assert ei.value.diagnostics["reason"] == "queue_full"
         assert telemetry.counter("events.admission_rejected") == before + 1
+        # the metrics plane counted the same incident the event log
+        # narrated: one rejection, zero admissions
+        m1 = _metric_state("service.rejected", "service.admitted",
+                           "service.completed")
+        assert m1["service.rejected"] == m0["service.rejected"] + 1
+        assert m1["service.admitted"] == m0["service.admitted"]
         # the queued request is untouched by the rejection
         svc.drain()
         assert held.result()[1]["converged"]
+        m2 = _metric_state("service.completed")
+        assert m2["service.completed"] == m0["service.completed"] + 1
         return True
 
     _run(driver)
@@ -255,6 +291,12 @@ def test_matrix_service_deadline_expiry():
             return t["now"]
 
         svc = SolveService(A, kmax=2, chunk=4, clock=clock)
+        m0 = _metric_state(
+            "service.deadline_expired", "service.failed",
+            "service.completed", "service.total_s",
+            "service.deadline_slack_s", "service.slo.requests{1e-09}",
+            "service.slo.hits{1e-09}",
+        )
         rd = svc.submit(b, x0=x0, tol=1e-9, deadline=0.5, tag="tight")
         rf = svc.submit(b, x0=x0, tol=1e-9, tag="free")
         svc.drain()
@@ -266,6 +308,22 @@ def test_matrix_service_deadline_expiry():
         assert _has_event(rec, "deadline_expired", "tight")
         assert _has_event(rec, "health_error", "SolveDeadlineError")
         assert _has_event(rec, "request_failed", "tight")
+        # metric deltas, not just events: the expiry counted, both
+        # requests' total latencies landed, and the SLO accounting for
+        # the 1e-09 class saw one deadline-carrying request and NO hit
+        m1 = _metric_state(
+            "service.deadline_expired", "service.failed",
+            "service.completed", "service.total_s",
+            "service.deadline_slack_s", "service.slo.requests{1e-09}",
+            "service.slo.hits{1e-09}",
+        )
+        d = {k: m1[k] - m0[k] for k in m0}
+        assert d["service.deadline_expired"] == 1, d
+        assert d["service.failed"] == 1 and d["service.completed"] == 1, d
+        assert d["service.total_s"] == 2, d
+        assert d["service.deadline_slack_s"] == 1, d
+        assert d["service.slo.requests{1e-09}"] == 1, d
+        assert d["service.slo.hits{1e-09}"] == 0, d
         return True
 
     _run(driver)
@@ -290,11 +348,31 @@ def test_matrix_service_poisoned_column_ejection():
 
         pa.map_parts(poison, bad.rows.partition, bad.values)
         svc = SolveService(A, kmax=3, retries=0)
+        m0 = _metric_state(
+            "service.ejected", "service.failed", "service.completed",
+            "service.retried_solo", "service.slabs",
+            "service.queue_wait_s", "service.total_s",
+        )
         h_good = svc.submit(b, x0=x0, tol=1e-9, tag="good")
         h_bad = svc.submit(bad, x0=x0, tol=1e-9, tag="bad")
         h_good2 = svc.submit(b, x0=x0, tol=1e-9, tag="good2")
         svc.drain()
         assert svc.stats["slabs"] == 1  # one shared slab
+        # metric deltas: one slab, one ejection (NO solo retry —
+        # retries=0), one failure, two completions, and all three
+        # requests' queue-wait + total-latency observations
+        m1 = _metric_state(
+            "service.ejected", "service.failed", "service.completed",
+            "service.retried_solo", "service.slabs",
+            "service.queue_wait_s", "service.total_s",
+        )
+        d = {k: m1[k] - m0[k] for k in m0}
+        assert d["service.slabs"] == 1, d
+        assert d["service.ejected"] == 1, d
+        assert d["service.retried_solo"] == 0, d
+        assert d["service.failed"] == 1 and d["service.completed"] == 2, d
+        assert d["service.queue_wait_s"] == 3, d
+        assert d["service.total_s"] == 3, d
         with pytest.raises(NonFiniteError):
             h_bad.result()
         for h in (h_good, h_good2):

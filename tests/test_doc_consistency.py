@@ -177,6 +177,108 @@ def test_multirhs_artifact_agrees_with_guard_bands():
     assert rec["bands_ok_device"] is True
 
 
+def test_metric_catalog_agrees_with_registry_both_directions():
+    """docs/observability.md's '### Metric catalog' table is the
+    exhaustive declared-metric surface, machine-checked against
+    `telemetry.registry.CATALOG` in BOTH directions: a metric the
+    package declares (and bumps) that the table omits is an
+    undocumented signal; a row naming an undeclared metric is a ghost.
+    Type, unit, labels, and the bumped-at site must match the spec —
+    the table may not claim an instrumentation point the code moved."""
+    import re as _re
+
+    from partitionedarrays_jl_tpu.telemetry import CATALOG
+
+    text = open(
+        os.path.join(REPO, "docs", "observability.md"), encoding="utf-8"
+    ).read()
+    m = re.search(
+        r"### Metric catalog(.*?)\n## ", text, flags=re.S
+    )
+    assert m, "docs/observability.md lost its '### Metric catalog'"
+    rows = _re.findall(
+        r"^\| `([^`]+)` \| (\w+) \| (\S+) \| (.+?) \| `([^`]+)` \|",
+        m.group(1), flags=_re.M,
+    )
+    assert rows, "metric catalog table unparsable (format drifted?)"
+    documented = {r[0] for r in rows}
+    declared = set(CATALOG)
+    assert declared - documented == set(), (
+        f"declared metrics missing from the doc table: "
+        f"{declared - documented}"
+    )
+    assert documented - declared == set(), (
+        f"ghost rows documenting undeclared metrics: "
+        f"{documented - declared}"
+    )
+    for name, kind, unit, labels, where in rows:
+        spec = CATALOG[name]
+        assert kind == spec.kind, (name, kind, spec.kind)
+        assert unit == spec.unit, (name, unit, spec.unit)
+        assert where == spec.where, (name, where, spec.where)
+        doc_labels = (
+            () if labels.strip() in ("—", "-", "")
+            else tuple(s.strip() for s in labels.split(","))
+        )
+        assert doc_labels == spec.labels, (name, doc_labels, spec.labels)
+
+
+def test_throughput_model_ties_to_multirhs():
+    """The committed THROUGHPUT_MODEL.json (round 12 — the adaptive-K
+    input) must be the real thing: schema-versioned under the shared
+    artifact envelope, its online-measured entries internally
+    consistent (per_rhs = s_per_it/K, EWMA fed by >= 2 samples — a
+    one-shot value is a bench row, not an online model), measured at
+    every K the SERVICE_BENCH sweep ran, and its reference curve EQUAL
+    to the committed MULTIRHS device record at every overlapping K —
+    the committed model can never drift from the device curve it
+    converges to."""
+    from partitionedarrays_jl_tpu import telemetry
+
+    bench_svc = _load_tool("bench_service")
+    rec = json.load(open(os.path.join(REPO, "THROUGHPUT_MODEL.json")))
+    mr = json.load(open(os.path.join(REPO, "MULTIRHS_BENCH.json")))
+    assert rec["throughput_schema_version"] == (
+        telemetry.THROUGHPUT_SCHEMA_VERSION
+    )
+    # the shared artifact envelope
+    assert rec.get("schema_version") == telemetry.ARTIFACT_SCHEMA_VERSION
+    assert rec.get("generated_by") == "bench_service"
+    assert rec.get("platform") and isinstance(rec.get("pa_env"), dict)
+    assert 0.0 < rec["ewma_alpha"] <= 1.0
+    # online-measured entries: loadable, consistent, covering the sweep
+    model = telemetry.ThroughputModel.load(rec)
+    entries = rec["entries"]
+    assert entries, "committed model must hold measured entries"
+    for e in entries:
+        assert abs(
+            e["per_rhs_s_per_it"] - e["s_per_it"] / e["K"]
+        ) <= 1e-6 * e["per_rhs_s_per_it"], e
+        assert e["samples"] >= 2, (e, "an online EWMA needs >= 2 samples")
+        assert e["iterations"] >= e["samples"], e
+    fp = rec["operator_fingerprint"]
+    dtype = rec["dtype"]
+    measured_ks = set(model.curve(fp, dtype))
+    assert measured_ks == set(bench_svc.KS), (measured_ks, bench_svc.KS)
+    # suggest_k reads the committed curve coherently: never wider than
+    # the queue, and the argmin of the measured per-RHS curve when wide
+    curve = model.curve(fp, dtype)
+    best = min(curve, key=lambda k: (curve[k], -k))
+    assert model.suggest_k(fp, dtype, queue_depth=64, kmax=64) == best
+    assert model.suggest_k(fp, dtype, queue_depth=1, kmax=64) == 1
+    # the reference curve IS the MULTIRHS device record
+    ref = rec["reference_curve"]
+    assert ref["source"] == "MULTIRHS_BENCH.json"
+    assert (ref["n"], ref["dtype"]) == (mr["n"], mr["dtype"])
+    mr_by_k = {str(r["K"]): r for r in mr["curve"]}
+    assert set(ref["per_rhs_s_per_it"]) == set(mr_by_k)
+    for k, row in mr_by_k.items():
+        assert ref["per_rhs_s_per_it"][k] == row["per_rhs_s_per_it"], k
+        assert ref["per_rhs_speedup_vs_k1"][k] == (
+            row["per_rhs_speedup_vs_k1"]
+        ), k
+
+
 def test_service_artifact_inherits_multirhs_floor():
     """The committed solve-service artifact (round 10) and its bench
     guard must agree — and the artifact's device claim must be
@@ -217,6 +319,27 @@ def test_service_artifact_inherits_multirhs_floor():
             assert abs(rps - row["K"] / row[f"{leg}_wall_s"]) <= 1e-3 * rps
         ratio = row["solo_wall_s"] / row["service_wall_s"]
         assert abs(row["service_vs_solo"] - ratio) <= 1e-2 * ratio, row
+    # round 12: the metrics-on/off marginal — the drained requests/s
+    # with the observability plane on vs killed must be recorded,
+    # internally consistent, and inside its committed canary band (the
+    # PR 9 acceptance criterion: metrics are measurably ~free)
+    marg = rec["metrics_marginal"]
+    ratio = marg["on_requests_per_s"] / marg["off_requests_per_s"]
+    assert abs(marg["ratio_on_off"] - ratio) <= 1e-2 * ratio, marg
+    for key, (lo, hi, kind) in bench_svc.METRICS_BANDS.items():
+        band = rec["bands"][key]
+        assert (band["lo"], band["hi"], band["kind"]) == (lo, hi, kind)
+        assert band["measured"] == marg["ratio_on_off"]
+        assert band["in_band"] and lo <= band["measured"] <= hi, band
+    # the locally measured per-RHS table agrees with itself and covers
+    # the sweep (its committed twin is THROUGHPUT_MODEL.json, checked
+    # in test_throughput_model_ties_to_multirhs)
+    per_rhs = {r["K"]: r for r in rec["measured_per_rhs"]}
+    assert set(per_rhs) == set(rec["ks"])
+    for r in rec["measured_per_rhs"]:
+        assert abs(
+            r["per_rhs_s_per_it"] - r["s_per_it"] / r["K"]
+        ) <= 1e-6 * r["per_rhs_s_per_it"], r
 
 
 def test_scale_curve_fused_headline_consistent_with_bench():

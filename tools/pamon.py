@@ -1,0 +1,376 @@
+#!/usr/bin/env python
+"""pamon — live service observability: metric snapshots, SLO
+attainment, and the measured throughput model.
+
+The operator console of the `telemetry.registry` metrics plane
+(docs/observability.md has the metric catalog). Data sources:
+
+* in-process — ``--check`` / ``--demo`` run a small solve service and
+  render its live registry (the tier-1 smoke path);
+* a snapshot file — ``--snapshot FILE`` renders a registry export
+  (``telemetry.registry().to_json()`` written by your process, e.g.
+  `tools/paserve.py --metrics-json`); ``--watch`` re-reads it every
+  ``--interval`` seconds and shows histogram deltas since the last
+  poll;
+* the committed model — ``--model [PATH]`` renders
+  ``THROUGHPUT_MODEL.json`` (default: the repo's committed artifact),
+  the online-measured per-RHS curve that feeds adaptive K.
+
+Output modes: the default table, ``--prom`` (Prometheus text
+exposition), ``--json`` (the raw snapshot), ``--slo`` (deadline
+attainment per tolerance class).
+
+Usage:
+    python tools/pamon.py --check                  # tier-1 smoke
+    python tools/pamon.py --demo --slo
+    python tools/pamon.py --snapshot metrics.json --watch --interval 2
+    python tools/pamon.py --model --json
+    python tools/pamon.py --snapshot metrics.json --prom
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def _hist_line(name, snap):
+    from partitionedarrays_jl_tpu.telemetry import LatencyHistogram
+
+    h = LatencyHistogram.from_snapshot(snap)
+    if h.total == 0:
+        return f"  {name:32s} count=0"
+    return (
+        f"  {name:32s} count={h.total:<6d} mean={h.mean():.6f}s "
+        f"p50<={h.quantile(0.5):.6f}s p90<={h.quantile(0.9):.6f}s "
+        f"p99<={h.quantile(0.99):.6f}s max={h.max:.6f}s"
+    )
+
+
+def render_snapshot(snap, prev=None):
+    """The default table: counters, gauges, histogram summaries (with
+    deltas against ``prev`` in watch mode)."""
+    from partitionedarrays_jl_tpu.telemetry import LatencyHistogram
+
+    lines = []
+    counters = snap.get("counters") or {}
+    if counters:
+        lines.append("counters:")
+        prev_c = (prev or {}).get("counters") or {}
+        for name, v in sorted(counters.items()):
+            d = v - prev_c.get(name, 0)
+            delta = f"  (+{d})" if prev is not None and d else ""
+            lines.append(f"  {name:32s} {v}{delta}")
+    gauges = snap.get("gauges") or {}
+    if gauges:
+        lines.append("gauges:")
+        for name, v in sorted(gauges.items()):
+            lines.append(f"  {name:32s} {v:g}")
+    hists = snap.get("histograms") or {}
+    if hists:
+        lines.append("histograms (quantiles are bucket upper edges):")
+        prev_h = (prev or {}).get("histograms") or {}
+        for name, hsnap in sorted(hists.items()):
+            lines.append(_hist_line(name, hsnap))
+            if prev is not None and name in prev_h:
+                d = LatencyHistogram.from_snapshot(hsnap).delta(
+                    prev_h[name]
+                )
+                if d["count"]:
+                    lines.append(
+                        f"  {'':32s} +{d['count']} since last poll "
+                        f"(+{d['sum']:.6f}s)"
+                    )
+    return "\n".join(lines) if lines else "(registry empty)"
+
+
+def render_slo(snap):
+    """Deadline attainment per tolerance class + the slack
+    distribution."""
+    counters = snap.get("counters") or {}
+    classes = {}
+    for name, v in counters.items():
+        if name.startswith("service.slo.requests{"):
+            cls = name.split("tol_class=", 1)[1].rstrip("}")
+            classes.setdefault(cls, {})["requests"] = v
+        elif name.startswith("service.slo.hits{"):
+            cls = name.split("tol_class=", 1)[1].rstrip("}")
+            classes.setdefault(cls, {})["hits"] = v
+    lines = ["SLO attainment (deadline-carrying requests):"]
+    if not classes:
+        lines.append("  (no deadline-carrying requests observed)")
+    for cls in sorted(classes):
+        req = classes[cls].get("requests", 0)
+        hit = classes[cls].get("hits", 0)
+        rate = hit / req if req else 0.0
+        lines.append(
+            f"  tol_class={cls:8s} requests={req:<5d} hits={hit:<5d} "
+            f"attainment={rate:.1%}"
+        )
+    slack = (snap.get("histograms") or {}).get("service.deadline_slack_s")
+    if slack:
+        lines.append(_hist_line("service.deadline_slack_s", slack))
+    return "\n".join(lines)
+
+
+def render_model(rec):
+    """The measured per-RHS throughput table (the adaptive-K input)."""
+    lines = [
+        f"throughput model (schema {rec.get('throughput_schema_version')}"
+        f", ewma_alpha={rec.get('ewma_alpha')}, "
+        f"platform={rec.get('platform', '?')}):"
+    ]
+    entries = rec.get("entries") or []
+    if not entries:
+        lines.append("  (no measured entries)")
+    groups = {}
+    for e in entries:
+        groups.setdefault((e["fingerprint"], e["dtype"]), []).append(e)
+    for (fp, dt), es in sorted(groups.items()):
+        lines.append(f"  operator {fp} [{dt}]:")
+        base = next(
+            (e["per_rhs_s_per_it"] for e in es if e["K"] == 1), None
+        )
+        for e in sorted(es, key=lambda e: e["K"]):
+            gain = (
+                f"  per-RHS x{base / e['per_rhs_s_per_it']:.2f} vs K=1"
+                if base
+                else ""
+            )
+            lines.append(
+                f"    K={e['K']:<3d} s_per_it={e['s_per_it']:.6f} "
+                f"per_rhs={e['per_rhs_s_per_it']:.6f} "
+                f"samples={e['samples']}{gain}"
+            )
+    ref = rec.get("reference_curve")
+    if ref:
+        lines.append(
+            f"  reference curve ({ref.get('source')}, n={ref.get('n')}, "
+            f"device record):"
+        )
+        for k, v in sorted(
+            ref.get("per_rhs_s_per_it", {}).items(), key=lambda t: int(t[0])
+        ):
+            sp = ref.get("per_rhs_speedup_vs_k1", {}).get(k)
+            lines.append(
+                f"    K={k:<3s} per_rhs={v:.6f}"
+                + (f"  x{sp:.2f} vs K=1" if sp else "")
+            )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# the in-process demo (also the --check smoke)
+# ---------------------------------------------------------------------------
+
+
+def _run_demo():
+    """A small drained service: every metric family in the catalog gets
+    exercised — admission (+1 rejection), coalescing, a deadline class,
+    completion — against the sequential backend."""
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu.models import assemble_poisson
+    from partitionedarrays_jl_tpu.service import (
+        AdmissionRejected,
+        SolveService,
+    )
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A, kmax=4, queue_depth=4)
+        handles = [
+            svc.submit(b, x0=x0, tol=1e-9, deadline=3600.0,
+                       tag=f"demo-{i}")
+            for i in range(4)
+        ]
+        try:  # the 5th overflows the bound: typed backpressure
+            svc.submit(b, x0=x0, tol=1e-9, tag="demo-over")
+        except AdmissionRejected:
+            pass
+        profile = svc.queue_profile()
+        svc.drain()
+        for h in handles:
+            h.result()
+        return svc.fingerprint, profile, dict(svc.stats)
+
+    return pa.prun(driver, pa.sequential, (2, 2))
+
+
+def _check() -> int:
+    """--check: run the demo, assert the metrics plane saw it, render
+    every surface once. Exit nonzero on any broken invariant."""
+    from partitionedarrays_jl_tpu import telemetry
+
+    reg = telemetry.registry()
+    base = reg.snapshot()
+
+    def c(name):
+        return (base.get("counters") or {}).get(name, 0)
+
+    before = {
+        k: c(k)
+        for k in ("service.admitted", "service.rejected",
+                  "service.completed")
+    }
+    fingerprint, profile, stats = _run_demo()
+    snap = reg.snapshot()
+    failures = []
+
+    def expect(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    counters = snap["counters"]
+    expect(
+        counters.get("service.admitted", 0) - before["service.admitted"]
+        == 4,
+        "admitted counter must advance by the demo's 4 admissions",
+    )
+    expect(
+        counters.get("service.rejected", 0) - before["service.rejected"]
+        == 1,
+        "rejected counter must advance by the demo's 1 overflow",
+    )
+    expect(
+        counters.get("service.completed", 0)
+        - before["service.completed"] == 4,
+        "completed counter must advance by 4",
+    )
+    hists = snap["histograms"]
+    for name in ("service.queue_wait_s", "service.total_s",
+                 "service.solve_s", "service.slab_wait_s"):
+        expect(
+            (hists.get(name) or {}).get("count", 0) > 0,
+            f"histogram {name} must have observations",
+        )
+    expect(
+        any(k.startswith("service.slo.requests{") for k in counters),
+        "SLO accounting must tick for the deadline-carrying demo class",
+    )
+    expect(profile == [] or isinstance(profile, list),
+           "queue_profile must return a list")
+    model = telemetry.throughput_model()
+    curve = model.curve(fingerprint, "float64")
+    curve.update(model.curve(fingerprint, "float32"))
+    expect(
+        bool(curve),
+        "the throughput model must hold a measured entry for the demo "
+        f"operator {fingerprint}",
+    )
+    # every export surface renders without raising
+    print(render_snapshot(snap))
+    print()
+    print(render_slo(snap))
+    print()
+    prom = reg.to_prometheus()
+    expect("pa_service_total_s_count" in prom,
+           "prometheus export must expose the total-latency histogram")
+    json.loads(reg.to_json())
+    model_path = os.path.join(REPO, "THROUGHPUT_MODEL.json")
+    if os.path.exists(model_path):
+        rec = json.load(open(model_path))
+        print(render_model(rec))
+        expect(
+            rec.get("throughput_schema_version")
+            == telemetry.THROUGHPUT_SCHEMA_VERSION,
+            "committed THROUGHPUT_MODEL.json schema mismatch",
+        )
+    for f in failures:
+        print(f"pamon --check FAILURE: {f}", file=sys.stderr)
+    print("pamon --check:", "FAILED" if failures else "OK")
+    return 1 if failures else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="in-process smoke: demo service + invariants")
+    ap.add_argument("--demo", action="store_true",
+                    help="run the demo service, then render")
+    ap.add_argument("--snapshot", metavar="FILE",
+                    help="render a registry snapshot JSON export")
+    ap.add_argument("--model", nargs="?", const=os.path.join(
+        REPO, "THROUGHPUT_MODEL.json"), metavar="PATH",
+        help="render a THROUGHPUT_MODEL.json (default: committed)")
+    ap.add_argument("--prom", action="store_true",
+                    help="Prometheus text exposition format")
+    ap.add_argument("--json", action="store_true", dest="json_",
+                    help="raw snapshot JSON")
+    ap.add_argument("--slo", action="store_true",
+                    help="SLO attainment per tolerance class")
+    ap.add_argument("--watch", action="store_true",
+                    help="with --snapshot: poll and show deltas")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="watch poll seconds (default 5)")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="watch iterations (0 = until interrupted)")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        return _check()
+
+    if args.model is not None and not (args.demo or args.snapshot):
+        rec = json.load(open(args.model))
+        if args.json_:
+            print(json.dumps(rec, indent=1, sort_keys=True))
+        else:
+            print(render_model(rec))
+        return 0
+
+    snap = None
+    if args.demo:
+        from partitionedarrays_jl_tpu import telemetry
+
+        _run_demo()
+        reg = telemetry.registry()
+        snap = reg.snapshot()
+        if args.prom:
+            print(reg.to_prometheus())
+            return 0
+    elif args.snapshot:
+        if args.watch:
+            prev = None
+            i = 0
+            while True:
+                snap = json.load(open(args.snapshot))
+                print(f"--- pamon watch poll {i} ---")
+                print(render_snapshot(snap, prev=prev))
+                if args.slo:
+                    print(render_slo(snap))
+                prev = snap
+                i += 1
+                if args.iterations and i >= args.iterations:
+                    return 0
+                time.sleep(args.interval)
+        snap = json.load(open(args.snapshot))
+    else:
+        ap.print_help()
+        return 2
+
+    if args.json_:
+        print(json.dumps(snap, indent=1, sort_keys=True))
+    elif args.prom:
+        # re-render a file snapshot as prometheus text is not supported
+        # (the registry object is needed); --demo --prom handled above
+        print("pamon: --prom needs --demo (live registry)",
+              file=sys.stderr)
+        return 2
+    else:
+        print(render_snapshot(snap))
+    if args.slo:
+        print(render_slo(snap))
+    if args.model is not None:
+        print(render_model(json.load(open(args.model))))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

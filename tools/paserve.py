@@ -14,6 +14,7 @@ Usage:
     python tools/paserve.py --grid 8 8 8 --requests 8 --poison 3
     python tools/paserve.py --backend tpu --requests 8 --deadline 30
     python tools/paserve.py ... --summary-json out.json
+    python tools/paserve.py ... --metrics-json m.json   # pamon --snapshot
 
 Exit status: 0 when every request ends in a documented terminal state
 (done, or failed-with-typed-error for poisoned requests), 1 otherwise.
@@ -78,6 +79,10 @@ def main(argv=None):
     ap.add_argument("--backend", choices=("seq", "tpu"), default="seq")
     ap.add_argument("--summary-json", default=None,
                     help="write the outcome summary as JSON")
+    ap.add_argument("--metrics-json", default=None,
+                    help="export the metric-registry snapshot as JSON "
+                         "(render/watch it with tools/pamon.py "
+                         "--snapshot)")
     args = ap.parse_args(argv)
 
     import partitionedarrays_jl_tpu as pa
@@ -169,6 +174,12 @@ def main(argv=None):
                 f, indent=1, sort_keys=True,
             )
         print(f"wrote {args.summary_json}")
+    if args.metrics_json:
+        from partitionedarrays_jl_tpu import telemetry
+
+        with open(args.metrics_json, "w", encoding="utf-8") as f:
+            f.write(telemetry.registry().to_json())
+        print(f"wrote {args.metrics_json}")
     print("paserve:", "OK" if ok else "FAILED")
     return 0 if ok else 1
 

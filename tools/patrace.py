@@ -20,6 +20,13 @@ asks after the fact:
                    mesh — the same check `tools/palint.py --check`
                    gates on) and print the per-case verdict. ``--full``
                    widens the fast subset to all 15 cases.
+* ``--service``    join the solve service's request-level records into
+                   per-SLAB timelines: because events append to every
+                   active record, one poisoned-column incident is
+                   smeared across K separate request records — this
+                   leg dedups and merges them so the incident reads as
+                   a single story (formation, verdicts, ejection, each
+                   request's outcome).
 
 Usage:
     PA_METRICS_DIR=/tmp/rec python your_solve.py
@@ -141,6 +148,145 @@ def _summarize(path, rec):
         print(line)
 
 
+def _service_slabs(recs):
+    """Group service-request records into slab stories.
+
+    Returns ``[(members, member_recs, events)]`` where ``events`` is the
+    deduped, absolute-time-sorted union of the members' event logs.
+    Records are joined on the ``requests`` list each non-topped-up
+    ``slab_formed`` event carries; an event belongs to a slab when it
+    names a member (label, ``details.request``) or the slab itself
+    (``details.requests`` overlap). Dedup key is the event's content —
+    the same event lands in every record that was active when it fired,
+    with per-record relative clocks, so identity must come from WHAT
+    happened, not when each record saw it."""
+    svc = [
+        (path, rec) for path, rec in recs
+        if rec.get("solver") == "service-request"
+    ]
+    by_tag = {}
+    for _path, rec in svc:
+        tag = (rec.get("config") or {}).get("request")
+        if tag is not None:
+            by_tag.setdefault(tag, rec)
+
+    # two passes: base slabs first, THEN top-up extensions — records
+    # persist at finish time, so a topped-up request that terminated
+    # before the founding members files its record (and its
+    # topped_up slab_formed event) ahead of the base formation
+    slabs = []  # [{"members": set, "order": [tags]}]
+    topups = []
+    for _path, rec in svc:
+        for ev in rec.get("events") or []:
+            if ev.get("kind") != "slab_formed":
+                continue
+            details = ev.get("details") or {}
+            tags = list(details.get("requests") or [])
+            if not tags:
+                continue
+            if details.get("topped_up"):
+                topups.append(tags)
+                continue
+            if not any(s["members"] == set(tags) for s in slabs):
+                slabs.append({"members": set(tags), "order": tags})
+    for tags in topups:
+        for s in slabs:  # extend the slab the arrivals joined
+            if s["members"] & set(tags):
+                for t in tags:
+                    if t not in s["members"]:
+                        s["members"].add(t)
+                        s["order"].append(t)
+                break
+
+    out = []
+    for s in slabs:
+        members = s["members"]
+        member_recs = [
+            (t, by_tag[t]) for t in s["order"] if t in by_tag
+        ]
+        seen = {}
+        unnamed = {}
+        t_form = None
+        for tag, rec in member_recs:
+            t0 = rec.get("started_at") or 0.0
+            for ev in rec.get("events") or []:
+                details = ev.get("details") or {}
+                abs_t = t0 + (ev.get("t") or 0.0)
+                key = (
+                    ev.get("kind"), ev.get("label"),
+                    json.dumps(details, sort_keys=True, default=str),
+                )
+                named = (
+                    ev.get("label") in members
+                    or details.get("request") in members
+                    or bool(set(details.get("requests") or []) & members)
+                )
+                if not named:
+                    # column_verdict carries column INDICES, not tags —
+                    # window it into the slab below (a member's record
+                    # can hold an EARLIER slab's verdicts from its
+                    # queued phase; those predate this slab's formation)
+                    if ev.get("kind") == "column_verdict":
+                        if key not in unnamed or abs_t < unnamed[key][0]:
+                            unnamed[key] = (abs_t, ev)
+                    continue
+                if ev.get("kind") == "slab_formed" and not details.get(
+                    "topped_up"
+                ):
+                    t_form = abs_t if t_form is None else min(t_form,
+                                                              abs_t)
+                if key not in seen or abs_t < seen[key][0]:
+                    seen[key] = (abs_t, ev)
+        for key, (abs_t, ev) in unnamed.items():
+            if t_form is None or abs_t >= t_form - 1e-3:
+                seen.setdefault(key, (abs_t, ev))
+        events = sorted(seen.values(), key=lambda kv: kv[0])
+        out.append((s["order"], member_recs, events))
+    return out
+
+
+def _service_timeline(recs) -> int:
+    """--service: print one joined timeline per slab."""
+    slabs = _service_slabs(recs)
+    if not slabs:
+        print(
+            "patrace --service: no service-request records found "
+            "(submit through SolveService with PA_METRICS_DIR set)",
+            file=sys.stderr,
+        )
+        return 1
+    for i, (members, member_recs, events) in enumerate(slabs):
+        print(f"slab {i}: K={len(members)} requests: "
+              + ", ".join(members))
+        t0 = events[0][0] if events else 0.0
+        for abs_t, ev in events:
+            label = ev.get("label") or ""
+            it = ev.get("iteration")
+            at = f" it={it}" if it is not None else ""
+            details = ev.get("details") or {}
+            extra = ", ".join(
+                f"{k}={v}" for k, v in sorted(details.items())
+                if k not in ("message",)
+            )
+            print(
+                f"    [{abs_t - t0:9.4f}s] {ev.get('kind')}"
+                f"{':' + label if label else ''}{at}"
+                + (f"  ({extra})" if extra else "")
+            )
+        outcomes = []
+        for tag, rec in member_recs:
+            if rec.get("status") == "raised":
+                err = (rec.get("error") or {}).get("type", "error")
+                outcomes.append(f"{tag} FAILED({err})")
+            else:
+                outcomes.append(
+                    f"{tag} {rec.get('status') or 'done'}"
+                    f"(it={rec.get('iterations')})"
+                )
+        print("  outcomes: " + "; ".join(outcomes))
+    return 0
+
+
 def _diff_static(full: bool) -> int:
     # CPU mesh setup — same pattern as tools/palint.py: the dev image
     # may pre-import jax on another platform, so update the config too
@@ -197,12 +343,15 @@ def main(argv=None):
                          "measured comms against the lowered programs")
     ap.add_argument("--full", action="store_true",
                     help="with --diff-static: all 15 matrix cases")
+    ap.add_argument("--service", action="store_true",
+                    help="join service-request records into per-slab "
+                         "timelines")
     args = ap.parse_args(argv)
 
     if args.diff_static:
         return _diff_static(args.full)
 
-    if not (args.last or args.list_ or args.trace):
+    if not (args.last or args.list_ or args.trace or args.service):
         ap.print_help()
         return 2
 
@@ -213,6 +362,9 @@ def main(argv=None):
     if not recs:
         print(f"patrace: no records under {d}", file=sys.stderr)
         return 1
+
+    if args.service:
+        return _service_timeline(recs)
 
     if args.list_:
         for path, rec in recs:
