@@ -201,6 +201,29 @@ NON_LOWERING: Dict[str, str] = {
         "model (telemetry/throughput.py) — shapes a measured-cost "
         "table, never a staged program"
     ),
+    "PA_SERVE_ADAPTIVE_K": (
+        "adaptive slab-width policy switch — selects WHICH cached "
+        "block program (rhs_batch=K) a slab runs from the measured "
+        "per-RHS curve (telemetry.throughput.suggest_k); like "
+        "PA_SERVE_KMAX, each candidate program is keyed by its own K "
+        "through _krylov_fn_for, so the policy never alters a staged "
+        "program"
+    ),
+    "PA_PROF": (
+        "phase-profiling master switch (telemetry/profile.py) — "
+        "capture builds STANDALONE chain programs; the solver path "
+        "never reads it (StableHLO-identity pinned in "
+        "tests/test_paprof.py)"
+    ),
+    "PA_PROF_REPS": (
+        "phase-profiling timing repetitions — host-side measurement "
+        "parameter of the standalone profiling chains"
+    ),
+    "PA_PROF_TRACE": (
+        "phase-profiling capture-method selector (jax-trace vs "
+        "split-timer) — chooses how a standalone profile is measured, "
+        "never what a solver program stages"
+    ),
     "PA_METRICS_DIR": (
         "telemetry record persistence directory — where finished "
         "SolveRecord JSONs land on the host, never part of a staged "

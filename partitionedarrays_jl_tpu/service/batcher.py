@@ -13,14 +13,64 @@ current queue head). A slab narrower than ``kmax`` is a RAGGED
 leftover and runs anyway — `_krylov_fn_for` caches the compiled
 program per K, and the service tops ragged slabs back up with newly
 admitted compatible requests at chunk boundaries.
+
+``PA_SERVE_ADAPTIVE_K`` (default off) adds the measured policy on top
+of the static bound: `effective_kmax` shrinks the slab-width cap to
+`telemetry.throughput.suggest_k`'s per-RHS optimum for the queue
+head's compatibility class — queue depth x the MEASURED per-RHS curve,
+the ROADMAP item-1 scheduling step the online throughput model
+(PR 9) was built to feed. Off (the default), the static
+``PA_SERVE_KMAX`` path is byte-for-byte unchanged.
 """
 from __future__ import annotations
 
+import os
 from typing import List, Tuple
 
 import numpy as np
 
-__all__ = ["compat_key", "next_slab", "top_up", "queue_compat_profile"]
+__all__ = [
+    "adaptive_k_enabled",
+    "compat_key",
+    "effective_kmax",
+    "next_slab",
+    "top_up",
+    "queue_compat_profile",
+]
+
+
+def adaptive_k_enabled() -> bool:
+    """The PA_SERVE_ADAPTIVE_K switch (default off): host-side
+    scheduling policy only — which cached block program runs, never
+    what any program stages."""
+    return os.environ.get("PA_SERVE_ADAPTIVE_K", "0") == "1"
+
+
+def effective_kmax(queue: List, kmax: int, fingerprint: str,
+                   anchor=None, base: int = 0) -> int:
+    """The slab-width cap `next_slab` / `top_up` should run under:
+    ``kmax`` verbatim while adaptive K is off (or nothing anchors a
+    compatibility class), else `suggest_k` over the anchor's class —
+    the widest slab is feasible only up to the number of columns that
+    could actually ride it, and the measured per-RHS curve picks the
+    best width at or below that. ``anchor`` fixes the class (default:
+    the queue head; a chunk-boundary `top_up` passes the RUNNING
+    slab's head so the refill honors the same adaptive cap the slab
+    was formed under) and ``base`` counts columns already riding
+    (the running slab's width). An unmeasured operator falls back to
+    the static ``min(depth, kmax)`` inside `suggest_k` itself."""
+    if not adaptive_k_enabled():
+        return int(kmax)
+    head = anchor if anchor is not None else (queue[0] if queue else None)
+    if head is None:
+        return int(kmax)
+    from ..telemetry.throughput import model
+
+    key = compat_key(head)
+    depth = int(base) + sum(
+        1 for req in queue if compat_key(req) == key
+    )
+    return model().suggest_k(fingerprint, key[2], depth, int(kmax))
 
 
 def compat_key(req) -> Tuple[float, object, str]:

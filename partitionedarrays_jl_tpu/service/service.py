@@ -56,7 +56,7 @@ from .admission import (
     default_retries,
     slab_kmax,
 )
-from .batcher import compat_key, next_slab, top_up
+from .batcher import compat_key, effective_kmax, next_slab, top_up
 from .request import SolveRequest
 
 __all__ = ["SolveService"]
@@ -213,8 +213,13 @@ class SolveService:
 
     def _pop_slab(self) -> list:
         """`next_slab` plus the queue-depth gauge update (callers hold
-        ``self._lock``)."""
-        slab = next_slab(self._queue, self.kmax)
+        ``self._lock``). With ``PA_SERVE_ADAPTIVE_K=1`` the width cap
+        comes from the measured per-RHS curve (`batcher.effective_kmax`
+        -> `throughput.suggest_k`) instead of the static kmax."""
+        slab = next_slab(
+            self._queue,
+            effective_kmax(self._queue, self.kmax, self.fingerprint),
+        )
         if slab and monitoring_enabled():
             registry().gauge("service.queue_depth").set(len(self._queue))
         return slab
@@ -451,9 +456,17 @@ class SolveService:
                     done += 1
                 break
             # re-batch ragged leftovers: compatible late arrivals join
-            # the running slab at the chunk boundary
+            # the running slab at the chunk boundary — under the SAME
+            # adaptive cap the slab was formed with (effective_kmax
+            # anchored on the running slab), not the static kmax
             with self._lock:
-                added = top_up(self._queue, active, self.kmax)
+                added = top_up(
+                    self._queue, active,
+                    effective_kmax(
+                        self._queue, self.kmax, self.fingerprint,
+                        anchor=active[0], base=len(active),
+                    ),
+                )
                 if added and mon:
                     reg.gauge("service.queue_depth").set(len(self._queue))
             for r in added:

@@ -85,11 +85,41 @@ from .trace import (  # noqa: F401
     record_trace_events,
     write_chrome_trace,
 )
+from .profile import (  # noqa: F401
+    PHASE_SCHEMA_VERSION,
+    PHASE_SUM_BAND,
+    PHASES,
+    capture_phase_profile,
+    phase_trace_events,
+    reconcile_phases,
+    render_phase_profile,
+)
+from .commsmatrix import (  # noqa: F401
+    COMMS_MATRIX_SCHEMA_VERSION,
+    classify_edge,
+    measure_comms_matrix,
+    reconcile_matrix,
+    render_comms_matrix,
+    static_matrix,
+)
+from .ledger import (  # noqa: F401
+    LEDGER_SCHEMA_VERSION,
+    build_ledger,
+    check_artifact,
+    check_repo,
+    extract_metrics,
+    update_ledger,
+)
 
 __all__ = [
     "ARTIFACT_SCHEMA_VERSION",
     "CATALOG",
+    "COMMS_MATRIX_SCHEMA_VERSION",
     "COMM_KINDS",
+    "LEDGER_SCHEMA_VERSION",
+    "PHASES",
+    "PHASE_SCHEMA_VERSION",
+    "PHASE_SUM_BAND",
     "HISTOGRAM_SCHEMA_VERSION",
     "InfoDict",
     "LatencyHistogram",
@@ -105,10 +135,24 @@ __all__ = [
     "annotate",
     "apply_delta",
     "begin_record",
+    "build_ledger",
     "bump",
+    "capture_phase_profile",
     "cg_comms_profile",
+    "check_artifact",
+    "check_repo",
     "chrome_trace",
+    "classify_edge",
     "clear_history",
+    "extract_metrics",
+    "measure_comms_matrix",
+    "phase_trace_events",
+    "reconcile_matrix",
+    "reconcile_phases",
+    "render_comms_matrix",
+    "render_phase_profile",
+    "static_matrix",
+    "update_ledger",
     "counter",
     "counters",
     "current_record",

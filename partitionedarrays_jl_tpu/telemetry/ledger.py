@@ -1,0 +1,320 @@
+"""The longitudinal perf ledger — the bench trajectory as a
+first-class, machine-checked object.
+
+The repo's ~10 committed ``*_BENCH.json`` artifacts are each
+internally honest (band-checked by their bench guards, envelope-checked
+by tests/test_doc_consistency.py) but mutually DISCONNECTED: nothing
+records the trajectory of a metric across artifact regenerations, and
+"did this PR make anything slower" is answered by eyeballing git
+diffs of JSON. This module makes the trajectory an object:
+
+* `extract_metrics` pulls every artifact's headline numbers into flat
+  ``metric-key -> {value, lo, hi, kind, in_band}`` rows — band entries
+  where the artifact carries them (the ``bands`` table every banded
+  bench writes; per-size ``band`` rows in IRREGULAR), plus curated
+  rows for the two band-less artifacts (GMG mode tables, ICI legs).
+* `build_ledger` folds all committed artifacts into ONE
+  ``PERF_LEDGER.json``: per-metric SERIES, each point carrying the
+  value, its band, the platform it was measured on, and the content
+  hash of the source artifact. `update_ledger` appends a new point
+  when a regenerated artifact's hash changes and keeps history
+  otherwise — the trajectory grows monotonically.
+* `check_artifact` is the REGRESSION SENTINEL (`tools/pareg.py
+  --check`): a fresh artifact must carry the shared envelope, every
+  recorded ``in_band`` flag must be arithmetically consistent with its
+  bounds, device-kind bands must hold on device-measured records
+  (cpu canaries record ``in_band: null`` and are exempt — the
+  established ABFT/OBS gating), non-device bands must HOLD, and the
+  committed ledger's latest point must equal the artifact (a stale
+  ledger is a failure, so the trajectory can never silently fork from
+  its sources). Any failure exits the tool nonzero.
+
+The committed ``PERF_LEDGER.json`` goes through the shared
+`telemetry.artifacts` writer (same envelope as everything else);
+tests/test_doc_consistency.py pins coverage (every committed
+``*_BENCH.json`` appears) and value equality (ledger == sources).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional
+
+__all__ = [
+    "LEDGER_SCHEMA_VERSION",
+    "LEDGER_NAME",
+    "artifact_paths",
+    "content_hash",
+    "extract_metrics",
+    "build_ledger",
+    "update_ledger",
+    "check_artifact",
+    "check_repo",
+]
+
+LEDGER_SCHEMA_VERSION = 1
+LEDGER_NAME = "PERF_LEDGER.json"
+
+#: Envelope keys every committed artifact must carry (the
+#: telemetry.artifacts stamp — the same set
+#: test_every_committed_bench_artifact_is_schema_versioned pins).
+_ENVELOPE = ("schema_version", "generated_by", "platform", "pa_env")
+
+
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def artifact_paths(repo: Optional[str] = None) -> List[str]:
+    """Every committed ``*_BENCH.json`` at the repo root, sorted."""
+    repo = repo or _repo_root()
+    return sorted(
+        os.path.join(repo, f)
+        for f in os.listdir(repo)
+        if f.endswith("_BENCH.json")
+    )
+
+
+def content_hash(rec: dict) -> str:
+    """Canonical content hash of one artifact (sorted-key JSON,
+    envelope's volatile ``pa_env`` excluded so an unrelated env var in
+    the regenerating shell does not read as a new measurement)."""
+    body = {k: v for k, v in rec.items() if k != "pa_env"}
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True, default=str).encode()
+    ).hexdigest()[:16]
+
+
+def _band_row(band: dict) -> dict:
+    return {
+        "value": band.get("measured"),
+        "lo": band.get("lo"),
+        "hi": band.get("hi"),
+        "kind": band.get("kind"),
+        "in_band": band.get("in_band"),
+    }
+
+
+def extract_metrics(name: str, rec: dict) -> Dict[str, dict]:
+    """Flat headline metrics of one artifact (see module docstring).
+    Keys are stable across regenerations — the series identity."""
+    out: Dict[str, dict] = {}
+    for key, band in sorted((rec.get("bands") or {}).items()):
+        out[key] = _band_row(band)
+    for row in rec.get("sizes") or []:
+        band = row.get("band")
+        if isinstance(band, dict) and "key" in band:
+            out[band["key"]] = {
+                "value": band.get("measured"),
+                "lo": band.get("lo"),
+                "hi": band.get("hi"),
+                "kind": "device",
+                "in_band": row.get("in_band"),
+            }
+    if name == "GMG_BENCH.json":
+        for mode in ("dirichlet", "periodic-torus"):
+            table = rec.get(mode) or {}
+            for k in ("cg_ms_per_it", "gmg_ms_per_it", "derived_speedup"):
+                if k in table:
+                    out[f"{mode}.{k}"] = {
+                        "value": table[k], "lo": None, "hi": None,
+                        "kind": "unbanded", "in_band": None,
+                    }
+    if name == "ICI_BENCH.json":
+        for leg in rec.get("legs") or []:
+            if "metric" in leg and "value" in leg:
+                out[leg["metric"]] = {
+                    "value": leg["value"], "lo": None, "hi": None,
+                    "kind": "unbanded", "in_band": None,
+                }
+    return out
+
+
+def build_ledger(repo: Optional[str] = None) -> dict:
+    """One fresh ledger from the committed artifact set: every metric a
+    one-point series (update_ledger grows the history on
+    regeneration)."""
+    repo = repo or _repo_root()
+    artifacts: Dict[str, dict] = {}
+    series: Dict[str, List[dict]] = {}
+    for path in artifact_paths(repo):
+        name = os.path.basename(path)
+        with open(path, encoding="utf-8") as f:
+            rec = json.load(f)
+        metrics = extract_metrics(name, rec)
+        h = content_hash(rec)
+        artifacts[name] = {
+            "source_hash": h,
+            "platform": rec.get("platform"),
+            "generated_by": rec.get("generated_by"),
+            "metrics": sorted(metrics),
+        }
+        for key, row in metrics.items():
+            series[f"{name}:{key}"] = [
+                dict(row, source_hash=h, platform=rec.get("platform"))
+            ]
+    return {
+        "ledger_schema_version": LEDGER_SCHEMA_VERSION,
+        "artifacts": artifacts,
+        "series": {k: series[k] for k in sorted(series)},
+    }
+
+
+def update_ledger(prev: dict, repo: Optional[str] = None) -> dict:
+    """Fold the current artifact set into an existing ledger: a metric
+    whose source hash changed gains a new trailing point; unchanged
+    sources keep their history verbatim; metrics of artifacts that
+    vanished are retained (history is never dropped)."""
+    fresh = build_ledger(repo)
+    series: Dict[str, List[dict]] = {
+        k: [dict(p) for p in v]
+        for k, v in (prev.get("series") or {}).items()
+    }
+    for key, points in fresh["series"].items():
+        new = points[0]
+        if key not in series:
+            series[key] = [new]
+        elif series[key][-1].get("source_hash") != new["source_hash"]:
+            series[key].append(new)
+    return {
+        "ledger_schema_version": LEDGER_SCHEMA_VERSION,
+        "artifacts": fresh["artifacts"],
+        "series": {k: series[k] for k in sorted(series)},
+    }
+
+
+def _last_known_good(points: List[dict]) -> Optional[dict]:
+    for p in reversed(points):
+        if p.get("in_band"):
+            return p
+    return None
+
+
+def check_artifact(
+    name: str, rec: dict, ledger: Optional[dict] = None
+) -> List[str]:
+    """The sentinel: validate one (fresh or committed) artifact.
+    Returns failure strings (empty = healthy); see module docstring
+    for the rule set."""
+    out = []
+    for key in _ENVELOPE:
+        if rec.get(key) in (None, ""):
+            out.append(f"{name}: missing envelope key {key!r} "
+                       "(write through telemetry.artifacts)")
+    metrics = extract_metrics(name, rec)
+    if not metrics:
+        out.append(f"{name}: no extractable headline metrics — extend "
+                   "telemetry.ledger.extract_metrics for this artifact")
+    platform = rec.get("platform")
+    for key, row in sorted(metrics.items()):
+        v, lo, hi = row["value"], row["lo"], row["hi"]
+        if lo is None and hi is None:
+            continue
+        if v is None:
+            # the cpu-canary convention: device bands on a non-device
+            # record stay unmeasured with in_band null
+            if row["in_band"] is not None:
+                out.append(
+                    f"{name}:{key}: unmeasured band must record "
+                    f"in_band null, got {row['in_band']!r}"
+                )
+            continue
+        consistent = (lo <= v <= hi)
+        if row["in_band"] is not None and bool(row["in_band"]) != (
+            consistent
+        ):
+            out.append(
+                f"{name}:{key}: in_band flag {row['in_band']!r} "
+                f"inconsistent with measured {v} vs [{lo}, {hi}]"
+            )
+        gates = row["kind"] != "device" or platform == "tpu"
+        if gates and not consistent:
+            msg = (
+                f"{name}:{key}: REGRESSION — measured {v} outside its "
+                f"band [{lo}, {hi}]"
+            )
+            if ledger is not None:
+                lkg = _last_known_good(
+                    (ledger.get("series") or {}).get(f"{name}:{key}")
+                    or []
+                )
+                if lkg is not None:
+                    msg += (
+                        f" (last known good: {lkg['value']} from "
+                        f"source {lkg.get('source_hash')})"
+                    )
+            out.append(msg)
+    if ledger is not None:
+        known = ledger.get("artifacts") or {}
+        if name in known:
+            points = ledger.get("series") or {}
+            for key, row in metrics.items():
+                skey = f"{name}:{key}"
+                last = (points.get(skey) or [{}])[-1]
+                if skey not in points:
+                    out.append(
+                        f"{name}:{key}: metric absent from the ledger "
+                        "— run pareg --update"
+                    )
+                elif last.get("value") != row["value"]:
+                    out.append(
+                        f"{name}:{key}: ledger is stale "
+                        f"({last.get('value')} != artifact "
+                        f"{row['value']}) — run pareg --update"
+                    )
+    return out
+
+
+def check_repo(repo: Optional[str] = None) -> List[str]:
+    """Validate the whole committed set: every artifact against the
+    sentinel AND against the committed ledger; the ledger must cover
+    every artifact and carry no unknown sources."""
+    repo = repo or _repo_root()
+    out = []
+    ledger_path = os.path.join(repo, LEDGER_NAME)
+    ledger = None
+    if not os.path.exists(ledger_path):
+        out.append(f"{LEDGER_NAME} missing — run pareg --update")
+    else:
+        with open(ledger_path, encoding="utf-8") as f:
+            ledger = json.load(f)
+        if ledger.get("ledger_schema_version") != LEDGER_SCHEMA_VERSION:
+            out.append(
+                f"{LEDGER_NAME}: schema "
+                f"{ledger.get('ledger_schema_version')!r} != "
+                f"{LEDGER_SCHEMA_VERSION}"
+            )
+    names = [os.path.basename(p) for p in artifact_paths(repo)]
+    if ledger is not None:
+        covered = set(ledger.get("artifacts") or {})
+        for name in names:
+            if name not in covered:
+                out.append(
+                    f"{name}: committed artifact not covered by "
+                    f"{LEDGER_NAME} — run pareg --update"
+                )
+        for name in sorted(covered - set(names)):
+            out.append(
+                f"{LEDGER_NAME} covers {name} but no such artifact is "
+                "committed — run pareg --update (series history is "
+                "kept; the artifact table must match the tree)"
+            )
+    for path in artifact_paths(repo):
+        name = os.path.basename(path)
+        with open(path, encoding="utf-8") as f:
+            rec = json.load(f)
+        out.extend(check_artifact(name, rec, ledger=ledger))
+        if ledger is not None and name in (
+            ledger.get("artifacts") or {}
+        ):
+            want = ledger["artifacts"][name].get("source_hash")
+            if want != content_hash(rec):
+                out.append(
+                    f"{name}: content hash {content_hash(rec)} != "
+                    f"ledger's {want} — a non-metric field changed; "
+                    "run pareg --update"
+                )
+    return out

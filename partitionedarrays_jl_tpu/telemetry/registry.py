@@ -362,7 +362,11 @@ class Registry:
     def to_prometheus(self) -> str:
         """Prometheus text exposition: dotted names become
         ``pa_``-prefixed underscore names; histograms render cumulative
-        ``le`` buckets + ``_sum``/``_count`` per convention."""
+        ``le`` buckets + ``_sum``/``_count`` per convention (every
+        series of one labeled histogram carries the IDENTICAL escaped
+        label set). Label values are escaped per the exposition format
+        (backslash, double quote, newline) — a hostile tol-class or
+        request tag can no longer corrupt the scrape."""
         from .histogram import BUCKET_BOUNDS
 
         lines = []
@@ -371,8 +375,16 @@ class Registry:
         def pname(name):
             return "pa_" + name.replace(".", "_").replace("*", "all")
 
+        def esc(v):
+            return (
+                str(v)
+                .replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
+
         def plabels(lk, extra=None):
-            parts = [f'{k}="{v}"' for k, v in lk]
+            parts = [f'{k}="{esc(v)}"' for k, v in lk]
             if extra:
                 parts.append(extra)
             return "{" + ",".join(parts) + "}" if parts else ""
@@ -391,7 +403,10 @@ class Registry:
                 if pn not in typed:
                     spec = CATALOG.get(name)
                     if spec is not None:
-                        lines.append(f"# HELP {pn} {spec.desc}")
+                        desc = spec.desc.replace("\\", "\\\\").replace(
+                            "\n", "\\n"
+                        )
+                        lines.append(f"# HELP {pn} {desc}")
                     lines.append(f"# TYPE {pn} {kind}")
                     typed.add(pn)
                 if isinstance(m, Counter):

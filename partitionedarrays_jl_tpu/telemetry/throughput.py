@@ -21,10 +21,11 @@ What the model answers:
 * ``suggest_k(fp, dtype, queue_depth, kmax)`` — the pure-policy
   helper: among measured widths ≤ min(queue_depth, kmax), the K with
   the best per-RHS cost (ties to the wider slab; falls back to
-  min(queue_depth, kmax) while unmeasured). The SERVICE does not act
-  on it yet — wiring it into the batcher is ROADMAP item 1's adaptive
-  scheduling step; this module is the observation layer it was blocked
-  on.
+  min(queue_depth, kmax) while unmeasured). Under
+  ``PA_SERVE_ADAPTIVE_K=1`` the service ACTS on it (round 13):
+  `service.batcher.effective_kmax` caps slab formation AND
+  chunk-boundary top-ups at this readout; off (the default), the
+  static ``PA_SERVE_KMAX`` path is unchanged.
 
 Updates are EWMA (``PA_MON_EWMA``, default 0.25) so the model tracks
 drift (thermal throttling, co-tenant load) without forgetting history,

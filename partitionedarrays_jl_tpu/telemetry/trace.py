@@ -125,7 +125,14 @@ def chrome_trace(
     }
 
 
-def write_chrome_trace(path: str, records=None, timers=None) -> str:
+def write_chrome_trace(path: str, records=None, timers=None,
+                       extra_events=None) -> str:
+    """The ONE trace serializer. ``extra_events`` appends pre-built
+    Chrome events (e.g. `telemetry.profile.phase_trace_events`) onto
+    the same timeline — callers never hand-roll the file format."""
+    trace = chrome_trace(records=records, timers=timers)
+    if extra_events:
+        trace["traceEvents"].extend(extra_events)
     with open(path, "w", encoding="utf-8") as f:
-        json.dump(chrome_trace(records=records, timers=timers), f, indent=1)
+        json.dump(trace, f, indent=1)
     return path

@@ -549,6 +549,48 @@ def test_repro_artifacts_carry_the_shared_envelope():
             assert s["min"] <= s["median"] <= s["max"], (name, k)
 
 
+def test_perf_ledger_covers_every_bench_artifact_and_equals_sources():
+    """The committed PERF_LEDGER.json (round 13 — the perf trajectory
+    as a machine-checked object) must COVER every committed
+    ``*_BENCH.json`` and carry, as each series' latest point, exactly
+    the value its source artifact records — the ledger can never fork
+    from the artifacts it summarizes. It also rides the shared
+    artifact envelope like everything else committed."""
+    from partitionedarrays_jl_tpu.telemetry import (
+        ARTIFACT_SCHEMA_VERSION,
+        ledger,
+    )
+
+    led = json.load(open(os.path.join(REPO, "PERF_LEDGER.json")))
+    assert led["ledger_schema_version"] == ledger.LEDGER_SCHEMA_VERSION
+    assert led.get("schema_version") == ARTIFACT_SCHEMA_VERSION
+    assert led.get("generated_by") == "pareg"
+    assert led.get("platform") and isinstance(led.get("pa_env"), dict)
+    names = sorted(
+        f for f in os.listdir(REPO) if f.endswith("_BENCH.json")
+    )
+    assert names, "no committed *_BENCH.json artifacts found"
+    assert sorted(led["artifacts"]) == names, (
+        "ledger coverage drifted — run tools/pareg.py --update"
+    )
+    for name in names:
+        rec = json.load(open(os.path.join(REPO, name)))
+        metrics = ledger.extract_metrics(name, rec)
+        assert metrics, f"{name}: no extractable metrics"
+        assert sorted(metrics) == led["artifacts"][name]["metrics"]
+        assert led["artifacts"][name]["source_hash"] == (
+            ledger.content_hash(rec)
+        ), f"{name}: ledger is stale — run tools/pareg.py --update"
+        for key, row in metrics.items():
+            points = led["series"][f"{name}:{key}"]
+            assert points[-1]["value"] == row["value"], (name, key)
+            assert points[-1]["lo"] == row["lo"], (name, key)
+            assert points[-1]["hi"] == row["hi"], (name, key)
+    # the sentinel itself is green on the committed set (the same
+    # invariant tools/pareg.py --check gates in tier-1)
+    assert ledger.check_repo(REPO) == []
+
+
 def test_every_committed_bench_artifact_is_schema_versioned():
     """Every committed ``*_BENCH.json`` carries the FULL shared artifact
     envelope (telemetry.artifacts): ``schema_version``, the generating

@@ -521,3 +521,200 @@ def test_patrace_service_timeline_joins_slab(tmp_path, monkeypatch,
     assert "outcomes:" in out
     assert "tl-bad FAILED(NonFiniteError)" in out
     assert "tl-good converged" in out
+
+
+# ---------------------------------------------------------------------------
+# round 13 (ISSUE 10): exporter label hygiene, labeled-histogram
+# concurrency, adaptive K
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_label_hygiene_with_hostile_value():
+    """Exposition-format escaping: a label value carrying backslash,
+    double quote, and newline must render escaped (\\\\, \\", \\n), the
+    scrape must stay line-structured, and a LABELED histogram must emit
+    ``_bucket``/``_sum``/``_count`` all carrying the identical escaped
+    label set with the +Inf bucket equal to ``_count``."""
+    import re
+
+    reg = telemetry.registry()
+    reg.reset("t_esc")
+    try:
+        hostile = 'wei"rd\\lab\nel'
+        reg.counter("t_esc.c", labels={"tag": hostile}).inc(3)
+        h = reg.histogram("t_esc.h", labels={"tag": hostile})
+        h.observe(0.5)
+        h.observe(2.0)
+        prom = reg.to_prometheus()
+        esc = 'tag="wei\\"rd\\\\lab\\nel"'
+        assert "pa_t_esc_c{%s} 3" % esc in prom
+        # every series line still parses as one NAME{LABELS} VALUE line
+        # (an unescaped newline/quote would shatter this)
+        for ln in prom.splitlines():
+            if ln.startswith("#") or not ln:
+                continue
+            assert re.fullmatch(
+                r"[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \S+", ln
+            ), ln
+        hist_lines = [
+            ln for ln in prom.splitlines()
+            if ln.startswith("pa_t_esc_h")
+        ]
+        buckets = [ln for ln in hist_lines if "_bucket{" in ln]
+        sums = [ln for ln in hist_lines if ln.startswith("pa_t_esc_h_sum")]
+        counts = [
+            ln for ln in hist_lines if ln.startswith("pa_t_esc_h_count")
+        ]
+        assert buckets and len(sums) == 1 and len(counts) == 1
+        # identical escaped label set on every series of the family
+        assert all(esc in ln for ln in buckets + sums + counts)
+        assert sums[0] == "pa_t_esc_h_sum{%s} 2.5" % esc
+        assert counts[0] == "pa_t_esc_h_count{%s} 2" % esc
+        inf = [ln for ln in buckets if 'le="+Inf"' in ln]
+        assert len(inf) == 1 and inf[0].endswith(" 2")
+    finally:
+        reg.reset("t_esc")
+
+
+def test_labeled_histogram_two_thread_observe_vs_snapshot_hammer():
+    """ISSUE-10 lean concurrency pin: one thread observes a LABELED
+    histogram while another snapshots it through the shared lock —
+    every snapshot must be internally consistent (bucket sum == count)
+    and the final total exact. Bounded work, no sleeps."""
+    reg = telemetry.registry()
+    reg.reset("t_lh")
+    try:
+        labels = {"tol_class": "1e-08"}
+        h = reg.histogram("t_lh.h", labels=labels)
+        N = 3000
+        torn = []
+        done = threading.Event()
+
+        def observer():
+            for i in range(N):
+                h.observe(1e-3 if i % 2 else 1e-1)
+            done.set()
+
+        def snapshotter():
+            while not done.is_set():
+                snap = h.snapshot()
+                if sum(snap["buckets"].values()) != snap["count"]:
+                    torn.append(snap)
+            # one read after the writer finished: the final state
+            snap = reg.snapshot("t_lh")["histograms"][
+                "t_lh.h{tol_class=1e-08}"
+            ]
+            torn.extend(
+                [snap]
+                if sum(snap["buckets"].values()) != snap["count"]
+                else []
+            )
+
+        threads = [
+            threading.Thread(target=observer),
+            threading.Thread(target=snapshotter),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not torn, torn[:1]
+        assert h.count == N
+    finally:
+        reg.reset("t_lh")
+
+
+def test_histogram_merge_associative_across_label_sets():
+    """Per-label-set histograms roll up into one view in ANY grouping
+    order: (a+b)+c == a+(b+c) == (c+b)+a, bucket-exactly — the
+    property that lets per-class SLO histograms aggregate."""
+    feeds = {
+        "1e-06": [1e-4, 2e-3, 2e-3],
+        "1e-08": [5e-2, 7e-1],
+        "1e-10": [3.0, 3e-5, 9e-2, 2e-2],
+    }
+    hists = {}
+    for cls, values in feeds.items():
+        h = LatencyHistogram()
+        for v in values:
+            h.observe(v)
+        hists[cls] = h
+    a, b, c = (hists[k] for k in sorted(feeds))
+    left = a.copy().merge(b).merge(c)
+    bc = b.copy().merge(c)
+    right = a.copy().merge(bc)
+    rev = c.copy().merge(b).merge(a)
+    assert left.snapshot() == right.snapshot()
+    assert left.counts == rev.counts
+    assert left.total == rev.total == sum(len(v) for v in feeds.values())
+    assert left.min == rev.min and left.max == rev.max
+
+
+def test_adaptive_k_picks_measured_optimum_and_static_path_unchanged(
+    monkeypatch,
+):
+    """ISSUE-10 satellite: PA_SERVE_ADAPTIVE_K=1 caps the slab at
+    suggest_k's measured per-RHS optimum (a deep queue picks the
+    measured-best width, not kmax); off (default) the static
+    PA_SERVE_KMAX path coalesces exactly as before."""
+    from partitionedarrays_jl_tpu.service.batcher import effective_kmax
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        telemetry.reset_model()
+        try:
+            svc = SolveService(A, kmax=4, queue_depth=16)
+            m = telemetry.throughput_model()
+            dt = str(np.dtype(b.dtype))
+            # measured per-RHS curve with its optimum at K=2:
+            # K=1 -> 4.0e-3, K=2 -> 1.5e-3, K=4 -> 4.0e-3 per RHS
+            m.observe_slab(svc.fingerprint, dt, 1, 0.004, 10)
+            m.observe_slab(svc.fingerprint, dt, 2, 0.003, 10)
+            m.observe_slab(svc.fingerprint, dt, 4, 0.016, 10)
+            handles = [
+                svc.submit(b, x0=x0, tol=1e-9, tag=f"ad-{i}")
+                for i in range(6)
+            ]
+            # adaptive ON: a 6-deep queue forms a width-2 slab
+            monkeypatch.setenv("PA_SERVE_ADAPTIVE_K", "1")
+            assert effective_kmax(svc._queue, svc.kmax,
+                                  svc.fingerprint) == 2
+            # ...and a chunk-boundary top_up of a width-2 RUNNING slab
+            # honors the same cap (anchor = the slab, base = its
+            # width): no refill back toward the static kmax
+            from partitionedarrays_jl_tpu.service.batcher import top_up
+
+            queue = list(svc._queue)
+            slab = [queue.pop(0), queue.pop(0)]
+            cap = effective_kmax(queue, svc.kmax, svc.fingerprint,
+                                 anchor=slab[0], base=len(slab))
+            assert cap == 2
+            assert top_up(queue, slab, cap) == []
+            assert len(queue) == 4  # nothing consumed
+            assert svc.step() == 2
+            # OFF (the default): the static path runs kmax wide
+            monkeypatch.delenv("PA_SERVE_ADAPTIVE_K")
+            assert effective_kmax(svc._queue, svc.kmax,
+                                  svc.fingerprint) == 4
+            assert svc.step() == 4
+            assert svc.pending() == 0
+            for h in handles:
+                x, info = h.result()
+                assert info["converged"]
+            # an UNMEASURED operator under adaptive K falls back to
+            # the static min(depth, kmax) policy
+            monkeypatch.setenv("PA_SERVE_ADAPTIVE_K", "1")
+            svc2 = SolveService(A, kmax=4, queue_depth=16)
+            telemetry.reset_model()
+            q = [svc2.submit(b, x0=x0, tol=1e-9, tag="un-0"),
+                 svc2.submit(b, x0=x0, tol=1e-9, tag="un-1")]
+            assert effective_kmax(svc2._queue, svc2.kmax,
+                                  svc2.fingerprint) == 2
+            assert svc2.step() == 2
+            for h in q:
+                h.result()
+        finally:
+            telemetry.reset_model()
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))

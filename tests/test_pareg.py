@@ -1,0 +1,187 @@
+"""pareg — the perf ledger and regression sentinel (ISSUE 10).
+
+Acceptance pins: PERF_LEDGER.json covers every committed
+``*_BENCH.json`` with values equal to their sources (the companion
+coverage test lives in test_doc_consistency.py), `pareg --check` is
+green on the committed set, and it exits NONZERO on the committed
+seeded-regression fixture. Pure-JSON layer — no jax, no devices."""
+import importlib.util
+import json
+import os
+
+import pytest
+
+from partitionedarrays_jl_tpu.telemetry import artifacts, ledger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(
+    REPO, "tests", "fixtures", "pareg", "SEEDED_REGRESSION_BENCH.json"
+)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ledger_builds_and_covers_every_committed_artifact():
+    led = ledger.build_ledger(REPO)
+    assert led["ledger_schema_version"] == ledger.LEDGER_SCHEMA_VERSION
+    names = {os.path.basename(p) for p in ledger.artifact_paths(REPO)}
+    assert names, "no committed *_BENCH.json artifacts found"
+    assert set(led["artifacts"]) == names
+    # every artifact contributes at least one metric series
+    for name in names:
+        assert led["artifacts"][name]["metrics"], name
+    # series keys are namespaced by their artifact
+    for key in led["series"]:
+        art = key.split(":", 1)[0]
+        assert art in names, key
+
+
+def test_pareg_check_green_on_committed_set(capsys):
+    pareg = _load_tool("pareg")
+    rc = pareg.main(["--check"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "pareg --check: OK" in out
+
+
+def test_pareg_check_exits_nonzero_on_seeded_regression(capsys):
+    """The acceptance pin: the committed seeded-regression fixture
+    (a lying in_band flag + an on-device out-of-band measurement)
+    trips the sentinel."""
+    pareg = _load_tool("pareg")
+    rc = pareg.main(["--check", FIXTURE])
+    cap = capsys.readouterr()
+    assert rc != 0
+    assert "REGRESSION" in cap.err
+    assert "inconsistent" in cap.err
+    assert "pareg --check: FAILED" in cap.out
+
+
+def test_check_artifact_rule_set(tmp_path):
+    """Unit-level sentinel rules: envelope, band arithmetic, device
+    gating by platform, cpu-canary exemption, ledger staleness."""
+    rec = {
+        "schema_version": 1, "generated_by": "t", "platform": "cpu",
+        "pa_env": {},
+        "bands": {
+            "ok": {"lo": 1.0, "hi": 2.0, "kind": "canary",
+                   "measured": 1.5, "in_band": True},
+            "dev": {"lo": 1.0, "hi": 2.0, "kind": "device",
+                    "measured": 5.0, "in_band": False},
+            "canary_unmeasured": {"lo": 0.9, "hi": 1.1,
+                                  "kind": "device", "measured": None,
+                                  "in_band": None},
+        },
+    }
+    # cpu platform: the out-of-band device value does not gate, the
+    # unmeasured canary is exempt, the flags are consistent -> healthy
+    assert ledger.check_artifact("X_BENCH.json", rec) == []
+    # the same record measured on tpu IS a regression
+    tpu = json.loads(json.dumps(rec))
+    tpu["platform"] = "tpu"
+    fails = ledger.check_artifact("X_BENCH.json", tpu)
+    assert any("REGRESSION" in f and "dev" in f for f in fails)
+    # a non-device band out of its bounds gates on ANY platform
+    bad = json.loads(json.dumps(rec))
+    bad["bands"]["ok"]["measured"] = 9.9
+    bad["bands"]["ok"]["in_band"] = False
+    fails = ledger.check_artifact("X_BENCH.json", bad)
+    assert any("REGRESSION" in f and ":ok" in f for f in fails)
+    # a lying in_band flag is its own failure even when gated off
+    liar = json.loads(json.dumps(rec))
+    liar["bands"]["dev"]["in_band"] = True
+    assert any(
+        "inconsistent" in f
+        for f in ledger.check_artifact("X_BENCH.json", liar)
+    )
+    # missing envelope
+    naked = {"bands": rec["bands"]}
+    assert any(
+        "envelope" in f
+        for f in ledger.check_artifact("X_BENCH.json", naked)
+    )
+
+
+def test_update_ledger_appends_points_and_detects_staleness(tmp_path):
+    """The trajectory grows: a regenerated artifact with a changed
+    value appends a series point; checking the NEW artifact against
+    the OLD ledger reports staleness."""
+    art = tmp_path / "MINI_BENCH.json"
+    rec = {
+        "schema_version": 1, "generated_by": "t", "platform": "cpu",
+        "pa_env": {},
+        "bands": {"m": {"lo": 0.0, "hi": 10.0, "kind": "canary",
+                        "measured": 4.0, "in_band": True}},
+    }
+    art.write_text(json.dumps(rec))
+    led1 = ledger.build_ledger(str(tmp_path))
+    assert led1["series"]["MINI_BENCH.json:m"][0]["value"] == 4.0
+    # unchanged artifact: update is a no-op on the series
+    led_same = ledger.update_ledger(led1, str(tmp_path))
+    assert led_same["series"] == led1["series"]
+    # regenerated artifact: the history grows, latest point wins
+    rec["bands"]["m"]["measured"] = 6.0
+    art.write_text(json.dumps(rec))
+    stale = ledger.check_artifact("MINI_BENCH.json", rec, ledger=led1)
+    assert any("stale" in f for f in stale)
+    led2 = ledger.update_ledger(led1, str(tmp_path))
+    points = led2["series"]["MINI_BENCH.json:m"]
+    assert [p["value"] for p in points] == [4.0, 6.0]
+    assert ledger.check_artifact("MINI_BENCH.json", rec,
+                                 ledger=led2) == []
+    # last-known-good is quoted when a fresh value regresses
+    rec["bands"]["m"]["measured"] = 99.0
+    rec["bands"]["m"]["in_band"] = False
+    fails = ledger.check_artifact("MINI_BENCH.json", rec, ledger=led2)
+    assert any("last known good: 6.0" in f for f in fails)
+
+
+def test_check_repo_flags_orphaned_ledger_entries(tmp_path):
+    """The reverse coverage direction: a ledger entry whose source
+    artifact vanished (deleted/renamed without --update) must trip the
+    sentinel — the artifact table may not reference ghosts."""
+    art = tmp_path / "GONE_BENCH.json"
+    art.write_text(json.dumps({
+        "schema_version": 1, "generated_by": "t", "platform": "cpu",
+        "pa_env": {},
+        "bands": {"m": {"lo": 0.0, "hi": 1.0, "kind": "canary",
+                        "measured": 0.5, "in_band": True}},
+    }))
+    led = ledger.build_ledger(str(tmp_path))
+    (tmp_path / ledger.LEDGER_NAME).write_text(json.dumps(led))
+    assert ledger.check_repo(str(tmp_path)) == []
+    art.unlink()
+    fails = ledger.check_repo(str(tmp_path))
+    assert any("GONE_BENCH.json" in f and "no such artifact" in f
+               for f in fails)
+
+
+def test_content_hash_ignores_pa_env_noise():
+    rec = {"schema_version": 1, "pa_env": {"PA_X": "1"}, "v": 2}
+    other = dict(rec, pa_env={"PA_Y": "0"})
+    assert ledger.content_hash(rec) == ledger.content_hash(other)
+    assert ledger.content_hash(rec) != ledger.content_hash(
+        dict(rec, v=3)
+    )
+
+
+def test_pareg_update_writes_through_shared_envelope(tmp_path, capsys,
+                                                     monkeypatch):
+    """--update writes PERF_LEDGER.json through telemetry.artifacts
+    (the committed file's envelope is pinned by test_doc_consistency);
+    here: the dry-run output is the stamped record."""
+    pareg = _load_tool("pareg")
+    rc = pareg.main(["--update", "--dry-run"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    rec = json.loads(out[: out.rindex("}") + 1])
+    assert rec["ledger_schema_version"] == ledger.LEDGER_SCHEMA_VERSION
+    assert rec["schema_version"] == artifacts.ARTIFACT_SCHEMA_VERSION
+    assert rec["generated_by"] == "pareg"

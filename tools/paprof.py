@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""paprof — phase-attributed solver profiling and the exchange cost
+matrix.
+
+The operator console of `telemetry.profile` (where one CG iteration's
+time goes: SpMV compute / halo exchange / dot all_gathers / axpy
+sweeps) and `telemetry.commsmatrix` (what each per-neighbor exchange
+edge costs — the measured feed for node-aware planning, ROADMAP
+item 3). Legs:
+
+* ``--check``             in-process smoke on the 4-part (6, 6)
+                          conformance fixture: capture a profile,
+                          verify the per-phase collective split
+                          reconciles against `telemetry.comms` and the
+                          attributed sum lands in the pinned band,
+                          measure + reconcile the comms matrix, and
+                          validate the committed artifacts. Exits
+                          nonzero on any broken invariant (the tier-1
+                          smoke, tests/test_paprof.py).
+* ``--profile [OUT]``     capture a phase profile of the fixture (or
+                          ``--n N`` for an N^2 grid) and print the
+                          phase table; with OUT, write the
+                          schema-versioned JSON through the shared
+                          artifacts envelope (`tools/patrace.py
+                          --phases OUT --trace t.json`` merges it onto
+                          the solve timeline).
+* ``--comms-matrix [OUT]`` measure the per-neighbor, per-round
+                          exchange cost matrix of the fixture operator
+                          and print/write it.
+* ``--write``             regenerate the committed PHASE_PROFILE.json
+                          and COMMS_MATRIX.json (the comms matrix on
+                          the generic index plan — ``PA_TPU_BOX=0`` —
+                          where per-round timings are truly measured,
+                          not proportionally attributed).
+
+Options: ``--case standard|fused`` (body form; default the shipped
+default), ``--k K`` (block width), ``--n N`` (grid edge, default 6),
+``--trace 0|1|auto`` (override PA_PROF_TRACE).
+
+Usage:
+    python tools/paprof.py --check
+    python tools/paprof.py --profile --case fused
+    python tools/paprof.py --comms-matrix COMMS_MATRIX.json
+    python tools/paprof.py --write
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _cpu_mesh():
+    """CPU mesh setup — same pattern as tools/patrace.py: the dev
+    image may pre-import jax on another platform, so update the config
+    too."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_ENABLE_X64"] = "true"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    return jax
+
+
+def _fixture(jax, n: int):
+    """The 4-part (n, n) Poisson fixture on a (2, 2) mesh — the same
+    operator family the conformance suite's golden 4-part data pins."""
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu.models import assemble_poisson
+    from partitionedarrays_jl_tpu.parallel.tpu import TPUBackend
+
+    backend = TPUBackend(devices=jax.devices()[:4])
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (n, n))
+        return A
+
+    return pa.prun(driver, backend, (2, 2)), backend
+
+
+def _capture(jax, args):
+    from partitionedarrays_jl_tpu.telemetry import profile as prof
+
+    A, backend = _fixture(jax, args.n)
+    fused = (
+        None if args.case is None else (args.case == "fused")
+    )
+    return prof.capture_phase_profile(
+        A, backend, fused=fused, rhs_batch=args.k or None
+    )
+
+
+def _check(args) -> int:
+    jax = _cpu_mesh()
+    from partitionedarrays_jl_tpu.parallel.tpu import device_matrix
+    from partitionedarrays_jl_tpu.telemetry import (
+        commsmatrix as cm,
+        profile as prof,
+    )
+
+    failures = []
+
+    def expect(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    A, backend = _fixture(jax, args.n)
+    profile = prof.capture_phase_profile(A, backend)
+    expect(profile is not None,
+           "capture returned None (PA_PROF=0 in the environment?)")
+    if profile is not None:
+        print(prof.render_phase_profile(profile))
+        dA = device_matrix(A, backend)
+        mismatches = prof.reconcile_phases(profile, dA=dA)
+        for m in mismatches:
+            expect(False, f"phase reconciliation: {m}")
+        expect(profile["in_band"],
+               f"attributed/measured ratio "
+               f"{profile['ratio_attributed_over_measured']} outside "
+               f"the pinned band {profile['band']}")
+        json.dumps(profile)  # the export is JSON-clean
+
+    matrix = cm.measure_comms_matrix(A, backend)
+    print(cm.render_comms_matrix(matrix))
+    for m in matrix["static_check"]:
+        expect(False, f"comms-matrix reconciliation: {m}")
+    expect(matrix["edges"], "comms matrix recorded no edges")
+    expect(
+        all(e["measured_s"] >= 0.0 for e in matrix["edges"]),
+        "comms matrix recorded a negative edge cost",
+    )
+
+    for name, schema_key, version in (
+        ("PHASE_PROFILE.json", "phase_schema_version",
+         prof.PHASE_SCHEMA_VERSION),
+        ("COMMS_MATRIX.json", "comms_matrix_schema_version",
+         cm.COMMS_MATRIX_SCHEMA_VERSION),
+    ):
+        path = os.path.join(REPO, name)
+        if os.path.exists(path):
+            rec = json.load(open(path))
+            expect(
+                rec.get(schema_key) == version,
+                f"committed {name}: {schema_key} "
+                f"{rec.get(schema_key)!r} != {version}",
+            )
+            if name == "PHASE_PROFILE.json":
+                for m in prof.reconcile_phases(rec):
+                    expect(False, f"committed {name}: {m}")
+
+    for f in failures:
+        print(f"paprof --check FAILURE: {f}", file=sys.stderr)
+    print("paprof --check:", "FAILED" if failures else "OK")
+    return 1 if failures else 0
+
+
+def _write_committed() -> int:
+    jax = _cpu_mesh()
+    import partitionedarrays_jl_tpu as pa  # noqa: F401
+    from partitionedarrays_jl_tpu.parallel.tpu import _env_overrides
+    from partitionedarrays_jl_tpu.telemetry import (
+        artifacts,
+        commsmatrix as cm,
+        profile as prof,
+    )
+
+    A, backend = _fixture(jax, 6)
+    profile = prof.capture_phase_profile(A, backend)
+    if profile is None:
+        print("paprof --write: PA_PROF=0 — nothing captured",
+              file=sys.stderr)
+        return 1
+    artifacts.write(
+        os.path.join(REPO, "PHASE_PROFILE.json"), profile, tool="paprof"
+    )
+    # the committed matrix rides the GENERIC index plan: its per-round
+    # timings are individually measured (the box plan's fused slice
+    # program only supports proportional attribution), and the generic
+    # plan is the structure the node-aware tier will transform
+    with _env_overrides({"PA_TPU_BOX": "0"}):
+        A2, backend2 = _fixture(jax, 6)
+        matrix = cm.measure_comms_matrix(A2, backend2)
+    artifacts.write(
+        os.path.join(REPO, "COMMS_MATRIX.json"), matrix, tool="paprof"
+    )
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="in-process smoke on the 4-part fixture")
+    ap.add_argument("--profile", nargs="?", const="-", metavar="OUT",
+                    help="capture a phase profile (write to OUT)")
+    ap.add_argument("--comms-matrix", nargs="?", const="-",
+                    metavar="OUT", dest="comms_matrix",
+                    help="measure the exchange cost matrix")
+    ap.add_argument("--write", action="store_true",
+                    help="regenerate the committed artifacts")
+    ap.add_argument("--case", choices=("standard", "fused"),
+                    help="CG body form (default: shipped default)")
+    ap.add_argument("--k", type=int, default=0,
+                    help="block width (rhs_batch; 0 = single RHS)")
+    ap.add_argument("--n", type=int, default=6,
+                    help="fixture grid edge (default 6)")
+    ap.add_argument("--trace", choices=("0", "1", "auto"),
+                    help="override PA_PROF_TRACE for this run")
+    args = ap.parse_args(argv)
+
+    if args.trace is not None:
+        # scoped override, restored on exit: tier-1 runs main()
+        # in-process and must not leak the mode into later tests or
+        # into artifacts' pa_env stamps
+        prev = os.environ.get("PA_PROF_TRACE")
+        os.environ["PA_PROF_TRACE"] = args.trace
+        try:
+            return _dispatch(ap, args)
+        finally:
+            if prev is None:
+                os.environ.pop("PA_PROF_TRACE", None)
+            else:
+                os.environ["PA_PROF_TRACE"] = prev
+    return _dispatch(ap, args)
+
+
+def _dispatch(ap, args):
+    if args.check:
+        return _check(args)
+    if args.write:
+        return _write_committed()
+
+    if args.profile is not None:
+        jax = _cpu_mesh()
+        from partitionedarrays_jl_tpu.telemetry import (
+            artifacts,
+            profile as prof,
+        )
+
+        profile = _capture(jax, args)
+        if profile is None:
+            print("paprof: PA_PROF=0 — profiling disabled",
+                  file=sys.stderr)
+            return 1
+        print(prof.render_phase_profile(profile))
+        if args.profile != "-":
+            artifacts.write(args.profile, profile, tool="paprof",
+                            echo=True)
+        return 0
+
+    if args.comms_matrix is not None:
+        jax = _cpu_mesh()
+        from partitionedarrays_jl_tpu.telemetry import (
+            artifacts,
+            commsmatrix as cm,
+        )
+
+        A, backend = _fixture(jax, args.n)
+        matrix = cm.measure_comms_matrix(
+            A, backend, K=max(1, args.k or 1)
+        )
+        print(cm.render_comms_matrix(matrix))
+        if args.comms_matrix != "-":
+            artifacts.write(args.comms_matrix, matrix, tool="paprof",
+                            echo=True)
+        return 0 if not matrix["static_check"] else 1
+
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

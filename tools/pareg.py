@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""pareg — the perf-trajectory ledger and regression sentinel.
+
+The committed ``*_BENCH.json`` artifacts are point-in-time snapshots;
+`telemetry.ledger` folds them into ONE ``PERF_LEDGER.json`` of
+per-metric series and validates any artifact — committed or fresh —
+against its recorded band and its last-known-good point. This tool is
+the operator console and the CI gate:
+
+* ``--check``            validate the WHOLE committed set: every
+                         artifact's envelope, band arithmetic, and
+                         device gates, plus ledger coverage and
+                         staleness. Exits nonzero on any failure —
+                         the tier-1 smoke (tests/test_pareg.py).
+* ``--check PATH [...]`` validate specific artifact files (a fresh
+                         bench output before committing it); each is
+                         also compared against the committed ledger's
+                         last point when its name is ledger-known.
+* ``--update``           rebuild/extend ``PERF_LEDGER.json`` from the
+                         committed artifacts (through the shared
+                         `telemetry.artifacts` envelope writer);
+                         ``--dry-run`` prints without writing.
+* ``--list``             render the ledger's series table.
+
+Usage:
+    python tools/pareg.py --check
+    python tools/pareg.py --check /tmp/fresh_SCALE_BENCH.json
+    python tools/pareg.py --update
+    python tools/pareg.py --list
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_ledger():
+    from partitionedarrays_jl_tpu.telemetry import ledger
+
+    path = os.path.join(REPO, ledger.LEDGER_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _check(paths) -> int:
+    from partitionedarrays_jl_tpu.telemetry import ledger
+
+    failures = []
+    if paths:
+        led = _load_ledger()
+        for path in paths:
+            with open(path, encoding="utf-8") as f:
+                rec = json.load(f)
+            failures.extend(
+                ledger.check_artifact(
+                    os.path.basename(path), rec, ledger=led
+                )
+            )
+    else:
+        failures = ledger.check_repo(REPO)
+    for f in failures:
+        print(f"pareg --check FAILURE: {f}", file=sys.stderr)
+    n = len(ledger.artifact_paths(REPO)) if not paths else len(paths)
+    print(
+        f"pareg --check: {'FAILED' if failures else 'OK'} "
+        f"({n} artifact(s), {len(failures)} failure(s))"
+    )
+    return 1 if failures else 0
+
+
+def _update(dry_run: bool) -> int:
+    from partitionedarrays_jl_tpu.telemetry import artifacts, ledger
+
+    prev = _load_ledger()
+    led = (
+        ledger.update_ledger(prev, REPO)
+        if prev and prev.get("ledger_schema_version")
+        == ledger.LEDGER_SCHEMA_VERSION
+        else ledger.build_ledger(REPO)
+    )
+    artifacts.write(
+        os.path.join(REPO, ledger.LEDGER_NAME), led, tool="pareg",
+        dry_run=dry_run,
+    )
+    print(
+        f"ledger: {len(led['artifacts'])} artifacts, "
+        f"{len(led['series'])} metric series"
+    )
+    return 0
+
+
+def _list() -> int:
+    led = _load_ledger()
+    if led is None:
+        print("pareg: no committed PERF_LEDGER.json — run --update",
+              file=sys.stderr)
+        return 1
+    print(
+        f"PERF_LEDGER.json (schema {led.get('ledger_schema_version')}): "
+        f"{len(led.get('artifacts') or {})} artifacts"
+    )
+    for key, points in sorted((led.get("series") or {}).items()):
+        last = points[-1]
+        band = (
+            f" band=[{last['lo']}, {last['hi']}] ({last['kind']})"
+            if last.get("lo") is not None or last.get("hi") is not None
+            else ""
+        )
+        verdict = (
+            "in-band" if last.get("in_band")
+            else "OUT" if last.get("in_band") is False
+            else "unmeasured" if last.get("value") is None
+            else "unbanded"
+        )
+        print(
+            f"  {key:58s} {len(points)} pt "
+            f"last={last.get('value')}{band} [{verdict}]"
+        )
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", nargs="*", metavar="PATH",
+                    help="validate artifacts (no PATH = whole "
+                         "committed set + ledger)")
+    ap.add_argument("--update", action="store_true",
+                    help="rebuild/extend PERF_LEDGER.json")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="with --update: print instead of writing")
+    ap.add_argument("--list", action="store_true", dest="list_",
+                    help="render the committed ledger")
+    args = ap.parse_args(argv)
+
+    if args.update:
+        return _update(args.dry_run)
+    if args.list_:
+        return _list()
+    if args.check is not None:
+        return _check(args.check)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

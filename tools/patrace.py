@@ -20,6 +20,12 @@ asks after the fact:
                    mesh — the same check `tools/palint.py --check`
                    gates on) and print the per-case verdict. ``--full``
                    widens the fast subset to all 15 cases.
+* ``--phases P``   merge a `telemetry.profile` PhaseProfile JSON
+                   (written by ``tools/paprof.py --profile OUT``) into
+                   the ``--trace`` export as its own synthetic-
+                   iteration track — phase attribution lands on the
+                   same Perfetto timeline as the solve records (alone,
+                   ``--phases`` just renders the phase table).
 * ``--service``    join the solve service's request-level records into
                    per-SLAB timelines: because events append to every
                    active record, one poisoned-column incident is
@@ -338,6 +344,12 @@ def main(argv=None):
                     help="write newest --n records as Chrome-trace JSON")
     ap.add_argument("--n", type=int, default=8,
                     help="record count for --trace (default 8)")
+    ap.add_argument("--phases", metavar="PROFILE",
+                    help="PhaseProfile JSON to merge into --trace "
+                         "(or render standalone)")
+    ap.add_argument("--iterations", type=int, default=4,
+                    help="synthetic iterations for --phases spans "
+                         "(default 4)")
     ap.add_argument("--diff-static", action="store_true",
                     help="probe-solve the lowering matrix and reconcile "
                          "measured comms against the lowered programs")
@@ -351,9 +363,52 @@ def main(argv=None):
     if args.diff_static:
         return _diff_static(args.full)
 
+    phase_profile = None
+    if args.phases:
+        from partitionedarrays_jl_tpu.telemetry import (
+            PHASE_SCHEMA_VERSION,
+            render_phase_profile,
+        )
+
+        phase_profile = json.load(open(args.phases))
+        if phase_profile.get("phase_schema_version") != (
+            PHASE_SCHEMA_VERSION
+        ):
+            print(
+                f"patrace: {args.phases} has phase_schema_version "
+                f"{phase_profile.get('phase_schema_version')!r} (this "
+                f"tool speaks {PHASE_SCHEMA_VERSION})",
+                file=sys.stderr,
+            )
+            return 2
+        if not args.trace:
+            # render the table, then fall through to any OTHER
+            # requested leg (--service/--last/--list must still run)
+            print(render_phase_profile(phase_profile))
+            if not (args.last or args.list_ or args.service):
+                return 0
+
     if not (args.last or args.list_ or args.trace or args.service):
         ap.print_help()
         return 2
+
+    if args.trace and phase_profile is not None and not (
+        args.dir or os.environ.get("PA_METRICS_DIR")
+    ):
+        # phases-only timeline: no records required
+        from partitionedarrays_jl_tpu.telemetry import (
+            phase_trace_events,
+            write_chrome_trace,
+        )
+
+        write_chrome_trace(
+            args.trace,
+            extra_events=phase_trace_events(
+                phase_profile, iterations=args.iterations
+            ),
+        )
+        print(f"wrote {args.trace} (phase profile only)")
+        return 0
 
     d = _records_dir(args)
     if d is None:
@@ -383,8 +438,18 @@ def main(argv=None):
         from partitionedarrays_jl_tpu.telemetry import write_chrome_trace
 
         newest = [rec for _, rec in recs[-max(1, args.n):]]
-        write_chrome_trace(args.trace, records=newest)
-        print(f"wrote {args.trace} ({len(newest)} records)")
+        extra = None
+        if phase_profile is not None:
+            from partitionedarrays_jl_tpu.telemetry import (
+                phase_trace_events,
+            )
+
+            extra = phase_trace_events(
+                phase_profile, iterations=args.iterations
+            )
+        write_chrome_trace(args.trace, records=newest, extra_events=extra)
+        merged = " + phase profile" if extra else ""
+        print(f"wrote {args.trace} ({len(newest)} records{merged})")
     return 0
 
 
