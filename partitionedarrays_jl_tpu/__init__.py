@@ -16,6 +16,8 @@ Import convention::
 
 from . import telemetry  # noqa: F401
 from . import service  # noqa: F401
+from . import frontdoor  # noqa: F401
+from .frontdoor import Gate, LoadShedded, TenantBudgetError  # noqa: F401
 from .service import AdmissionRejected, SolveService  # noqa: F401
 from .models import *  # noqa: F401,F403
 from .models import __all__ as _models_all
@@ -31,5 +33,6 @@ __version__ = "0.1.0"
 __all__ = (
     list(_parallel_all) + list(_utils_all) + list(_ops_all)
     + list(_models_all)
-    + ["telemetry", "service", "SolveService", "AdmissionRejected"]
+    + ["telemetry", "service", "SolveService", "AdmissionRejected",
+       "frontdoor", "Gate", "LoadShedded", "TenantBudgetError"]
 )

@@ -224,6 +224,28 @@ NON_LOWERING: Dict[str, str] = {
         "split-timer) — chooses how a standalone profile is measured, "
         "never what a solver program stages"
     ),
+    "PA_GATE_MEM_BUDGET": (
+        "front-door tenancy budget (frontdoor/tenancy.py) — bounds how "
+        "many operators stay RESIDENT (LRU paging of whole tenants); "
+        "which cached programs exist per tenant is unchanged, and a "
+        "re-staged tenant rebuilds plan_fingerprint-identical plans "
+        "(tests/test_pagate.py)"
+    ),
+    "PA_GATE_CLASSES": (
+        "front-door SLO class vocabulary (frontdoor/scheduler.py) — "
+        "pure admission policy: which requests are refused under "
+        "overload, never what any program stages"
+    ),
+    "PA_GATE_SHED_DEPTH": (
+        "front-door shed watermark (frontdoor/scheduler.py) — queue-"
+        "depth threshold for SLO-class load shedding; host-side "
+        "admission policy only"
+    ),
+    "PA_GATE_PORT": (
+        "front-door HTTP listen port (frontdoor/rpc.py) — transport "
+        "configuration; the RPC surface adds zero in-graph work "
+        "(byte-identical StableHLO pinned in tests/test_pagate.py)"
+    ),
     "PA_METRICS_DIR": (
         "telemetry record persistence directory — where finished "
         "SolveRecord JSONs land on the host, never part of a staged "

@@ -1,0 +1,67 @@
+"""pagate — the out-of-process multi-tenant front door (ROADMAP item 1).
+
+The layer that makes one process look like a SERVICE: many operators,
+many clients, deadlines, and graceful behavior under overload. It
+composes OVER — never reaches into — the in-process service stack
+(PR 7 `service.SolveService`, PR 8 `MEMORY_FOOTPRINT.json` admission
+budgets, PR 9 pamon metrics/SLO accounting, PR 10 adaptive K):
+
+* `frontdoor.tenancy`  — `OperatorRegistry`: N named operators admitted
+  against ``PA_GATE_MEM_BUDGET`` (sum of resident static footprints —
+  the committed MEMORY_FOOTPRINT.json shape-sum convention), routed to
+  per-tenant `SolveService`s, with LRU operator paging/eviction
+  (in-flight slabs drain through the PR 7 checkpoint path, device
+  buffers drop, the next request re-stages — counted and evented).
+* `frontdoor.scheduler` — `Gate`: the EDF cross-tenant queue (earliest
+  absolute deadline dispatches first; the PR 9 deadline-slack/SLO
+  metrics are the asserted measured feed) and SLO-class load shedding
+  (``PA_GATE_CLASSES``/``PA_GATE_SHED_DEPTH``): past the watermark the
+  lowest class is refused with the typed, ``Retry-After``-carrying
+  `LoadShedded` — distinct from queue-full `AdmissionRejected` — while
+  higher classes keep their SLO.
+* `frontdoor.rpc`      — the stdlib HTTP/JSON surface (``/v1/solve``
+  submit-poll-fetch, ``/v1/tenants``, ``/healthz``, ``/metrics``) with
+  exact-float serialization: an HTTP solve returns bitwise the same
+  iterate as the same request in-process, and the tenants' compiled
+  block programs stay byte-identical StableHLO (tests/test_pagate.py).
+
+CLI: ``tools/pagate.py serve|submit|loadgen`` (``--check`` is the
+tier-1 smoke); bench: ``tools/bench_gate.py`` -> ``GATE_BENCH.json``.
+Protocol docs: docs/service.md (Front door).
+"""
+from .rpc import GateServer, gate_port, http_solve, serve_gate  # noqa: F401
+from .scheduler import (  # noqa: F401
+    Gate,
+    GateHandle,
+    LoadShedded,
+    gate_classes,
+    shed_classes,
+    shed_depth,
+)
+from .tenancy import (  # noqa: F401
+    OperatorRegistry,
+    Tenant,
+    TenantBudgetError,
+    UnknownTenantError,
+    mem_budget,
+    operator_footprint_bytes,
+)
+
+__all__ = [
+    "Gate",
+    "GateHandle",
+    "GateServer",
+    "LoadShedded",
+    "OperatorRegistry",
+    "Tenant",
+    "TenantBudgetError",
+    "UnknownTenantError",
+    "gate_classes",
+    "gate_port",
+    "http_solve",
+    "mem_budget",
+    "operator_footprint_bytes",
+    "serve_gate",
+    "shed_classes",
+    "shed_depth",
+]

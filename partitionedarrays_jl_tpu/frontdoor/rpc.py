@@ -1,0 +1,347 @@
+"""The out-of-process HTTP/JSON surface of the gate (stdlib-only).
+
+A thin shim — `http.server.ThreadingHTTPServer`, zero new deps — that
+makes the in-process `Gate` reachable from other processes. It adds
+ZERO in-graph work: request bodies deserialize to the exact host-side
+`PVector`s an in-process caller would build (`scatter_pvector_values`),
+the tenant services' compiled block programs are untouched (pinned
+byte-identical StableHLO in tests/test_pagate.py), and results
+serialize through JSON's exact float64 round-trip (``repr`` —> 17
+significant digits), so a request submitted over HTTP returns BITWISE
+the same iterate as the same request submitted in-process.
+
+Endpoints (the request-handle lifecycle is submit-poll-fetch):
+
+* ``POST /v1/solve`` — body ``{tenant, b, x0?, tol?, maxiter?,
+  deadline?, slo_class?, tag?, dtype?}`` (``b``/``x0`` are the global
+  vectors as JSON arrays); 202 with ``{id, state}``. Overload maps to
+  typed statuses: 429 + ``Retry-After`` for `LoadShedded` (the shed
+  class's measured backoff), 503 for `AdmissionRejected`
+  (queue-full/draining backpressure), 404 for an unknown tenant.
+* ``GET /v1/solve/<id>`` — poll the handle: ``{id, state}``, plus
+  ``{x, info}`` once done or ``{error, message}`` once failed.
+* ``GET /v1/tenants`` — the residency table (resident/evicted,
+  footprint vs budget).
+* ``GET /healthz`` — liveness + queue depth.
+* ``GET /metrics`` — the pamon Prometheus text exposition.
+
+`serve_gate` wires a pump thread (EDF dispatch + SLO accounting) next
+to the HTTP threads; `tools/pagate.py` is the CLI
+(``serve``/``submit``/``loadgen``/``--check``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib import error as _urlerror
+from urllib import request as _urlrequest
+
+import numpy as np
+
+from ..service.admission import AdmissionRejected
+from ..telemetry.registry import registry
+from .scheduler import Gate, LoadShedded
+from .tenancy import UnknownTenantError
+
+__all__ = ["GateServer", "serve_gate", "gate_port", "http_solve"]
+
+
+def gate_port() -> int:
+    """``PA_GATE_PORT`` (default 8642; 0 = ephemeral)."""
+    try:
+        return int(os.environ.get("PA_GATE_PORT", "8642"))
+    except ValueError:
+        return 8642
+
+
+def _vector(gate: Gate, tenant: str, values, dtype) -> object:
+    """One global JSON array -> the tenant-shaped PVector an in-process
+    caller would hold (ghosts filled from the same global data)."""
+    from ..models.solvers import scatter_pvector_values
+
+    A = gate.registry.tenant(tenant).A
+    arr = np.asarray(values, dtype=dtype)
+    if arr.shape != (A.rows.ngids,):
+        raise ValueError(
+            f"tenant {tenant!r} expects a global vector of length "
+            f"{A.rows.ngids}, got shape {arr.shape}"
+        )
+    return scatter_pvector_values(arr, A.cols)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """One request handler bound to the server's gate (the server
+    instance carries ``gate`` and the handle store)."""
+
+    server_version = "pagate/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if self.server.verbose:
+            super().log_message(fmt, *args)
+
+    # -- plumbing ---------------------------------------------------------
+    def _json(self, status: int, payload: dict,
+              headers: Optional[dict] = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _text(self, status: int, text: str, ctype: str) -> None:
+        body = text.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- routes -----------------------------------------------------------
+    def do_GET(self):
+        gate = self.server.gate
+        if self.path == "/healthz":
+            self._json(200, {
+                "ok": True,
+                "tenants": len(gate.registry._tenants),
+                "queue_depth": gate.depth(),
+                "classes": list(gate.classes),
+            })
+        elif self.path == "/metrics":
+            self._text(200, registry().to_prometheus(),
+                       "text/plain; version=0.0.4")
+        elif self.path == "/v1/tenants":
+            self._json(200, {
+                "tenants": gate.residency(),
+                "budget_bytes": gate.registry.budget,
+                "resident_bytes": gate.registry.resident_bytes(),
+            })
+        elif self.path.startswith("/v1/solve/"):
+            rid = self.path.rsplit("/", 1)[-1]
+            h = self.server.handles.get(rid)
+            if h is None:
+                self._json(404, {"error": "UnknownRequest", "id": rid})
+                return
+            out = {"id": rid, "state": h.state,
+                   "tenant": h.tenant, "slo_class": h.slo_class}
+            if h.state == "done":
+                from ..models.solvers import gather_pvector
+
+                x, info = h.result()
+                out["x"] = gather_pvector(x).tolist()
+                out["info"] = {
+                    "converged": bool(info.get("converged")),
+                    "iterations": int(info.get("iterations", 0)),
+                    "status": str(info.get("status")),
+                }
+            elif h.state == "failed":
+                out["error"] = type(h.error).__name__
+                out["message"] = str(h.error)
+            self._json(200, out)
+        else:
+            self._json(404, {"error": "NotFound", "path": self.path})
+
+    def do_POST(self):
+        if self.path != "/v1/solve":
+            self._json(404, {"error": "NotFound", "path": self.path})
+            return
+        gate = self.server.gate
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            tenant = body["tenant"]
+            dtype = np.dtype(body.get("dtype", "float64"))
+            kwargs = {"b": _vector(gate, tenant, body["b"], dtype)}
+            if body.get("x0") is not None:
+                kwargs["x0"] = _vector(gate, tenant, body["x0"], dtype)
+            for k in ("tol", "deadline"):
+                if body.get(k) is not None:
+                    kwargs[k] = float(body[k])
+            if body.get("maxiter") is not None:
+                kwargs["maxiter"] = int(body["maxiter"])
+        except UnknownTenantError as e:
+            self._json(404, {"error": "UnknownTenant", "message": str(e)})
+            return
+        except (KeyError, ValueError, TypeError,
+                json.JSONDecodeError) as e:
+            self._json(400, {"error": "BadRequest", "message": str(e)})
+            return
+        try:
+            h = gate.submit(
+                tenant,
+                slo_class=body.get("slo_class"),
+                tag=str(body.get("tag", "")),
+                **kwargs,
+            )
+        except LoadShedded as e:
+            self._json(
+                429,
+                {"error": "LoadShedded", "message": str(e),
+                 "retry_after_s": e.retry_after_s,
+                 "diagnostics": e.diagnostics},
+                headers={
+                    "Retry-After": max(1, int(round(e.retry_after_s)))
+                },
+            )
+            return
+        except AdmissionRejected as e:
+            self._json(503, {
+                "error": "AdmissionRejected", "message": str(e),
+                "diagnostics": e.diagnostics,
+            })
+            return
+        except UnknownTenantError as e:
+            self._json(404, {"error": "UnknownTenant", "message": str(e)})
+            return
+        rid = self.server.store(h)
+        self._json(202, {"id": rid, "state": h.state,
+                         "tenant": h.tenant, "slo_class": h.slo_class})
+
+
+class GateServer(ThreadingHTTPServer):
+    """The HTTP front of one `Gate` + the pump thread that keeps EDF
+    dispatch and SLO accounting moving while HTTP threads only enqueue
+    and poll."""
+
+    daemon_threads = True
+
+    def __init__(self, gate: Gate, host: str = "127.0.0.1",
+                 port: Optional[int] = None, verbose: bool = False,
+                 max_handles: int = 4096):
+        super().__init__((host, gate_port() if port is None else port),
+                         _Handler)
+        self.gate = gate
+        self.verbose = verbose
+        self.handles = {}
+        #: Retention bound: a long-lived server would otherwise grow
+        #: one handle (holding full b/x0 vectors) per request forever —
+        #: the OLDEST terminal handles are pruned past this; live
+        #: handles are never dropped.
+        self.max_handles = max(1, int(max_handles))
+        self._next = 0
+        self._hlock = threading.Lock()
+        self._stop = threading.Event()
+        self._pump: Optional[threading.Thread] = None
+        self._http: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def store(self, handle) -> str:
+        with self._hlock:
+            rid = f"r{self._next}"
+            self._next += 1
+            self.handles[rid] = handle
+            if len(self.handles) > self.max_handles:
+                # dict preserves insertion order: scan oldest-first and
+                # drop finished handles (a poll after pruning gets the
+                # explicit UnknownRequest 404, not a silent hang)
+                for old in list(self.handles):
+                    if len(self.handles) <= self.max_handles:
+                        break
+                    if self.handles[old].done():
+                        del self.handles[old]
+            return rid
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "GateServer":
+        self._pump = threading.Thread(
+            target=self._pump_loop, daemon=True, name="pagate-pump"
+        )
+        self._pump.start()
+        self._http = threading.Thread(
+            target=self.serve_forever, daemon=True, name="pagate-http"
+        )
+        self._http.start()
+        return self
+
+    def _pump_loop(self) -> None:
+        while not self._stop.wait(0.005):
+            self.gate.pump()
+
+    def stop(self, drain: bool = True) -> None:
+        self._stop.set()
+        if self._pump is not None:
+            self._pump.join()
+        self.shutdown()
+        if self._http is not None:
+            self._http.join()
+        self.server_close()
+        self.gate.shutdown(drain=drain)
+
+
+def serve_gate(gate: Gate, host: str = "127.0.0.1",
+               port: Optional[int] = None,
+               verbose: bool = False) -> GateServer:
+    """Start the HTTP surface (and its pump thread) over ``gate``;
+    returns the running server (``.url``, ``.stop()``)."""
+    return GateServer(gate, host=host, port=port, verbose=verbose).start()
+
+
+# ---------------------------------------------------------------------------
+# the stdlib client (pagate submit/loadgen, tests)
+# ---------------------------------------------------------------------------
+
+
+def http_solve(base_url: str, tenant: str, b, x0=None,
+               tol: Optional[float] = None,
+               maxiter: Optional[int] = None,
+               deadline: Optional[float] = None,
+               slo_class: Optional[str] = None, tag: str = "",
+               dtype: str = "float64", poll_s: float = 0.01,
+               timeout_s: float = 120.0) -> dict:
+    """Submit-poll-fetch one solve over HTTP; returns the final poll
+    payload (state ``done`` with ``x``/``info``, or the typed error
+    payload with its HTTP status under ``"http_status"``)."""
+    import time
+
+    body = {
+        "tenant": tenant, "b": list(map(float, b)), "tag": tag,
+        "dtype": dtype,
+    }
+    if x0 is not None:
+        body["x0"] = list(map(float, x0))
+    if tol is not None:
+        body["tol"] = tol
+    if maxiter is not None:
+        body["maxiter"] = maxiter
+    if deadline is not None:
+        body["deadline"] = deadline
+    if slo_class is not None:
+        body["slo_class"] = slo_class
+    req = _urlrequest.Request(
+        base_url + "/v1/solve", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with _urlrequest.urlopen(req) as resp:
+            sub = json.loads(resp.read())
+            status = resp.status
+    except _urlerror.HTTPError as e:  # typed overload statuses
+        out = json.loads(e.read())
+        out["http_status"] = e.code
+        if e.headers.get("Retry-After"):
+            out["retry_after"] = e.headers["Retry-After"]
+        return out
+    sub["http_status"] = status
+    deadline_at = time.monotonic() + timeout_s
+    while time.monotonic() < deadline_at:
+        with _urlrequest.urlopen(
+            f"{base_url}/v1/solve/{sub['id']}"
+        ) as resp:
+            poll = json.loads(resp.read())
+        if poll["state"] not in ("gate-queued", "queued", "running"):
+            poll["http_status"] = status
+            return poll
+        time.sleep(poll_s)
+    raise TimeoutError(
+        f"request {sub['id']} still {poll['state']} after {timeout_s}s"
+    )

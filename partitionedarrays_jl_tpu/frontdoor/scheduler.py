@@ -1,0 +1,531 @@
+"""EDF deadline scheduling and SLO-class load shedding — the gate's
+cross-tenant queue.
+
+The in-process `SolveService` coalesces FIFO within ONE operator; the
+gate sits above N tenants and decides WHICH tenant's batcher gets fed
+next. Two policies compose here:
+
+* **EDF admission ordering.** The gate holds one cross-tenant queue
+  sorted by absolute deadline (submission clock + the request's
+  relative deadline; deadline-free requests sort last, FIFO among
+  themselves) and dispatches the earliest deadline first into its
+  tenant's service. The measured feed is the PR 9 deadline-slack
+  histogram (``service.deadline_slack_s``) plus the per-class
+  attainment counters — `Gate` asserts at construction that the feed
+  is declared in the metric CATALOG, so the scheduling policy can
+  never outlive its measurement. The EDF invariant (pinned in
+  tests/test_pagate.py): completed-request order never inverts two
+  same-tenant deadlines by more than one chunk boundary — at slab
+  width 1 the order is exact, and coalescing can only reorder within
+  one slab's chunk.
+
+* **SLO-class load shedding.** Requests declare a class from
+  ``PA_GATE_CLASSES`` (ordered best-protected first; default
+  ``interactive,batch,besteffort``). When the gate queue depth crosses
+  the shed watermark ``PA_GATE_SHED_DEPTH``, the LOWEST class is
+  refused with the typed `LoadShedded` — carrying a measured
+  ``retry_after_s`` (scaled from the live ``service.total_s``
+  distribution) that the HTTP surface forwards as ``Retry-After`` —
+  while every higher class keeps its SLO and falls through to the
+  per-tenant bounded-queue `AdmissionRejected` like before, so the two
+  overload behaviors stay typed and separable: ``gate.shed{class=…}``
+  vs ``service.rejected{reason=queue_full}``.
+
+Env knobs (host-side; ``analysis.env_lint.NON_LOWERING`` records the
+reasons):
+
+* ``PA_GATE_CLASSES`` (default ``interactive,batch,besteffort``) —
+  SLO classes, best-protected first.
+* ``PA_GATE_SHED_DEPTH`` (default ``32``) — gate queue depth at which
+  the lowest class starts shedding.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from ..telemetry.registry import CATALOG, monitoring_enabled, registry
+from ..utils.helpers import check
+from .tenancy import OperatorRegistry
+
+__all__ = [
+    "LoadShedded",
+    "Gate",
+    "GateHandle",
+    "gate_classes",
+    "shed_depth",
+    "shed_classes",
+]
+
+#: The PR 9 metrics the EDF policy schedules against — their CATALOG
+#: declarations are asserted at Gate construction (the measured feed
+#: may not silently vanish from under the policy).
+_MEASURED_FEED = (
+    "service.deadline_slack_s", "service.slo.requests",
+    "service.slo.hits", "service.total_s",
+)
+
+
+def gate_classes() -> Tuple[str, ...]:
+    """``PA_GATE_CLASSES``, best-protected first; malformed values fall
+    back to the default triple."""
+    raw = os.environ.get(
+        "PA_GATE_CLASSES", "interactive,batch,besteffort"
+    )
+    classes = tuple(
+        c.strip() for c in raw.split(",") if c.strip()
+    )
+    return classes or ("interactive", "batch", "besteffort")
+
+
+def shed_depth() -> int:
+    try:
+        return max(1, int(os.environ.get("PA_GATE_SHED_DEPTH", "32")))
+    except ValueError:
+        return 32
+
+
+def shed_classes(depth: int, classes: Tuple[str, ...],
+                 watermark: int) -> Tuple[str, ...]:
+    """The classes shed at gate queue ``depth``: the LOWEST class once
+    the watermark is crossed, nothing above it — higher classes keep
+    their SLO and fall through to the per-tenant bounded queue's
+    typed backpressure instead. A single-class configuration never
+    sheds (there is no lower class to sacrifice)."""
+    if depth < watermark or len(classes) < 2:
+        return ()
+    return (classes[-1],)
+
+
+class LoadShedded(RuntimeError):
+    """The gate refused a request because its SLO class is being shed
+    under overload. DISTINCT from `AdmissionRejected` (queue-full /
+    draining backpressure): shedding is a POLICY decision that
+    sacrifices the lowest class so higher classes keep their SLO, and
+    it carries a measured ``retry_after_s`` (the HTTP surface forwards
+    it as ``Retry-After``). ``diagnostics``: class, queue depth,
+    watermark, shed set."""
+
+    def __init__(self, message: str, retry_after_s: float,
+                 diagnostics: Optional[dict] = None):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+        self.diagnostics = dict(diagnostics or {})
+        from ..telemetry import emit_event
+
+        registry().counter(
+            "gate.shed",
+            labels={"slo_class": str(self.diagnostics.get("slo_class"))},
+        ).inc()
+        emit_event(
+            "load_shedded",
+            label=str(self.diagnostics.get("slo_class", "")),
+            tag=self.diagnostics.get("tag"),
+            depth=self.diagnostics.get("depth"),
+            watermark=self.diagnostics.get("watermark"),
+            retry_after_s=self.retry_after_s,
+        )
+
+
+def _edf_key(h: "GateHandle"):
+    """THE queue order: absolute deadline first, deadline-free last,
+    FIFO (seq) among equals — shared by fresh submissions and
+    eviction requeues so the two paths can never diverge."""
+    return (
+        h.deadline_abs is None,
+        h.deadline_abs if h.deadline_abs is not None else 0.0,
+        h.seq,
+    )
+
+
+class GateHandle:
+    """The gate-level result handle: wraps the queued entry until EDF
+    dispatch assigns the tenant-level `SolveRequest`, then delegates to
+    it (same vocabulary: ``state``/``done``/``result``)."""
+
+    __slots__ = ("tenant", "tag", "slo_class", "deadline_abs", "seq",
+                 "kwargs", "request", "_error", "accounted")
+
+    def __init__(self, tenant, tag, slo_class, deadline_abs, seq, kwargs):
+        self.tenant = tenant
+        self.tag = tag
+        self.slo_class = slo_class
+        #: Absolute service-clock deadline (None = no deadline) — the
+        #: EDF sort key.
+        self.deadline_abs = deadline_abs
+        self.seq = seq
+        self.kwargs = kwargs
+        self.request = None  # SolveRequest once dispatched
+        self._error: Optional[BaseException] = None
+        self.accounted = False
+
+    @property
+    def state(self) -> str:
+        if self._error is not None:
+            return "failed"
+        if self.request is None:
+            return "gate-queued"
+        # an eviction's drained states are TRANSIENT at the gate level
+        # (the requeue hook puts the request back in the EDF queue and
+        # it resumes after the next page-in) — reporting them terminal
+        # would let a concurrent account() or HTTP poll consume the
+        # request in the shutdown->requeue window and lose it
+        if self.request.state in ("checkpointed", "suspended"):
+            return "gate-queued"
+        return self.request.state
+
+    def done(self) -> bool:
+        return self.state in ("done", "failed")
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        if self._error is not None:
+            return self._error
+        return self.request.error if self.request is not None else None
+
+    def result(self):
+        if self._error is not None:
+            raise self._error
+        if self.request is None:
+            raise RuntimeError(
+                f"request {self.tag!r} is still gate-queued — pump the "
+                "gate (Gate.pump()/drain()) before asking for the result"
+            )
+        return self.request.result()
+
+    def __repr__(self):
+        return (
+            f"GateHandle(tenant={self.tenant!r}, tag={self.tag!r}, "
+            f"class={self.slo_class!r}, state={self.state!r})"
+        )
+
+
+class Gate:
+    """The multi-tenant front door: an `OperatorRegistry` (tenancy +
+    LRU paging) under an EDF cross-tenant queue with SLO-class load
+    shedding. Composes OVER the service layer — every per-request
+    behavior (bounded admission, coalescing, containment, chunked
+    deadlines) stays the tenant `SolveService`'s.
+
+    Drive it synchronously (``pump()``/``drain()``) or construct with
+    ``start_workers=True`` (each paged-in tenant runs its background
+    worker; ``pump`` then only dispatches and accounts) — the mode the
+    RPC server uses.
+    """
+
+    def __init__(
+        self,
+        mem_budget_bytes: Optional[int] = None,
+        shed_watermark: Optional[int] = None,
+        classes: Optional[Tuple[str, ...]] = None,
+        checkpoint_dir: Optional[str] = None,
+        clock: Optional[Callable[[], float]] = None,
+        start_workers: bool = False,
+    ):
+        self.registry = OperatorRegistry(
+            mem_budget_bytes=mem_budget_bytes,
+            checkpoint_dir=checkpoint_dir,
+            clock=clock, start_workers=start_workers,
+        )
+        self.clock = self.registry.clock
+        self.classes = tuple(classes) if classes else gate_classes()
+        check(len(self.classes) >= 1, "gate: need at least one SLO class")
+        self.watermark = (
+            shed_depth() if shed_watermark is None
+            else max(1, int(shed_watermark))
+        )
+        # the measured feed the EDF/SLO policy reads must stay declared
+        for name in _MEASURED_FEED:
+            check(
+                name in CATALOG,
+                f"gate: measured feed {name!r} missing from the metric "
+                "CATALOG — the PR 9 instrumentation is the scheduling "
+                "input, not an optional extra",
+            )
+        self._queue: List[GateHandle] = []
+        self._inflight: List[GateHandle] = []
+        self._lock = threading.RLock()
+        self._seq = 0
+        #: While True, `pump` dispatches nothing — demos and tests use
+        #: it to build a deterministic backlog (shedding is a function
+        #: of queue depth, which a fast drain would race away).
+        self.paused = False
+        # an eviction's drained requests re-enter the EDF queue and
+        # resume (checkpointed iterates become the resubmission's x0)
+        self.registry.on_evict = self._requeue_evicted
+
+    # -- tenancy passthrough ---------------------------------------------
+    def register(self, name, A, **kwargs):
+        return self.registry.register(name, A, **kwargs)
+
+    def evict(self, name):
+        return self.registry.evict(name)
+
+    def service(self, name):
+        return self.registry.service(name)
+
+    def residency(self):
+        return self.registry.residency()
+
+    # -- admission ---------------------------------------------------------
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def retry_after(self, depth: int) -> float:
+        """Measured backoff hint for a shed request: the live p50
+        request latency (``service.total_s``) times the queue depth in
+        watermark units — how long until the backlog plausibly clears.
+        Falls back to 1 s while unmeasured."""
+        h = registry().histogram("service.total_s")
+        p50 = h.quantile(0.5) if h.count else None
+        base = p50 if p50 else 1.0
+        return round(base * max(1.0, depth / self.watermark), 6)
+
+    def submit(self, tenant: str, b, slo_class: Optional[str] = None,
+               tag: str = "", **kwargs) -> GateHandle:
+        """Admit one request into the gate queue (EDF-ordered), or
+        raise: `LoadShedded` when the request's class is being shed at
+        the current depth, `UnknownTenantError` for an unregistered
+        tenant. ``kwargs`` pass through to `SolveService.submit`
+        (x0/tol/maxiter/deadline/retries)."""
+        cls = slo_class if slo_class is not None else self.classes[-1]
+        check(
+            cls in self.classes,
+            f"gate: unknown SLO class {cls!r} "
+            f"(PA_GATE_CLASSES={','.join(self.classes)})",
+        )
+        self.registry.tenant(tenant)  # raise UnknownTenantError early
+        with self._lock:
+            depth = len(self._queue)
+            shed = shed_classes(depth, self.classes, self.watermark)
+            if cls in shed:
+                raise LoadShedded(
+                    f"gate: class {cls!r} is shedding at queue depth "
+                    f"{depth} (watermark PA_GATE_SHED_DEPTH="
+                    f"{self.watermark}; shed classes: {', '.join(shed)})"
+                    " — retry after the backlog clears",
+                    retry_after_s=self.retry_after(depth),
+                    diagnostics={
+                        "slo_class": cls, "tag": tag, "depth": depth,
+                        "watermark": self.watermark, "shed": list(shed),
+                    },
+                )
+            deadline = kwargs.get("deadline")
+            now = self.clock()
+            h = GateHandle(
+                tenant=tenant,
+                tag=tag or f"gate-{self._seq}",
+                slo_class=cls,
+                deadline_abs=(
+                    None if deadline is None else now + float(deadline)
+                ),
+                seq=self._seq,
+                kwargs=dict(kwargs, b=b, tag=tag or f"gate-{self._seq}"),
+            )
+            self._seq += 1
+            # EDF: sorted by absolute deadline, deadline-free last,
+            # FIFO among equals (stable by seq)
+            self._queue.append(h)
+            self._queue.sort(key=_edf_key)
+            if monitoring_enabled():
+                registry().gauge("gate.queue_depth").set(
+                    len(self._queue)
+                )
+            return h
+
+    # -- dispatch / drive --------------------------------------------------
+    def _requeue_evicted(self, name: str, tenant) -> None:
+        """The eviction hook (`OperatorRegistry.on_evict`): every
+        dispatched-but-unfinished request the page-out drained —
+        SUSPENDED (never started) or CHECKPOINTED (iterate saved at the
+        chunk boundary, the PR 7 path) — re-enters the gate's EDF queue
+        and resumes after the next page-in. A checkpointed request
+        resubmits FROM its saved iterate (``x0``; its spent iterations
+        come off the maxiter budget), so eviction costs a chunk
+        restart, never progress."""
+        from .. import telemetry
+
+        requeued = 0
+        with self._lock:
+            for h in self._inflight:
+                req = h.request
+                if h.tenant != name or req is None or h.accounted:
+                    continue
+                if req.state not in ("suspended", "checkpointed"):
+                    continue
+                if req.state == "checkpointed" and req.checkpoint_path:
+                    from ..parallel.checkpoint import load_solver_state
+
+                    st = load_solver_state(
+                        req.checkpoint_path, {"x": tenant.A.cols}
+                    )
+                    if st is not None:
+                        h.kwargs["x0"] = st["x"]
+                        if h.kwargs.get("maxiter") is not None:
+                            h.kwargs["maxiter"] = max(
+                                1, int(h.kwargs["maxiter"])
+                                - req.iterations
+                            )
+                h.request = None
+                self._queue.append(h)
+                requeued += 1
+            if requeued:
+                self._inflight = [
+                    h for h in self._inflight if h.request is not None
+                    or h._error is not None
+                ]
+                self._queue.sort(key=_edf_key)
+                if monitoring_enabled():
+                    registry().gauge("gate.queue_depth").set(
+                        len(self._queue)
+                    )
+        if requeued:
+            telemetry.emit_event(
+                "tenant_requeued", label=name, requests=requeued
+            )
+
+    def _busy_residents(self) -> bool:
+        """Any resident tenant still holding queued OR in-flight gate
+        work? The pump defers a tenant SWITCH (a page-in, hence an
+        eviction) until then — paging per request would thrash the
+        budget, and a worker-mode slab is in flight precisely while its
+        service queue reads empty, so the gate's own dispatched-but-
+        unfinished handles are part of the busy test (without them the
+        5 ms pump would evict every slab mid-solve — a livelock where
+        nothing ever completes)."""
+        busy = {
+            h.tenant
+            for h in self._inflight
+            if h.request is not None
+            and h.request.state in ("queued", "running")
+        }
+        return any(
+            t.resident and (
+                t.name in busy
+                or (t.svc is not None and t.svc.pending() > 0)
+            )
+            for t in self.registry._tenants.values()
+        )
+
+    def pump(self, dispatch_only: bool = False) -> int:
+        """One scheduling round: take the EDF head, dispatch EVERY
+        gate-queued request of the head's tenant (in EDF order — the
+        same-tenant deadline order is preserved exactly; the service's
+        FIFO batcher consumes it in that order) into its service,
+        paging the tenant in if needed, then — unless the tenants run
+        their own workers or ``dispatch_only`` — drive that service to
+        completion and account finished requests. A switch to a
+        NON-resident tenant is deferred while resident tenants still
+        hold queued work (one page-in per quiescent switch, not per
+        request). Returns the number of requests dispatched."""
+        if self.paused:
+            self.account()
+            return 0
+        with self._lock:
+            if not self._queue:
+                batch = []
+            else:
+                target = self._queue[0].tenant
+                t = self.registry._tenants.get(target)
+                if (
+                    t is not None and not t.resident
+                    and self._busy_residents()
+                ):
+                    batch = []  # defer the page-in until quiescence
+                    if not self.registry.start_workers and not (
+                        dispatch_only
+                    ):
+                        # synchronous tenants have no worker to reach
+                        # quiescence on their own — drive them here
+                        for v in self.registry._tenants.values():
+                            if v.resident and v.svc is not None:
+                                v.svc.drain()
+                else:
+                    batch = [
+                        h for h in self._queue if h.tenant == target
+                    ]
+                    self._queue = [
+                        h for h in self._queue if h.tenant != target
+                    ]
+            if monitoring_enabled():
+                registry().gauge("gate.queue_depth").set(
+                    len(self._queue)
+                )
+        for h in batch:
+            kwargs = dict(h.kwargs)
+            if h.deadline_abs is not None:
+                # the service measures deadlines from ITS submission;
+                # charge the time spent in the gate queue against the
+                # request's budget so EDF cannot mint extra slack
+                kwargs["deadline"] = max(
+                    1e-9, h.deadline_abs - self.clock()
+                )
+            try:
+                h.request = self.registry.submit(h.tenant, **kwargs)
+            except Exception as e:  # typed AdmissionRejected etc.
+                h._error = e
+            with self._lock:  # account() rebinds _inflight under it
+                self._inflight.append(h)
+        if batch and not dispatch_only and not (
+            self.registry.start_workers
+        ):
+            svc = self.registry.tenant(batch[0].tenant).svc
+            if svc is not None:
+                svc.drain()
+        self.account()
+        return len(batch)
+
+    def drain(self) -> None:
+        """Pump until the gate queue is empty and every dispatched
+        request is terminal (worker-mode tenants finish on their own
+        threads; synchronous tenants are driven here)."""
+        import time as _time
+
+        check(not self.paused, "gate: resume() before drain()")
+
+        while True:
+            self.pump()
+            with self._lock:
+                pending = bool(self._queue) or any(
+                    not h.done() for h in self._inflight
+                )
+            if not pending:
+                return
+            # worker-mode tenants finish on their own threads; the
+            # tiny sleep also keeps a pathological sync-mode wait (an
+            # inflight request owned by an un-driven service) from
+            # busy-spinning
+            _time.sleep(0.005 if self.registry.start_workers else 0.001)
+
+    def account(self) -> None:
+        """Fold terminal requests into the per-class SLO counters:
+        every finished gate request ticks ``gate.slo.requests`` for its
+        class; a request that resolved (``done``) ticks
+        ``gate.slo.hits`` too — a deadline miss fails typed at the
+        service layer, so hits/requests IS the per-class attainment."""
+        reg = registry()
+        with self._lock:
+            for h in self._inflight:
+                if h.accounted or not h.done():
+                    continue
+                labels = {"slo_class": h.slo_class}
+                reg.counter("gate.slo.requests", labels=labels).inc()
+                if h.state == "done":
+                    reg.counter("gate.slo.hits", labels=labels).inc()
+                h.accounted = True
+            self._inflight = [
+                h for h in self._inflight if not h.accounted
+            ]
+
+    def shutdown(self, drain: bool = True):
+        if drain:
+            self.drain()
+        return self.registry.shutdown(drain=drain)
+
+    def __repr__(self):
+        return (
+            f"Gate(classes={self.classes}, watermark={self.watermark}, "
+            f"depth={self.depth()}, {self.registry!r})"
+        )

@@ -1,0 +1,387 @@
+"""Operator tenancy: N named operators admitted against a memory
+budget, with LRU paging.
+
+One `SolveService` serves ONE operator (its device-resident staging,
+compiled block programs, and caches are all per-``A`` — docs/service.md);
+"millions of users" means MANY operators behind one front door. This
+module is the registry that makes that safe: every registered operator
+declares a static memory footprint (the same ``operands + 2 x carry``
+shape-sum convention the committed ``MEMORY_FOOTPRINT.json`` admission
+table records for the lowering matrix — PR 8 built that table precisely
+as this input), and the sum of RESIDENT footprints may never exceed
+``PA_GATE_MEM_BUDGET``. When admitting or paging an operator in would
+break the bound, the least-recently-used resident tenant is EVICTED:
+
+1. its in-flight slabs are drained through the PR 7 checkpoint path
+   (``SolveService.shutdown(drain=False)`` — running requests
+   checkpoint their iterates under the tenant's checkpoint dir,
+   never-started ones suspend; both resumable by resubmission);
+2. its device buffers are dropped (the ``A._device`` staging cache —
+   DeviceMatrix, exchange-plan operands, compiled-program cache all
+   hang off it);
+3. the tenant is marked evicted; the NEXT request pages it back in
+   (a fresh `SolveService`; staging re-runs lazily at the first solve,
+   and the re-staged plan is `plan_fingerprint`-identical to the
+   evicted one — the PR 8 rebuild invariant, pinned in
+   tests/test_pagate.py).
+
+An operator whose footprint exceeds the whole budget can NEVER be
+served and is refused with the typed `TenantBudgetError` at
+registration (budget-exceeded admission — a chaos-matrix row, distinct
+from per-request backpressure). Evictions and page-ins are counted
+(``gate.evictions`` / ``gate.page_ins``) and evented
+(``tenant_evicted`` / ``tenant_paged_in``), and the residency table
+(resident/evicted, footprint vs budget) is exported for
+``/v1/tenants`` and the `tools/pamon.py` gate view.
+
+Env knobs (host-side; ``analysis.env_lint.NON_LOWERING`` records the
+reasons):
+
+* ``PA_GATE_MEM_BUDGET`` (default 0 = unbounded) — resident-footprint
+  budget in bytes for the operator registry.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..service.service import SolveService
+from ..telemetry.registry import monitoring_enabled, registry
+from ..utils.helpers import check
+
+__all__ = [
+    "TenantBudgetError",
+    "UnknownTenantError",
+    "Tenant",
+    "OperatorRegistry",
+    "mem_budget",
+    "operator_footprint_bytes",
+]
+
+
+def mem_budget() -> int:
+    """``PA_GATE_MEM_BUDGET`` in bytes; 0 (the default) = unbounded."""
+    try:
+        return max(0, int(os.environ.get("PA_GATE_MEM_BUDGET", "0")))
+    except ValueError:
+        return 0
+
+
+class TenantBudgetError(RuntimeError):
+    """Registering (or paging in) an operator would exceed the memory
+    budget even after every other tenant is evicted — the operator can
+    never be served under this budget. ``diagnostics`` carries the
+    tenant name, its footprint, and the bound. NOT an
+    `AdmissionRejected`: the refusal is per-OPERATOR capacity planning,
+    not per-request backpressure."""
+
+    def __init__(self, message: str, diagnostics: Optional[dict] = None):
+        super().__init__(message)
+        self.diagnostics = dict(diagnostics or {})
+        from ..telemetry import emit_event
+
+        registry().counter("gate.budget_rejected").inc()
+        emit_event(
+            "tenant_budget_rejected",
+            label=str(self.diagnostics.get("tenant", "")),
+            footprint_bytes=self.diagnostics.get("footprint_bytes"),
+            budget_bytes=self.diagnostics.get("budget_bytes"),
+        )
+
+
+class UnknownTenantError(KeyError):
+    """A request named a tenant the registry never admitted."""
+
+
+def operator_footprint_bytes(A, kmax: int, dtype=None) -> int:
+    """Conservative static footprint of serving ``A`` at slab width
+    ``kmax``: staged operand bytes (the local matrix value streams —
+    what `analysis.memory_report` counts as ``operand_bytes``) plus
+    2 x the block-CG carry (3 state vectors of (local rows, K) in and
+    out of the loop) — the same ``operands + 2 x carry`` shape-sum
+    convention the committed ``MEMORY_FOOTPRINT.json`` records where no
+    compiled leg exists. Deliberately cheap and structural: admission
+    needs a bound before anything stages, not a compile."""
+    itemsize = np.dtype(dtype or np.float64).itemsize
+    operand = 0
+    rows_local = 0
+    for vals in A.values.part_values():
+        arr = np.asarray(getattr(vals, "data", vals))
+        operand += arr.size * itemsize
+    for iset in A.rows.partition.part_values():
+        rows_local += int(iset.num_lids)
+    carry = 3 * rows_local * max(1, int(kmax)) * itemsize
+    return int(operand + 2 * carry)
+
+
+class Tenant:
+    """One registered operator and its serving state."""
+
+    __slots__ = (
+        "name", "A", "minv", "footprint_bytes", "svc", "resident",
+        "last_used", "svc_kwargs", "checkpoint_dir", "evictions",
+        "page_ins",
+    )
+
+    def __init__(self, name, A, minv, footprint_bytes, checkpoint_dir,
+                 svc_kwargs):
+        self.name = name
+        self.A = A
+        self.minv = minv
+        self.footprint_bytes = int(footprint_bytes)
+        self.svc: Optional[SolveService] = None
+        self.resident = False
+        self.last_used = 0.0
+        self.svc_kwargs = dict(svc_kwargs)
+        self.checkpoint_dir = checkpoint_dir
+        self.evictions = 0
+        self.page_ins = 0
+
+
+class OperatorRegistry:
+    """The multi-operator admission layer (see module docstring).
+
+    ``mem_budget_bytes`` overrides ``PA_GATE_MEM_BUDGET``; ``clock`` is
+    the LRU/latency time source (injectable, like the service's);
+    ``checkpoint_dir`` roots each tenant's eviction checkpoints at
+    ``<dir>/<tenant>``; ``start_workers=True`` starts each paged-in
+    service's background worker thread (the live-server mode `rpc` and
+    eviction-during-inflight need — synchronous ``drain`` callers keep
+    the default off)."""
+
+    def __init__(
+        self,
+        mem_budget_bytes: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        clock: Optional[Callable[[], float]] = None,
+        start_workers: bool = False,
+    ):
+        self.budget = (
+            mem_budget() if mem_budget_bytes is None
+            else max(0, int(mem_budget_bytes))
+        )
+        self.checkpoint_dir = checkpoint_dir
+        self.clock = clock if clock is not None else time.monotonic
+        self.start_workers = bool(start_workers)
+        #: Optional hook called AFTER a tenant is paged out (the gate
+        #: installs its requeue here, so an eviction's drained
+        #: suspended/checkpointed requests re-enter the EDF queue and
+        #: resume after the next page-in instead of dying terminal).
+        #: Called holding the registry lock; the hook may take the
+        #: gate lock (the inverse order never happens — `Gate` touches
+        #: the registry only from outside its own lock).
+        self.on_evict: Optional[Callable[[str, "Tenant"], None]] = None
+        self._tenants: Dict[str, Tenant] = {}
+        self._lock = threading.RLock()
+        if monitoring_enabled():
+            registry().gauge("gate.mem_budget_bytes").set(self.budget)
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+
+    def register(self, name: str, A, minv=None,
+                 footprint_bytes: Optional[int] = None,
+                 **svc_kwargs) -> Tenant:
+        """Admit one named operator. ``footprint_bytes`` defaults to
+        the `operator_footprint_bytes` shape-sum at the service's slab
+        width. Raises `TenantBudgetError` when the operator alone
+        exceeds the budget; otherwise admits it and pages it in
+        (evicting LRU residents as needed)."""
+        from .. import telemetry
+
+        kmax = svc_kwargs.get("kmax")
+        fp = (
+            operator_footprint_bytes(
+                A, kmax if kmax else 8
+            )
+            if footprint_bytes is None
+            else int(footprint_bytes)
+        )
+        ckpt = (
+            os.path.join(self.checkpoint_dir, name)
+            if self.checkpoint_dir is not None else None
+        )
+        with self._lock:
+            # the whole admit decision runs under the lock: a racing
+            # duplicate register must lose here, not double-insert
+            check(name not in self._tenants,
+                  f"gate: tenant {name!r} already registered")
+            if self.budget and fp > self.budget:
+                raise TenantBudgetError(
+                    f"gate: operator {name!r} needs {fp} bytes but the "
+                    f"budget is PA_GATE_MEM_BUDGET={self.budget} — it "
+                    "can never be served; raise the budget or shrink "
+                    "the slab",
+                    diagnostics={
+                        "tenant": name, "footprint_bytes": fp,
+                        "budget_bytes": self.budget,
+                    },
+                )
+            t = Tenant(name, A, minv, fp, ckpt, svc_kwargs)
+            self._tenants[name] = t
+            telemetry.emit_event(
+                "tenant_registered", label=name, footprint_bytes=fp,
+                budget_bytes=self.budget,
+            )
+            self._page_in(t)
+            return t
+
+    # ------------------------------------------------------------------
+    # routing / paging
+    # ------------------------------------------------------------------
+
+    def tenant(self, name: str) -> Tenant:
+        t = self._tenants.get(name)
+        if t is None:
+            raise UnknownTenantError(
+                f"gate: unknown tenant {name!r} (registered: "
+                f"{sorted(self._tenants)})"
+            )
+        return t
+
+    def service(self, name: str) -> SolveService:
+        """The tenant's live service — paging it back in (and evicting
+        LRU residents) when it was evicted. Touches the LRU clock."""
+        with self._lock:
+            t = self.tenant(name)
+            if not t.resident:
+                self._page_in(t)
+            t.last_used = self.clock()
+            return t.svc
+
+    def submit(self, name: str, b, **kwargs):
+        """Route one request to its tenant's service (the request-level
+        admission — bounded queue, typed backpressure — stays the
+        service's)."""
+        return self.service(name).submit(b, **kwargs)
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(
+                t.footprint_bytes for t in self._tenants.values()
+                if t.resident
+            )
+
+    def residency(self) -> List[dict]:
+        """The tenancy table `/v1/tenants` serves and pamon renders."""
+        with self._lock:
+            return [
+                {
+                    "tenant": t.name,
+                    "resident": t.resident,
+                    "footprint_bytes": t.footprint_bytes,
+                    "evictions": t.evictions,
+                    "page_ins": t.page_ins,
+                    "pending": t.svc.pending() if t.svc else 0,
+                    "ngids": t.A.rows.ngids,
+                }
+                for _, t in sorted(self._tenants.items())
+            ]
+
+    def _page_in(self, t: Tenant) -> None:
+        """Make ``t`` resident: evict LRU residents until it fits, then
+        build a fresh `SolveService` (device staging re-runs lazily at
+        the first solve)."""
+        from .. import telemetry
+
+        if self.budget:
+            # evict the least-recently-used resident until t fits —
+            # register() guarantees t alone fits, so this terminates
+            while self.resident_bytes() + t.footprint_bytes > self.budget:
+                victims = [
+                    v for v in self._tenants.values()
+                    if v.resident and v is not t
+                ]
+                assert victims, "budget invariant broken"
+                self.evict(min(victims, key=lambda v: v.last_used).name)
+        t.svc = SolveService(
+            t.A, minv=t.minv, checkpoint_dir=t.checkpoint_dir,
+            clock=self.clock, **t.svc_kwargs,
+        )
+        if self.start_workers:
+            t.svc.start()
+        t.resident = True
+        t.page_ins += 1
+        t.last_used = self.clock()
+        registry().counter("gate.page_ins").inc()
+        telemetry.emit_event(
+            "tenant_paged_in", label=t.name,
+            footprint_bytes=t.footprint_bytes,
+            resident_bytes=self.resident_bytes(),
+        )
+        self._update_gauges()
+
+    def evict(self, name: str) -> dict:
+        """Page one tenant out: drain its in-flight slabs through the
+        PR 7 checkpoint path, drop its device buffers, mark it evicted.
+        Returns the drained service's stats snapshot."""
+        from .. import telemetry
+
+        with self._lock:
+            t = self.tenant(name)
+            check(t.resident, f"gate: tenant {name!r} is not resident")
+            stats = t.svc.shutdown(drain=False)
+            # drop the device-resident staging (DeviceMatrix, plan
+            # operands, compiled programs all hang off A._device) —
+            # the next page-in re-stages from the host plan, which the
+            # PR 8 invariant pins plan_fingerprint-identical
+            getattr(t.A, "_device", {}).clear()
+            t.svc = None
+            t.resident = False
+            t.evictions += 1
+            registry().counter("gate.evictions").inc()
+            telemetry.emit_event(
+                "tenant_evicted", label=name,
+                footprint_bytes=t.footprint_bytes,
+                checkpointed=stats.get("checkpointed", 0),
+                suspended=stats.get("suspended", 0),
+                resident_bytes=self.resident_bytes(),
+            )
+            self._update_gauges()
+            if self.on_evict is not None:
+                self.on_evict(name, t)
+            return stats
+
+    def _update_gauges(self) -> None:
+        if not monitoring_enabled():
+            return
+        reg = registry()
+        reg.gauge("gate.resident_bytes").set(self.resident_bytes())
+        reg.gauge("gate.mem_budget_bytes").set(self.budget)
+        for t in self._tenants.values():
+            labels = {"tenant": t.name}
+            reg.gauge("gate.tenant_resident", labels=labels).set(
+                1.0 if t.resident else 0.0
+            )
+            reg.gauge(
+                "gate.tenant_footprint_bytes", labels=labels
+            ).set(t.footprint_bytes)
+
+    # ------------------------------------------------------------------
+    # shutdown
+    # ------------------------------------------------------------------
+
+    def shutdown(self, drain: bool = True) -> Dict[str, dict]:
+        """Shut every resident tenant's service down (same ``drain``
+        semantics as `SolveService.shutdown`); returns per-tenant
+        stats."""
+        out = {}
+        with self._lock:
+            for name, t in sorted(self._tenants.items()):
+                if t.resident and t.svc is not None:
+                    out[name] = t.svc.shutdown(drain=drain)
+        return out
+
+    def __repr__(self):
+        with self._lock:
+            res = sum(1 for t in self._tenants.values() if t.resident)
+            return (
+                f"OperatorRegistry(tenants={len(self._tenants)}, "
+                f"resident={res}, bytes={self.resident_bytes()}/"
+                f"{self.budget or 'inf'})"
+            )

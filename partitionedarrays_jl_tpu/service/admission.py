@@ -56,9 +56,15 @@ class AdmissionRejected(RuntimeError):
         from ..telemetry.registry import registry
 
         # the rejection counter is always-on (pamon's overload signal:
-        # rejected/admitted is the shed-load rate) — the event below
-        # additionally ticks events.admission_rejected
-        registry().counter("service.rejected").inc()
+        # rejected/admitted is the shed-load rate) and labeled by
+        # reason, so queue-full backpressure and a draining service
+        # stay separable from each other AND from gate.shed (SLO-class
+        # load shedding) in /metrics — the event below additionally
+        # ticks events.admission_rejected
+        registry().counter(
+            "service.rejected",
+            labels={"reason": str(self.diagnostics.get("reason", ""))},
+        ).inc()
         emit_event(
             "admission_rejected",
             label=str(self.diagnostics.get("reason", "")),

@@ -142,7 +142,10 @@ CATALOG: Dict[str, MetricSpec] = {
               "requests admitted past the bounded queue"),
         _spec("service.rejected", "counter", "1",
               "service/admission.py:AdmissionRejected",
-              "typed admission backpressure (queue_full or draining)"),
+              "typed admission backpressure, split by reason "
+              "(queue_full or draining) — load shedding counts under "
+              "gate.shed, never here",
+              labels=("reason",)),
         _spec("service.completed", "counter", "1",
               "service/service.py:_finish",
               "requests resolved with a result"),
@@ -210,6 +213,51 @@ CATALOG: Dict[str, MetricSpec] = {
               "service/service.py:_slo_account",
               "deadline-carrying requests that finished within deadline",
               labels=("tol_class",)),
+        # -- the front door (pagate) ----------------------------------
+        _spec("gate.shed", "counter", "1",
+              "frontdoor/scheduler.py:LoadShedded",
+              "requests refused by SLO-class load shedding (typed "
+              "LoadShedded with Retry-After — distinct from the "
+              "queue-full/draining service.rejected reasons)",
+              labels=("slo_class",)),
+        _spec("gate.budget_rejected", "counter", "1",
+              "frontdoor/tenancy.py:TenantBudgetError",
+              "operator registrations refused because the footprint "
+              "exceeds PA_GATE_MEM_BUDGET outright"),
+        _spec("gate.evictions", "counter", "1",
+              "frontdoor/tenancy.py:evict",
+              "tenants paged out (in-flight slabs drained via the "
+              "checkpoint path, device buffers dropped)"),
+        _spec("gate.page_ins", "counter", "1",
+              "frontdoor/tenancy.py:_page_in",
+              "tenants made resident (registration or re-stage after "
+              "an eviction)"),
+        _spec("gate.slo.requests", "counter", "1",
+              "frontdoor/scheduler.py:account",
+              "gate requests reaching a terminal state, per SLO class",
+              labels=("slo_class",)),
+        _spec("gate.slo.hits", "counter", "1",
+              "frontdoor/scheduler.py:account",
+              "gate requests that resolved (done — deadline misses "
+              "fail typed and do not count), per SLO class",
+              labels=("slo_class",)),
+        _spec("gate.queue_depth", "gauge", "requests",
+              "frontdoor/scheduler.py:submit/pump",
+              "requests in the cross-tenant EDF queue right now"),
+        _spec("gate.resident_bytes", "gauge", "bytes",
+              "frontdoor/tenancy.py:_update_gauges",
+              "sum of resident tenants' static footprints"),
+        _spec("gate.mem_budget_bytes", "gauge", "bytes",
+              "frontdoor/tenancy.py:_update_gauges",
+              "the PA_GATE_MEM_BUDGET bound (0 = unbounded)"),
+        _spec("gate.tenant_resident", "gauge", "1",
+              "frontdoor/tenancy.py:_update_gauges",
+              "1 while the tenant is resident, 0 while evicted",
+              labels=("tenant",)),
+        _spec("gate.tenant_footprint_bytes", "gauge", "bytes",
+              "frontdoor/tenancy.py:_update_gauges",
+              "the tenant's declared static footprint",
+              labels=("tenant",)),
     ]
 }
 

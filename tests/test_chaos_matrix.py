@@ -47,6 +47,16 @@ wrong answer lands; with ``PA_PLAN_VERIFY=1`` it is caught STATICALLY:
 | condition               | detector            | documented outcome   |
 |-------------------------|---------------------|----------------------|
 | corrupted exchange plan | static plan verifier at the build site | PlanSoundnessError (typed, with check + part/slot diagnostics) + plan_defect/health_error events, BEFORE any solve runs |
+
+Round 14 (pagate): the front door adds the TENANCY/overload rows —
+failures hitting the multi-OPERATOR layer, each with its documented
+outcome, event trail, and metric deltas (docs/service.md Front door):
+
+| condition               | detector            | documented outcome   |
+|-------------------------|---------------------|----------------------|
+| operator footprint over PA_GATE_MEM_BUDGET | registry admission | TenantBudgetError (typed; tenant never registered) + tenant_budget_rejected event + gate.budget_rejected counter |
+| gate queue past the shed watermark | SLO-class shed policy | lowest class refused with typed LoadShedded (retry_after_s / HTTP 429 Retry-After) + load_shedded event + gate.shed{slo_class=…}; DISTINCT from service.rejected{reason=queue_full} |
+| eviction during an in-flight chunked solve | LRU paging + PR 7 checkpoint path | request_checkpointed at the chunk boundary, tenant_evicted/tenant_requeued/tenant_paged_in events, checkpoint_restore on resume, and the request COMPLETES from its saved iterate |
 """
 import numpy as np
 import pytest
@@ -83,17 +93,17 @@ def _has_event(rec, kind, label=None):
 def _metric_state(*names):
     """Counter values + histogram counts before an incident (the
     service rows assert exact DELTAS against this, not absolutes — the
-    registry is process-wide and other tests feed it)."""
+    registry is process-wide and other tests feed it). Labeled
+    counters spell their label inline: ``name{key=value}``."""
     reg = telemetry.registry()
     out = {}
     for name in names:
         if name.endswith("_s"):
             out[name] = reg.histogram(name).count
         elif "{" in name:
-            base, cls = name.split("{", 1)
-            out[name] = reg.counter(
-                base, labels={"tol_class": cls.rstrip("}")}
-            ).value
+            base, rest = name.split("{", 1)
+            key, value = rest.rstrip("}").split("=", 1)
+            out[name] = reg.counter(base, labels={key: value}).value
         else:
             out[name] = telemetry.counter(name)
     return out
@@ -252,7 +262,8 @@ def test_matrix_service_admission_rejected():
         svc = SolveService(A, queue_depth=1)
         held = svc.submit(b, x0=x0, tol=1e-9, tag="held")
         before = telemetry.counter("events.admission_rejected")
-        m0 = _metric_state("service.rejected", "service.admitted",
+        m0 = _metric_state("service.rejected{reason=queue_full}",
+                           "service.admitted",
                            "service.completed")
         with pytest.raises(AdmissionRejected) as ei:
             svc.submit(b, x0=x0, tol=1e-9, tag="over")
@@ -260,9 +271,12 @@ def test_matrix_service_admission_rejected():
         assert telemetry.counter("events.admission_rejected") == before + 1
         # the metrics plane counted the same incident the event log
         # narrated: one rejection, zero admissions
-        m1 = _metric_state("service.rejected", "service.admitted",
+        m1 = _metric_state("service.rejected{reason=queue_full}",
+                           "service.admitted",
                            "service.completed")
-        assert m1["service.rejected"] == m0["service.rejected"] + 1
+        assert m1["service.rejected{reason=queue_full}"] == (
+            m0["service.rejected{reason=queue_full}"] + 1
+        )
         assert m1["service.admitted"] == m0["service.admitted"]
         # the queued request is untouched by the rejection
         svc.drain()
@@ -294,8 +308,8 @@ def test_matrix_service_deadline_expiry():
         m0 = _metric_state(
             "service.deadline_expired", "service.failed",
             "service.completed", "service.total_s",
-            "service.deadline_slack_s", "service.slo.requests{1e-09}",
-            "service.slo.hits{1e-09}",
+            "service.deadline_slack_s", "service.slo.requests{tol_class=1e-09}",
+            "service.slo.hits{tol_class=1e-09}",
         )
         rd = svc.submit(b, x0=x0, tol=1e-9, deadline=0.5, tag="tight")
         rf = svc.submit(b, x0=x0, tol=1e-9, tag="free")
@@ -314,16 +328,16 @@ def test_matrix_service_deadline_expiry():
         m1 = _metric_state(
             "service.deadline_expired", "service.failed",
             "service.completed", "service.total_s",
-            "service.deadline_slack_s", "service.slo.requests{1e-09}",
-            "service.slo.hits{1e-09}",
+            "service.deadline_slack_s", "service.slo.requests{tol_class=1e-09}",
+            "service.slo.hits{tol_class=1e-09}",
         )
         d = {k: m1[k] - m0[k] for k in m0}
         assert d["service.deadline_expired"] == 1, d
         assert d["service.failed"] == 1 and d["service.completed"] == 1, d
         assert d["service.total_s"] == 2, d
         assert d["service.deadline_slack_s"] == 1, d
-        assert d["service.slo.requests{1e-09}"] == 1, d
-        assert d["service.slo.hits{1e-09}"] == 0, d
+        assert d["service.slo.requests{tol_class=1e-09}"] == 1, d
+        assert d["service.slo.hits{tol_class=1e-09}"] == 0, d
         return True
 
     _run(driver)
@@ -458,6 +472,160 @@ def test_matrix_never_returns_silently_wrong(monkeypatch):
         assert _has_event(aborted, "sdc_detection")
         assert _has_event(aborted, "sdc_escalation")
         assert _has_event(aborted, "solve_aborted", "SilentCorruptionError")
+        return True
+
+    _run(driver)
+
+
+# ---------------------------------------------------------------------------
+# round 14 — the front-door (pagate) rows
+# ---------------------------------------------------------------------------
+
+
+def test_matrix_gate_budget_exceeded_admission():
+    """Gate row 1: an operator whose static footprint exceeds
+    PA_GATE_MEM_BUDGET outright — the documented outcome is the typed
+    TenantBudgetError at REGISTRATION (capacity planning, not
+    per-request backpressure): the tenant is never admitted, the
+    refusal is evented AND counted, and no service ever runs."""
+    from partitionedarrays_jl_tpu.frontdoor import (
+        Gate,
+        TenantBudgetError,
+    )
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        m0 = _metric_state("gate.budget_rejected")
+        ev0 = telemetry.counter("events.tenant_budget_rejected")
+        gate = Gate(mem_budget_bytes=4096)
+        with pytest.raises(TenantBudgetError) as ei:
+            gate.register("toolarge", A, footprint_bytes=8192)
+        assert ei.value.diagnostics == {
+            "tenant": "toolarge", "footprint_bytes": 8192,
+            "budget_bytes": 4096,
+        }
+        m1 = _metric_state("gate.budget_rejected")
+        assert m1["gate.budget_rejected"] == m0["gate.budget_rejected"] + 1
+        assert telemetry.counter("events.tenant_budget_rejected") == ev0 + 1
+        assert gate.residency() == []  # never admitted
+        return True
+
+    _run(driver)
+
+
+def test_matrix_gate_load_shed_distinct_from_queue_full():
+    """Gate row 2: overload past the shed watermark — the documented
+    outcome for the LOWEST class is the typed LoadShedded carrying a
+    retry_after_s (HTTP 429 + Retry-After on the wire), counted under
+    gate.shed{slo_class=…} and narrated by the load_shedded event,
+    while the queue-full AdmissionRejected reason counter does NOT
+    move — the two overload behaviors stay separable in /metrics."""
+    from partitionedarrays_jl_tpu.frontdoor import Gate, LoadShedded
+    from partitionedarrays_jl_tpu.service import AdmissionRejected
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        gate = Gate(shed_watermark=1)
+        gate.register("t", A, kmax=2)
+        m0 = _metric_state(
+            "gate.shed{slo_class=besteffort}",
+            "service.rejected{reason=queue_full}",
+        )
+        ev0 = telemetry.counter("events.load_shedded")
+        held = gate.submit("t", b, x0=x0, tol=1e-9,
+                           slo_class="besteffort", tag="held")
+        with pytest.raises(LoadShedded) as ei:
+            gate.submit("t", b, x0=x0, tol=1e-9,
+                        slo_class="besteffort", tag="over")
+        assert not isinstance(ei.value, AdmissionRejected)
+        assert ei.value.retry_after_s > 0.0
+        assert ei.value.diagnostics["slo_class"] == "besteffort"
+        assert ei.value.diagnostics["watermark"] == 1
+        m1 = _metric_state(
+            "gate.shed{slo_class=besteffort}",
+            "service.rejected{reason=queue_full}",
+        )
+        assert m1["gate.shed{slo_class=besteffort}"] == (
+            m0["gate.shed{slo_class=besteffort}"] + 1
+        )
+        assert m1["service.rejected{reason=queue_full}"] == (
+            m0["service.rejected{reason=queue_full}"]
+        ), "shedding must never masquerade as queue-full backpressure"
+        assert telemetry.counter("events.load_shedded") == ev0 + 1
+        # the held request is untouched: it drains to a clean result
+        gate.drain()
+        assert held.result()[1]["converged"]
+        return True
+
+    _run(driver)
+
+
+def test_matrix_gate_eviction_during_inflight_checkpoint_resume(tmp_path):
+    """Gate row 3: a tenant is EVICTED while one of its chunked solves
+    is in flight — the documented outcome is the PR 7 checkpoint path:
+    the iterate checkpoints at the chunk boundary
+    (request_checkpointed), the tenant pages out (tenant_evicted), the
+    drained request re-enters the gate's EDF queue (tenant_requeued),
+    and after the next page-in it RESUMES from the saved iterate
+    (checkpoint_restore) and completes. Driven synchronously: the stop
+    flag is raised mid-slab exactly as a live eviction's
+    shutdown(drain=False) would at the next chunk boundary."""
+    from partitionedarrays_jl_tpu.frontdoor import Gate
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (12, 12))
+        x_direct, _ = cg(A, b, x0=x0, tol=1e-9)
+        gate = Gate(checkpoint_dir=str(tmp_path))
+        gate.register("t", A, kmax=2, chunk=4)
+        m0 = _metric_state(
+            "service.checkpointed", "gate.evictions", "gate.page_ins",
+            "service.completed",
+        )
+        # a deadline-carrying request runs CHUNKED; dispatch it, then
+        # signal stop mid-slab (what a concurrent eviction does) so
+        # the first chunk boundary checkpoints the iterate
+        h = gate.submit("t", b, x0=x0, tol=1e-9, deadline=3600.0,
+                        slo_class="interactive", tag="inflight")
+        gate.pump(dispatch_only=True)  # into the tenant's batcher
+        svc = gate.service("t")
+        svc._stop = True
+        svc.step()  # one chunk, then checkpoint at the boundary
+        assert h.request.state == "checkpointed"
+        it_before = h.request.iterations
+        assert it_before > 0
+        rec_ck = h.request.record
+        assert _has_event(rec_ck, "request_checkpointed", "inflight")
+        ev_requeue0 = telemetry.counter("events.tenant_requeued")
+        gate.evict("t")
+        # the eviction requeued the checkpointed request with its
+        # saved iterate as x0
+        assert telemetry.counter("events.tenant_requeued") == (
+            ev_requeue0 + 1
+        )
+        assert h.state == "gate-queued"
+        assert h.kwargs["x0"] is not None
+        res = {r["tenant"]: r for r in gate.residency()}
+        assert not res["t"]["resident"]
+        # drain: page back in, re-stage, resume from the checkpoint
+        gate.drain()
+        x, info = h.result()
+        assert info["converged"]
+        np.testing.assert_allclose(
+            gather_pvector(x), gather_pvector(x_direct),
+            rtol=0, atol=1e-6,
+        )
+        m1 = _metric_state(
+            "service.checkpointed", "gate.evictions", "gate.page_ins",
+            "service.completed",
+        )
+        d = {k: m1[k] - m0[k] for k in m0}
+        assert d["service.checkpointed"] == 1, d
+        assert d["gate.evictions"] == 1, d
+        assert d["gate.page_ins"] == 1, d
+        assert d["service.completed"] == 1, d
+        # the resume is narrated end to end
+        assert _has_event(h.request.record, "request_done", "inflight")
+        assert telemetry.counter("events.checkpoint_restore") > 0
         return True
 
     _run(driver)

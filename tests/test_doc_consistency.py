@@ -622,3 +622,63 @@ def test_every_committed_bench_artifact_is_schema_versioned():
             f"{name} must carry the PA_* environment snapshot "
             "(the writer stamps it unconditionally — empty is fine)"
         )
+
+
+def test_gate_artifact_agrees_with_guard_bands():
+    """The committed front-door artifact (round 14 — ROADMAP item 1's
+    acceptance leg) and the bench guard must agree: identical band
+    bounds, a multi-client leg with N>=2 tenants under a budget that
+    FORCED at least one eviction during load, the per-class attainment
+    read from the pamon registry deltas equal to the client-side
+    outcome table, and the interactive class meeting its target WHILE
+    shedding was active — measured, not asserted. Canary-kind bands
+    gate on every platform."""
+    bench_gate = _load_tool("bench_gate")
+    rec = json.load(open(os.path.join(REPO, "GATE_BENCH.json")))
+    assert rec["methodology"] == bench_gate.METHODOLOGY
+    for key, (lo, hi, kind) in bench_gate.GATE_BANDS.items():
+        band = rec["bands"].get(key)
+        assert band is not None, f"artifact missing band {key}"
+        assert (band["lo"], band["hi"], band["kind"]) == (lo, hi, kind), (
+            key, band,
+        )
+        assert band["in_band"], (key, band)
+    # N>=2 operators under a budget that cannot hold them all resident
+    assert len(rec["tenants"]) >= 2
+    assert rec["budget_bytes"] < sum(
+        t["footprint_bytes"] for t in rec["tenants"]
+    )
+    multi = rec["multi_client"]
+    assert multi["clients"] >= 2
+    assert multi["evictions_during_load"] >= 1
+    # shedding was ACTIVE, absorbed entirely by the lowest class,
+    # and the interactive target held while it was
+    assert multi["shed_total"] >= 1
+    per = multi["per_class"]
+    assert per["besteffort"]["shed"] == multi["shed_total"]
+    assert per["interactive"]["shed"] == 0
+    target = multi["attainment_target"]
+    assert rec["bands"]["interactive_attainment"]["lo"] == target
+    assert per["interactive"]["attainment"] >= target
+    # attainment is the pamon readout, consistent with the client side
+    for cls, row in per.items():
+        assert row["pamon_requests"] == row["submitted"] - row["shed"], (
+            cls, row,
+        )
+        assert row["pamon_hits"] == row["done"], (cls, row)
+        if row["pamon_requests"]:
+            want = row["pamon_hits"] / row["pamon_requests"]
+            assert abs(row["attainment"] - want) <= 1e-6, (cls, row)
+    # eviction cost is internally consistent
+    ev = rec["eviction_cost"]
+    ratio = ev["cold_solve_s"] / ev["warm_solve_s"]
+    assert abs(ev["ratio"] - ratio) <= 1e-2 * max(ratio, 1.0), ev
+    assert abs(
+        ev["page_in_overhead_s"]
+        - max(0.0, ev["cold_solve_s"] - ev["warm_solve_s"])
+    ) <= 2e-6, ev  # fields round independently of their difference
+    # the shared artifact envelope
+    assert rec.get("schema_version") and rec.get("generated_by") == (
+        "bench_gate"
+    )
+    assert rec.get("platform") and isinstance(rec.get("pa_env"), dict)

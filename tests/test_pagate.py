@@ -1,0 +1,369 @@
+"""pagate — the out-of-process multi-tenant front door
+(`partitionedarrays_jl_tpu.frontdoor`).
+
+The contracts pinned here:
+
+* **Tenancy / budget** — N operators admitted against
+  ``PA_GATE_MEM_BUDGET`` (the MEMORY_FOOTPRINT.json shape-sum
+  convention), LRU eviction when the budget forces it, typed
+  `TenantBudgetError` for an operator that can never fit.
+* **EDF** — completed-request order under EDF never inverts two
+  same-tenant deadlines (exact at slab width 1 — stronger than the
+  one-chunk-boundary tolerance the invariant allows).
+* **Shedding** — past the watermark the lowest class is refused with
+  the typed, ``retry_after``-carrying `LoadShedded` (distinct from
+  `AdmissionRejected`) while ``interactive`` keeps 100% attainment.
+* **Eviction** — a page-out/page-in cycle re-stages the operator to a
+  `plan_fingerprint`-IDENTICAL device plan and reproduces the solve
+  BITWISE (the PR 8 rebuild invariant riding the gate).
+* **RPC** — a request submitted over HTTP returns bitwise the same
+  iterate as the same request submitted in-process, and the gate adds
+  zero in-graph work (byte-identical StableHLO with the gate enabled).
+
+Budget note: everything host-path runs on the sequential backend (tiny
+Poisson grids, milliseconds); only the eviction-bitwise and HLO pins
+touch device programs, on the tiny 4-/8-part fixtures.
+"""
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu import telemetry
+from partitionedarrays_jl_tpu.analysis import plan_verifier as pv
+from partitionedarrays_jl_tpu.frontdoor import (
+    Gate,
+    LoadShedded,
+    TenantBudgetError,
+    http_solve,
+    operator_footprint_bytes,
+    serve_gate,
+    shed_classes,
+)
+from partitionedarrays_jl_tpu.models import assemble_poisson, gather_pvector
+from partitionedarrays_jl_tpu.service import AdmissionRejected
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _poisson(grid=(8, 8)):
+    return pa.prun(
+        lambda parts: assemble_poisson(parts, grid), pa.sequential, (2, 2)
+    )
+
+
+def _counter(name, labels=None):
+    return telemetry.registry().counter(name, labels=labels).value
+
+
+# ---------------------------------------------------------------------------
+# tenancy: budget admission + LRU paging
+# ---------------------------------------------------------------------------
+
+
+def test_budget_admission_and_lru_eviction():
+    """Two tenants under a one-resident budget: registering the second
+    evicts the first (LRU), routing a request back pages it in again,
+    and the residency table + gate counters narrate every move."""
+    A1, b1, xe1, x01 = _poisson((8, 8))
+    A2, b2, xe2, x02 = _poisson((10, 10))
+    fp1 = operator_footprint_bytes(A1, 4)
+    fp2 = operator_footprint_bytes(A2, 4)
+    assert fp1 > 0 and fp2 > fp1  # bigger grid, bigger footprint
+    ev0 = _counter("gate.evictions")
+    pi0 = _counter("gate.page_ins")
+    gate = Gate(mem_budget_bytes=max(fp1, fp2) + 8)
+    gate.register("t1", A1, kmax=4)
+    gate.register("t2", A2, kmax=4)  # must evict t1
+    res = {r["tenant"]: r for r in gate.residency()}
+    assert not res["t1"]["resident"] and res["t2"]["resident"]
+    assert res["t1"]["footprint_bytes"] == fp1
+    assert gate.registry.resident_bytes() == fp2
+    assert _counter("gate.evictions") == ev0 + 1
+    assert _counter("gate.page_ins") == pi0 + 2
+    # routing to the evicted tenant pages it back in (and evicts t2)
+    h = gate.submit("t1", b1, x0=x01, tol=1e-9, slo_class="interactive")
+    gate.drain()
+    assert h.result()[1]["converged"]
+    res = {r["tenant"]: r for r in gate.residency()}
+    assert res["t1"]["resident"] and not res["t2"]["resident"]
+    assert _counter("gate.evictions") == ev0 + 2
+    assert _counter("gate.page_ins") == pi0 + 3
+
+
+def test_operator_too_big_for_budget_is_typed():
+    A, b, xe, x0 = _poisson((8, 8))
+    gate = Gate(mem_budget_bytes=1000)
+    with pytest.raises(TenantBudgetError) as ei:
+        gate.register("huge", A, footprint_bytes=2000)
+    assert ei.value.diagnostics["budget_bytes"] == 1000
+    assert "huge" not in {r["tenant"] for r in gate.residency()}
+
+
+# ---------------------------------------------------------------------------
+# EDF
+# ---------------------------------------------------------------------------
+
+
+def test_edf_same_tenant_completion_order_never_inverts():
+    """The EDF invariant at slab width 1 (each dispatch is its own
+    slab, so the tolerance collapses to EXACT order): completion order
+    equals deadline order regardless of submission order."""
+    A, b, xe, x0 = _poisson((8, 8))
+    gate = Gate()
+    gate.register("t", A, kmax=1)
+    rng = np.random.default_rng(7)
+    deadlines = [100.0, 400.0, 200.0, 600.0, 300.0, 500.0]
+    order = rng.permutation(len(deadlines))
+    handles = {}
+    for i in order:
+        handles[deadlines[i]] = gate.submit(
+            "t", b, x0=x0, tol=1e-9, deadline=deadlines[i],
+            slo_class="interactive", tag=f"edf-{deadlines[i]:.0f}",
+        )
+    gate.drain()
+    for h in handles.values():
+        assert h.result()[1]["converged"]
+    finished = sorted(
+        handles.items(), key=lambda kv: kv[1].request.finished_at
+    )
+    assert [d for d, _ in finished] == sorted(deadlines), (
+        "EDF must complete same-tenant requests in deadline order"
+    )
+    # deadline-free requests sort last (behind every deadline)
+    hf = gate.submit("t", b, x0=x0, tol=1e-9, tag="edf-free")
+    hd = gate.submit("t", b, x0=x0, tol=1e-9, deadline=900.0,
+                     slo_class="interactive", tag="edf-late")
+    gate.drain()
+    assert hd.request.finished_at < hf.request.finished_at
+
+
+# ---------------------------------------------------------------------------
+# SLO-class shedding
+# ---------------------------------------------------------------------------
+
+
+def test_shed_policy_function():
+    classes = ("interactive", "batch", "besteffort")
+    assert shed_classes(0, classes, 4) == ()
+    assert shed_classes(3, classes, 4) == ()
+    assert shed_classes(4, classes, 4) == ("besteffort",)
+    assert shed_classes(400, classes, 4) == ("besteffort",)
+    assert shed_classes(10, ("only",), 1) == ()  # nothing to sacrifice
+
+
+def test_shed_keeps_interactive_and_is_distinct_from_queue_full():
+    """Past the watermark: besteffort sheds typed (LoadShedded with a
+    positive retry_after_s, counted under gate.shed) while interactive
+    keeps being admitted and reaches 100% attainment; LoadShedded is
+    NOT an AdmissionRejected and moves neither service.rejected
+    reason."""
+    A, b, xe, x0 = _poisson((8, 8))
+    gate = Gate(shed_watermark=2)
+    gate.register("t", A, kmax=4)
+    shed0 = _counter("gate.shed", labels={"slo_class": "besteffort"})
+    rej0 = _counter("service.rejected",
+                    labels={"reason": "queue_full"})
+    req0 = _counter("gate.slo.requests",
+                    labels={"slo_class": "interactive"})
+    hit0 = _counter("gate.slo.hits",
+                    labels={"slo_class": "interactive"})
+    backlog = [
+        gate.submit("t", b, x0=x0, tol=1e-9, slo_class="besteffort")
+        for _ in range(2)
+    ]
+    with pytest.raises(LoadShedded) as ei:
+        gate.submit("t", b, x0=x0, tol=1e-9, slo_class="besteffort")
+    assert not isinstance(ei.value, AdmissionRejected)
+    assert ei.value.retry_after_s > 0.0
+    assert ei.value.diagnostics["slo_class"] == "besteffort"
+    assert ei.value.diagnostics["depth"] == 2
+    hi = gate.submit("t", b, x0=x0, tol=1e-9, deadline=600.0,
+                     slo_class="interactive")
+    gate.drain()
+    assert hi.result()[1]["converged"]
+    for h in backlog:
+        assert h.result()[1]["converged"]
+    assert _counter(
+        "gate.shed", labels={"slo_class": "besteffort"}
+    ) == shed0 + 1
+    assert _counter(
+        "service.rejected", labels={"reason": "queue_full"}
+    ) == rej0, "shedding must not count as queue-full backpressure"
+    assert _counter(
+        "gate.slo.requests", labels={"slo_class": "interactive"}
+    ) == req0 + 1
+    assert _counter(
+        "gate.slo.hits", labels={"slo_class": "interactive"}
+    ) == hit0 + 1
+    # the pamon gate view renders residency + attainment from exactly
+    # this snapshot (no new collection)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "pamon", os.path.join(REPO, "tools", "pamon.py")
+    )
+    pamon = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pamon)
+    view = pamon.render_gate(telemetry.registry().snapshot())
+    assert "front door (pagate)" in view
+    assert "tenant t" in view
+    assert "class=interactive" in view and "attainment=" in view
+
+
+# ---------------------------------------------------------------------------
+# eviction: page-out/page-in reproduces the solve bitwise
+# ---------------------------------------------------------------------------
+
+
+def test_eviction_pageout_pagein_bitwise_and_plan_fingerprint():
+    """The eviction pin: solve, page the tenant out (device buffers
+    dropped), route a request back in — the re-staged device exchange
+    plan is `plan_fingerprint`-IDENTICAL (the PR 8 rebuild invariant)
+    and the solve reproduces BITWISE with the same iteration count."""
+    import jax
+
+    from test_fused_cg import _fixture_spd_system
+
+    backend = pa.TPUBackend(devices=jax.devices()[:4])
+    A, b = pa.prun(
+        lambda parts: _fixture_spd_system(parts), backend, 4
+    )
+    gate = Gate()
+    gate.register("t", A, kmax=2)
+    h1 = gate.submit("t", b, tol=1e-10, maxiter=200)
+    gate.drain()
+    x1, i1 = h1.result()
+    assert i1["converged"]
+    assert A._device, "the solve must have staged device buffers"
+    dA = next(iter(A._device.values()))
+    fp0 = pv.plan_fingerprint(dA.col_plan)
+    misses0 = telemetry.counter("lowering_cache.miss")
+    gate.evict("t")
+    assert not A._device, "eviction must drop the device staging"
+    h2 = gate.submit("t", b, tol=1e-10, maxiter=200)  # auto page-in
+    gate.drain()
+    x2, i2 = h2.result()
+    assert telemetry.counter("lowering_cache.miss") == misses0 + 1, (
+        "the page-in must RE-stage (a cache hit would mean eviction "
+        "never dropped the buffers)"
+    )
+    dA2 = next(iter(A._device.values()))
+    assert pv.plan_fingerprint(dA2.col_plan) == fp0
+    assert i2["converged"] and i2["iterations"] == i1["iterations"]
+    np.testing.assert_array_equal(
+        gather_pvector(x1), gather_pvector(x2)
+    )
+
+
+# ---------------------------------------------------------------------------
+# RPC: the HTTP surface
+# ---------------------------------------------------------------------------
+
+
+def test_http_roundtrip_bitwise_and_endpoints():
+    """Submit-poll-fetch over HTTP returns bitwise the same iterate as
+    the same request submitted in-process, and the operational
+    endpoints (healthz / tenants / metrics) serve the gate's state."""
+    A, b, xe, x0 = _poisson((8, 8))
+    gate = Gate(start_workers=True)
+    gate.register("p8", A, kmax=4)
+    srv = serve_gate(gate, port=0)
+    try:
+        bg, x0g = gather_pvector(b), gather_pvector(x0)
+        out = http_solve(srv.url, "p8", bg, x0=x0g, tol=1e-9,
+                         slo_class="interactive", tag="http-req")
+        assert out["state"] == "done" and out["info"]["converged"]
+        h = gate.submit("p8", b, x0=x0, tol=1e-9, tag="inproc-req")
+        gate.drain()
+        x_in, info_in = h.result()
+        np.testing.assert_array_equal(
+            np.asarray(out["x"]), gather_pvector(x_in)
+        )
+        assert out["info"]["iterations"] == info_in["iterations"]
+        with urllib.request.urlopen(srv.url + "/healthz") as resp:
+            health = json.loads(resp.read())
+        assert health["ok"] and health["tenants"] == 1
+        with urllib.request.urlopen(srv.url + "/v1/tenants") as resp:
+            tenants = json.loads(resp.read())
+        assert tenants["tenants"][0]["tenant"] == "p8"
+        assert tenants["tenants"][0]["resident"]
+        with urllib.request.urlopen(srv.url + "/metrics") as resp:
+            prom = resp.read().decode()
+        assert "pa_gate_page_ins" in prom
+        assert "pa_gate_slo_requests" in prom
+        # unknown tenant and unknown request are typed 404s
+        ghost = http_solve(srv.url, "ghost", bg)
+        assert ghost["http_status"] == 404
+        assert ghost["error"] == "UnknownTenant"
+        try:
+            urllib.request.urlopen(srv.url + "/v1/solve/r999999")
+            raise AssertionError("unknown request must 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
+
+
+def test_gate_enabled_block_program_hlo_identical(monkeypatch):
+    """The overhead pin (the PR 6/9 convention): with every PA_GATE_*
+    knob set and a gate actively serving, the block body lowers to
+    byte-identical StableHLO vs the no-gate baseline — the front door
+    adds ZERO in-graph work."""
+    import jax
+
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        TPUBackend,
+        _matrix_operands,
+        device_matrix,
+        make_cg_fn,
+    )
+
+    backend = TPUBackend(devices=jax.devices()[:8])
+    A = pa.prun(
+        lambda parts: assemble_poisson(parts, (6, 6, 6))[0],
+        backend, (2, 2, 2),
+    )
+    dA = device_matrix(A, backend)
+    ops = _matrix_operands(dA)
+    P, W = dA.col_plan.layout.P, dA.col_plan.layout.W
+    zb = np.zeros((P, W, 2))
+
+    def text():
+        fn = make_cg_fn(dA, tol=1e-9, maxiter=50, rhs_batch=2)
+        return fn.jit_fn.lower(zb, zb, zb[..., 0], ops).as_text()
+
+    baseline = text()
+    monkeypatch.setenv("PA_GATE_MEM_BUDGET", "123456789")
+    monkeypatch.setenv("PA_GATE_CLASSES", "interactive,besteffort")
+    monkeypatch.setenv("PA_GATE_SHED_DEPTH", "5")
+    monkeypatch.setenv("PA_GATE_PORT", "0")
+    As, bs, xes, x0s = _poisson((8, 8))
+    gate = Gate()
+    gate.register("seq", As, kmax=2)
+    h = gate.submit("seq", bs, x0=x0s, tol=1e-9, deadline=600.0,
+                    slo_class="interactive")
+    gate.drain()
+    assert h.result()[1]["converged"]
+    assert text() == baseline
+
+
+def test_pagate_check_smoke(capsys):
+    """The tier-1 smoke: tools/pagate.py --check serves on an ephemeral
+    port, forces one shed and one eviction, and asserts outcomes,
+    events, and metric deltas in-process."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "pagate", os.path.join(REPO, "tools", "pagate.py")
+    )
+    pagate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pagate)
+    rc = pagate.main(["--check"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "pagate --check: OK" in out

@@ -1,0 +1,357 @@
+#!/usr/bin/env python
+"""Front-door load bench -> GATE_BENCH.json (ROADMAP item 1's
+acceptance artifact).
+
+Three legs over the demo gate (two Poisson operators under a memory
+budget that fits only ONE resident at a time — every tenant switch is
+a forced page-out/page-in):
+
+* **multi-client overload leg** — N client threads POST a mixed-class
+  request stream (interactive / batch / besteffort, round-robin over
+  both tenants) against the HTTP surface while dispatch is held, so
+  the gate queue genuinely crosses the shed watermark: besteffort is
+  refused with the typed 429 + ``Retry-After`` `LoadShedded` while
+  interactive and batch keep being admitted; dispatch then resumes and
+  the backlog drains under EDF with the tenant alternation forcing
+  >= 1 eviction DURING the load. Per-class attainment is read from the
+  pamon registry deltas (``gate.slo.requests``/``gate.slo.hits`` —
+  the same counters ``tools/pamon.py`` renders), cross-checked against
+  the client-side outcome table.
+* **eviction-cost leg** — the same solve on a resident tenant (warm)
+  vs right after a page-out (cold: fresh `SolveService` + lazy
+  re-stage + solve); the difference is the measured price of paging.
+* **bands** — ``interactive_attainment`` must meet the 0.9 target
+  WHILE shedding is active (the ROADMAP acceptance line, measured not
+  asserted), every shed must land on the lowest class
+  (``besteffort_shed_share``), and the eviction round-trip ratio is a
+  structural canary. All canary-kind: they gate on every platform
+  (tools/pareg.py --check), and none is a device-throughput claim.
+
+``--dry-run`` prints without writing.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: Guard bands for the committed artifact (canary kind: structural
+#: claims about the gate's behavior under overload — they must hold on
+#: every platform the bench runs on).
+GATE_BANDS = {
+    "interactive_attainment": (0.9, 1.0, "canary"),
+    "besteffort_shed_share": (0.999, 1.0, "canary"),
+    "eviction_roundtrip_ratio": (0.8, 500.0, "canary"),
+}
+
+METHODOLOGY = "v1-gate-load"
+
+#: The interactive class's SLO attainment target the overload leg must
+#: meet while shedding is active (the band's lower edge).
+ATTAINMENT_TARGET = 0.9
+
+CLIENTS = 3
+#: Per client: phase 1 submits (interactive, batch) — the protected
+#: backlog; phase 2 submits (besteffort, interactive) at full depth.
+REQUESTS_PER_CLIENT = 4
+CLASSES = ("interactive", "batch", "besteffort")
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url + "/v1/solve", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _poll(url, rid, timeout_s=300.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        with urllib.request.urlopen(f"{url}/v1/solve/{rid}") as resp:
+            poll = json.loads(resp.read())
+        if poll["state"] not in ("gate-queued", "queued", "running"):
+            return poll
+        time.sleep(0.005)
+    raise TimeoutError(rid)
+
+
+def run_multi_client(gate, srv, systems):
+    """The overload leg (see module docstring). Returns the record
+    fragment."""
+    from partitionedarrays_jl_tpu import telemetry
+    from partitionedarrays_jl_tpu.models.solvers import gather_pvector
+
+    reg = telemetry.registry()
+
+    def gauge(name):
+        return reg.snapshot()["counters"].get(name, 0)
+
+    names = sorted(systems)
+    rhs = {
+        name: (
+            gather_pvector(systems[name][1]).tolist(),
+            gather_pvector(systems[name][3]).tolist(),
+        )
+        for name in names
+    }
+    before = {
+        "evictions": gauge("gate.evictions"),
+        "page_ins": gauge("gate.page_ins"),
+        **{
+            f"req.{c}": gauge(
+                f"gate.slo.requests{{slo_class={c}}}"
+            ) for c in CLASSES
+        },
+        **{
+            f"hit.{c}": gauge(f"gate.slo.hits{{slo_class={c}}}")
+            for c in CLASSES
+        },
+        **{
+            f"shed.{c}": gauge(f"gate.shed{{slo_class={c}}}")
+            for c in CLASSES
+        },
+    }
+    outcomes = []
+    olock = threading.Lock()
+    gate.paused = True  # hold dispatch: the backlog must really build
+
+    def client(cid, phase_classes, phase):
+        for i, cls in enumerate(phase_classes):
+            tenant = names[(cid + i) % len(names)]
+            b, x0 = rhs[tenant]
+            status, payload = _post(srv.url, {
+                "tenant": tenant, "b": b, "x0": x0, "tol": 1e-9,
+                "deadline": 600.0, "slo_class": cls,
+                "tag": f"bench-{cid}-{phase}-{i}",
+            })
+            with olock:
+                outcomes.append((cls, status, payload))
+
+    def run_phase(phase, phase_classes):
+        threads = [
+            threading.Thread(
+                target=client, args=(cid, phase_classes, phase)
+            )
+            for cid in range(CLIENTS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    # phase 1 — protected classes build the backlog past the
+    # watermark; phase 2 — besteffort arrives at full depth (all shed,
+    # deterministically) while interactive keeps being admitted
+    run_phase(1, ("interactive", "batch"))
+    assert gate.depth() >= gate.watermark, (
+        gate.depth(), gate.watermark,
+    )
+    run_phase(2, ("besteffort", "interactive"))
+    t0 = time.perf_counter()
+    gate.paused = False
+    finals = []
+    for cls, status, payload in outcomes:
+        if status == 202:
+            finals.append((cls, _poll(srv.url, payload["id"])))
+    drain_wall = time.perf_counter() - t0
+    # the pump accounts terminal requests on its next tick — settle
+    # before reading the SLO deltas
+    for _ in range(1000):
+        gate.account()
+        with gate._lock:
+            if not gate._inflight:
+                break
+        time.sleep(0.005)
+    after = {
+        "evictions": gauge("gate.evictions"),
+        "page_ins": gauge("gate.page_ins"),
+        **{
+            f"req.{c}": gauge(
+                f"gate.slo.requests{{slo_class={c}}}"
+            ) for c in CLASSES
+        },
+        **{
+            f"hit.{c}": gauge(f"gate.slo.hits{{slo_class={c}}}")
+            for c in CLASSES
+        },
+        **{
+            f"shed.{c}": gauge(f"gate.shed{{slo_class={c}}}")
+            for c in CLASSES
+        },
+    }
+    delta = {k: after[k] - before[k] for k in before}
+    per_class = {}
+    for cls in CLASSES:
+        submitted = sum(1 for c, _s, _p in outcomes if c == cls)
+        shed = sum(
+            1 for c, s, _p in outcomes if c == cls and s == 429
+        )
+        done = sum(
+            1 for c, p in finals if c == cls and p["state"] == "done"
+        )
+        # attainment via pamon: the registry's requests/hits deltas
+        req_m, hit_m = delta[f"req.{cls}"], delta[f"hit.{cls}"]
+        per_class[cls] = {
+            "submitted": submitted,
+            "shed": shed,
+            "done": done,
+            "pamon_requests": req_m,
+            "pamon_hits": hit_m,
+            "attainment": round(hit_m / req_m, 6) if req_m else None,
+        }
+        assert delta[f"shed.{cls}"] == shed, (cls, delta, shed)
+        assert req_m == submitted - shed, (cls, delta, per_class)
+        assert hit_m == done, (cls, delta, per_class)
+    total_shed = sum(r["shed"] for r in per_class.values())
+    admitted = sum(
+        r["submitted"] - r["shed"] for r in per_class.values()
+    )
+    return {
+        "clients": CLIENTS,
+        "requests_per_client": REQUESTS_PER_CLIENT,
+        "classes": list(CLASSES),
+        "submitted": CLIENTS * REQUESTS_PER_CLIENT,
+        "admitted": admitted,
+        "shed_total": total_shed,
+        "shed_rate": round(
+            total_shed / (CLIENTS * REQUESTS_PER_CLIENT), 6
+        ),
+        "evictions_during_load": delta["evictions"],
+        "page_ins_during_load": delta["page_ins"],
+        "drain_wall_s": round(drain_wall, 6),
+        "drained_requests_per_s": round(admitted / drain_wall, 3),
+        "attainment_target": ATTAINMENT_TARGET,
+        "per_class": per_class,
+    }
+
+
+def run_eviction_cost(gate, systems):
+    """Warm vs post-eviction (cold) solve wall on the larger tenant."""
+    name = max(systems, key=lambda n: systems[n][0].rows.ngids)
+    A, b, xe, x0 = systems[name]
+
+    def solve():
+        t0 = time.perf_counter()
+        h = gate.submit(name, b, x0=x0, tol=1e-9,
+                        slo_class="interactive")
+        while not h.done():
+            time.sleep(0.001)
+        h.result()
+        return time.perf_counter() - t0
+
+    solve()  # ensure resident + warm
+    warm = min(solve() for _ in range(3))
+    gate.evict(name)
+    cold = solve()  # page-in + lazy re-stage + solve
+    return {
+        "tenant": name,
+        "warm_solve_s": round(warm, 6),
+        "cold_solve_s": round(cold, 6),
+        "page_in_overhead_s": round(max(0.0, cold - warm), 6),
+        "ratio": round(cold / warm, 3),
+    }
+
+
+def main():
+    argv = sys.argv[1:]
+    dry = "--dry-run" in argv
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "pagate", os.path.join(REPO, "tools", "pagate.py")
+    )
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    from partitionedarrays_jl_tpu.frontdoor import serve_gate
+    from partitionedarrays_jl_tpu.telemetry import artifacts
+
+    gate, systems = pg.build_demo_gate(budget="one", shed_watermark=4)
+    srv = serve_gate(gate, port=0)
+    try:
+        multi = run_multi_client(gate, srv, systems)
+        evict = run_eviction_cost(gate, systems)
+    finally:
+        srv.stop()
+
+    shed_by_class = {
+        cls: multi["per_class"][cls]["shed"] for cls in CLASSES
+    }
+    measured = {
+        "interactive_attainment": multi["per_class"]["interactive"][
+            "attainment"
+        ],
+        "besteffort_shed_share": (
+            round(shed_by_class["besteffort"] / multi["shed_total"], 6)
+            if multi["shed_total"] else None
+        ),
+        "eviction_roundtrip_ratio": evict["ratio"],
+    }
+    rec = {
+        "methodology": METHODOLOGY,
+        "protocol": (
+            f"{CLIENTS} client threads x {REQUESTS_PER_CLIENT} "
+            "mixed-class HTTP requests round-robin over "
+            f"{len(systems)} Poisson tenants under a one-resident "
+            "memory budget; dispatch held while phase 1 "
+            "(interactive+batch) builds the backlog past "
+            "PA_GATE_SHED_DEPTH, then phase 2 submits besteffort "
+            "(shed typed with Retry-After, deterministically at full "
+            "depth) alongside interactive (still admitted); dispatch "
+            "released and drained under EDF with the tenant "
+            "alternation forcing evictions during load; "
+            "attainment from the pamon gate.slo.* registry deltas, "
+            "cross-checked against client-side outcomes; eviction "
+            "cost = cold (page-in + lazy re-stage + solve) vs warm "
+            "min-of-3 solve wall on the larger tenant"
+        ),
+        "tenants": [
+            {
+                "tenant": name,
+                "ngids": systems[name][0].rows.ngids,
+                "footprint_bytes": gate.registry.tenant(
+                    name
+                ).footprint_bytes,
+            }
+            for name in sorted(systems)
+        ],
+        "budget_bytes": gate.registry.budget,
+        "shed_watermark": gate.watermark,
+        "multi_client": multi,
+        "eviction_cost": evict,
+        "bands": {},
+    }
+    ok = True
+    for key, (lo, hi, kind) in GATE_BANDS.items():
+        v = measured[key]
+        in_band = (v is not None) and lo <= v <= hi
+        rec["bands"][key] = {
+            "lo": lo, "hi": hi, "measured": v, "in_band": in_band,
+            "kind": kind,
+        }
+        ok = ok and in_band
+    rec["bands_ok_device"] = ok
+    if not ok:
+        print("bench_gate: BAND FAILURE", file=sys.stderr)
+    artifacts.write(
+        os.path.join(REPO, "GATE_BENCH.json"), rec, tool="bench_gate",
+        dry_run=dry,
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,415 @@
+#!/usr/bin/env python
+"""pagate — the out-of-process multi-tenant front door, from the CLI.
+
+The operator console of `partitionedarrays_jl_tpu.frontdoor`: serve N
+demo operators behind the HTTP/JSON gate, submit solves from another
+process, and generate mixed-class load. The demo registry is two
+Poisson operators under a deliberately tight ``PA_GATE_MEM_BUDGET``
+(only one fits resident at a time), so alternating tenants exercises
+the LRU page-out/page-in ladder and mixed-class overload exercises
+EDF + SLO-class shedding — the whole ROADMAP item 1 surface from a
+shell.
+
+Usage:
+    python tools/pagate.py serve [--port 8642] [--budget one]
+    python tools/pagate.py submit --url http://127.0.0.1:8642 \
+        --tenant poisson8 [--slo-class interactive] [--deadline 30]
+    python tools/pagate.py loadgen --url ... --clients 4 --requests 24 \
+        [--mixed]
+    python tools/pagate.py --check        # tier-1 smoke (in-process)
+
+``--check`` serves on an ephemeral port, runs a mixed-class demo that
+forces at least one load-shed (typed 429 + Retry-After) and at least
+one eviction (alternating tenants under the tight budget), and asserts
+the outcome table, the event trails, and the metric deltas. Exit
+status 0 iff every invariant held.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: The demo tenants: name -> Poisson grid (sequential backend, (2, 2)
+#: parts — the gate is host-side policy; the backend is whatever the
+#: tenants' services run).
+DEMO_TENANTS = {"poisson8": (8, 8), "poisson12": (12, 12)}
+
+
+def build_demo_gate(budget: str = "one", shed_watermark: int = 4,
+                    start_workers: bool = True, checkpoint_dir=None):
+    """The demo registry: both Poisson tenants under a budget. With
+    ``budget="one"`` only the larger tenant fits resident at a time
+    (every tenant switch is a page-out/page-in); ``"all"`` fits both;
+    an integer string is taken as bytes. ``checkpoint_dir`` defaults to
+    a fresh temp dir so an eviction catching a slab mid-flight takes
+    the checkpoint/resume path instead of losing the iterate."""
+    import tempfile
+
+    if checkpoint_dir is None:
+        checkpoint_dir = tempfile.mkdtemp(prefix="pagate-ckpt-")
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu.frontdoor import (
+        Gate,
+        operator_footprint_bytes,
+    )
+    from partitionedarrays_jl_tpu.models import assemble_poisson
+
+    systems = {
+        name: pa.prun(
+            lambda parts, g=grid: assemble_poisson(parts, g),
+            pa.sequential, (2, 2),
+        )
+        for name, grid in DEMO_TENANTS.items()
+    }
+    fps = {
+        name: operator_footprint_bytes(sys_[0], 4)
+        for name, sys_ in systems.items()
+    }
+    if budget == "one":
+        budget_bytes = max(fps.values()) + 16
+    elif budget == "all":
+        budget_bytes = sum(fps.values()) + 16
+    else:
+        budget_bytes = int(budget)
+    gate = Gate(
+        mem_budget_bytes=budget_bytes, shed_watermark=shed_watermark,
+        start_workers=start_workers, checkpoint_dir=checkpoint_dir,
+    )
+    for name, (A, b, xe, x0) in systems.items():
+        gate.register(name, A, kmax=4)
+    return gate, systems
+
+
+def _demo_rhs(systems, tenant):
+    from partitionedarrays_jl_tpu.models.solvers import gather_pvector
+
+    A, b, xe, x0 = systems[tenant]
+    return gather_pvector(b), gather_pvector(x0)
+
+
+# ---------------------------------------------------------------------------
+# subcommands
+# ---------------------------------------------------------------------------
+
+
+def cmd_serve(args) -> int:
+    from partitionedarrays_jl_tpu.frontdoor import serve_gate
+
+    gate, _systems = build_demo_gate(budget=args.budget,
+                                     shed_watermark=args.shed_depth)
+    srv = serve_gate(gate, host=args.host, port=args.port,
+                     verbose=args.verbose)
+    print(f"pagate: serving {sorted(DEMO_TENANTS)} at {srv.url}")
+    print("  endpoints: POST /v1/solve; GET /v1/solve/<id>, "
+          "/v1/tenants, /healthz, /metrics")
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("pagate: draining...")
+        srv.stop()
+        return 0
+
+
+def cmd_submit(args) -> int:
+    """One client-side solve: fetch the tenant's size from the server,
+    build the demo right-hand side, submit-poll-fetch."""
+    import urllib.request
+
+    import numpy as np
+
+    from partitionedarrays_jl_tpu.frontdoor import http_solve
+
+    with urllib.request.urlopen(args.url + "/v1/tenants") as resp:
+        tenants = {
+            t["tenant"]: t for t in json.loads(resp.read())["tenants"]
+        }
+    if args.tenant not in tenants:
+        print(f"pagate: unknown tenant {args.tenant!r} "
+              f"(server has {sorted(tenants)})", file=sys.stderr)
+        return 2
+    n = tenants[args.tenant]["ngids"]
+    rng = np.random.default_rng(args.seed)
+    b = (
+        rng.standard_normal(n) if args.b == "random"
+        else np.full(n, float(args.b))
+    )
+    out = http_solve(
+        args.url, args.tenant, b, tol=args.tol, maxiter=args.maxiter,
+        deadline=args.deadline, slo_class=args.slo_class,
+        tag=args.tag or f"cli-{args.seed}",
+    )
+    state = out.get("state", out.get("error"))
+    print(f"  {args.tenant:>10s}  {state}  "
+          + json.dumps(out.get("info") or
+                       {k: out[k] for k in ("error", "retry_after_s")
+                        if k in out}))
+    return 0 if out.get("state") == "done" else 1
+
+
+def cmd_loadgen(args) -> int:
+    """Multi-client mixed-class load: ``--clients`` threads submit
+    round-robin over the server's tenants; prints the per-class
+    outcome table (done / shed / failed) and the residency table."""
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from partitionedarrays_jl_tpu.frontdoor import http_solve
+
+    with urllib.request.urlopen(args.url + "/v1/tenants") as resp:
+        tenants = json.loads(resp.read())["tenants"]
+    classes = args.classes.split(",")
+    results = []
+    rlock = threading.Lock()
+
+    def client(cid):
+        rng = np.random.default_rng(1000 + cid)
+        for i in range(args.requests):
+            t = tenants[(cid + i) % len(tenants)]
+            cls = classes[(cid + i) % len(classes)]
+            b = rng.standard_normal(t["ngids"])
+            out = http_solve(
+                args.url, t["tenant"], b, tol=args.tol,
+                deadline=args.deadline, slo_class=cls,
+                tag=f"lg-{cid}-{i}",
+            )
+            with rlock:
+                results.append((cls, out))
+
+    threads = [
+        threading.Thread(target=client, args=(cid,))
+        for cid in range(args.clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    table = {}
+    for cls, out in results:
+        row = table.setdefault(cls, {"done": 0, "shed": 0, "failed": 0})
+        if out.get("state") == "done":
+            row["done"] += 1
+        elif out.get("error") == "LoadShedded":
+            row["shed"] += 1
+        else:
+            row["failed"] += 1
+    for cls in sorted(table):
+        row = table[cls]
+        total = sum(row.values())
+        print(f"  class={cls:12s} done={row['done']:<4d} "
+              f"shed={row['shed']:<4d} failed={row['failed']:<4d} "
+              f"attainment={row['done'] / total:.1%}")
+    with urllib.request.urlopen(args.url + "/v1/tenants") as resp:
+        for t in json.loads(resp.read())["tenants"]:
+            print(f"  tenant {t['tenant']:12s} "
+                  f"{'resident' if t['resident'] else 'EVICTED':8s} "
+                  f"evictions={t['evictions']} page_ins={t['page_ins']}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# --check: the tier-1 smoke
+# ---------------------------------------------------------------------------
+
+
+def _check() -> int:
+    """Serve on an ephemeral port, run a mixed-class demo including at
+    least one shed and one eviction, assert the outcome table, event
+    trails, and metric deltas."""
+    import numpy as np
+
+    from partitionedarrays_jl_tpu import telemetry
+    from partitionedarrays_jl_tpu.frontdoor import serve_gate
+
+    failures = []
+
+    def expect(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    reg = telemetry.registry()
+
+    def counters():
+        snap = reg.snapshot()["counters"]
+        return {
+            k: snap.get(k, 0)
+            for k in (
+                "gate.evictions", "gate.page_ins",
+                "gate.shed{slo_class=besteffort}",
+                "gate.slo.requests{slo_class=interactive}",
+                "gate.slo.hits{slo_class=interactive}",
+            )
+        }
+
+    ev_shed0 = telemetry.counter("events.load_shedded")
+    ev_evict0 = telemetry.counter("events.tenant_evicted")
+    ev_page0 = telemetry.counter("events.tenant_paged_in")
+    c0 = counters()
+    gate, systems = build_demo_gate(budget="one", shed_watermark=3)
+    srv = serve_gate(gate, port=0)
+    outcomes = []
+    try:
+        from partitionedarrays_jl_tpu.frontdoor import http_solve
+
+        # leg 1 — the eviction ladder: alternating tenants under the
+        # one-resident budget forces a page-out/page-in per switch
+        for tenant in ("poisson8", "poisson12", "poisson8"):
+            b, x0 = _demo_rhs(systems, tenant)
+            out = http_solve(srv.url, tenant, b, x0=x0, tol=1e-9,
+                             deadline=600.0, slo_class="interactive",
+                             tag=f"check-{tenant}")
+            outcomes.append((tenant, "interactive", out))
+            expect(out["state"] == "done",
+                   f"{tenant}: interactive solve must finish "
+                   f"(got {out.get('state') or out.get('error')})")
+            expect(out.get("info", {}).get("converged"),
+                   f"{tenant}: demo solve must converge")
+        # leg 2 — overload: pause dispatch, build a backlog past the
+        # watermark, and watch the lowest class shed typed while
+        # interactive keeps being admitted
+        gate.paused = True
+        b, x0 = _demo_rhs(systems, "poisson8")
+        # submit without polling (bare POSTs) so the backlog stays
+        import urllib.error
+        import urllib.request
+
+        def post(cls, tag):
+            req = urllib.request.Request(
+                srv.url + "/v1/solve",
+                data=json.dumps({
+                    "tenant": "poisson8", "b": list(map(float, b)),
+                    "x0": list(map(float, x0)), "tol": 1e-9,
+                    "slo_class": cls, "tag": tag,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    return resp.status, json.loads(resp.read()), {}
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read()), dict(e.headers)
+
+        ids = []
+        for i in range(3):
+            status, payload, _ = post("besteffort", f"check-bg-{i}")
+            expect(status == 202, f"backlog submit {i} must be 202")
+            ids.append(payload.get("id"))
+        status, payload, headers = post("besteffort", "check-shed")
+        outcomes.append(("poisson8", "besteffort", payload))
+        expect(status == 429,
+               f"besteffort past the watermark must shed (got {status})")
+        expect(payload.get("error") == "LoadShedded",
+               "shed must be the typed LoadShedded payload")
+        expect("Retry-After" in headers,
+               "shed response must carry Retry-After")
+        status, payload, _ = post("interactive", "check-keep")
+        expect(status == 202,
+               f"interactive must be admitted while besteffort sheds "
+               f"(got {status})")
+        ids.append(payload.get("id"))
+        gate.paused = False
+        for rid in ids:
+            import time
+
+            for _ in range(2000):
+                with urllib.request.urlopen(
+                    f"{srv.url}/v1/solve/{rid}"
+                ) as resp:
+                    poll = json.loads(resp.read())
+                if poll["state"] not in ("gate-queued", "queued",
+                                         "running"):
+                    break
+                time.sleep(0.005)
+            expect(poll["state"] == "done",
+                   f"backlog request {rid} must finish "
+                   f"(got {poll['state']})")
+    finally:
+        srv.stop()
+    c1 = counters()
+    d = {k: c1[k] - c0[k] for k in c0}
+    expect(d["gate.evictions"] >= 1,
+           f"the tenant switches must evict at least once ({d})")
+    expect(d["gate.page_ins"] >= 3,
+           f"page-ins must cover registration + re-stages ({d})")
+    expect(d["gate.shed{slo_class=besteffort}"] == 1,
+           f"exactly the one shed must count ({d})")
+    expect(
+        d["gate.slo.hits{slo_class=interactive}"]
+        == d["gate.slo.requests{slo_class=interactive}"] >= 4,
+        f"interactive attainment must stay 100% ({d})",
+    )
+    # the event trails narrate the same incidents the metrics counted
+    expect(telemetry.counter("events.load_shedded") == ev_shed0 + 1,
+           "load_shedded event must fire once")
+    expect(telemetry.counter("events.tenant_evicted")
+           >= ev_evict0 + 1, "tenant_evicted events must fire")
+    expect(telemetry.counter("events.tenant_paged_in")
+           >= ev_page0 + 3, "tenant_paged_in events must fire")
+    for tenant, cls, out in outcomes:
+        state = out.get("state") or out.get("error")
+        print(f"  {tenant:>10s}  {cls:12s} {state}")
+    for f in failures:
+        print(f"pagate --check FAILURE: {f}", file=sys.stderr)
+    print("pagate --check:", "FAILED" if failures else "OK")
+    return 1 if failures else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="in-process smoke: serve + mixed-class demo "
+                         "with one shed and one eviction")
+    sub = ap.add_subparsers(dest="cmd")
+    ps = sub.add_parser("serve", help="serve the demo tenants")
+    ps.add_argument("--host", default="127.0.0.1")
+    ps.add_argument("--port", type=int, default=None,
+                    help="default PA_GATE_PORT (8642); 0 = ephemeral")
+    ps.add_argument("--budget", default="one",
+                    help="'one' (default: one resident tenant), 'all', "
+                         "or bytes")
+    ps.add_argument("--shed-depth", type=int, default=4)
+    ps.add_argument("--verbose", action="store_true")
+    pc = sub.add_parser("submit", help="submit one solve to a server")
+    pc.add_argument("--url", required=True)
+    pc.add_argument("--tenant", required=True)
+    pc.add_argument("--b", default="random",
+                    help="'random' or a constant value")
+    pc.add_argument("--seed", type=int, default=0)
+    pc.add_argument("--tol", type=float, default=1e-9)
+    pc.add_argument("--maxiter", type=int, default=None)
+    pc.add_argument("--deadline", type=float, default=None)
+    pc.add_argument("--slo-class", default=None)
+    pc.add_argument("--tag", default="")
+    pl = sub.add_parser("loadgen", help="multi-client mixed load")
+    pl.add_argument("--url", required=True)
+    pl.add_argument("--clients", type=int, default=4)
+    pl.add_argument("--requests", type=int, default=8,
+                    help="requests per client")
+    pl.add_argument("--classes",
+                    default="interactive,batch,besteffort")
+    pl.add_argument("--tol", type=float, default=1e-9)
+    pl.add_argument("--deadline", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    if args.check:
+        return _check()
+    if args.cmd == "serve":
+        return cmd_serve(args)
+    if args.cmd == "submit":
+        return cmd_submit(args)
+    if args.cmd == "loadgen":
+        return cmd_loadgen(args)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

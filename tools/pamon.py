@@ -92,6 +92,77 @@ def render_snapshot(snap, prev=None):
     return "\n".join(lines) if lines else "(registry empty)"
 
 
+def render_gate(snap, prev=None):
+    """The front-door view (round 14 — pagate): tenant residency
+    (resident/evicted, footprint vs budget) and per-SLO-class
+    attainment with deltas against ``prev`` in watch mode. Pure
+    rendering over the existing snapshot — the gate collects nothing
+    new for this view."""
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    if not any(k.startswith("gate.") for k in
+               list(counters) + list(gauges)):
+        return ""
+    lines = ["front door (pagate):"]
+    budget = gauges.get("gate.mem_budget_bytes", 0)
+    resident = gauges.get("gate.resident_bytes", 0)
+    lines.append(
+        f"  resident {resident:,.0f} B / budget "
+        + (f"{budget:,.0f} B" if budget else "unbounded")
+        + f"  queue_depth={gauges.get('gate.queue_depth', 0):g}"
+        + f"  evictions={counters.get('gate.evictions', 0)}"
+        + f"  page_ins={counters.get('gate.page_ins', 0)}"
+    )
+    tenants = {}
+    for name, v in gauges.items():
+        for field, prefix in (
+            ("resident", "gate.tenant_resident{tenant="),
+            ("footprint", "gate.tenant_footprint_bytes{tenant="),
+        ):
+            if name.startswith(prefix):
+                tenant = name[len(prefix):].rstrip("}")
+                tenants.setdefault(tenant, {})[field] = v
+    for tenant in sorted(tenants):
+        row = tenants[tenant]
+        state = "resident" if row.get("resident") else "EVICTED"
+        lines.append(
+            f"  tenant {tenant:16s} {state:8s} "
+            f"footprint={row.get('footprint', 0):,.0f} B"
+        )
+    classes = {}
+    prev_c = (prev or {}).get("counters") or {}
+    for name, v in counters.items():
+        for field, prefix in (
+            ("requests", "gate.slo.requests{slo_class="),
+            ("hits", "gate.slo.hits{slo_class="),
+            ("shed", "gate.shed{slo_class="),
+        ):
+            if name.startswith(prefix):
+                cls = name[len(prefix):].rstrip("}")
+                classes.setdefault(cls, {})[field] = v
+                classes[cls][field + "_d"] = v - prev_c.get(name, 0)
+    if classes:
+        lines.append("  SLO classes (attainment = hits/requests):")
+    for cls in sorted(classes):
+        row = classes[cls]
+        req, hit = row.get("requests", 0), row.get("hits", 0)
+        rate = hit / req if req else 0.0
+        line = (
+            f"    class={cls:12s} requests={req:<5d} hits={hit:<5d} "
+            f"shed={row.get('shed', 0):<5d} attainment={rate:.1%}"
+        )
+        if prev is not None and (
+            row.get("requests_d") or row.get("shed_d")
+        ):
+            line += (
+                f"  (+{row.get('requests_d', 0)} req, "
+                f"+{row.get('hits_d', 0)} hit, "
+                f"+{row.get('shed_d', 0)} shed since last poll)"
+            )
+        lines.append(line)
+    return "\n".join(lines)
+
+
 def render_slo(snap):
     """Deadline attainment per tolerance class + the slack
     distribution."""
@@ -217,7 +288,8 @@ def _check() -> int:
 
     before = {
         k: c(k)
-        for k in ("service.admitted", "service.rejected",
+        for k in ("service.admitted",
+                  "service.rejected{reason=queue_full}",
                   "service.completed")
     }
     fingerprint, profile, stats = _run_demo()
@@ -235,9 +307,10 @@ def _check() -> int:
         "admitted counter must advance by the demo's 4 admissions",
     )
     expect(
-        counters.get("service.rejected", 0) - before["service.rejected"]
-        == 1,
-        "rejected counter must advance by the demo's 1 overflow",
+        counters.get("service.rejected{reason=queue_full}", 0)
+        - before["service.rejected{reason=queue_full}"] == 1,
+        "queue_full-reason rejected counter must advance by the "
+        "demo's 1 overflow",
     )
     expect(
         counters.get("service.completed", 0)
@@ -343,6 +416,9 @@ def main(argv=None):
                 snap = json.load(open(args.snapshot))
                 print(f"--- pamon watch poll {i} ---")
                 print(render_snapshot(snap, prev=prev))
+                gate = render_gate(snap, prev=prev)
+                if gate:
+                    print(gate)
                 if args.slo:
                     print(render_slo(snap))
                 prev = snap
@@ -365,6 +441,9 @@ def main(argv=None):
         return 2
     else:
         print(render_snapshot(snap))
+        gate = render_gate(snap)
+        if gate:
+            print(gate)
     if args.slo:
         print(render_slo(snap))
     if args.model is not None:
