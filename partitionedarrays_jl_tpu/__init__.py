@@ -17,7 +17,13 @@ Import convention::
 from . import telemetry  # noqa: F401
 from . import service  # noqa: F401
 from . import frontdoor  # noqa: F401
-from .frontdoor import Gate, LoadShedded, TenantBudgetError  # noqa: F401
+from .frontdoor import (  # noqa: F401
+    Gate,
+    JournalCorruptError,
+    LoadShedded,
+    RequestJournal,
+    TenantBudgetError,
+)
 from .service import AdmissionRejected, SolveService  # noqa: F401
 from .models import *  # noqa: F401,F403
 from .models import __all__ as _models_all
@@ -34,5 +40,6 @@ __all__ = (
     list(_parallel_all) + list(_utils_all) + list(_ops_all)
     + list(_models_all)
     + ["telemetry", "service", "SolveService", "AdmissionRejected",
-       "frontdoor", "Gate", "LoadShedded", "TenantBudgetError"]
+       "frontdoor", "Gate", "LoadShedded", "TenantBudgetError",
+       "JournalCorruptError", "RequestJournal"]
 )

@@ -246,6 +246,22 @@ NON_LOWERING: Dict[str, str] = {
         "configuration; the RPC surface adds zero in-graph work "
         "(byte-identical StableHLO pinned in tests/test_pagate.py)"
     ),
+    "PA_GATE_JOURNAL": (
+        "front-door write-ahead journal master switch "
+        "(frontdoor/journal.py) — host-side durability bookkeeping "
+        "only; the journal-off program path is byte-identical "
+        "StableHLO (tests/test_padur.py)"
+    ),
+    "PA_GATE_JOURNAL_DIR": (
+        "default journal directory for Gate(journal_dir=None) "
+        "(frontdoor/journal.py) — where host-side JSONL segments "
+        "land, never part of a staged program"
+    ),
+    "PA_GATE_JOURNAL_FSYNC": (
+        "journal append fsync policy (frontdoor/journal.py) — trades "
+        "the power-loss guarantee for append speed on the host path; "
+        "no staged program reads it"
+    ),
     "PA_METRICS_DIR": (
         "telemetry record persistence directory — where finished "
         "SolveRecord JSONs land on the host, never part of a staged "

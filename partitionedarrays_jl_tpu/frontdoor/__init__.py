@@ -24,12 +24,39 @@ budgets, PR 9 pamon metrics/SLO accounting, PR 10 adaptive K):
   exact-float serialization: an HTTP solve returns bitwise the same
   iterate as the same request in-process, and the tenants' compiled
   block programs stay byte-identical StableHLO (tests/test_pagate.py).
+* `frontdoor.journal`  — the round-15 (padur) durability layer: the
+  write-ahead request journal (CRC'd fsync'd JSONL, PR 4 checkpoint
+  conventions) every lifecycle transition lands in BEFORE the client
+  ack, idempotency keys on submit (a retried request returns the
+  original id and bitwise result — never a second solve), and
+  ``Gate.recover()``: after a kill -9, completed requests serve their
+  recorded results, in-flight requests resume from chunk-checkpointed
+  iterates (deadline clock resumed), queued requests re-enter EDF —
+  zero lost, zero duplicated (tools/padur.py --drill is the proof).
 
 CLI: ``tools/pagate.py serve|submit|loadgen`` (``--check`` is the
-tier-1 smoke); bench: ``tools/bench_gate.py`` -> ``GATE_BENCH.json``.
-Protocol docs: docs/service.md (Front door).
+tier-1 smoke); durability drills: ``tools/padur.py`` (``--check``
+tier-1, ``--drill`` the SIGKILL harness under ``-m slow``); bench:
+``tools/bench_gate.py`` -> ``GATE_BENCH.json``.
+Protocol docs: docs/service.md (Front door), docs/resilience.md
+(Durability).
 """
-from .rpc import GateServer, gate_port, http_solve, serve_gate  # noqa: F401
+from .journal import (  # noqa: F401
+    JournalCorruptError,
+    RecoveredError,
+    RequestJournal,
+    journal_enabled,
+    journal_env_dir,
+    journal_fsync,
+    read_journal,
+)
+from .rpc import (  # noqa: F401
+    GateServer,
+    gate_port,
+    http_solve,
+    serve_gate,
+    serve_until_signalled,
+)
 from .scheduler import (  # noqa: F401
     Gate,
     GateHandle,
@@ -51,17 +78,25 @@ __all__ = [
     "Gate",
     "GateHandle",
     "GateServer",
+    "JournalCorruptError",
     "LoadShedded",
     "OperatorRegistry",
+    "RecoveredError",
+    "RequestJournal",
     "Tenant",
     "TenantBudgetError",
     "UnknownTenantError",
     "gate_classes",
     "gate_port",
     "http_solve",
+    "journal_enabled",
+    "journal_env_dir",
+    "journal_fsync",
     "mem_budget",
     "operator_footprint_bytes",
+    "read_journal",
     "serve_gate",
+    "serve_until_signalled",
     "shed_classes",
     "shed_depth",
 ]

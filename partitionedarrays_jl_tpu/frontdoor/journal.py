@@ -1,0 +1,322 @@
+"""The front door's write-ahead request journal (crash durability).
+
+PR 11 made the gate the production surface, but every piece of its
+state — the EDF queue, tenant residency, in-flight slab membership —
+lived in process memory: a gate crash lost every queued request and
+orphaned every checkpointed iterate, and an HTTP client that retried a
+timed-out submit double-solved. This module is the durability layer
+underneath `Gate`: every request lifecycle transition (admitted /
+dispatched / chunk-checkpointed / completed / failed / shed) is
+appended — CRC'd and fsync'd — BEFORE it is acknowledged to the
+client, so `Gate.recover()` can replay the journal after a kill -9 and
+leave zero requests lost and zero duplicated (tools/padur.py is the
+drill harness; tests/test_padur.py pins the contract).
+
+Format — append-only JSONL segments, the PR 4 checkpoint conventions
+(per-record CRC32, atomic generation-style rotation) applied to a log:
+
+* one record per line: the payload dict serialized canonically
+  (``sort_keys``, compact separators) with a ``crc`` field holding the
+  CRC32 of the record WITHOUT that field — a reader re-serializes and
+  compares, so a torn or bit-rotted line can never parse as clean;
+* segments are named ``journal-<epoch:06d>-<n:06d>.jsonl``; every
+  journal OPEN starts a fresh epoch (monotonic, recorded as an
+  ``epoch`` record) and a fresh segment, and an append that grows the
+  current segment past ``segment_bytes`` rotates to the next one
+  (close + fsync the old file, fsync the directory so the new name is
+  durable — the same publish-last discipline as `_commit_index`);
+* ``seq`` is monotonic across epochs — the total order recovery
+  replays in.
+
+Torn tails vs corruption: a crash mid-append can tear exactly the LAST
+record of the LAST segment — replay truncates it (``journal.truncated``
+counter + ``journal_truncated`` event) and continues, the WAL
+convention. A bad record anywhere ELSE is real corruption (bit rot, a
+concurrent writer) and raises the typed `JournalCorruptError` instead
+of silently dropping acknowledged history.
+
+Env knobs (host-side; ``analysis.env_lint.NON_LOWERING`` records the
+reasons — the journal-off program path is byte-identical StableHLO,
+pinned in tests/test_padur.py):
+
+* ``PA_GATE_JOURNAL`` (default ``1``) — master switch: ``0`` disables
+  journaling even when a journal directory is configured.
+* ``PA_GATE_JOURNAL_DIR`` (default unset) — default journal directory
+  for ``Gate(journal_dir=None)``.
+* ``PA_GATE_JOURNAL_FSYNC`` (default ``1``) — fsync every appended
+  record before the caller proceeds; ``0`` trades the power-loss
+  guarantee for speed (tests, tmpfs).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from typing import List, Optional, Tuple
+
+__all__ = [
+    "JOURNAL_SCHEMA_VERSION",
+    "JournalCorruptError",
+    "RecoveredError",
+    "RequestJournal",
+    "journal_enabled",
+    "journal_env_dir",
+    "journal_fsync",
+    "read_journal",
+]
+
+JOURNAL_SCHEMA_VERSION = 1
+
+#: Record kinds the gate appends (docs/resilience.md documents each).
+RECORD_KINDS = (
+    "epoch", "admitted", "dispatched", "chunk", "completed", "failed",
+    "shed", "shutdown", "recovered",
+)
+
+
+def journal_enabled() -> bool:
+    """``PA_GATE_JOURNAL`` master switch (default on — journaling still
+    requires a configured directory to activate)."""
+    return os.environ.get("PA_GATE_JOURNAL", "1") != "0"
+
+
+def journal_env_dir() -> Optional[str]:
+    """``PA_GATE_JOURNAL_DIR`` or None."""
+    return os.environ.get("PA_GATE_JOURNAL_DIR") or None
+
+
+def journal_fsync() -> bool:
+    """``PA_GATE_JOURNAL_FSYNC`` (default on): fsync each append."""
+    return os.environ.get("PA_GATE_JOURNAL_FSYNC", "1") != "0"
+
+
+class JournalCorruptError(RuntimeError):
+    """A journal record that is NOT the torn tail failed its CRC or
+    would not parse — acknowledged history has been damaged (bit rot,
+    a concurrent writer, manual editing). Deliberately distinct from
+    the torn-tail case, which is the expected crash artifact and is
+    truncated with an event instead of raised."""
+
+
+class RecoveredError(RuntimeError):
+    """A typed failure replayed from the journal: the original error
+    class no longer exists as a live exception object, so recovery
+    serves this wrapper carrying the original class name
+    (``error_type``) and message — the RPC surface reports
+    ``error_type`` for pre-restart ids, keeping the wire contract."""
+
+    def __init__(self, error_type: str, message: str):
+        super().__init__(message)
+        self.error_type = str(error_type)
+
+
+def _canonical(body: dict) -> str:
+    return json.dumps(body, sort_keys=True, separators=(",", ":"))
+
+
+def _verify_line(line: bytes) -> dict:
+    """Parse + CRC-verify one journal line; ValueError on any defect
+    (the caller decides torn-tail vs corruption)."""
+    rec = json.loads(line.decode("utf-8"))
+    if not isinstance(rec, dict):
+        raise ValueError("journal record is not an object")
+    crc = rec.pop("crc", None)
+    if crc is None:
+        raise ValueError("journal record has no crc")
+    if (zlib.crc32(_canonical(rec).encode()) & 0xFFFFFFFF) != int(crc):
+        raise ValueError("journal record fails its CRC32")
+    return rec
+
+
+def _segments(directory: str) -> List[str]:
+    if not os.path.isdir(directory):
+        return []
+    return sorted(
+        os.path.join(directory, f)
+        for f in os.listdir(directory)
+        if f.startswith("journal-") and f.endswith(".jsonl")
+    )
+
+
+def _fsync_dir(directory: str) -> None:
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass  # platforms without directory fsync
+
+
+def _scan(directory: str, truncate: bool,
+          strict: bool = True) -> Tuple[List[dict], int]:
+    """Replay every segment in order. Returns ``(records,
+    truncated_records)``. A defective record that is the tail of the
+    LAST segment is the torn-tail case: with ``truncate`` the file is
+    cut back to the last clean record (counted + evented), otherwise it
+    is skipped. A defective record anywhere else raises
+    `JournalCorruptError` when ``strict`` (read-only monitors pass
+    ``strict=False`` and simply stop at the first defect — a live
+    writer may be mid-append)."""
+    records: List[dict] = []
+    dropped = 0
+    segs = _segments(directory)
+    for i, seg in enumerate(segs):
+        with open(seg, "rb") as f:
+            raw = f.read()
+        pos = 0
+        while pos < len(raw):
+            nl = raw.find(b"\n", pos)
+            line = raw[pos:] if nl < 0 else raw[pos:nl]
+            end = len(raw) if nl < 0 else nl + 1
+            if line.strip():
+                try:
+                    records.append(_verify_line(line))
+                except ValueError as e:
+                    tail_rest = raw[end:].strip()
+                    is_tail = i == len(segs) - 1 and not tail_rest
+                    if not is_tail:
+                        if strict:
+                            raise JournalCorruptError(
+                                f"journal {directory}: defective record "
+                                f"in {os.path.basename(seg)} at byte "
+                                f"{pos} is NOT the torn tail ({e}) — "
+                                "acknowledged history is damaged"
+                            )
+                        return records, dropped
+                    dropped += 1
+                    if truncate:
+                        _truncate_tail(seg, pos, len(raw) - pos)
+                    break
+            pos = end
+    return records, dropped
+
+
+def _truncate_tail(seg: str, pos: int, nbytes: int) -> None:
+    """Cut the torn tail off ``seg`` at byte ``pos`` — counted and
+    evented so an operator learns the crash ate an unacknowledged
+    record (never an acknowledged one: the ack happens after fsync)."""
+    from ..telemetry import emit_event
+    from ..telemetry.registry import registry
+
+    with open(seg, "rb+") as f:
+        f.truncate(pos)
+        f.flush()
+        os.fsync(f.fileno())
+    registry().counter("journal.truncated").inc()
+    emit_event(
+        "journal_truncated", label=os.path.basename(seg),
+        offset=pos, dropped_bytes=nbytes,
+    )
+
+
+def read_journal(directory: str, truncate: bool = False,
+                 strict: bool = False) -> List[dict]:
+    """Read-only replay (tools, drills, tests): returns the clean
+    records without mutating the journal by default."""
+    return _scan(directory, truncate=truncate, strict=strict)[0]
+
+
+class RequestJournal:
+    """One gate's append-only request journal (see module docstring).
+
+    Opening replays every prior segment (truncating a torn tail),
+    exposes the clean history as ``prior_records``, allocates the next
+    ``epoch``, and starts a fresh segment with an ``epoch`` record —
+    so a journal directory narrates every gate generation that ever
+    served it, in one total ``seq`` order."""
+
+    def __init__(self, directory: str, fsync: Optional[bool] = None,
+                 segment_bytes: int = 1 << 20):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.fsync = journal_fsync() if fsync is None else bool(fsync)
+        self.segment_bytes = max(4096, int(segment_bytes))
+        self._lock = threading.Lock()
+        self.prior_records, _ = _scan(self.directory, truncate=True)
+        self.epoch = 1 + max(
+            (int(r["epoch"]) for r in self.prior_records
+             if r.get("kind") == "epoch"),
+            default=0,
+        )
+        self._seq = 1 + max(
+            (int(r.get("seq", -1)) for r in self.prior_records),
+            default=-1,
+        )
+        self._segment_n = 0
+        self._fh = open(self._segment_path(), "ab")
+        _fsync_dir(self.directory)
+        self.append("epoch", epoch=self.epoch,
+                    journal_schema_version=JOURNAL_SCHEMA_VERSION)
+
+    def _segment_path(self) -> str:
+        return os.path.join(
+            self.directory,
+            f"journal-{self.epoch:06d}-{self._segment_n:06d}.jsonl",
+        )
+
+    def append(self, kind: str, _sync: Optional[bool] = None,
+               **payload) -> dict:
+        """Durably append one lifecycle record; returns it (with its
+        ``seq``). The write is flushed (and fsync'd unless disabled)
+        BEFORE returning — the caller may acknowledge the transition
+        to a client the moment this returns. ``_sync=False`` skips the
+        per-record fsync for records nothing acknowledges against
+        (e.g. ``shed`` refusals under overload — cheap refusal must
+        stay cheap); the next synced append or rotation flushes them
+        too."""
+        from ..telemetry.registry import registry
+
+        assert kind in RECORD_KINDS, kind
+        import time as _time
+
+        with self._lock:
+            rec = dict(payload)
+            rec["kind"] = kind
+            rec["seq"] = self._seq
+            rec["wall"] = _time.time()
+            self._seq += 1
+            body = _canonical(rec)
+            rec_crc = dict(rec)
+            rec_crc["crc"] = zlib.crc32(body.encode()) & 0xFFFFFFFF
+            self._fh.write((_canonical(rec_crc) + "\n").encode())
+            self._fh.flush()
+            if self.fsync and (_sync is None or _sync):
+                os.fsync(self._fh.fileno())
+            registry().counter("journal.appends").inc()
+            if self._fh.tell() >= self.segment_bytes:
+                self._rotate()
+            return rec
+
+    def _rotate(self) -> None:
+        """Close the full segment (fsync'd) and open the next one —
+        the directory fsync publishes the new name durably (callers
+        hold ``self._lock``)."""
+        from ..telemetry.registry import registry
+
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._fh.close()
+        self._segment_n += 1
+        self._fh = open(self._segment_path(), "ab")
+        _fsync_dir(self.directory)
+        registry().counter("journal.rotations").inc()
+
+    def segments(self) -> List[str]:
+        return _segments(self.directory)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.flush()
+                if self.fsync:
+                    os.fsync(self._fh.fileno())
+                self._fh.close()
+
+    def __repr__(self):
+        return (
+            f"RequestJournal({self.directory!r}, epoch={self.epoch}, "
+            f"seq={self._seq}, segments={len(self.segments())})"
+        )

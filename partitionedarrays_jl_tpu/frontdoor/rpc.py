@@ -13,11 +13,15 @@ the same iterate as the same request submitted in-process.
 Endpoints (the request-handle lifecycle is submit-poll-fetch):
 
 * ``POST /v1/solve`` — body ``{tenant, b, x0?, tol?, maxiter?,
-  deadline?, slo_class?, tag?, dtype?}`` (``b``/``x0`` are the global
-  vectors as JSON arrays); 202 with ``{id, state}``. Overload maps to
-  typed statuses: 429 + ``Retry-After`` for `LoadShedded` (the shed
-  class's measured backoff), 503 for `AdmissionRejected`
-  (queue-full/draining backpressure), 404 for an unknown tenant.
+  deadline?, slo_class?, tag?, dtype?, idempotency_key?}`` (``b``/
+  ``x0`` are the global vectors as JSON arrays); 202 with ``{id,
+  state}`` — or 200 with the ORIGINAL id (``replayed: true``) when
+  the ``idempotency_key`` was seen before: a retried submit can never
+  double-solve, across gate restarts included (the journal persists
+  the key map). Overload maps to typed statuses: 429 + ``Retry-After``
+  for `LoadShedded` (the shed class's measured backoff), 503 for
+  `AdmissionRejected` (queue-full/draining backpressure), 404 for an
+  unknown tenant.
 * ``GET /v1/solve/<id>`` — poll the handle: ``{id, state}``, plus
   ``{x, info}`` once done or ``{error, message}`` once failed.
 * ``GET /v1/tenants`` — the residency table (resident/evicted,
@@ -46,7 +50,13 @@ from ..telemetry.registry import registry
 from .scheduler import Gate, LoadShedded
 from .tenancy import UnknownTenantError
 
-__all__ = ["GateServer", "serve_gate", "gate_port", "http_solve"]
+__all__ = [
+    "GateServer",
+    "serve_gate",
+    "serve_until_signalled",
+    "gate_port",
+    "http_solve",
+]
 
 
 def gate_port() -> int:
@@ -134,14 +144,25 @@ class _Handler(BaseHTTPRequestHandler):
                 from ..models.solvers import gather_pvector
 
                 x, info = h.result()
-                out["x"] = gather_pvector(x).tolist()
+                # journal-recovered results are already global arrays
+                out["x"] = (
+                    np.asarray(x).tolist()
+                    if isinstance(x, np.ndarray)
+                    else gather_pvector(x).tolist()
+                )
                 out["info"] = {
                     "converged": bool(info.get("converged")),
                     "iterations": int(info.get("iterations", 0)),
                     "status": str(info.get("status")),
                 }
+                if info.get("recovered"):
+                    out["info"]["recovered"] = True
             elif h.state == "failed":
-                out["error"] = type(h.error).__name__
+                # a journal-replayed failure keeps its ORIGINAL typed
+                # class name on the wire (pre-restart id pin)
+                out["error"] = getattr(
+                    h.error, "error_type", type(h.error).__name__
+                )
                 out["message"] = str(h.error)
             self._json(200, out)
         else:
@@ -172,11 +193,20 @@ class _Handler(BaseHTTPRequestHandler):
                 json.JSONDecodeError) as e:
             self._json(400, {"error": "BadRequest", "message": str(e)})
             return
+        idem = body.get("idempotency_key")
+        # replay detection is the GATE's call (its key map is the
+        # source of truth, reported from inside the submit lock — a
+        # pre-submit snapshot would race a concurrent duplicate)
+        replay = {}
         try:
             h = gate.submit(
                 tenant,
                 slo_class=body.get("slo_class"),
                 tag=str(body.get("tag", "")),
+                idempotency_key=(
+                    str(idem) if idem is not None else None
+                ),
+                replay_out=replay,
                 **kwargs,
             )
         except LoadShedded as e:
@@ -199,9 +229,15 @@ class _Handler(BaseHTTPRequestHandler):
         except UnknownTenantError as e:
             self._json(404, {"error": "UnknownTenant", "message": str(e)})
             return
+        # an idempotency-key replay returns the ORIGINAL id (200, not
+        # 202 — nothing new was admitted); a fresh submit stores + 202
+        replayed = bool(replay.get("replayed"))
         rid = self.server.store(h)
-        self._json(202, {"id": rid, "state": h.state,
-                         "tenant": h.tenant, "slo_class": h.slo_class})
+        self._json(
+            200 if replayed else 202,
+            {"id": rid, "state": h.state, "tenant": h.tenant,
+             "slo_class": h.slo_class, "replayed": replayed},
+        )
 
 
 class GateServer(ThreadingHTTPServer):
@@ -219,12 +255,16 @@ class GateServer(ThreadingHTTPServer):
         self.gate = gate
         self.verbose = verbose
         self.handles = {}
+        # pre-restart ids stay pollable: a recovered gate's journal
+        # handles (completed results, replayed failures, resumed
+        # requests) seed the store under their ORIGINAL ids
+        for rid, h in gate.handles_snapshot():
+            self.handles[rid] = h
         #: Retention bound: a long-lived server would otherwise grow
         #: one handle (holding full b/x0 vectors) per request forever —
         #: the OLDEST terminal handles are pruned past this; live
         #: handles are never dropped.
         self.max_handles = max(1, int(max_handles))
-        self._next = 0
         self._hlock = threading.Lock()
         self._stop = threading.Event()
         self._pump: Optional[threading.Thread] = None
@@ -237,8 +277,9 @@ class GateServer(ThreadingHTTPServer):
 
     def store(self, handle) -> str:
         with self._hlock:
-            rid = f"r{self._next}"
-            self._next += 1
+            # the GATE mints the id (epoch-qualified, collision-safe
+            # across restarts) — the server only indexes it for polls
+            rid = handle.rid
             self.handles[rid] = handle
             if len(self.handles) > self.max_handles:
                 # dict preserves insertion order: scan oldest-first and
@@ -286,6 +327,43 @@ def serve_gate(gate: Gate, host: str = "127.0.0.1",
     return GateServer(gate, host=host, port=port, verbose=verbose).start()
 
 
+def serve_until_signalled(srv: GateServer, drain: bool = False) -> int:
+    """Block the MAIN thread until SIGTERM/SIGINT, then shut the gate
+    down gracefully instead of dying mid-slab: ``drain=False`` (the
+    default) takes the PR 7 checkpoint path — in-flight slabs save
+    their iterates at the next chunk boundary and queued requests
+    suspend (all resumable; a journaling gate recovers them on the
+    next start) — while ``drain=True`` finishes the queue first.
+
+    The exit-code contract (pinned by the tools' subprocess tests):
+    returns 0 after a clean signalled shutdown — the `Gate.shutdown`
+    path (reached through ``srv.stop``) emits the ONE
+    ``gate_shutdown`` event and, when journaling, the ``shutdown``
+    journal record. Signal handlers are installed here (main thread
+    only) and restored on exit."""
+    import signal
+
+    stop = threading.Event()
+    got = {"sig": None}
+
+    def _handler(signum, frame):
+        got["sig"] = signum
+        stop.set()
+
+    previous = {
+        s: signal.signal(s, _handler)
+        for s in (signal.SIGTERM, signal.SIGINT)
+    }
+    try:
+        while not stop.wait(0.2):
+            pass
+    finally:
+        for s, old in previous.items():
+            signal.signal(s, old)
+    srv.stop(drain=drain)
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # the stdlib client (pagate submit/loadgen, tests)
 # ---------------------------------------------------------------------------
@@ -296,12 +374,40 @@ def http_solve(base_url: str, tenant: str, b, x0=None,
                maxiter: Optional[int] = None,
                deadline: Optional[float] = None,
                slo_class: Optional[str] = None, tag: str = "",
+               idempotency_key: Optional[str] = None,
                dtype: str = "float64", poll_s: float = 0.01,
-               timeout_s: float = 120.0) -> dict:
+               timeout_s: float = 120.0, retries: int = 0,
+               retry_cap_s: float = 5.0, opener=None,
+               sleep=None) -> dict:
     """Submit-poll-fetch one solve over HTTP; returns the final poll
     payload (state ``done`` with ``x``/``info``, or the typed error
-    payload with its HTTP status under ``"http_status"``)."""
+    payload with its HTTP status under ``"http_status"``).
+
+    Resilience (``retries`` > 0; the default 0 keeps the one-shot
+    behavior benches depend on):
+
+    * transient CONNECTION failures (refused/reset/timeout — the
+      server restarting) retry through `retry_with_backoff` (seeded
+      jitter via ``PA_RETRY_JITTER``, delays capped at
+      ``retry_cap_s``, ``give_up`` once the overall ``timeout_s``
+      budget is spent);
+    * a 429 `LoadShedded` honors the server's measured ``Retry-After``
+      (capped at ``retry_cap_s``) before resubmitting, up to
+      ``retries`` times — no hand-rolled sleeps in callers;
+    * pair ``retries`` with ``idempotency_key`` and a retried submit
+      can NEVER double-solve: the gate returns the original id (and
+      bitwise result) for a replayed key.
+
+    ``opener``/``sleep`` are injectable for tests (default
+    ``urllib.request.urlopen`` / ``time.sleep``). A poll that gets an
+    HTTP error payload (e.g. 404 after handle pruning) returns it
+    typed instead of raising."""
     import time
+
+    from ..parallel.health import retry_with_backoff
+
+    opener = opener if opener is not None else _urlrequest.urlopen
+    sleep = sleep if sleep is not None else time.sleep
 
     body = {
         "tenant": tenant, "b": list(map(float, b)), "tag": tag,
@@ -317,31 +423,85 @@ def http_solve(base_url: str, tenant: str, b, x0=None,
         body["deadline"] = deadline
     if slo_class is not None:
         body["slo_class"] = slo_class
-    req = _urlrequest.Request(
-        base_url + "/v1/solve", data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"}, method="POST",
-    )
-    try:
-        with _urlrequest.urlopen(req) as resp:
-            sub = json.loads(resp.read())
-            status = resp.status
-    except _urlerror.HTTPError as e:  # typed overload statuses
-        out = json.loads(e.read())
-        out["http_status"] = e.code
-        if e.headers.get("Retry-After"):
-            out["retry_after"] = e.headers["Retry-After"]
-        return out
-    sub["http_status"] = status
+    if idempotency_key is not None:
+        body["idempotency_key"] = idempotency_key
     deadline_at = time.monotonic() + timeout_s
+
+    def _request(url, data=None):
+        """One HTTP exchange -> (status, payload, headers); an HTTP
+        error STATUS is a response (typed payload), not a transient
+        failure — only connection-level errors propagate for retry."""
+        req = _urlrequest.Request(
+            url, data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST" if data is not None else "GET",
+        )
+        try:
+            with opener(req) as resp:
+                return resp.status, json.loads(resp.read()), {}
+        except _urlerror.HTTPError as e:
+            out = json.loads(e.read())
+            return e.code, out, dict(e.headers)
+
+    def _post():
+        return retry_with_backoff(
+            lambda: _request(
+                base_url + "/v1/solve", json.dumps(body).encode()
+            ),
+            attempts=max(1, retries + 1),
+            max_backoff=retry_cap_s,
+            exceptions=(_urlerror.URLError, ConnectionError, OSError),
+            describe=f"http_solve submit {tag or tenant}",
+            sleep=sleep,
+            give_up=lambda: time.monotonic() >= deadline_at,
+        )
+
+    status, sub, headers = _post()
+    shed_tries = 0
+    while (
+        status == 429 and shed_tries < retries
+        and time.monotonic() < deadline_at
+    ):
+        # honor the measured Retry-After (capped) before resubmitting
+        ra = (
+            sub.get("retry_after_s")
+            or headers.get("Retry-After") or 1.0
+        )
+        sleep(min(max(0.0, float(ra)), retry_cap_s))
+        shed_tries += 1
+        status, sub, headers = _post()
+    if status not in (200, 202):
+        sub["http_status"] = status
+        if headers.get("Retry-After"):
+            sub["retry_after"] = headers["Retry-After"]
+        return sub
+    sub["http_status"] = status
+
+    def _get():
+        return retry_with_backoff(
+            lambda: _request(f"{base_url}/v1/solve/{sub['id']}"),
+            attempts=max(1, retries + 1),
+            max_backoff=retry_cap_s,
+            exceptions=(_urlerror.URLError, ConnectionError, OSError),
+            describe=f"http_solve poll {sub['id']}",
+            sleep=sleep,
+            give_up=lambda: time.monotonic() >= deadline_at,
+        )
+
+    poll = sub  # the submit retries may have spent the whole budget
     while time.monotonic() < deadline_at:
-        with _urlrequest.urlopen(
-            f"{base_url}/v1/solve/{sub['id']}"
-        ) as resp:
-            poll = json.loads(resp.read())
+        pstatus, poll, _ = _get()
+        if pstatus != 200:
+            poll["http_status"] = pstatus
+            return poll
         if poll["state"] not in ("gate-queued", "queued", "running"):
             poll["http_status"] = status
+            # surface the submit-time replay verdict (the poll payload
+            # itself cannot know it)
+            poll["replayed"] = bool(sub.get("replayed", False))
             return poll
-        time.sleep(poll_s)
+        sleep(poll_s)
     raise TimeoutError(
-        f"request {sub['id']} still {poll['state']} after {timeout_s}s"
+        f"request {sub['id']} still "
+        f"{poll.get('state', 'unpolled')} after {timeout_s}s"
     )

@@ -31,6 +31,24 @@ next. Two policies compose here:
   overload behaviors stay typed and separable: ``gate.shed{class=…}``
   vs ``service.rejected{reason=queue_full}``.
 
+* **Crash durability (padur).** With a ``journal_dir`` (or
+  ``PA_GATE_JOURNAL_DIR``), every lifecycle transition is written ahead
+  to the `frontdoor.journal.RequestJournal` BEFORE it is acknowledged:
+  admitted (with the request payload), dispatched, chunk-checkpointed
+  (the iterate lands in the PR 4 CRC'd checkpoint format under the
+  journal dir), completed (with the bitwise result), failed, shed.
+  ``Gate.recover()`` replays the journal after a crash: completed
+  requests serve their recorded results, in-flight requests resume
+  from their checkpointed iterates as resubmissions (x0 = saved
+  iterate, deadline clock RESUMED against wall time, not reset),
+  queued-but-never-dispatched requests re-enter EDF in original
+  deadline order, and torn tail records truncate with a typed event.
+  **Idempotency keys** (``submit(idempotency_key=...)``) make retried
+  submits safe: the same key returns the original request id — and,
+  once done, the original bitwise result — never a second solve.
+  Request ids are epoch-qualified (``r<epoch>-<n>``) so a restarted
+  gate can never reissue an id an old client still polls.
+
 Env knobs (host-side; ``analysis.env_lint.NON_LOWERING`` records the
 reasons):
 
@@ -38,15 +56,26 @@ reasons):
   SLO classes, best-protected first.
 * ``PA_GATE_SHED_DEPTH`` (default ``32``) — gate queue depth at which
   the lowest class starts shedding.
+* ``PA_GATE_JOURNAL`` / ``PA_GATE_JOURNAL_DIR`` /
+  ``PA_GATE_JOURNAL_FSYNC`` — the write-ahead journal (see
+  `frontdoor.journal`).
 """
 from __future__ import annotations
 
 import os
+import secrets
 import threading
-from typing import Callable, List, Optional, Tuple
+import time as _walltime
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..telemetry.registry import CATALOG, monitoring_enabled, registry
 from ..utils.helpers import check
+from .journal import (
+    RecoveredError,
+    RequestJournal,
+    journal_enabled,
+    journal_env_dir,
+)
 from .tenancy import OperatorRegistry
 
 __all__ = [
@@ -57,6 +86,10 @@ __all__ = [
     "shed_depth",
     "shed_classes",
 ]
+
+#: Terminal handles retained for poll/idempotency lookup before the
+#: oldest accounted ones are pruned (live handles are never dropped).
+_MAX_HANDLES = 4096
 
 #: The PR 9 metrics the EDF policy schedules against — their CATALOG
 #: declarations are asserted at Gate construction (the measured feed
@@ -142,12 +175,18 @@ def _edf_key(h: "GateHandle"):
 class GateHandle:
     """The gate-level result handle: wraps the queued entry until EDF
     dispatch assigns the tenant-level `SolveRequest`, then delegates to
-    it (same vocabulary: ``state``/``done``/``result``)."""
+    it (same vocabulary: ``state``/``done``/``result``). A handle
+    recovered TERMINAL from the journal carries its recorded result
+    (``_result`` — a global ndarray, not a PVector) or its replayed
+    typed error instead of a live request."""
 
     __slots__ = ("tenant", "tag", "slo_class", "deadline_abs", "seq",
-                 "kwargs", "request", "_error", "accounted")
+                 "kwargs", "request", "_error", "accounted", "rid",
+                 "idempotency_key", "submitted_wall", "_result",
+                 "journal_pending")
 
-    def __init__(self, tenant, tag, slo_class, deadline_abs, seq, kwargs):
+    def __init__(self, tenant, tag, slo_class, deadline_abs, seq, kwargs,
+                 rid: Optional[str] = None):
         self.tenant = tenant
         self.tag = tag
         self.slo_class = slo_class
@@ -159,9 +198,22 @@ class GateHandle:
         self.request = None  # SolveRequest once dispatched
         self._error: Optional[BaseException] = None
         self.accounted = False
+        #: Epoch-qualified request id (``r<epoch>-<n>``): collision-safe
+        #: across gate restarts — the RPC store keys polls by it.
+        self.rid = rid
+        self.idempotency_key: Optional[str] = None
+        self.submitted_wall: float = 0.0
+        self._result = None  # journal-recovered (x, info)
+        #: True on a journaling gate until the terminal record is
+        #: durably appended: `state` masks an unjournaled done/failed
+        #: as still running, so a client can never observe (and act
+        #: on) a terminal outcome a crash could then contradict — the
+        #: write-ahead-before-ack invariant applied to completion.
+        self.journal_pending = False
 
-    @property
-    def state(self) -> str:
+    def _raw_state(self) -> str:
+        if self._result is not None:
+            return "done"
         if self._error is not None:
             return "failed"
         if self.request is None:
@@ -175,6 +227,14 @@ class GateHandle:
             return "gate-queued"
         return self.request.state
 
+    @property
+    def state(self) -> str:
+        raw = self._raw_state()
+        if self.journal_pending and raw in ("done", "failed"):
+            # terminal but not yet journaled: not acknowledged yet
+            return "running"
+        return raw
+
     def done(self) -> bool:
         return self.state in ("done", "failed")
 
@@ -185,6 +245,17 @@ class GateHandle:
         return self.request.error if self.request is not None else None
 
     def result(self):
+        if self.journal_pending and self._raw_state() in (
+            "done", "failed"
+        ):
+            raise RuntimeError(
+                f"request {self.tag!r} finished but its terminal "
+                "journal record has not landed yet — pump the gate "
+                "(pump()/drain()) so the outcome is durable before it "
+                "is served"
+            )
+        if self._result is not None:
+            return self._result
         if self._error is not None:
             raise self._error
         if self.request is None:
@@ -222,6 +293,7 @@ class Gate:
         checkpoint_dir: Optional[str] = None,
         clock: Optional[Callable[[], float]] = None,
         start_workers: bool = False,
+        journal_dir: Optional[str] = None,
     ):
         self.registry = OperatorRegistry(
             mem_budget_bytes=mem_budget_bytes,
@@ -251,9 +323,43 @@ class Gate:
         #: it to build a deterministic backlog (shedding is a function
         #: of queue depth, which a fast drain would race away).
         self.paused = False
+        # -- durability (padur) -----------------------------------------
+        jd = journal_dir if journal_dir is not None else journal_env_dir()
+        self.journal: Optional[RequestJournal] = (
+            RequestJournal(jd) if (jd and journal_enabled()) else None
+        )
+        #: Journal-off gates still mint collision-safe ids: a random
+        #: epoch token keeps a restarted gate from reissuing an id an
+        #: old client still polls (journaled gates use the journal's
+        #: monotonic epoch instead, so recovered ids stay resolvable).
+        self._epoch_token = secrets.token_hex(3)
+        self._handles: Dict[str, GateHandle] = {}  # rid -> handle
+        self._idem: Dict[str, str] = {}  # idempotency key -> rid
+        self._recovered = False  # recover() is one-shot
+        if self.journal is not None:
+            self.registry.on_page_in = self._install_chunk_hook
         # an eviction's drained requests re-enter the EDF queue and
         # resume (checkpointed iterates become the resubmission's x0)
         self.registry.on_evict = self._requeue_evicted
+
+    def _mint_rid(self, seq: int) -> str:
+        epoch = (
+            self.journal.epoch if self.journal is not None
+            else self._epoch_token
+        )
+        return f"r{epoch}-{seq}"
+
+    def handle(self, rid: str) -> Optional[GateHandle]:
+        """The handle for a (possibly pre-restart) request id, or None
+        once pruned/never issued — the RPC poll surface."""
+        with self._lock:
+            return self._handles.get(rid)
+
+    def handles_snapshot(self) -> List[Tuple[str, GateHandle]]:
+        """(rid, handle) pairs in submission order (recovered first) —
+        what `GateServer` seeds its poll store from."""
+        with self._lock:
+            return list(self._handles.items())
 
     # -- tenancy passthrough ---------------------------------------------
     def register(self, name, A, **kwargs):
@@ -284,34 +390,62 @@ class Gate:
         return round(base * max(1.0, depth / self.watermark), 6)
 
     def submit(self, tenant: str, b, slo_class: Optional[str] = None,
-               tag: str = "", **kwargs) -> GateHandle:
+               tag: str = "", idempotency_key: Optional[str] = None,
+               replay_out: Optional[dict] = None,
+               **kwargs) -> GateHandle:
         """Admit one request into the gate queue (EDF-ordered), or
         raise: `LoadShedded` when the request's class is being shed at
         the current depth, `UnknownTenantError` for an unregistered
         tenant. ``kwargs`` pass through to `SolveService.submit`
-        (x0/tol/maxiter/deadline/retries)."""
+        (x0/tol/maxiter/deadline/retries).
+
+        ``idempotency_key`` makes retried submits safe: a second call
+        with the same key returns the ORIGINAL handle (and, once done,
+        the original bitwise result) instead of admitting a second
+        solve — the key->id map survives restarts when the gate
+        journals, so an HTTP client retrying a timed-out submit against
+        a recovered gate still cannot double-solve. ``replay_out``
+        (a dict) gets ``replay_out["replayed"] = True/False`` set
+        AUTHORITATIVELY — the RPC surface reads it instead of guessing
+        from a pre-submit snapshot that a concurrent duplicate can
+        race past."""
         cls = slo_class if slo_class is not None else self.classes[-1]
         check(
             cls in self.classes,
             f"gate: unknown SLO class {cls!r} "
             f"(PA_GATE_CLASSES={','.join(self.classes)})",
         )
-        self.registry.tenant(tenant)  # raise UnknownTenantError early
+        if replay_out is not None:
+            replay_out["replayed"] = False
         with self._lock:
-            depth = len(self._queue)
-            shed = shed_classes(depth, self.classes, self.watermark)
-            if cls in shed:
-                raise LoadShedded(
-                    f"gate: class {cls!r} is shedding at queue depth "
-                    f"{depth} (watermark PA_GATE_SHED_DEPTH="
-                    f"{self.watermark}; shed classes: {', '.join(shed)})"
-                    " — retry after the backlog clears",
-                    retry_after_s=self.retry_after(depth),
-                    diagnostics={
-                        "slo_class": cls, "tag": tag, "depth": depth,
-                        "watermark": self.watermark, "shed": list(shed),
-                    },
-                )
+            h0 = self._idem_hit(idempotency_key)
+            if h0 is not None:
+                if replay_out is not None:
+                    replay_out["replayed"] = True
+                return h0
+            # shedding must stay CHEAP refusal: decide it before any
+            # payload gathering (re-checked at admission below)
+            self._check_shed(cls, tag)
+        self.registry.tenant(tenant)  # raise UnknownTenantError early
+        # the EXPENSIVE part of the admitted record — gathering the
+        # global vectors and converting to floats — happens before the
+        # gate lock (b/x0 are immutable inputs); only the append itself
+        # serializes under it, so polls/dispatch don't stall behind
+        # per-submit serialization work
+        payload = (
+            self._admitted_payload(b, kwargs)
+            if self.journal is not None else None
+        )
+        with self._lock:
+            # re-check under the admission lock: a concurrent same-key
+            # submit (or a backlog crossing the watermark) that won the
+            # race since the first look must still win here
+            h0 = self._idem_hit(idempotency_key)
+            if h0 is not None:
+                if replay_out is not None:
+                    replay_out["replayed"] = True
+                return h0
+            self._check_shed(cls, tag)
             deadline = kwargs.get("deadline")
             now = self.clock()
             h = GateHandle(
@@ -323,8 +457,26 @@ class Gate:
                 ),
                 seq=self._seq,
                 kwargs=dict(kwargs, b=b, tag=tag or f"gate-{self._seq}"),
+                rid=self._mint_rid(self._seq),
             )
+            h.idempotency_key = idempotency_key
+            h.submitted_wall = _walltime.time()
+            h.journal_pending = self.journal is not None
             self._seq += 1
+            if self.journal is not None:
+                self.journal.append(
+                    "admitted",
+                    rid=h.rid,
+                    tenant=h.tenant,
+                    tag=h.tag,
+                    slo_class=h.slo_class,
+                    idempotency_key=h.idempotency_key,
+                    submitted_wall=h.submitted_wall,
+                    **payload,
+                )
+            self._handles[h.rid] = h
+            if idempotency_key is not None:
+                self._idem[idempotency_key] = h.rid
             # EDF: sorted by absolute deadline, deadline-free last,
             # FIFO among equals (stable by seq)
             self._queue.append(h)
@@ -334,6 +486,71 @@ class Gate:
                     len(self._queue)
                 )
             return h
+
+    def _idem_hit(self, key: Optional[str]) -> Optional[GateHandle]:
+        """The ONE idempotency-replay path (callers hold the gate
+        lock): the live handle for a known key, counted and evented —
+        or None for a fresh key/pruned handle."""
+        from .. import telemetry
+
+        if key is None:
+            return None
+        rid = self._idem.get(key)
+        h = self._handles.get(rid) if rid is not None else None
+        if h is not None:
+            registry().counter("gate.idempotent_hits").inc()
+            telemetry.emit_event(
+                "idempotent_replay", label=key, rid=h.rid, state=h.state,
+            )
+        return h
+
+    def _check_shed(self, cls: str, tag: str) -> None:
+        """Raise `LoadShedded` when ``cls`` is being shed at the
+        current depth (callers hold the gate lock). The shed record is
+        appended WITHOUT an fsync — nothing acknowledges against it,
+        so refusal stays cheap under exactly the overload that
+        triggers it."""
+        depth = len(self._queue)
+        shed = shed_classes(depth, self.classes, self.watermark)
+        if cls not in shed:
+            return
+        if self.journal is not None:
+            self.journal.append(
+                "shed", tag=tag, slo_class=cls, depth=depth,
+                _sync=False,
+            )
+        raise LoadShedded(
+            f"gate: class {cls!r} is shedding at queue depth "
+            f"{depth} (watermark PA_GATE_SHED_DEPTH="
+            f"{self.watermark}; shed classes: {', '.join(shed)})"
+            " — retry after the backlog clears",
+            retry_after_s=self.retry_after(depth),
+            diagnostics={
+                "slo_class": cls, "tag": tag, "depth": depth,
+                "watermark": self.watermark, "shed": list(shed),
+            },
+        )
+
+    def _admitted_payload(self, b, kwargs) -> dict:
+        """The data half of the ``admitted`` record — the full request
+        payload (global vectors via JSON's exact float round-trip), so
+        a never-dispatched request is resubmittable from the journal
+        alone after a crash. Built OUTSIDE the gate lock."""
+        from ..models.solvers import gather_pvector
+
+        x0 = kwargs.get("x0")
+        return {
+            "dtype": str(b.dtype),
+            "b": [float(v) for v in gather_pvector(b)],
+            "x0": (
+                None if x0 is None
+                else [float(v) for v in gather_pvector(x0)]
+            ),
+            "tol": kwargs.get("tol"),
+            "maxiter": kwargs.get("maxiter"),
+            "deadline": kwargs.get("deadline"),
+            "retries": kwargs.get("retries"),
+        }
 
     # -- dispatch / drive --------------------------------------------------
     def _requeue_evicted(self, name: str, tenant) -> None:
@@ -367,6 +584,15 @@ class Gate:
                             h.kwargs["maxiter"] = max(
                                 1, int(h.kwargs["maxiter"])
                                 - req.iterations
+                            )
+                        if self.journal is not None:
+                            # a crash after the eviction must not lose
+                            # the checkpointed progress: record where
+                            # the iterate lives and how far it got
+                            self.journal.append(
+                                "chunk", rid=h.rid,
+                                iterations=req.iterations,
+                                checkpoint=req.checkpoint_path,
                             )
                 h.request = None
                 self._queue.append(h)
@@ -464,6 +690,10 @@ class Gate:
                 )
             try:
                 h.request = self.registry.submit(h.tenant, **kwargs)
+                if self.journal is not None:
+                    self.journal.append(
+                        "dispatched", rid=h.rid, tenant=h.tenant,
+                    )
             except Exception as e:  # typed AdmissionRejected etc.
                 h._error = e
             with self._lock:  # account() rebinds _inflight under it
@@ -504,25 +734,324 @@ class Gate:
         every finished gate request ticks ``gate.slo.requests`` for its
         class; a request that resolved (``done``) ticks
         ``gate.slo.hits`` too — a deadline miss fails typed at the
-        service layer, so hits/requests IS the per-class attainment."""
+        service layer, so hits/requests IS the per-class attainment.
+        Journaling gates also write the terminal record here (the
+        completed record carries the bitwise result, so a recovered
+        gate serves it without re-solving)."""
         reg = registry()
         with self._lock:
             for h in self._inflight:
-                if h.accounted or not h.done():
+                # the RAW state: the public `state` masks unjournaled
+                # terminals as running, and this is the very place
+                # that journals them
+                raw = h._raw_state()
+                if h.accounted or raw not in ("done", "failed"):
                     continue
+                if self.journal is not None and h.journal_pending:
+                    self._journal_terminal(h)
+                h.journal_pending = False
                 labels = {"slo_class": h.slo_class}
                 reg.counter("gate.slo.requests", labels=labels).inc()
-                if h.state == "done":
+                if raw == "done":
                     reg.counter("gate.slo.hits", labels=labels).inc()
                 h.accounted = True
             self._inflight = [
                 h for h in self._inflight if not h.accounted
             ]
+            if len(self._handles) > _MAX_HANDLES:
+                for rid in list(self._handles):
+                    if len(self._handles) <= _MAX_HANDLES:
+                        break
+                    old = self._handles[rid]
+                    if old.accounted and old.done():
+                        del self._handles[rid]
+                        # the idempotency window is the handle
+                        # retention window: a pruned key must not
+                        # linger as a dangling entry (memory leak) —
+                        # journaling gates rebuild pruned keys from
+                        # the journal at the next recovery
+                        key = old.idempotency_key
+                        if key is not None and self._idem.get(key) == rid:
+                            del self._idem[key]
+
+    def _journal_terminal(self, h: GateHandle) -> None:
+        """One ``completed``/``failed`` record per terminal handle
+        (callers hold the gate lock and have checked the raw state)."""
+        from ..models.solvers import gather_pvector
+
+        import numpy as np
+
+        if h._raw_state() == "done":
+            x, info = (
+                h._result if h._result is not None
+                else h.request.result()
+            )
+            xg = x if isinstance(x, np.ndarray) else gather_pvector(x)
+            self.journal.append(
+                "completed", rid=h.rid,
+                x=[float(v) for v in xg],
+                converged=bool(info.get("converged")),
+                iterations=int(info.get("iterations", 0)),
+                status=str(info.get("status")),
+            )
+        else:
+            err = h.error
+            self.journal.append(
+                "failed", rid=h.rid,
+                error=getattr(
+                    err, "error_type", type(err).__name__
+                ),
+                message=str(err)[:500],
+            )
+
+    # -- durability: chunk checkpoints + recovery --------------------------
+    def _install_chunk_hook(self, name: str, tenant) -> None:
+        """`OperatorRegistry.on_page_in` hook (journal mode): every
+        paged-in tenant service checkpoints its in-flight iterates at
+        each chunk boundary through `_journal_chunk`, so a kill -9
+        mid-slab costs at most one chunk of a chunked solve."""
+        if tenant.svc is not None:
+            tenant.svc.on_chunk = self._journal_chunk
+
+    def _journal_chunk(self, req, x) -> None:
+        """Called by a tenant service at a chunk boundary (worker
+        thread): save the live iterate in the PR 4 CRC'd checkpoint
+        format under the journal dir and journal the transition —
+        recovery resumes from here (x0 = saved iterate)."""
+        from ..parallel.checkpoint import SolverCheckpointer
+
+        with self._lock:
+            h = next(
+                (h for h in self._inflight if h.request is req), None
+            )
+        if h is None or self.journal is None:
+            return
+        d = os.path.join(self.journal.directory, "ckpt", h.rid)
+        ck = SolverCheckpointer(d, every=1, async_write=False)
+        ck.save_state(
+            {"x": x},
+            {"rid": h.rid, "it": req.iterations, "request": req.tag},
+        )
+        ck.wait()
+        self.journal.append(
+            "chunk", rid=h.rid, iterations=req.iterations, checkpoint=d,
+        )
+
+    def recover(self, journal_dir: Optional[str] = None) -> dict:
+        """Replay the journal into THIS gate (tenants must already be
+        registered — operators are code + data, not journal payload):
+
+        * ``completed`` requests become terminal handles serving their
+          RECORDED results (bitwise — JSON floats round-trip exactly);
+        * ``failed`` requests become terminal handles re-raising the
+          replayed typed error (`RecoveredError` keeps the original
+          class name on the wire);
+        * in-flight requests (dispatched, possibly chunk-checkpointed)
+          are RESUBMITTED: x0 = the newest checkpointed iterate when
+          one exists (spent iterations charged against maxiter), the
+          original x0 otherwise; the deadline clock RESUMES against
+          wall time (a request whose deadline passed during the outage
+          fails typed `SolveDeadlineError` instead of solving late);
+        * queued-but-never-dispatched requests re-enter the EDF queue
+          in their original deadline order;
+        * the idempotency key map is rebuilt, so retried submits from
+          before the crash still return their original ids.
+
+        Returns the outcome summary (also evented as ``gate_recovered``
+        and counted per-outcome under ``gate.recovered``). One-shot:
+        a second call would re-enqueue every non-terminal request
+        (double-solving acknowledged work), so it refuses."""
+        from .. import telemetry
+
+        check(
+            not self._recovered,
+            "gate: recover() already replayed this journal — a second "
+            "replay would resubmit (and double-solve) every "
+            "non-terminal request",
+        )
+        self._recovered = True
+        if self.journal is None:
+            check(
+                journal_dir is not None,
+                "gate: recover() needs a journal (pass journal_dir or "
+                "construct the gate with one)",
+            )
+            self.journal = RequestJournal(journal_dir)
+            self.registry.on_page_in = self._install_chunk_hook
+            for name, t in self.registry._tenants.items():
+                self._install_chunk_hook(name, t)
+        states: Dict[str, dict] = {}
+        order: List[str] = []
+        for rec in self.journal.prior_records:
+            kind, rid = rec.get("kind"), rec.get("rid")
+            if kind == "admitted":
+                if rid not in states:
+                    order.append(rid)
+                states[rid] = {"admitted": rec}
+            elif rid in states and kind in (
+                "dispatched", "chunk", "completed", "failed"
+            ):
+                states[rid][kind] = rec
+        summary = {
+            "completed": 0, "failed": 0, "resumed": 0,
+            "requeued": 0, "expired": 0,
+        }
+        for rid in order:
+            outcome = self._recover_one(rid, states[rid])
+            summary[outcome] += 1
+            registry().counter(
+                "gate.recovered", labels={"outcome": outcome}
+            ).inc()
+            telemetry.emit_event(
+                "request_recovered", label=rid, outcome=outcome,
+            )
+        self.journal.append("recovered", **summary)
+        telemetry.emit_event(
+            "gate_recovered", label=self.journal.directory, **summary
+        )
+        return summary
+
+    def _recover_one(self, rid: str, st: dict) -> str:
+        """Recover one journaled request; returns its outcome key."""
+        import numpy as np
+
+        from ..models.solvers import scatter_pvector_values
+        from ..parallel.checkpoint import load_solver_state
+        from ..parallel.health import SolveDeadlineError
+
+        adm = st["admitted"]
+        key = adm.get("idempotency_key")
+        if key:
+            self._idem[key] = rid
+        if "completed" in st:
+            rec = st["completed"]
+            h = self._terminal_handle(adm, rid)
+            h._result = (
+                np.asarray(rec["x"], dtype=adm.get("dtype", "float64")),
+                {
+                    "converged": bool(rec.get("converged")),
+                    "iterations": int(rec.get("iterations", 0)),
+                    "status": str(rec.get("status")),
+                    "recovered": True,
+                },
+            )
+            return "completed"
+        if "failed" in st:
+            rec = st["failed"]
+            h = self._terminal_handle(adm, rid)
+            h._error = RecoveredError(
+                rec.get("error", "RuntimeError"), rec.get("message", "")
+            )
+            return "failed"
+        # in-flight or queued: resubmit. Unknown tenant (the operator
+        # was not re-registered before recover()) fails typed instead
+        # of silently dropping an acknowledged request.
+        tenant = self.registry._tenants.get(adm["tenant"])
+        if tenant is None:
+            h = self._terminal_handle(adm, rid)
+            h._error = RecoveredError(
+                "UnknownTenant",
+                f"request {rid}: tenant {adm['tenant']!r} was not "
+                "re-registered before recover()",
+            )
+            return "failed"
+        dtype = np.dtype(adm.get("dtype", "float64"))
+        kwargs = {
+            "b": scatter_pvector_values(
+                np.asarray(adm["b"], dtype=dtype), tenant.A.cols
+            ),
+            "tag": adm.get("tag") or rid,
+        }
+        for k in ("tol", "maxiter", "retries"):
+            if adm.get(k) is not None:
+                kwargs[k] = adm[k]
+        if adm.get("x0") is not None:
+            kwargs["x0"] = scatter_pvector_values(
+                np.asarray(adm["x0"], dtype=dtype), tenant.A.cols
+            )
+        outcome = "requeued"
+        chunk = st.get("chunk")
+        if chunk is not None:
+            saved = load_solver_state(
+                chunk["checkpoint"], {"x": tenant.A.cols}
+            )
+            if saved is not None:
+                kwargs["x0"] = saved["x"]
+                if kwargs.get("maxiter") is not None:
+                    kwargs["maxiter"] = max(
+                        1, int(kwargs["maxiter"])
+                        - int(chunk.get("iterations", 0))
+                    )
+                outcome = "resumed"
+        deadline_abs = None
+        if adm.get("deadline") is not None:
+            # the deadline clock RESUMES: the outage consumed budget
+            remaining = float(adm["deadline"]) - (
+                _walltime.time() - float(adm.get("submitted_wall", 0.0))
+            )
+            if remaining <= 0.0:
+                h = self._terminal_handle(adm, rid)
+                err = SolveDeadlineError(
+                    f"request {rid}: deadline of {adm['deadline']}s "
+                    "expired during the outage — recovery fails it "
+                    "typed instead of solving late",
+                    diagnostics={
+                        "context": "gate-recovery", "request": rid,
+                        "deadline_s": adm["deadline"],
+                    },
+                )
+                h._error = err
+                if self.journal is not None:
+                    self._journal_terminal(h)
+                    h.accounted = True
+                return "expired"
+            kwargs["deadline"] = remaining
+            deadline_abs = self.clock() + remaining
+        with self._lock:
+            h = GateHandle(
+                tenant=adm["tenant"], tag=kwargs["tag"],
+                slo_class=adm.get("slo_class") or self.classes[-1],
+                deadline_abs=deadline_abs, seq=self._seq,
+                kwargs=kwargs, rid=rid,
+            )
+            h.idempotency_key = key
+            h.submitted_wall = float(adm.get("submitted_wall", 0.0))
+            h.journal_pending = True  # its terminal must journal too
+            self._seq += 1
+            self._handles[rid] = h
+            self._queue.append(h)
+            self._queue.sort(key=_edf_key)
+        return outcome
+
+    def _terminal_handle(self, adm: dict, rid: str) -> GateHandle:
+        """A journal-recovered terminal handle, registered for polls
+        (it never enters the queue or the SLO accounting — its life
+        was accounted by the gate generation that served it)."""
+        with self._lock:
+            h = GateHandle(
+                tenant=adm.get("tenant"), tag=adm.get("tag") or rid,
+                slo_class=adm.get("slo_class") or self.classes[-1],
+                deadline_abs=None, seq=self._seq, kwargs={}, rid=rid,
+            )
+            h.idempotency_key = adm.get("idempotency_key")
+            h.accounted = True
+            self._seq += 1
+            self._handles[rid] = h
+            return h
 
     def shutdown(self, drain: bool = True):
+        from .. import telemetry
+
         if drain:
             self.drain()
-        return self.registry.shutdown(drain=drain)
+        stats = self.registry.shutdown(drain=drain)
+        telemetry.emit_event(
+            "gate_shutdown", label="drain" if drain else "checkpoint",
+            tenants=sorted(stats),
+        )
+        if self.journal is not None:
+            self.journal.append("shutdown", drain=bool(drain))
+        return stats
 
     def __repr__(self):
         return (

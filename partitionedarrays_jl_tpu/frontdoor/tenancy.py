@@ -175,6 +175,12 @@ class OperatorRegistry:
         #: gate lock (the inverse order never happens — `Gate` touches
         #: the registry only from outside its own lock).
         self.on_evict: Optional[Callable[[str, "Tenant"], None]] = None
+        #: Optional hook called AFTER a tenant is paged in (fresh
+        #: `SolveService` built) — the journaling gate installs its
+        #: chunk-boundary checkpoint hook on every new service here, so
+        #: paging can never produce an unjournaled service. Same lock
+        #: discipline as ``on_evict``.
+        self.on_page_in: Optional[Callable[[str, "Tenant"], None]] = None
         self._tenants: Dict[str, Tenant] = {}
         self._lock = threading.RLock()
         if monitoring_enabled():
@@ -308,6 +314,8 @@ class OperatorRegistry:
         t.resident = True
         t.page_ins += 1
         t.last_used = self.clock()
+        if self.on_page_in is not None:
+            self.on_page_in(t.name, t)
         registry().counter("gate.page_ins").inc()
         telemetry.emit_event(
             "tenant_paged_in", label=t.name,

@@ -113,6 +113,20 @@ class SolveService:
         #: Structural operator identity: the throughput-model key this
         #: service's finished slabs report their measured s_per_it under.
         self.fingerprint = operator_fingerprint(A)
+        #: Per-instance token qualifying request checkpoint paths:
+        #: request ids are process-local monotonic, so a re-built
+        #: service (an evicted tenant paged back in) would otherwise
+        #: reuse ``req-0`` and `solve_with_recovery` could resume a
+        #: DIFFERENT request's stale iterate from the shared dir.
+        import secrets as _secrets
+
+        self._uid = _secrets.token_hex(3)
+        #: Optional chunk-boundary hook ``(request, iterate) -> None``,
+        #: called for every still-running request of a CHUNKED slab
+        #: after each chunk's verdicts — the journaling front door
+        #: checkpoints in-flight iterates here (crash durability); the
+        #: unchunked path has no boundaries and never calls it.
+        self.on_chunk: Optional[Callable] = None
         self._queue: list = []
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
@@ -446,6 +460,12 @@ class SolveService:
                     X[r.id] = xs[k]
                     still.append(r)
             active = still
+            if chunked and active and self.on_chunk is not None:
+                # chunk-boundary durability hook (the journaling gate
+                # checkpoints the live iterates) — BEFORE the stop
+                # check, so even the final pre-shutdown chunk is saved
+                for r in active:
+                    self.on_chunk(r, X[r.id])
             if not active:
                 break
             if self._stop:
@@ -690,7 +710,7 @@ class SolveService:
                 self.A, req.b,
                 method="pcg" if self.minv is not None else "cg",
                 checkpoint_dir=os.path.join(
-                    self.checkpoint_dir, f"req-{req.id}"
+                    self.checkpoint_dir, f"req-{self._uid}-{req.id}"
                 ),
                 every=self.chunk, max_restarts=max(0, req.retries - 1),
                 minv=self.minv, x0=req.x0, tol=req.tol,
@@ -713,7 +733,9 @@ class SolveService:
             return
         from ..parallel.checkpoint import SolverCheckpointer
 
-        d = os.path.join(self.checkpoint_dir, f"req-{req.id}")
+        d = os.path.join(
+            self.checkpoint_dir, f"req-{self._uid}-{req.id}"
+        )
         ck = SolverCheckpointer(d, every=1, async_write=False)
         ck.save_state(
             {"x": x},

@@ -258,6 +258,30 @@ CATALOG: Dict[str, MetricSpec] = {
               "frontdoor/tenancy.py:_update_gauges",
               "the tenant's declared static footprint",
               labels=("tenant",)),
+        # -- durability (padur): write-ahead journal + recovery --------
+        _spec("journal.appends", "counter", "1",
+              "frontdoor/journal.py:append",
+              "request lifecycle records appended (fsync'd before the "
+              "transition is acknowledged to the client)"),
+        _spec("journal.rotations", "counter", "1",
+              "frontdoor/journal.py:_rotate",
+              "journal segments rotated (close + fsync + publish)"),
+        _spec("journal.truncated", "counter", "1",
+              "frontdoor/journal.py:_truncate_tail",
+              "torn tail records truncated at replay (the expected "
+              "crash artifact — mid-file corruption raises typed "
+              "JournalCorruptError instead)"),
+        _spec("gate.idempotent_hits", "counter", "1",
+              "frontdoor/scheduler.py:submit",
+              "submits answered from an existing idempotency key — "
+              "the original id/result served, no second solve"),
+        _spec("gate.recovered", "counter", "1",
+              "frontdoor/scheduler.py:recover",
+              "journaled requests replayed at recovery, by outcome "
+              "(completed/failed served from the record, resumed from "
+              "a checkpointed iterate, requeued from the original "
+              "payload, expired typed)",
+              labels=("outcome",)),
     ]
 }
 

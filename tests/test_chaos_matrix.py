@@ -57,6 +57,16 @@ outcome, event trail, and metric deltas (docs/service.md Front door):
 | operator footprint over PA_GATE_MEM_BUDGET | registry admission | TenantBudgetError (typed; tenant never registered) + tenant_budget_rejected event + gate.budget_rejected counter |
 | gate queue past the shed watermark | SLO-class shed policy | lowest class refused with typed LoadShedded (retry_after_s / HTTP 429 Retry-After) + load_shedded event + gate.shed{slo_class=…}; DISTINCT from service.rejected{reason=queue_full} |
 | eviction during an in-flight chunked solve | LRU paging + PR 7 checkpoint path | request_checkpointed at the chunk boundary, tenant_evicted/tenant_requeued/tenant_paged_in events, checkpoint_restore on resume, and the request COMPLETES from its saved iterate |
+
+Round 15 (padur): the DURABILITY rows — the gate's own death, each
+with its documented outcome, event trail, and metric deltas
+(docs/resilience.md Durability):
+
+| condition               | detector            | documented outcome   |
+|-------------------------|---------------------|----------------------|
+| gate killed mid-solve (kill -9 semantics: state abandoned, no shutdown) | write-ahead journal replay at the next start | Gate.recover() resumes the in-flight request from its chunk-checkpointed iterate (gate.recovered{outcome=resumed}, request_recovered/gate_recovered/checkpoint_restore events) and it COMPLETES; nothing lost, nothing duplicated |
+| torn journal tail (crash mid-append) | per-record CRC32 at replay | tail truncated (journal.truncated + journal_truncated event), clean prefix recovered intact; mid-file corruption raises typed JournalCorruptError instead |
+| duplicate idempotency-key submit | gate key map (journal-rebuilt) | original id + bitwise result returned (gate.idempotent_hits + idempotent_replay event); service.admitted does NOT move — a single solve, across restarts included |
 """
 import numpy as np
 import pytest
@@ -626,6 +636,182 @@ def test_matrix_gate_eviction_during_inflight_checkpoint_resume(tmp_path):
         # the resume is narrated end to end
         assert _has_event(h.request.record, "request_done", "inflight")
         assert telemetry.counter("events.checkpoint_restore") > 0
+        return True
+
+    _run(driver)
+
+
+# ---------------------------------------------------------------------------
+# round 15 — the durability (padur) rows
+# ---------------------------------------------------------------------------
+
+
+def test_matrix_gate_crash_midsolve_journal_recovery(tmp_path):
+    """Durability row 1: the gate dies mid-solve (kill -9 semantics —
+    the first gate's state is ABANDONED, no shutdown or eviction path
+    runs). The write-ahead journal has the admitted/dispatched/chunk
+    records, so a fresh gate over the same journal dir resumes the
+    request from its chunk-checkpointed iterate and COMPLETES it:
+    gate.recovered{outcome=resumed} counts it, request_recovered /
+    gate_recovered / checkpoint_restore narrate it, and the journal
+    ends with exactly one completed record for the rid (zero lost,
+    zero duplicated)."""
+    from partitionedarrays_jl_tpu.frontdoor import Gate, read_journal
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (12, 12))
+        x_direct, _ = cg(A, b, x0=x0, tol=1e-9)
+        jd = str(tmp_path / "journal")
+        g1 = Gate(journal_dir=jd, checkpoint_dir=str(tmp_path / "c1"))
+        g1.register("t", A, kmax=2, chunk=4)
+        h = g1.submit("t", b, x0=x0, tol=1e-9, deadline=3600.0,
+                      slo_class="interactive", tag="crashy",
+                      idempotency_key="crash-key")
+        g1.pump(dispatch_only=True)
+        svc = g1.service("t")
+        svc._stop = True  # freeze after one chunk: the kill window
+        svc.step()
+        assert h.request.iterations > 0
+        # ---- crash: g1 is abandoned with its request mid-flight ----
+        m0 = _metric_state(
+            "gate.recovered{outcome=resumed}", "service.completed",
+        )
+        ev0 = telemetry.counter("events.request_recovered")
+        evg0 = telemetry.counter("events.gate_recovered")
+        evr0 = telemetry.counter("events.checkpoint_restore")
+        g2 = Gate(journal_dir=jd, checkpoint_dir=str(tmp_path / "c2"))
+        g2.register("t", A, kmax=2, chunk=4)
+        summary = g2.recover()
+        assert summary["resumed"] == 1, summary
+        assert telemetry.counter("events.request_recovered") == ev0 + 1
+        assert telemetry.counter("events.gate_recovered") == evg0 + 1
+        assert telemetry.counter("events.checkpoint_restore") == evr0 + 1
+        g2.drain()
+        x, info = g2.handle(h.rid).result()
+        assert info["converged"]
+        np.testing.assert_allclose(
+            gather_pvector(x), gather_pvector(x_direct),
+            rtol=0, atol=1e-6,
+        )
+        m1 = _metric_state(
+            "gate.recovered{outcome=resumed}", "service.completed",
+        )
+        d = {k: m1[k] - m0[k] for k in m0}
+        assert d["gate.recovered{outcome=resumed}"] == 1, d
+        assert d["service.completed"] == 1, d
+        completed = [
+            r for r in read_journal(jd)
+            if r.get("kind") == "completed" and r.get("rid") == h.rid
+        ]
+        assert len(completed) == 1, "zero lost, zero duplicated"
+        return True
+
+    _run(driver)
+
+
+def test_matrix_torn_journal_tail_truncates_typed(tmp_path):
+    """Durability row 2: a crash mid-append tears the LAST journal
+    record — replay truncates it (journal.truncated counter +
+    journal_truncated event) and the clean prefix recovers intact; a
+    defective record that is NOT the tail is real corruption and
+    raises the typed JournalCorruptError instead of silently dropping
+    acknowledged history."""
+    from partitionedarrays_jl_tpu.frontdoor import (
+        Gate,
+        JournalCorruptError,
+        RequestJournal,
+        read_journal,
+    )
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        jd = str(tmp_path / "journal")
+        g1 = Gate(journal_dir=jd)
+        g1.register("t", A, kmax=4)
+        h = g1.submit("t", b, x0=x0, tol=1e-9, tag="pre-tear")
+        g1.drain()
+        x1 = gather_pvector(h.result()[0])
+        # tear the tail: a half-written record, as a crash mid-append
+        # would leave it
+        last = sorted(g1.journal.segments())[-1]
+        with open(last, "ab") as f:
+            f.write(b'{"kind":"completed","seq":999,"x":[0.123')
+        m0 = _metric_state("journal.truncated")
+        ev0 = telemetry.counter("events.journal_truncated")
+        g2 = Gate(journal_dir=jd)
+        g2.register("t", A, kmax=4)
+        summary = g2.recover()
+        m1 = _metric_state("journal.truncated")
+        assert m1["journal.truncated"] == m0["journal.truncated"] + 1
+        assert telemetry.counter("events.journal_truncated") == ev0 + 1
+        # the clean prefix survived: the completed request still serves
+        assert summary["completed"] == 1, summary
+        np.testing.assert_array_equal(
+            g2.handle(h.rid).result()[0], x1
+        )
+        # mid-file corruption is NOT a torn tail: typed refusal
+        jc = str(tmp_path / "corrupt")
+        jx = RequestJournal(jc, fsync=False)
+        jx.append("shed", tag="aaaa", slo_class="x", depth=0)
+        jx.append("shed", tag="bbbb", slo_class="x", depth=1)
+        jx.close()
+        seg = sorted(jx.segments())[0]
+        data = bytearray(open(seg, "rb").read())
+        data[data.find(b"aaaa")] = ord("z")
+        open(seg, "wb").write(bytes(data))
+        with pytest.raises(JournalCorruptError):
+            read_journal(jc, strict=True)
+        return True
+
+    _run(driver)
+
+
+def test_matrix_duplicate_idempotency_key_single_solve(tmp_path):
+    """Durability row 3: a duplicate idempotency-key submit — the
+    retried-timed-out-POST scenario — returns the ORIGINAL id and
+    bitwise result and never starts a second solve: gate.idempotent_hits
+    counts it, idempotent_replay narrates it, and service.admitted does
+    not move; the key map survives a gate restart via the journal."""
+    from partitionedarrays_jl_tpu.frontdoor import Gate
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        jd = str(tmp_path / "journal")
+        g1 = Gate(journal_dir=jd)
+        g1.register("t", A, kmax=4)
+        h1 = g1.submit("t", b, x0=x0, tol=1e-9, tag="orig",
+                       idempotency_key="dup-key")
+        g1.drain()
+        x1 = gather_pvector(h1.result()[0])
+        m0 = _metric_state(
+            "gate.idempotent_hits", "service.admitted",
+            "service.completed",
+        )
+        ev0 = telemetry.counter("events.idempotent_replay")
+        h2 = g1.submit("t", b, idempotency_key="dup-key")
+        assert h2 is h1, "the original handle, not a second request"
+        np.testing.assert_array_equal(gather_pvector(h2.result()[0]), x1)
+        m1 = _metric_state(
+            "gate.idempotent_hits", "service.admitted",
+            "service.completed",
+        )
+        d = {k: m1[k] - m0[k] for k in m0}
+        assert d["gate.idempotent_hits"] == 1, d
+        assert d["service.admitted"] == 0, "a replay admits NOTHING"
+        assert d["service.completed"] == 0, d
+        assert telemetry.counter("events.idempotent_replay") == ev0 + 1
+        # across a crash: the journal rebuilds the key map
+        g2 = Gate(journal_dir=jd)
+        g2.register("t", A, kmax=4)
+        g2.recover()
+        h3 = g2.submit("t", b, idempotency_key="dup-key")
+        assert h3.rid == h1.rid
+        np.testing.assert_array_equal(h3.result()[0], x1)
+        m2 = _metric_state("gate.idempotent_hits", "service.admitted")
+        assert m2["gate.idempotent_hits"] == (
+            m1["gate.idempotent_hits"] + 1
+        )
+        assert m2["service.admitted"] == m1["service.admitted"]
         return True
 
     _run(driver)

@@ -1,0 +1,646 @@
+"""padur — the crash-durable front door
+(`partitionedarrays_jl_tpu.frontdoor.journal` + `Gate.recover`).
+
+The contracts pinned here:
+
+* **Journal format** — append-only JSONL segments with per-record
+  CRC32, monotonic seq across epochs, fsync'd rotation; replay returns
+  exactly what was appended, in order.
+* **Torn tail vs corruption** — a defective LAST record truncates with
+  the ``journal_truncated`` event + counter (the expected crash
+  artifact); a defective record anywhere else raises the typed
+  `JournalCorruptError` (acknowledged history is damaged).
+* **Recovery ladder** — completed requests serve their RECORDED
+  results bitwise; failed requests re-raise typed with the original
+  class name; in-flight requests resume from chunk-checkpointed
+  iterates; queued requests re-enter EDF and complete bitwise-equal to
+  their solo solves; a request whose deadline passed during the outage
+  fails typed instead of solving late.
+* **Idempotency** — a retried submit with the same key returns the
+  original id and (once done) the original bitwise result — never a
+  second solve, across restarts included.
+* **Request-id collision safety** — ids are epoch-qualified: two gate
+  generations can never mint the same id, and `/v1/solve/<id>` for a
+  pre-restart id either serves the recovered state (journal on) or
+  404s typed (journal off) — never someone else's result.
+* **Client resilience** — `http_solve(retries=N)` retries transient
+  connection failures via `retry_with_backoff` and honors 429
+  ``Retry-After``, with ``give_up`` on the overall deadline.
+* **Overhead** — with every ``PA_GATE_JOURNAL*`` knob set and a
+  journaling gate actively serving, the block body lowers to
+  byte-identical StableHLO vs the journal-off baseline.
+
+The full SIGKILL drill (subprocess, kill -9 mid-slab over HTTP) runs
+under the ``slow`` marker; the graceful-SIGTERM exit-code contract has
+its own (fast) subprocess test.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu import telemetry
+from partitionedarrays_jl_tpu.frontdoor import (
+    Gate,
+    JournalCorruptError,
+    RecoveredError,
+    RequestJournal,
+    http_solve,
+    read_journal,
+    serve_gate,
+)
+from partitionedarrays_jl_tpu.models import (
+    assemble_poisson,
+    cg,
+    gather_pvector,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _poisson(grid=(8, 8)):
+    return pa.prun(
+        lambda parts: assemble_poisson(parts, grid), pa.sequential, (2, 2)
+    )
+
+
+def _counter(name, labels=None):
+    return telemetry.registry().counter(name, labels=labels).value
+
+
+# ---------------------------------------------------------------------------
+# the journal itself
+# ---------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_rotation_and_epochs(tmp_path):
+    """Append -> rotate -> replay round trip: every record comes back
+    CRC-verified in order, seq stays monotonic across segments and
+    epochs, and each open starts a fresh epoch + segment."""
+    jd = str(tmp_path / "j")
+    a0 = _counter("journal.appends")
+    r0 = _counter("journal.rotations")
+    j = RequestJournal(jd, fsync=True, segment_bytes=4096)
+    for i in range(40):
+        j.append("shed", tag=f"r{i}", slo_class="besteffort", depth=i)
+    assert len(j.segments()) >= 2, "must rotate past segment_bytes"
+    assert _counter("journal.appends") == a0 + 41  # + the epoch record
+    assert _counter("journal.rotations") >= r0 + 1
+    j.close()
+    j2 = RequestJournal(jd, fsync=False)
+    sheds = [r for r in j2.prior_records if r["kind"] == "shed"]
+    assert [r["tag"] for r in sheds] == [f"r{i}" for i in range(40)]
+    assert all("wall" in r for r in sheds)
+    seqs = [r["seq"] for r in j2.prior_records]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert j2.epoch == 2
+    # the new epoch's appends continue the seq line
+    rec = j2.append("shed", tag="post", slo_class="x", depth=0)
+    assert rec["seq"] > max(seqs)
+    j2.close()
+
+
+def test_torn_tail_truncates_mid_file_corruption_raises(tmp_path):
+    """The WAL convention: a torn LAST record truncates (counted +
+    evented, clean prefix preserved); a bad record followed by clean
+    data is real corruption and raises typed."""
+    jd = str(tmp_path / "torn")
+    j = RequestJournal(jd, fsync=False)
+    for i in range(3):
+        j.append("shed", tag=f"t{i}", slo_class="x", depth=i)
+    j.close()
+    last = sorted(j.segments())[-1]
+    with open(last, "ab") as f:
+        f.write(b'{"kind":"completed","seq":99,"x":[0.1')  # torn write
+    t0 = _counter("journal.truncated")
+    ev0 = telemetry.counter("events.journal_truncated")
+    j2 = RequestJournal(jd, fsync=False)
+    assert [
+        r["tag"] for r in j2.prior_records if r["kind"] == "shed"
+    ] == ["t0", "t1", "t2"], "clean prefix must survive the torn tail"
+    assert _counter("journal.truncated") == t0 + 1
+    assert telemetry.counter("events.journal_truncated") == ev0 + 1
+    # the truncation is durable: a THIRD open sees a clean journal
+    j2.close()
+    t1 = _counter("journal.truncated")
+    j3 = RequestJournal(jd, fsync=False)
+    assert _counter("journal.truncated") == t1
+    j3.close()
+    # mid-file corruption: flip a byte in the FIRST record
+    jc = str(tmp_path / "corrupt")
+    jx = RequestJournal(jc, fsync=False)
+    jx.append("shed", tag="aaaa", slo_class="x", depth=0)
+    jx.append("shed", tag="bbbb", slo_class="x", depth=1)
+    jx.close()
+    seg = sorted(jx.segments())[0]
+    data = bytearray(open(seg, "rb").read())
+    data[data.find(b"aaaa")] = ord("z")
+    open(seg, "wb").write(bytes(data))
+    with pytest.raises(JournalCorruptError):
+        read_journal(jc, strict=True)
+    # a fresh gate open over the damaged journal refuses too
+    with pytest.raises(JournalCorruptError):
+        RequestJournal(jc, fsync=False)
+
+
+# ---------------------------------------------------------------------------
+# recovery
+# ---------------------------------------------------------------------------
+
+
+def test_recover_completed_failed_and_queued(tmp_path):
+    """The recovery ladder over a simulated crash (the first gate is
+    simply abandoned — no shutdown runs): a completed request serves
+    its recorded result BITWISE, a failed request re-raises typed with
+    the original class name, and a queued-but-never-dispatched request
+    re-enters EDF and completes bitwise-equal to its solo solve."""
+    A, b, xe, x0 = _poisson((8, 8))
+    x_solo = gather_pvector(cg(A, b, x0=x0, tol=1e-9)[0])
+    jd = str(tmp_path / "j")
+    g1 = Gate(journal_dir=jd)
+    g1.register("t", A, kmax=4, chunk=2)
+    h_done = g1.submit("t", b, x0=x0, tol=1e-9, tag="done-req")
+    h_fail = g1.submit("t", b, x0=x0, tol=1e-9, maxiter=5000,
+                       deadline=1e-7, slo_class="interactive",
+                       tag="fail-req")
+    g1.drain()
+    assert h_done.state == "done" and h_fail.state == "failed"
+    x1 = gather_pvector(h_done.result()[0])
+    h_q = g1.submit("t", b, x0=x0, tol=1e-9, tag="queued-req")
+    assert h_q.state == "gate-queued"
+    # ---- crash ----
+    m0 = {
+        o: _counter("gate.recovered", labels={"outcome": o})
+        for o in ("completed", "failed", "requeued")
+    }
+    ev0 = telemetry.counter("events.gate_recovered")
+    g2 = Gate(journal_dir=jd)
+    g2.register("t", A, kmax=4)
+    summary = g2.recover()
+    assert summary["completed"] == 1 and summary["failed"] == 1
+    assert summary["requeued"] == 1 and summary["expired"] == 0
+    for o in m0:
+        assert _counter(
+            "gate.recovered", labels={"outcome": o}
+        ) == m0[o] + 1
+    assert telemetry.counter("events.gate_recovered") == ev0 + 1
+    # completed: bitwise from the record, no solve
+    hr = g2.handle(h_done.rid)
+    xr, ir = hr.result()
+    assert ir["recovered"] and ir["converged"]
+    np.testing.assert_array_equal(xr, x1)
+    # failed: typed with the ORIGINAL class name preserved
+    hf = g2.handle(h_fail.rid)
+    assert hf.state == "failed"
+    with pytest.raises(RecoveredError) as ei:
+        hf.result()
+    assert ei.value.error_type == "SolveDeadlineError"
+    # recover() is one-shot: a second replay would re-enqueue (and
+    # double-solve) the queued request
+    with pytest.raises(Exception, match="already replayed"):
+        g2.recover()
+    # queued: resubmitted, completes bitwise vs solo
+    g2.drain()
+    xq, iq = g2.handle(h_q.rid).result()
+    assert iq["converged"]
+    np.testing.assert_array_equal(gather_pvector(xq), x_solo)
+
+
+def test_recover_resumes_inflight_from_chunk_checkpoint(tmp_path):
+    """A chunked request crash-frozen mid-solve resumes from its
+    journal-checkpointed iterate: the resubmission's x0 is the saved
+    iterate (iterations already spent come off the budget, the
+    deadline clock resumes against wall time) and the request
+    completes instead of restarting from zero."""
+    A, b, xe, x0 = _poisson((12, 12))
+    x_direct = gather_pvector(cg(A, b, x0=x0, tol=1e-9)[0])
+    jd = str(tmp_path / "j")
+    g1 = Gate(journal_dir=jd, checkpoint_dir=str(tmp_path / "c"))
+    g1.register("t", A, kmax=2, chunk=4)
+    h = g1.submit("t", b, x0=x0, tol=1e-9, maxiter=400,
+                  deadline=3600.0, slo_class="interactive",
+                  tag="inflight")
+    g1.pump(dispatch_only=True)
+    svc = g1.service("t")
+    svc._stop = True  # freeze after ONE chunk — a crash mid-solve
+    svc.step()
+    it_done = h.request.iterations
+    assert it_done > 0
+    kinds = [r["kind"] for r in read_journal(jd)]
+    assert kinds.count("chunk") >= 1, kinds
+    # ---- crash ----
+    g2 = Gate(journal_dir=jd, checkpoint_dir=str(tmp_path / "c2"))
+    g2.register("t", A, kmax=2, chunk=4)
+    summary = g2.recover()
+    assert summary["resumed"] == 1, summary
+    h2 = g2.handle(h.rid)
+    # the resubmission carries the checkpointed iterate and the
+    # REDUCED budget — resumed, not reset
+    assert h2.kwargs["x0"] is not None
+    assert h2.kwargs["maxiter"] == 400 - it_done
+    assert h2.kwargs["deadline"] < 3600.0
+    g2.drain()
+    x, info = h2.result()
+    assert info["converged"]
+    np.testing.assert_allclose(
+        gather_pvector(x), x_direct, rtol=0, atol=1e-6
+    )
+
+
+def test_recover_expired_deadline_fails_typed(tmp_path):
+    """The deadline clock RESUMES across the outage: a journaled
+    request whose deadline already passed by recovery time fails typed
+    (`SolveDeadlineError` on the wire) instead of solving late."""
+    A, b, xe, x0 = _poisson((8, 8))
+    jd = str(tmp_path / "j")
+    g1 = Gate(journal_dir=jd)
+    g1.register("t", A, kmax=4)
+    g1.paused = True
+    h = g1.submit("t", b, x0=x0, tol=1e-9, deadline=0.05,
+                  slo_class="interactive", tag="expired")
+    # ---- crash; the "outage" outlives the deadline ----
+    time.sleep(0.1)
+    g2 = Gate(journal_dir=jd)
+    g2.register("t", A, kmax=4)
+    summary = g2.recover()
+    assert summary["expired"] == 1, summary
+    h2 = g2.handle(h.rid)
+    assert h2.state == "failed"
+    with pytest.raises(Exception) as ei:
+        h2.result()
+    assert type(ei.value).__name__ == "SolveDeadlineError"
+    # the typed failure is journaled, so the NEXT generation serves it
+    # from the record without re-deciding
+    g3 = Gate(journal_dir=jd)
+    g3.register("t", A, kmax=4)
+    assert g3.recover()["failed"] == 1
+    with pytest.raises(RecoveredError) as ei:
+        g3.handle(h.rid).result()
+    assert ei.value.error_type == "SolveDeadlineError"
+
+
+def test_idempotency_key_never_double_solves(tmp_path):
+    """A retried submit with the same idempotency key returns the
+    ORIGINAL handle/result and admits nothing new — live, and across a
+    crash-recovery (where the key map is rebuilt from the journal)."""
+    A, b, xe, x0 = _poisson((8, 8))
+    jd = str(tmp_path / "j")
+    g1 = Gate(journal_dir=jd)
+    g1.register("t", A, kmax=4)
+    hits0 = _counter("gate.idempotent_hits")
+    ev0 = telemetry.counter("events.idempotent_replay")
+    h1 = g1.submit("t", b, x0=x0, tol=1e-9, idempotency_key="k")
+    g1.drain()
+    x1 = gather_pvector(h1.result()[0])
+    adm0 = _counter("service.admitted")
+    assert g1.submit("t", b, idempotency_key="k") is h1
+    assert _counter("gate.idempotent_hits") == hits0 + 1
+    assert telemetry.counter("events.idempotent_replay") == ev0 + 1
+    assert _counter("service.admitted") == adm0, "no second solve"
+    # ---- crash ----
+    g2 = Gate(journal_dir=jd)
+    g2.register("t", A, kmax=4)
+    g2.recover()
+    h2 = g2.submit("t", b, idempotency_key="k")
+    assert h2.rid == h1.rid
+    np.testing.assert_array_equal(h2.result()[0], x1)
+    assert _counter("service.admitted") == adm0
+    assert _counter("gate.idempotent_hits") == hits0 + 2
+
+
+def test_request_ids_collision_safe_and_pre_restart_poll(tmp_path):
+    """Satellite bugfix: ids are epoch-qualified, so two gate
+    generations can never mint the same id. Journal-off, a pre-restart
+    id polls as a typed 404 (never someone else's result); journal-on,
+    it serves the recovered state."""
+    A, b, xe, x0 = _poisson((8, 8))
+    # journal-off: disjoint id spaces across "restarts"
+    ga, gb = Gate(), Gate()
+    ga.register("t", A, kmax=4)
+    gb.register("t", A, kmax=4)
+    ha = ga.submit("t", b, x0=x0, tol=1e-9)
+    hb = gb.submit("t", b, x0=x0, tol=1e-9)
+    assert ha.rid != hb.rid
+    ga.drain()
+    gb.drain()
+    # journal-on: ids carry the journal epoch and stay resolvable
+    jd = str(tmp_path / "j")
+    g1 = Gate(journal_dir=jd, start_workers=True)
+    g1.register("t", A, kmax=4)
+    srv = serve_gate(g1, port=0)
+    try:
+        bg, x0g = gather_pvector(b), gather_pvector(x0)
+        out = http_solve(srv.url, "t", bg, x0=x0g, tol=1e-9)
+        assert out["state"] == "done"
+        rid = out["id"]
+    finally:
+        srv.stop(drain=False)
+    # restarted server, same journal: the PRE-RESTART id still serves
+    g2 = Gate(journal_dir=jd, start_workers=True)
+    g2.register("t", A, kmax=4)
+    g2.recover()
+    srv2 = serve_gate(g2, port=0)
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"{srv2.url}/v1/solve/{rid}"
+        ) as resp:
+            poll = json.loads(resp.read())
+        assert poll["state"] == "done" and poll["info"]["recovered"]
+        np.testing.assert_array_equal(np.asarray(poll["x"]),
+                                      np.asarray(out["x"]))
+        # a journal-OFF restart 404s the pre-restart id typed
+        g3 = Gate(start_workers=True)
+        g3.register("t", A, kmax=4)
+        srv3 = serve_gate(g3, port=0)
+        try:
+            urllib.request.urlopen(f"{srv3.url}/v1/solve/{rid}")
+            raise AssertionError("pre-restart id must 404 journal-off")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert json.loads(e.read())["error"] == "UnknownRequest"
+        finally:
+            srv3.stop(drain=False)
+    finally:
+        srv2.stop(drain=False)
+
+
+def test_terminal_state_not_acknowledged_before_journaled(tmp_path):
+    """The write-ahead invariant applied to COMPLETION: on a journaling
+    gate, a finished request reads ``running`` (and ``result()``
+    refuses) until its terminal record is durably appended — a client
+    can never observe an outcome a crash could then contradict. The
+    non-journaling gate is unmasked (no behavior change)."""
+    A, b, xe, x0 = _poisson((8, 8))
+    jd = str(tmp_path / "j")
+    g = Gate(journal_dir=jd)
+    g.register("t", A, kmax=4)
+    h = g.submit("t", b, x0=x0, tol=1e-9, tag="wal")
+    g.pump(dispatch_only=True)
+    g.service("t").drain()  # the slab finishes; account() has NOT run
+    assert h.request.state == "done"
+    assert h.state == "running", "unjournaled terminal must not ack"
+    with pytest.raises(RuntimeError, match="journal record"):
+        h.result()
+    kinds = [r["kind"] for r in read_journal(jd)]
+    assert "completed" not in kinds
+    g.account()  # journals the terminal, then acknowledges
+    assert h.state == "done"
+    assert h.result()[1]["converged"]
+    kinds = [r["kind"] for r in read_journal(jd)]
+    assert kinds.count("completed") == 1
+    # journal-off: terminal is visible immediately (unchanged)
+    g2 = Gate()
+    g2.register("t", A, kmax=4)
+    h2 = g2.submit("t", b, x0=x0, tol=1e-9)
+    g2.pump(dispatch_only=True)
+    g2.service("t").drain()
+    assert h2.state == "done"
+
+
+# ---------------------------------------------------------------------------
+# http_solve client resilience (injected failures — no real server)
+# ---------------------------------------------------------------------------
+
+
+class _FakeResponse:
+    def __init__(self, status, payload):
+        self.status = status
+        self._payload = payload
+
+    def read(self):
+        return json.dumps(self._payload).encode()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class _FakeHTTPError(urllib.error.HTTPError):
+    def __init__(self, url, code, payload, headers=None):
+        import email.message
+
+        msg = email.message.Message()
+        for k, v in (headers or {}).items():
+            msg[k] = str(v)
+        super().__init__(url, code, "err", msg, None)
+        self._payload = payload
+
+    def read(self):
+        return json.dumps(self._payload).encode()
+
+
+def test_http_solve_retries_transient_and_honors_retry_after():
+    """Client resilience with injected failures: two connection
+    refusals then success (retry_with_backoff path), a 429 honoring
+    the measured Retry-After (capped) before resubmitting, and every
+    sleep visible to the injected clock — no real waiting."""
+    sleeps = []
+    script = [
+        urllib.error.URLError("refused"),          # submit try 1
+        ConnectionResetError("reset"),             # submit try 2
+        _FakeHTTPError("u", 429,                   # submit try 3: shed
+                       {"error": "LoadShedded", "retry_after_s": 2.5},
+                       {"Retry-After": "3"}),
+        _FakeResponse(202, {"id": "r1-0", "state": "gate-queued"}),
+        _FakeResponse(200, {"id": "r1-0", "state": "running"}),
+        urllib.error.URLError("mid-poll restart"),  # poll hiccup
+        _FakeResponse(200, {"id": "r1-0", "state": "done",
+                            "x": [1.0, 2.0],
+                            "info": {"converged": True,
+                                     "iterations": 3,
+                                     "status": "converged"}}),
+    ]
+
+    def opener(req):
+        ev = script.pop(0)
+        if isinstance(ev, Exception):
+            raise ev
+        return ev
+
+    out = http_solve(
+        "http://fake", "t", [0.0, 0.0], tol=1e-9, retries=3,
+        retry_cap_s=1.5, opener=opener, sleep=sleeps.append,
+        poll_s=0.0, timeout_s=60.0,
+    )
+    assert out["state"] == "done" and out["x"] == [1.0, 2.0]
+    assert not script, "every scripted exchange must be consumed"
+    # the 429 sleep honored retry_after_s but respected the cap
+    assert 1.5 in sleeps, sleeps
+    # transient retries actually backed off (nonzero sleeps besides
+    # the poll's zero-second ticks)
+    assert sum(1 for s in sleeps if s > 0) >= 3, sleeps
+
+
+def test_http_solve_gives_up_on_deadline():
+    """The give_up hook: once the overall timeout budget is spent, a
+    transient failure re-raises instead of retrying forever."""
+    calls = []
+
+    def opener(req):
+        calls.append(1)
+        raise urllib.error.URLError("down")
+
+    with pytest.raises(urllib.error.URLError):
+        http_solve(
+            "http://fake", "t", [0.0], retries=50,
+            opener=opener, sleep=lambda s: None, timeout_s=0.0,
+        )
+    assert len(calls) == 1, "deadline already spent -> no retries"
+
+
+def test_http_solve_zero_retries_unchanged():
+    """The default (retries=0) keeps the one-shot contract benches
+    depend on: a 429 returns the typed payload immediately."""
+    def opener(req):
+        raise _FakeHTTPError(
+            "u", 429, {"error": "LoadShedded", "retry_after_s": 9.0},
+            {"Retry-After": "9"},
+        )
+
+    out = http_solve("http://fake", "t", [0.0], opener=opener,
+                     sleep=lambda s: (_ for _ in ()).throw(
+                         AssertionError("must not sleep")))
+    assert out["http_status"] == 429
+    assert out["error"] == "LoadShedded"
+    assert out["retry_after"] == "9"
+
+
+# ---------------------------------------------------------------------------
+# overhead pin: the journal adds ZERO in-graph work
+# ---------------------------------------------------------------------------
+
+
+def test_journal_enabled_block_program_hlo_identical(
+    tmp_path, monkeypatch
+):
+    """The PR 6/9/11 convention: with every PA_GATE_JOURNAL* knob set
+    and a JOURNALING gate actively serving (admit/dispatch/chunk/
+    complete all journaled), the block body lowers to byte-identical
+    StableHLO vs the PA_GATE_JOURNAL=0 baseline — durability is
+    host-side bookkeeping, never graph work."""
+    import jax
+
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        TPUBackend,
+        _matrix_operands,
+        device_matrix,
+        make_cg_fn,
+    )
+
+    backend = TPUBackend(devices=jax.devices()[:8])
+    A = pa.prun(
+        lambda parts: assemble_poisson(parts, (6, 6, 6))[0],
+        backend, (2, 2, 2),
+    )
+    dA = device_matrix(A, backend)
+    ops = _matrix_operands(dA)
+    P, W = dA.col_plan.layout.P, dA.col_plan.layout.W
+    zb = np.zeros((P, W, 2))
+
+    def text():
+        fn = make_cg_fn(dA, tol=1e-9, maxiter=50, rhs_batch=2)
+        return fn.jit_fn.lower(zb, zb, zb[..., 0], ops).as_text()
+
+    monkeypatch.setenv("PA_GATE_JOURNAL", "0")
+    baseline = text()
+    monkeypatch.setenv("PA_GATE_JOURNAL", "1")
+    monkeypatch.setenv("PA_GATE_JOURNAL_DIR", str(tmp_path / "envj"))
+    monkeypatch.setenv("PA_GATE_JOURNAL_FSYNC", "1")
+    As, bs, xes, x0s = _poisson((8, 8))
+    gate = Gate(checkpoint_dir=str(tmp_path / "c"))
+    assert gate.journal is not None, "env dir must enable the journal"
+    gate.register("seq", As, kmax=2, chunk=4)
+    h = gate.submit("seq", bs, x0=x0s, tol=1e-9, deadline=600.0,
+                    slo_class="interactive", idempotency_key="hlo")
+    gate.drain()
+    assert h.result()[1]["converged"]
+    kinds = [r["kind"] for r in read_journal(str(tmp_path / "envj"))]
+    assert {"admitted", "dispatched", "chunk", "completed"} <= set(
+        kinds
+    ), kinds
+    assert text() == baseline
+
+
+# ---------------------------------------------------------------------------
+# CLI: the tier-1 smoke + the subprocess drills
+# ---------------------------------------------------------------------------
+
+
+def _load_padur():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "padur", os.path.join(REPO, "tools", "padur.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_padur_check_smoke(capsys):
+    """tools/padur.py --check: journal round-trip + forced torn-tail
+    recovery + gate recovery/idempotency, in-process (tier-1)."""
+    padur = _load_padur()
+    rc = padur.main(["--check"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "padur --check: OK" in out
+
+
+def test_sigterm_graceful_shutdown_subprocess(tmp_path):
+    """Satellite: SIGTERM takes the drain-or-checkpoint path (the PR 7
+    `shutdown(drain=False)` ladder) instead of dying mid-slab — the
+    exit-code contract is 0 after a clean signalled shutdown, and the
+    journal records it (`shutdown` record after the `epoch` one)."""
+    jd = str(tmp_path / "j")
+    uf = str(tmp_path / "url")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "padur.py"),
+         "serve", "--journal-dir", jd, "--port", "0",
+         "--url-file", uf],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        t0 = time.monotonic()
+        while not os.path.exists(uf):
+            assert proc.poll() is None, proc.stdout.read()
+            assert time.monotonic() - t0 < 90, "server never came up"
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    out = proc.stdout.read()
+    assert rc == 0, out
+    assert "padur: shutdown (checkpoint) rc=0" in out
+    kinds = [r["kind"] for r in read_journal(jd)]
+    assert "shutdown" in kinds, kinds
+
+
+@pytest.mark.slow
+def test_crash_drill_sigkill_full(capsys):
+    """THE acceptance drill: SIGKILL the serving gate mid-slab over
+    HTTP, restart against the same journal + checkpoint dir, and every
+    admitted request completes bitwise-equal to its solo solve or
+    fails typed — zero lost, zero duplicated, idempotent resubmit
+    serves the original result (tools/padur.py --drill)."""
+    padur = _load_padur()
+    rc = padur.main(["--drill"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "padur --drill: OK" in out

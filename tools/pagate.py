@@ -39,13 +39,16 @@ DEMO_TENANTS = {"poisson8": (8, 8), "poisson12": (12, 12)}
 
 
 def build_demo_gate(budget: str = "one", shed_watermark: int = 4,
-                    start_workers: bool = True, checkpoint_dir=None):
+                    start_workers: bool = True, checkpoint_dir=None,
+                    journal_dir=None):
     """The demo registry: both Poisson tenants under a budget. With
     ``budget="one"`` only the larger tenant fits resident at a time
     (every tenant switch is a page-out/page-in); ``"all"`` fits both;
     an integer string is taken as bytes. ``checkpoint_dir`` defaults to
     a fresh temp dir so an eviction catching a slab mid-flight takes
-    the checkpoint/resume path instead of losing the iterate."""
+    the checkpoint/resume path instead of losing the iterate.
+    ``journal_dir`` enables the padur write-ahead journal — a prior
+    journal in that directory is recovered after registration."""
     import tempfile
 
     if checkpoint_dir is None:
@@ -77,9 +80,12 @@ def build_demo_gate(budget: str = "one", shed_watermark: int = 4,
     gate = Gate(
         mem_budget_bytes=budget_bytes, shed_watermark=shed_watermark,
         start_workers=start_workers, checkpoint_dir=checkpoint_dir,
+        journal_dir=journal_dir,
     )
     for name, (A, b, xe, x0) in systems.items():
         gate.register(name, A, kmax=4)
+    if gate.journal is not None:
+        gate.recover()
     return gate, systems
 
 
@@ -96,24 +102,25 @@ def _demo_rhs(systems, tenant):
 
 
 def cmd_serve(args) -> int:
-    from partitionedarrays_jl_tpu.frontdoor import serve_gate
+    from partitionedarrays_jl_tpu.frontdoor import (
+        serve_gate,
+        serve_until_signalled,
+    )
 
     gate, _systems = build_demo_gate(budget=args.budget,
-                                     shed_watermark=args.shed_depth)
+                                     shed_watermark=args.shed_depth,
+                                     journal_dir=args.journal_dir)
     srv = serve_gate(gate, host=args.host, port=args.port,
                      verbose=args.verbose)
     print(f"pagate: serving {sorted(DEMO_TENANTS)} at {srv.url}")
     print("  endpoints: POST /v1/solve; GET /v1/solve/<id>, "
           "/v1/tenants, /healthz, /metrics")
-    try:
-        import time
-
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        print("pagate: draining...")
-        srv.stop()
-        return 0
+    # SIGTERM/SIGINT drain-or-checkpoint instead of dying mid-slab
+    # (drain=False: in-flight iterates checkpoint at the next chunk
+    # boundary and a journaling gate resumes them on the next start)
+    rc = serve_until_signalled(srv, drain=False)
+    print("pagate: shutdown (checkpoint)")
+    return rc
 
 
 def cmd_submit(args) -> int:
@@ -163,11 +170,18 @@ def cmd_loadgen(args) -> int:
 
     from partitionedarrays_jl_tpu.frontdoor import http_solve
 
+    import secrets
+
     with urllib.request.urlopen(args.url + "/v1/tenants") as resp:
         tenants = json.loads(resp.read())["tenants"]
     classes = args.classes.split(",")
     results = []
     rlock = threading.Lock()
+    # per-RUN nonce: idempotency keys must dedupe retries WITHIN this
+    # run, not collide with a previous run against the same (possibly
+    # journal-recovered) gate — a nonce-less key would make the second
+    # loadgen a zero-load replay of stale results
+    nonce = secrets.token_hex(3)
 
     def client(cid):
         rng = np.random.default_rng(1000 + cid)
@@ -175,10 +189,16 @@ def cmd_loadgen(args) -> int:
             t = tenants[(cid + i) % len(tenants)]
             cls = classes[(cid + i) % len(classes)]
             b = rng.standard_normal(t["ngids"])
+            # client resilience lives in http_solve now (429 honors
+            # the measured Retry-After, transient connection failures
+            # retry with backoff+jitter) — no hand-rolled sleeps here,
+            # and the idempotency key makes every retry double-solve-
+            # safe
             out = http_solve(
                 args.url, t["tenant"], b, tol=args.tol,
                 deadline=args.deadline, slo_class=cls,
-                tag=f"lg-{cid}-{i}",
+                tag=f"lg-{cid}-{i}", retries=args.retries,
+                idempotency_key=f"lg-{nonce}-{cid}-{i}",
             )
             with rlock:
                 results.append((cls, out))
@@ -376,6 +396,9 @@ def main(argv=None):
                     help="'one' (default: one resident tenant), 'all', "
                          "or bytes")
     ps.add_argument("--shed-depth", type=int, default=4)
+    ps.add_argument("--journal-dir", default=None,
+                    help="enable the padur write-ahead journal there "
+                         "(default: PA_GATE_JOURNAL_DIR or off)")
     ps.add_argument("--verbose", action="store_true")
     pc = sub.add_parser("submit", help="submit one solve to a server")
     pc.add_argument("--url", required=True)
@@ -397,6 +420,10 @@ def main(argv=None):
                     default="interactive,batch,besteffort")
     pl.add_argument("--tol", type=float, default=1e-9)
     pl.add_argument("--deadline", type=float, default=None)
+    pl.add_argument("--retries", type=int, default=0,
+                    help="http_solve resilience: retry shed (429, "
+                         "honoring Retry-After) and transient "
+                         "connection failures this many times")
     args = ap.parse_args(argv)
 
     if args.check:
