@@ -271,6 +271,18 @@ NON_LOWERING: Dict[str, str] = {
         "depth of the host-side in-memory ring of finished "
         "SolveRecords — pure host bookkeeping"
     ),
+    "PA_TX": (
+        "distributed-tracing span capture switch (telemetry/"
+        "tracing.py) — spans are host-side objects opened by the "
+        "gate/service request path; no solver staging or tracing code "
+        "reads it, and the block program is byte-identical StableHLO "
+        "on/off (tests/test_patx.py)"
+    ),
+    "PA_TX_DIR": (
+        "span persistence directory (telemetry/tracing.py) — where "
+        "the per-process span JSONL lands for tools/patx.py; pure "
+        "host I/O policy, never part of a staged program"
+    ),
 }
 
 

@@ -38,6 +38,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib import error as _urlerror
@@ -46,6 +47,7 @@ from urllib import request as _urlrequest
 import numpy as np
 
 from ..service.admission import AdmissionRejected
+from ..telemetry import tracing
 from ..telemetry.registry import registry
 from .scheduler import Gate, LoadShedded
 from .tenancy import UnknownTenantError
@@ -117,11 +119,24 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         gate = self.server.gate
         if self.path == "/healthz":
+            # readiness-probe grade: depth, residency, journal epoch,
+            # uptime — everything a probe needs to decide "serving"
             self._json(200, {
                 "ok": True,
                 "tenants": len(gate.registry._tenants),
                 "queue_depth": gate.depth(),
                 "classes": list(gate.classes),
+                "resident": sorted(
+                    r["tenant"] for r in gate.residency()
+                    if r["resident"]
+                ),
+                "journal_epoch": (
+                    gate.journal.epoch
+                    if gate.journal is not None else None
+                ),
+                "uptime_s": round(
+                    time.monotonic() - self.server.started_at, 6
+                ),
             })
         elif self.path == "/metrics":
             self._text(200, registry().to_prometheus(),
@@ -140,6 +155,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             out = {"id": rid, "state": h.state,
                    "tenant": h.tenant, "slo_class": h.slo_class}
+            if h.trace is not None:
+                out["trace_id"] = h.trace.trace_id
             if h.state == "done":
                 from ..models.solvers import gather_pvector
 
@@ -194,6 +211,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(400, {"error": "BadRequest", "message": str(e)})
             return
         idem = body.get("idempotency_key")
+        # distributed tracing (patx): a W3C traceparent header joins
+        # the client's trace; ANY malformed header — bad version,
+        # length, hex, zero ids — is counted and replaced by a fresh
+        # minted trace, never a 500 (fuzz-pinned in tests/test_patx.py)
+        raw_tp = self.headers.get("traceparent")
+        ctx = tracing.parse_traceparent(raw_tp)
+        if raw_tp is not None and ctx is None:
+            registry().counter("gate.traceparent_invalid").inc()
         # replay detection is the GATE's call (its key map is the
         # source of truth, reported from inside the submit lock — a
         # pre-submit snapshot would race a concurrent duplicate)
@@ -207,6 +232,7 @@ class _Handler(BaseHTTPRequestHandler):
                     str(idem) if idem is not None else None
                 ),
                 replay_out=replay,
+                trace=ctx,
                 **kwargs,
             )
         except LoadShedded as e:
@@ -233,11 +259,16 @@ class _Handler(BaseHTTPRequestHandler):
         # 202 — nothing new was admitted); a fresh submit stores + 202
         replayed = bool(replay.get("replayed"))
         rid = self.server.store(h)
-        self._json(
-            200 if replayed else 202,
-            {"id": rid, "state": h.state, "tenant": h.tenant,
-             "slo_class": h.slo_class, "replayed": replayed},
-        )
+        out = {"id": rid, "state": h.state, "tenant": h.tenant,
+               "slo_class": h.slo_class, "replayed": replayed}
+        headers = {}
+        if h.trace is not None:
+            # echo the request's SERVER-side context (root span): the
+            # client learns the trace_id its traceparent joined — or
+            # the fresh one minted for it
+            out["trace_id"] = h.trace.trace_id
+            headers["traceparent"] = h.trace.traceparent()
+        self._json(200 if replayed else 202, out, headers=headers)
 
 
 class GateServer(ThreadingHTTPServer):
@@ -254,6 +285,7 @@ class GateServer(ThreadingHTTPServer):
                          _Handler)
         self.gate = gate
         self.verbose = verbose
+        self.started_at = time.monotonic()  # /healthz uptime_s
         self.handles = {}
         # pre-restart ids stay pollable: a recovered gate's journal
         # handles (completed results, replayed failures, resumed
@@ -378,7 +410,7 @@ def http_solve(base_url: str, tenant: str, b, x0=None,
                dtype: str = "float64", poll_s: float = 0.01,
                timeout_s: float = 120.0, retries: int = 0,
                retry_cap_s: float = 5.0, opener=None,
-               sleep=None) -> dict:
+               sleep=None, traceparent: Optional[str] = None) -> dict:
     """Submit-poll-fetch one solve over HTTP; returns the final poll
     payload (state ``done`` with ``x``/``info``, or the typed error
     payload with its HTTP status under ``"http_status"``).
@@ -401,13 +433,21 @@ def http_solve(base_url: str, tenant: str, b, x0=None,
     ``opener``/``sleep`` are injectable for tests (default
     ``urllib.request.urlopen`` / ``time.sleep``). A poll that gets an
     HTTP error payload (e.g. 404 after handle pruning) returns it
-    typed instead of raising."""
-    import time
+    typed instead of raising.
 
+    Tracing (patx): the submit carries a W3C ``traceparent`` header —
+    the one passed in, or a freshly minted client trace — so the
+    request's whole server-side span tree (gate queue, page-in, slab,
+    chunks) joins ONE trace; the returned payload surfaces the
+    server-confirmed ``trace_id`` (`tools/patx.py <trace_id>` renders
+    it)."""
     from ..parallel.health import retry_with_backoff
+    from ..telemetry import tracing as _tracing
 
     opener = opener if opener is not None else _urlrequest.urlopen
     sleep = sleep if sleep is not None else time.sleep
+    if traceparent is None:
+        traceparent = _tracing.mint_trace().traceparent()
 
     body = {
         "tenant": tenant, "b": list(map(float, b)), "tag": tag,
@@ -431,9 +471,11 @@ def http_solve(base_url: str, tenant: str, b, x0=None,
         """One HTTP exchange -> (status, payload, headers); an HTTP
         error STATUS is a response (typed payload), not a transient
         failure — only connection-level errors propagate for retry."""
+        headers = {"Content-Type": "application/json"}
+        if data is not None and traceparent:
+            headers["traceparent"] = traceparent
         req = _urlrequest.Request(
-            url, data=data,
-            headers={"Content-Type": "application/json"},
+            url, data=data, headers=headers,
             method="POST" if data is not None else "GET",
         )
         try:
@@ -499,6 +541,7 @@ def http_solve(base_url: str, tenant: str, b, x0=None,
             # surface the submit-time replay verdict (the poll payload
             # itself cannot know it)
             poll["replayed"] = bool(sub.get("replayed", False))
+            poll.setdefault("trace_id", sub.get("trace_id"))
             return poll
         sleep(poll_s)
     raise TimeoutError(

@@ -68,6 +68,7 @@ import threading
 import time as _walltime
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..telemetry import tracing
 from ..telemetry.registry import CATALOG, monitoring_enabled, registry
 from ..utils.helpers import check
 from .journal import (
@@ -183,7 +184,7 @@ class GateHandle:
     __slots__ = ("tenant", "tag", "slo_class", "deadline_abs", "seq",
                  "kwargs", "request", "_error", "accounted", "rid",
                  "idempotency_key", "submitted_wall", "_result",
-                 "journal_pending")
+                 "journal_pending", "span_root", "span_queue", "trace")
 
     def __init__(self, tenant, tag, slo_class, deadline_abs, seq, kwargs,
                  rid: Optional[str] = None):
@@ -204,6 +205,13 @@ class GateHandle:
         self.idempotency_key: Optional[str] = None
         self.submitted_wall: float = 0.0
         self._result = None  # journal-recovered (x, info)
+        #: patx: the request's ROOT span (``rpc.request``, opened at
+        #: submit, ended at terminal accounting), the live
+        #: ``gate.queue`` span, and the root's `TraceContext` (what the
+        #: service's slab/chunk spans and the RPC surface propagate).
+        self.span_root = None
+        self.span_queue = None
+        self.trace = None
         #: True on a journaling gate until the terminal record is
         #: durably appended: `state` masks an unjournaled done/failed
         #: as still running, so a client can never observe (and act
@@ -392,6 +400,7 @@ class Gate:
     def submit(self, tenant: str, b, slo_class: Optional[str] = None,
                tag: str = "", idempotency_key: Optional[str] = None,
                replay_out: Optional[dict] = None,
+               trace=None,
                **kwargs) -> GateHandle:
         """Admit one request into the gate queue (EDF-ordered), or
         raise: `LoadShedded` when the request's class is being shed at
@@ -408,7 +417,14 @@ class Gate:
         (a dict) gets ``replay_out["replayed"] = True/False`` set
         AUTHORITATIVELY — the RPC surface reads it instead of guessing
         from a pre-submit snapshot that a concurrent duplicate can
-        race past."""
+        race past.
+
+        ``trace`` propagates distributed-tracing context (patx): a
+        `telemetry.tracing.TraceContext` (the RPC surface parses the
+        client's W3C ``traceparent`` into one) becomes the REMOTE
+        parent of this request's ``rpc.request`` root span; None mints
+        a fresh trace. The root's context rides ``h.trace`` through
+        dispatch into the tenant service's slab/chunk spans."""
         cls = slo_class if slo_class is not None else self.classes[-1]
         check(
             cls in self.classes,
@@ -417,15 +433,24 @@ class Gate:
         )
         if replay_out is not None:
             replay_out["replayed"] = False
-        with self._lock:
-            h0 = self._idem_hit(idempotency_key)
-            if h0 is not None:
-                if replay_out is not None:
-                    replay_out["replayed"] = True
-                return h0
-            # shedding must stay CHEAP refusal: decide it before any
-            # payload gathering (re-checked at admission below)
-            self._check_shed(cls, tag)
+        if isinstance(trace, str):
+            trace = tracing.parse_traceparent(trace)
+        try:
+            with self._lock:
+                h0 = self._idem_hit(idempotency_key)
+                if h0 is not None:
+                    if replay_out is not None:
+                        replay_out["replayed"] = True
+                    return h0
+                # shedding must stay CHEAP refusal: decide it before
+                # any payload gathering (re-checked at admission below)
+                self._check_shed(cls, tag)
+        except LoadShedded as e:
+            # the shed span's file write happens OUTSIDE the gate lock
+            # — refusal under overload must not serialize span I/O
+            # through the submit critical section
+            self._shed_span(e, tag, cls, trace)
+            raise
         self.registry.tenant(tenant)  # raise UnknownTenantError early
         # the EXPENSIVE part of the admitted record — gathering the
         # global vectors and converting to floats — happens before the
@@ -436,6 +461,19 @@ class Gate:
             self._admitted_payload(b, kwargs)
             if self.journal is not None else None
         )
+        try:
+            return self._admit(
+                tenant, b, cls, tag, idempotency_key, replay_out,
+                trace, payload, kwargs,
+            )
+        except LoadShedded as e:
+            self._shed_span(e, tag, cls, trace)
+            raise
+
+    def _admit(self, tenant, b, cls, tag, idempotency_key, replay_out,
+               trace, payload, kwargs) -> GateHandle:
+        """The locked admission half of `submit` (split out so the
+        shed span can be emitted outside the lock)."""
         with self._lock:
             # re-check under the admission lock: a concurrent same-key
             # submit (or a backlog crossing the watermark) that won the
@@ -462,6 +500,26 @@ class Gate:
             h.idempotency_key = idempotency_key
             h.submitted_wall = _walltime.time()
             h.journal_pending = self.journal is not None
+            # patx: the request-level root span — an HTTP client's
+            # traceparent becomes its remote parent, an in-process
+            # submit mints a fresh trace; gate-queue wait starts now.
+            # Unlike the shed path (no fsync — _shed_span runs outside
+            # the lock), admission already holds an fsync'd journal
+            # append in this critical section by design; two buffered
+            # span writes are noise next to it, and creating the spans
+            # here keeps the admitted record's trace ids and the
+            # handle's spans atomic with the idem/shed re-checks.
+            h.span_root = tracing.start_span(
+                "rpc.request", name=h.tag, parent=trace,
+                remote=trace is not None,
+                tenant=h.tenant, slo_class=h.slo_class, rid=h.rid,
+            )
+            h.trace = (
+                h.span_root.ctx if h.span_root.recording else None
+            )
+            h.span_queue = tracing.start_span(
+                "gate.queue", name=h.tag, parent=h.span_root,
+            )
             self._seq += 1
             if self.journal is not None:
                 self.journal.append(
@@ -472,6 +530,14 @@ class Gate:
                     slo_class=h.slo_class,
                     idempotency_key=h.idempotency_key,
                     submitted_wall=h.submitted_wall,
+                    trace_id=(
+                        h.trace.trace_id
+                        if h.span_root.recording else None
+                    ),
+                    root_span_id=(
+                        h.trace.span_id
+                        if h.span_root.recording else None
+                    ),
                     **payload,
                 )
             self._handles[h.rid] = h
@@ -503,6 +569,19 @@ class Gate:
                 "idempotent_replay", label=key, rid=h.rid, state=h.state,
             )
         return h
+
+    def _shed_span(self, e: LoadShedded, tag: str, cls: str,
+                   trace) -> None:
+        """A shed request's whole trace is one ``gate.shed`` span
+        (under the client's remote context when one came in) — emitted
+        OUTSIDE the gate lock by `submit`, so refusal never serializes
+        span file I/O through the admission critical section."""
+        sp = tracing.start_span(
+            "gate.shed", name=tag, parent=trace,
+            remote=trace is not None, slo_class=cls,
+            depth=e.diagnostics.get("depth"),
+        )
+        sp.end(status="shed")
 
     def _check_shed(self, cls: str, tag: str) -> None:
         """Raise `LoadShedded` when ``cls`` is being shed at the
@@ -595,6 +674,12 @@ class Gate:
                                 checkpoint=req.checkpoint_path,
                             )
                 h.request = None
+                # the requeue re-enters gate-queue wait: a fresh
+                # gate.queue span under the SAME root narrates it
+                h.span_queue = tracing.start_span(
+                    "gate.queue", name=h.tag, parent=h.trace,
+                    requeued=True, evicted_tenant=name,
+                )
                 self._queue.append(h)
                 requeued += 1
             if requeued:
@@ -688,8 +773,18 @@ class Gate:
                 kwargs["deadline"] = max(
                     1e-9, h.deadline_abs - self.clock()
                 )
+            kwargs["trace"] = h.trace
+            # gate-queue wait ends HERE, before dispatch: queue-wait /
+            # page-in / solve stay disjoint spans, so the per-kind
+            # breakdown sums to within the root span's duration
+            if h.span_queue is not None:
+                h.span_queue.end()
+                h.span_queue = None
             try:
-                h.request = self.registry.submit(h.tenant, **kwargs)
+                # ambient ctx: a page-in this dispatch triggers parents
+                # its tenant.page_in span to THIS request's trace
+                with tracing.ambient(h.trace):
+                    h.request = self.registry.submit(h.tenant, **kwargs)
                 if self.journal is not None:
                     self.journal.append(
                         "dispatched", rid=h.rid, tenant=h.tenant,
@@ -754,6 +849,12 @@ class Gate:
                 reg.counter("gate.slo.requests", labels=labels).inc()
                 if raw == "done":
                     reg.counter("gate.slo.hits", labels=labels).inc()
+                if h.span_queue is not None:  # failed while queued
+                    h.span_queue.end(status=raw)
+                    h.span_queue = None
+                if h.span_root is not None:
+                    h.span_root.end(status=raw)
+                    h.span_root = None
                 h.accounted = True
             self._inflight = [
                 h for h in self._inflight if not h.accounted
@@ -925,7 +1026,7 @@ class Gate:
             self._idem[key] = rid
         if "completed" in st:
             rec = st["completed"]
-            h = self._terminal_handle(adm, rid)
+            h = self._terminal_handle(adm, rid, outcome="completed")
             h._result = (
                 np.asarray(rec["x"], dtype=adm.get("dtype", "float64")),
                 {
@@ -938,7 +1039,7 @@ class Gate:
             return "completed"
         if "failed" in st:
             rec = st["failed"]
-            h = self._terminal_handle(adm, rid)
+            h = self._terminal_handle(adm, rid, outcome="failed")
             h._error = RecoveredError(
                 rec.get("error", "RuntimeError"), rec.get("message", "")
             )
@@ -948,7 +1049,7 @@ class Gate:
         # of silently dropping an acknowledged request.
         tenant = self.registry._tenants.get(adm["tenant"])
         if tenant is None:
-            h = self._terminal_handle(adm, rid)
+            h = self._terminal_handle(adm, rid, outcome="failed")
             h._error = RecoveredError(
                 "UnknownTenant",
                 f"request {rid}: tenant {adm['tenant']!r} was not "
@@ -990,7 +1091,7 @@ class Gate:
                 _walltime.time() - float(adm.get("submitted_wall", 0.0))
             )
             if remaining <= 0.0:
-                h = self._terminal_handle(adm, rid)
+                h = self._terminal_handle(adm, rid, outcome="expired")
                 err = SolveDeadlineError(
                     f"request {rid}: deadline of {adm['deadline']}s "
                     "expired during the outage — recovery fails it "
@@ -1017,16 +1118,42 @@ class Gate:
             h.idempotency_key = key
             h.submitted_wall = float(adm.get("submitted_wall", 0.0))
             h.journal_pending = True  # its terminal must journal too
+            # patx crash stitching: the resumption keeps the ORIGINAL
+            # trace_id and parents its new root to the pre-crash root
+            # span — one tree across the kill, zero orphans (the old
+            # root survives as an interrupted span in PA_TX_DIR)
+            h.span_root = self._recovered_root(adm, rid, outcome)
+            h.trace = (
+                h.span_root.ctx if h.span_root.recording else None
+            )
+            h.span_queue = tracing.start_span(
+                "gate.queue", name=h.tag, parent=h.span_root,
+                recovered=True,
+            )
             self._seq += 1
             self._handles[rid] = h
             self._queue.append(h)
             self._queue.sort(key=_edf_key)
         return outcome
 
-    def _terminal_handle(self, adm: dict, rid: str) -> GateHandle:
+    def _recovered_root(self, adm: dict, rid: str, outcome: str):
+        """A post-recovery root span continuing the journaled trace
+        (fresh trace when the pre-crash gate ran with PA_TX=0)."""
+        tid = adm.get("trace_id") or None
+        return tracing.start_span(
+            "rpc.request", name=adm.get("tag") or rid,
+            trace_id=tid,
+            parent_id=adm.get("root_span_id") if tid else None,
+            recovered=outcome, rid=rid, tenant=adm.get("tenant"),
+        )
+
+    def _terminal_handle(self, adm: dict, rid: str,
+                         outcome: str = "completed") -> GateHandle:
         """A journal-recovered terminal handle, registered for polls
         (it never enters the queue or the SLO accounting — its life
-        was accounted by the gate generation that served it)."""
+        was accounted by the gate generation that served it). Its
+        trace gets one closing span (same trace_id, parented to the
+        pre-crash root) narrating the journal-served outcome."""
         with self._lock:
             h = GateHandle(
                 tenant=adm.get("tenant"), tag=adm.get("tag") or rid,
@@ -1035,6 +1162,9 @@ class Gate:
             )
             h.idempotency_key = adm.get("idempotency_key")
             h.accounted = True
+            sp = self._recovered_root(adm, rid, outcome)
+            sp.end(status=outcome)
+            h.trace = sp.ctx if sp.recording else None
             self._seq += 1
             self._handles[rid] = h
             return h

@@ -292,7 +292,32 @@ class OperatorRegistry:
     def _page_in(self, t: Tenant) -> None:
         """Make ``t`` resident: evict LRU residents until it fits, then
         build a fresh `SolveService` (device staging re-runs lazily at
-        the first solve)."""
+        the first solve). When a request's dispatch triggered this (the
+        gate holds its trace context ambient), the page-in records a
+        ``tenant.page_in`` span in that request's trace — the
+        eviction-cost line item of the patx breakdown."""
+        from ..telemetry import tracing
+
+        page_span = None
+        ctx = tracing.current_ctx()
+        if ctx is not None:
+            page_span = tracing.start_span(
+                "tenant.page_in", name=t.name, parent=ctx,
+            )
+        try:
+            self._page_in_body(t)
+        except BaseException as e:
+            # a failed page-in (eviction checkpoint I/O, service
+            # build) must not leak a live span: close it typed instead
+            # of leaving a bogus "interrupted" record behind
+            if page_span is not None:
+                page_span.end(status="error", error=type(e).__name__)
+            raise
+        if page_span is not None:
+            page_span.end(footprint_bytes=t.footprint_bytes)
+        self._update_gauges()
+
+    def _page_in_body(self, t: Tenant) -> None:
         from .. import telemetry
 
         if self.budget:
@@ -322,7 +347,6 @@ class OperatorRegistry:
             footprint_bytes=t.footprint_bytes,
             resident_bytes=self.resident_bytes(),
         )
-        self._update_gauges()
 
     def evict(self, name: str) -> dict:
         """Page one tenant out: drain its in-flight slabs through the

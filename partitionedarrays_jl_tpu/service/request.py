@@ -66,6 +66,12 @@ class SolveRequest:
         self.finished_at: Optional[float] = None
         self.iterations = 0  # committed across chunks
         self.record = None  # SolveRecord, opened by the service
+        #: Distributed-tracing context (`telemetry.tracing.TraceContext`)
+        #: propagated by the submitter (the gate stamps its root span's
+        #: context here); None = untraced request. The service opens
+        #: its ``slab.solve``/``chunk`` spans under it.
+        self.trace = None
+        self._span_solve = None  # live slab.solve Span while running
         self.checkpoint_path: Optional[str] = None
         self._x = None
         self._info = None

@@ -46,6 +46,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..telemetry import tracing
 from ..telemetry.registry import monitoring_enabled, registry
 from ..telemetry.throughput import model as throughput_model
 from ..telemetry.throughput import operator_fingerprint
@@ -160,10 +161,15 @@ class SolveService:
         deadline: Optional[float] = None,
         retries: Optional[int] = None,
         tag: str = "",
+        trace=None,
     ) -> SolveRequest:
         """Admit one request (or raise `AdmissionRejected`); returns the
         request, which doubles as the result handle. ``deadline`` is a
-        relative wall-clock budget in seconds (service clock units)."""
+        relative wall-clock budget in seconds (service clock units).
+        ``trace`` is an optional `telemetry.tracing.TraceContext` the
+        submitter propagates (the gate stamps its root span's context);
+        the service then opens its slab/chunk spans under it and stamps
+        the request record — untraced submits stay span-free."""
         from .. import telemetry
 
         check(tol > 0.0, "service: tol must be positive")
@@ -190,16 +196,18 @@ class SolveService:
             )
             self._next_id += 1
             req.submitted_at = self.clock()
-            req.record = telemetry.begin_record(
-                "service-request", request=req.tag, tol=float(tol),
-                maxiter=maxiter, deadline=deadline,
-            )
-            self.stats["admitted"] += 1
-            registry().counter("service.admitted").inc()
-            telemetry.emit_event(
-                "request_queued", label=req.tag, tol=float(tol),
-                deadline=deadline, queued=len(self._queue) + 1,
-            )
+            req.trace = trace
+            with tracing.ambient(trace):
+                req.record = telemetry.begin_record(
+                    "service-request", request=req.tag, tol=float(tol),
+                    maxiter=maxiter, deadline=deadline,
+                )
+                self.stats["admitted"] += 1
+                registry().counter("service.admitted").inc()
+                telemetry.emit_event(
+                    "request_queued", label=req.tag, tol=float(tol),
+                    deadline=deadline, queued=len(self._queue) + 1,
+                )
             self._queue.append(req)
             if monitoring_enabled():
                 registry().gauge("service.queue_depth").set(
@@ -363,6 +371,7 @@ class SolveService:
         X = {r.id: r.x0 for r in active}
         for r in active:
             r._set_state("running")
+            self._open_solve_span(r, len(slab))
         # deadline-free slabs run UNCHUNKED: one compiled solve, which
         # is the bitwise-containment mode (chunk continuation restarts
         # conjugacy — a different trajectory, and worth it only for
@@ -410,11 +419,30 @@ class SolveService:
                     max(0.0, self.clock() - formed)
                 )
             first_dispatch = False
-            t_solve = time.perf_counter()
-            xs, info = self._block_solve(
-                [r.b for r in active], X0, tol, max(1, step)
+            chunk_spans = {
+                r.id: tracing.start_span(
+                    "chunk", name=r.tag, parent=r._span_solve,
+                )
+                for r in active if r._span_solve is not None
+            }
+            # the block solve's own nested record joins the trace of
+            # the slab's first traced member (K co-batched traces, one
+            # compiled call — the per-request story stays in the spans)
+            slab_ctx = next(
+                (r.trace for r in active if r.trace is not None), None
             )
+            t_solve = time.perf_counter()
+            with tracing.ambient(slab_ctx):
+                xs, info = self._block_solve(
+                    [r.b for r in active], X0, tol, max(1, step)
+                )
             solve_wall = time.perf_counter() - t_solve
+            for k, r in enumerate(active):
+                sp = chunk_spans.get(r.id)
+                if sp is not None:
+                    sp.end(
+                        iterations=int(info["columns"][k]["iterations"])
+                    )
             trips = max(
                 (int(c["iterations"]) for c in info["columns"]),
                 default=0,
@@ -491,6 +519,7 @@ class SolveService:
                     reg.gauge("service.queue_depth").set(len(self._queue))
             for r in added:
                 r._set_state("running")
+                self._open_solve_span(r, len(active) + len(added))
                 X[r.id] = r.x0
             if added:
                 if mon:
@@ -539,6 +568,21 @@ class SolveService:
     # per-request terminal transitions
     # ------------------------------------------------------------------
 
+    def _open_solve_span(self, req, k: int) -> None:
+        """One per-REQUEST ``slab.solve`` span (K co-batched requests
+        get K parallel spans over the same wall window — each request's
+        tree stays single-parented). Untraced requests stay span-free."""
+        if req.trace is not None and req._span_solve is None:
+            req._span_solve = tracing.start_span(
+                "slab.solve", name=req.tag, parent=req.trace, k=int(k),
+            )
+
+    def _close_solve_span(self, req, status: str) -> None:
+        sp = req._span_solve
+        if sp is not None:
+            sp.end(status=status, iterations=req.iterations)
+            req._span_solve = None
+
     def _slo_account(self, req, succeeded: bool) -> None:
         """Terminal-state SLO bookkeeping: the total-latency histogram
         for every request, plus — for deadline-carrying requests — the
@@ -574,12 +618,14 @@ class SolveService:
         info["request_id"] = req.id
         if via:
             info["resolved_via"] = via
-        telemetry.emit_event(
-            "request_done", label=req.tag,
-            iteration=req.iterations,
-            converged=bool(info.get("converged")),
-            status=str(info.get("status")), via=via,
-        )
+        self._close_solve_span(req, "ok")
+        with tracing.ambient(req.trace):
+            telemetry.emit_event(
+                "request_done", label=req.tag,
+                iteration=req.iterations,
+                converged=bool(info.get("converged")),
+                status=str(info.get("status")), via=via,
+            )
         self.stats["completed"] += 1
         registry().counter("service.completed").inc()
         self._slo_account(req, succeeded=True)
@@ -588,10 +634,13 @@ class SolveService:
     def _fail(self, req, error) -> None:
         from .. import telemetry
 
-        telemetry.emit_event(
-            "request_failed", label=req.tag, iteration=req.iterations,
-            error=type(error).__name__,
-        )
+        self._close_solve_span(req, "failed")
+        with tracing.ambient(req.trace):
+            telemetry.emit_event(
+                "request_failed", label=req.tag,
+                iteration=req.iterations,
+                error=type(error).__name__,
+            )
         self.stats["failed"] += 1
         registry().counter("service.failed").inc()
         self._slo_account(req, succeeded=False)
@@ -636,10 +685,11 @@ class SolveService:
         )
         from .. import telemetry
 
-        telemetry.emit_event(
-            "column_ejected", label=str(verdict.get("status")),
-            iteration=req.iterations, request=req.tag,
-        )
+        with tracing.ambient(req.trace):
+            telemetry.emit_event(
+                "column_ejected", label=str(verdict.get("status")),
+                iteration=req.iterations, request=req.tag,
+            )
         self.stats["ejected"] += 1
         registry().counter("service.ejected").inc()
         error = verdict.get("error")
@@ -663,30 +713,40 @@ class SolveService:
         if req.retries <= 0 or expired:
             self._fail(req, error)
             return
+        from contextlib import nullcontext
+
+        retry_span = (
+            tracing.span(
+                "chunk", name=req.tag, parent=req._span_solve,
+                solo_retry=True,
+            )
+            if req._span_solve is not None else nullcontext()
+        )
         try:
-            if self.checkpoint_dir is not None:
-                # solve_with_recovery owns the WHOLE retry budget (its
-                # checkpoint-tier restarts ARE the attempts) — wrapping
-                # it in retry_with_backoff would multiply the budgets
-                # into retries × (1 + restarts) full solves
-                x, info = self._solo(req)
-            else:
-                x, info = retry_with_backoff(
-                    lambda: self._solo(req),
-                    attempts=req.retries,
-                    backoff=self.retry_backoff,
-                    exceptions=(SolverHealthError,),
-                    describe=f"solve-service {req.tag} solo retry",
-                    sleep=self._sleep,
-                    give_up=(
-                        (
-                            lambda: self.clock() - req.submitted_at
-                            > req.deadline
-                        )
-                        if req.deadline is not None
-                        else None
-                    ),
-                )
+            with retry_span:
+                if self.checkpoint_dir is not None:
+                    # solve_with_recovery owns the WHOLE retry budget
+                    # (its checkpoint-tier restarts ARE the attempts) —
+                    # wrapping it in retry_with_backoff would multiply
+                    # the budgets into retries × (1 + restarts) solves
+                    x, info = self._solo(req)
+                else:
+                    x, info = retry_with_backoff(
+                        lambda: self._solo(req),
+                        attempts=req.retries,
+                        backoff=self.retry_backoff,
+                        exceptions=(SolverHealthError,),
+                        describe=f"solve-service {req.tag} solo retry",
+                        sleep=self._sleep,
+                        give_up=(
+                            (
+                                lambda: self.clock() - req.submitted_at
+                                > req.deadline
+                            )
+                            if req.deadline is not None
+                            else None
+                        ),
+                    )
         except SolverHealthError as e:
             self._fail(req, e)
             return
@@ -747,10 +807,12 @@ class SolveService:
         )
         ck.wait()
         req.checkpoint_path = d
-        telemetry.emit_event(
-            "request_checkpointed", label=req.tag,
-            iteration=req.iterations, directory=d,
-        )
+        self._close_solve_span(req, "checkpointed")
+        with tracing.ambient(req.trace):
+            telemetry.emit_event(
+                "request_checkpointed", label=req.tag,
+                iteration=req.iterations, directory=d,
+            )
         self.stats["checkpointed"] += 1
         registry().counter("service.checkpointed").inc()
         req.finished_at = self.clock()
@@ -762,9 +824,12 @@ class SolveService:
     def _suspend(self, req) -> None:
         from .. import telemetry
 
-        telemetry.emit_event(
-            "request_suspended", label=req.tag, iteration=req.iterations
-        )
+        self._close_solve_span(req, "suspended")
+        with tracing.ambient(req.trace):
+            telemetry.emit_event(
+                "request_suspended", label=req.tag,
+                iteration=req.iterations,
+            )
         self.stats["suspended"] += 1
         registry().counter("service.suspended").inc()
         req.finished_at = self.clock()

@@ -102,6 +102,18 @@ from .commsmatrix import (  # noqa: F401
     render_comms_matrix,
     static_matrix,
 )
+from . import tracing  # noqa: F401
+from .tracing import (  # noqa: F401
+    SPAN_KINDS,
+    TX_SCHEMA_VERSION,
+    Span,
+    TraceContext,
+    mint_trace,
+    parse_traceparent,
+    start_span,
+    tracing_enabled,
+    verify_trace,
+)
 from .ledger import (  # noqa: F401
     LEDGER_SCHEMA_VERSION,
     build_ledger,
@@ -127,10 +139,14 @@ __all__ = [
     "RECORD_SCHEMA_VERSION",
     "REGISTRY_SCHEMA_VERSION",
     "Registry",
+    "SPAN_KINDS",
+    "Span",
     "SolveRecord",
     "THROUGHPUT_SCHEMA_VERSION",
     "TRACE_SCHEMA_VERSION",
+    "TX_SCHEMA_VERSION",
     "TelemetryEvent",
+    "TraceContext",
     "ThroughputModel",
     "annotate",
     "apply_delta",
@@ -163,8 +179,14 @@ __all__ = [
     "list_persisted_records",
     "load_record",
     "metrics_dir",
+    "mint_trace",
     "mon_ewma",
     "monitoring_enabled",
+    "parse_traceparent",
+    "start_span",
+    "tracing",
+    "tracing_enabled",
+    "verify_trace",
     "observed_comms",
     "operator_fingerprint",
     "reconcile",

@@ -49,7 +49,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from . import metrics
+from . import metrics, tracing
 from .registry import registry
 
 __all__ = [
@@ -170,6 +170,16 @@ class SolveRecord:
         if config:
             self.config.update(config)
         self.events: List[TelemetryEvent] = []
+        #: Distributed-tracing context (``{"trace_id", "span_id"}``)
+        #: stamped from the thread's ambient span (`telemetry.tracing`)
+        #: — the join key between this record and the patx span tree.
+        self.trace: Optional[Dict[str, str]] = None
+        if self.enabled:
+            ctx = tracing.current_ctx()
+            if ctx is not None:
+                self.trace = {
+                    "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+                }
         self.iterations: Optional[int] = None
         self.converged: Optional[bool] = None
         self.status: Optional[str] = None
@@ -263,6 +273,7 @@ class SolveRecord:
             "started_at": self.started_at,
             "wall_s": self.wall_s,
             "config": _jsonable(self.config),
+            "trace": self.trace,
             "iterations": self.iterations,
             "converged": self.converged,
             "status": self.status,
@@ -330,6 +341,12 @@ def emit_event(kind: str, label: str = "", iteration: Optional[int] = None,
         metrics.bump(f"events.{kind}")
         if not telemetry_enabled():
             return
+        # attach the ambient span context (patx): an event fired while
+        # a span is current carries its trace — the record/span join
+        ctx = tracing.current_ctx()
+        if ctx is not None:
+            details.setdefault("trace_id", ctx.trace_id)
+            details.setdefault("span_id", ctx.span_id)
         with _lock:
             recs = list(_stack)
         for rec in recs:

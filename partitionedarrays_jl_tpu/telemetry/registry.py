@@ -282,6 +282,16 @@ CATALOG: Dict[str, MetricSpec] = {
               "a checkpointed iterate, requeued from the original "
               "payload, expired typed)",
               labels=("outcome",)),
+        # -- PR 14 distributed tracing (patx) -------------------------
+        _spec("tx.spans", "counter", "1",
+              "telemetry/tracing.py:start_span",
+              "spans captured by the patx tracing plane (PA_TX=0 "
+              "stops capture and this counter with it)"),
+        _spec("gate.traceparent_invalid", "counter", "1",
+              "frontdoor/rpc.py:do_POST",
+              "malformed W3C traceparent headers on POST /v1/solve — "
+              "refused at parse, a fresh trace minted instead (a "
+              "hostile header can never 500 a submit)"),
     ]
 }
 

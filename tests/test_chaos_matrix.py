@@ -565,6 +565,30 @@ def test_matrix_gate_load_shed_distinct_from_queue_full():
         # the held request is untouched: it drains to a clean result
         gate.drain()
         assert held.result()[1]["converged"]
+        # patx continuity: the shed refusal is ONE one-span trace
+        # (gate.shed, status=shed) — no dangling request spans — and
+        # the held request's trace is a complete orphan-free tree
+        from partitionedarrays_jl_tpu.telemetry import tracing
+
+        spans = tracing.recorded_spans()
+        shed_spans = [
+            s for s in spans
+            if s["kind"] == "gate.shed" and s["name"] == "over"
+        ]
+        assert len(shed_spans) == 1
+        shed_tid = shed_spans[0]["trace_id"]
+        assert tracing.verify_trace(spans, shed_tid) == []
+        assert [
+            s["kind"] for s in spans if s["trace_id"] == shed_tid
+        ] == ["gate.shed"]
+        assert shed_spans[0]["status"] == "shed"
+        held_tid = held.trace.trace_id
+        assert held_tid != shed_tid
+        assert tracing.verify_trace(spans, held_tid) == []
+        roots, orphans = tracing.span_tree(
+            [s for s in spans if s["trace_id"] == held_tid]
+        )
+        assert len(roots) == 1 and not orphans
         return True
 
     _run(driver)
@@ -636,6 +660,33 @@ def test_matrix_gate_eviction_during_inflight_checkpoint_resume(tmp_path):
         # the resume is narrated end to end
         assert _has_event(h.request.record, "request_done", "inflight")
         assert telemetry.counter("events.checkpoint_restore") > 0
+        # patx continuity: the whole eviction/requeue/resume story is
+        # ONE trace — the root, BOTH gate-queue waits (the requeue
+        # flagged), the checkpointed AND the resumed slab rides, the
+        # re-stage page-in — with correct parentage and zero orphans
+        from partitionedarrays_jl_tpu.telemetry import tracing
+
+        gate.account()
+        tid = h.trace.trace_id
+        spans = tracing.recorded_spans()
+        assert tracing.verify_trace(spans, tid) == []
+        mine = [s for s in spans if s["trace_id"] == tid]
+        roots, orphans = tracing.span_tree(mine)
+        assert len(roots) == 1 and not orphans
+        assert roots[0]["kind"] == "rpc.request"
+        queues = [s for s in mine if s["kind"] == "gate.queue"]
+        assert len(queues) == 2
+        assert [bool(s["attrs"].get("requeued")) for s in queues].count(
+            True
+        ) == 1
+        solves = [s for s in mine if s["kind"] == "slab.solve"]
+        assert {s["status"] for s in solves} == {"checkpointed", "ok"}
+        assert any(s["kind"] == "tenant.page_in" for s in mine), (
+            "the re-stage page-in must land in the request's trace"
+        )
+        by_id = {s["span_id"]: s for s in mine}
+        for s in queues + solves:
+            assert by_id[s["parent_id"]]["kind"] == "rpc.request"
         return True
 
     _run(driver)
@@ -704,6 +755,34 @@ def test_matrix_gate_crash_midsolve_journal_recovery(tmp_path):
             if r.get("kind") == "completed" and r.get("rid") == h.rid
         ]
         assert len(completed) == 1, "zero lost, zero duplicated"
+        # patx continuity: the recovered request keeps its ORIGINAL
+        # trace_id; the post-crash root stitches to the pre-crash root
+        # (left interrupted by the abandoned gate); zero orphans — one
+        # tree across the "kill"
+        from partitionedarrays_jl_tpu.telemetry import tracing
+
+        g2.account()
+        h2 = g2.handle(h.rid)
+        tid = h.trace.trace_id
+        assert h2.trace.trace_id == tid, (
+            "recovery must preserve the original trace_id"
+        )
+        spans = tracing.recorded_spans()
+        assert tracing.verify_trace(spans, tid) == []
+        mine = [s for s in spans if s["trace_id"] == tid]
+        roots_list = [s for s in mine if s["kind"] == "rpc.request"]
+        pre = [s for s in roots_list if not s["attrs"].get("recovered")]
+        post = [s for s in roots_list if s["attrs"].get("recovered")]
+        assert len(pre) == 1 and len(post) == 1
+        assert pre[0]["status"] == "interrupted", (
+            "the abandoned gate's root must surface as interrupted"
+        )
+        assert post[0]["parent_id"] == pre[0]["span_id"], (
+            "the recovered root must parent to the pre-crash root"
+        )
+        assert post[0]["attrs"]["recovered"] == "resumed"
+        _, orphans = tracing.span_tree(mine)
+        assert not orphans
         return True
 
     _run(driver)

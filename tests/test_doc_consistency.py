@@ -331,6 +331,17 @@ def test_service_artifact_inherits_multirhs_floor():
         assert (band["lo"], band["hi"], band["kind"]) == (lo, hi, kind)
         assert band["measured"] == marg["ratio_on_off"]
         assert band["in_band"] and lo <= band["measured"] <= hi, band
+    # round 16: the tracing-on/off marginal (patx) — same canary
+    # convention; the ledger sentinel picks the band up like every
+    # other (test_perf_ledger_covers_every_bench_artifact below)
+    tx = rec["tracing_marginal"]
+    ratio = tx["on_requests_per_s"] / tx["off_requests_per_s"]
+    assert abs(tx["ratio_on_off"] - ratio) <= 1e-2 * ratio, tx
+    for key, (lo, hi, kind) in bench_svc.TRACING_BANDS.items():
+        band = rec["bands"][key]
+        assert (band["lo"], band["hi"], band["kind"]) == (lo, hi, kind)
+        assert band["measured"] == tx["ratio_on_off"]
+        assert band["in_band"] and lo <= band["measured"] <= hi, band
     # the locally measured per-RHS table agrees with itself and covers
     # the sweep (its committed twin is THROUGHPUT_MODEL.json, checked
     # in test_throughput_model_ties_to_multirhs)

@@ -288,6 +288,15 @@ def test_http_roundtrip_bitwise_and_endpoints():
         with urllib.request.urlopen(srv.url + "/healthz") as resp:
             health = json.loads(resp.read())
         assert health["ok"] and health["tenants"] == 1
+        # readiness-probe grade (ISSUE 14): depth, residency, journal
+        # epoch, uptime
+        assert health["queue_depth"] == 0
+        assert health["resident"] == ["p8"]
+        assert health["journal_epoch"] is None  # journal off here
+        assert (
+            isinstance(health["uptime_s"], float)
+            and health["uptime_s"] >= 0.0
+        )
         with urllib.request.urlopen(srv.url + "/v1/tenants") as resp:
             tenants = json.loads(resp.read())
         assert tenants["tenants"][0]["tenant"] == "p8"

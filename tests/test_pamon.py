@@ -523,6 +523,53 @@ def test_patrace_service_timeline_joins_slab(tmp_path, monkeypatch,
     assert "tl-good converged" in out
 
 
+def test_patrace_service_all_ejected_slab_shows_retry_story(
+    tmp_path, monkeypatch, capsys
+):
+    """ISSUE-14 BUGFIX pin: a slab whose EVERY request is ejected and
+    retried solo must render the retry continuation — the injected
+    faults, the typed health errors, the aborted attempts of the
+    nested solo solves — inside the incident view, not just the bare
+    formed/ejected/done skeleton. Pre-fix those events were dropped as
+    unnamed (the nested records never name the request); now they join
+    by their ejection-window timing, annotated ``retry_of``."""
+    from partitionedarrays_jl_tpu.parallel.faults import inject_faults
+
+    d = str(tmp_path / "svc-recs")
+    monkeypatch.setenv("PA_METRICS_DIR", d)
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A, kmax=2, retries=1, retry_backoff=0.0)
+        # one one-shot fault per slab column: BOTH columns eject, both
+        # solo retries heal (the faults do not refire)
+        with inject_faults("nan@part=1,call=5;nan@part=1,call=9",
+                           seed=1):
+            r0 = svc.submit(b, x0=x0, tol=1e-9, tag="ej-0")
+            r1 = svc.submit(b, x0=x0, tol=1e-9, tag="ej-1")
+            svc.drain()
+        assert r0.state == "done" and r1.state == "done"
+        assert svc.stats["ejected"] == 2
+        assert svc.stats["retried_solo"] == 2
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+    patrace = _load_tool("patrace")
+    rc = patrace.main(["--service", "--dir", d])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "slab 0: K=2" in out
+    # the continuation story renders inside the slab timeline
+    assert "fault_injected:nan" in out
+    assert "health_error:NonFiniteError" in out
+    assert "solve_aborted:NonFiniteError" in out
+    # ejection-window attribution: retry-window events name their
+    # owner (the first fault fires in the SLAB pass, pre-ejection —
+    # the in-window ones carry retry_of)
+    assert out.count("column_ejected") == 2
+    assert "ej-0 converged" in out and "ej-1 converged" in out
+
+
 # ---------------------------------------------------------------------------
 # round 13 (ISSUE 10): exporter label hygiene, labeled-histogram
 # concurrency, adaptive K

@@ -1,0 +1,410 @@
+"""patx — end-to-end distributed request tracing
+(`partitionedarrays_jl_tpu.telemetry.tracing` + the propagation seams).
+
+The contracts pinned here:
+
+* **W3C traceparent hygiene** — strict parse; a fuzz sweep of
+  truncated/overlong/non-hex/zero-id/bad-version headers over the live
+  HTTP surface never 500s: each one mints a fresh trace and bumps
+  `gate.traceparent_invalid`.
+* **One span tree per request** — in-process gate submit → drain yields
+  rpc.request → gate.queue + slab.solve → chunk with zero orphans, the
+  per-kind breakdown summing within the parent durations, the
+  `SolveRecord` stamped with the trace (`record.trace`), and events
+  carrying `trace_id`/`span_id`.
+* **HTTP propagation** — a client traceparent is JOINED (same
+  trace_id acknowledged and echoed), a missing one is minted.
+* **Overhead** — the solver path never reads PA_TX*: the block program
+  lowers to byte-identical StableHLO with tracing on+persisting vs
+  killed (the PR 6/9/10 convention), and PA_TX=0 takes the inert path
+  (no spans retained).
+* **patx --check** — the tier-1 CLI smoke (ephemeral HTTP gate →
+  reconstruct → span-tree invariants).
+
+Budget note: everything runs on the sequential backend's tiny Poisson
+fixtures except the one HLO pin (8-part 6³, the test_pagate pattern).
+"""
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu import telemetry
+from partitionedarrays_jl_tpu.frontdoor import Gate, serve_gate
+from partitionedarrays_jl_tpu.models import assemble_poisson, gather_pvector
+from partitionedarrays_jl_tpu.telemetry import tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _poisson(grid=(8, 8)):
+    return pa.prun(
+        lambda parts: assemble_poisson(parts, grid), pa.sequential, (2, 2)
+    )
+
+
+# ---------------------------------------------------------------------------
+# traceparent parsing
+# ---------------------------------------------------------------------------
+
+_VALID_TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+#: The fuzz corpus: every way a hostile/broken client mangles the
+#: header. Each must parse to None (and, over HTTP, mint a fresh
+#: trace instead of 500ing).
+_MALFORMED = [
+    "",                                          # empty
+    "00",                                        # truncated at version
+    _VALID_TP[:-4],                              # truncated flags
+    _VALID_TP + "-extra",                        # overlong (extra field)
+    _VALID_TP + "00",                            # overlong (glued)
+    _VALID_TP.replace("-", ""),                  # no separators
+    "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",  # non-hex trace id
+    "00-" + "ab" * 16 + "-" + "xy" * 8 + "-01",  # non-hex span id
+    "00-" + "AB" * 16 + "-" + "cd" * 8 + "-01",  # uppercase hex
+    "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # forbidden version
+    "0-" + "ab" * 16 + "-" + "cd" * 8 + "-01",   # short version
+    "00-" + "00" * 16 + "-" + "cd" * 8 + "-01",  # all-zero trace id
+    "00-" + "ab" * 16 + "-" + "00" * 8 + "-01",  # all-zero span id
+    "00-" + "ab" * 17 + "-" + "cd" * 8 + "-01",  # overlong trace id
+    "00-" + "ab" * 16 + "-" + "cd" * 7 + "-01",  # short span id
+    "garbage",
+]
+
+
+def test_traceparent_parse_strict():
+    ctx = tracing.parse_traceparent(_VALID_TP)
+    assert ctx is not None
+    assert ctx.trace_id == "ab" * 16 and ctx.span_id == "cd" * 8
+    assert ctx.traceparent() == _VALID_TP
+    # surrounding whitespace is tolerated (proxies pad headers)
+    assert tracing.parse_traceparent(f"  {_VALID_TP} ") is not None
+    for bad in _MALFORMED:
+        assert tracing.parse_traceparent(bad) is None, bad
+    assert tracing.parse_traceparent(None) is None
+    assert tracing.parse_traceparent(123) is None
+
+
+def test_mint_trace_shape_and_uniqueness():
+    a, b = tracing.mint_trace(), tracing.mint_trace()
+    assert tracing.parse_traceparent(a.traceparent()) is not None
+    assert a.trace_id != b.trace_id
+
+
+# ---------------------------------------------------------------------------
+# span store + tree algebra
+# ---------------------------------------------------------------------------
+
+
+def test_span_persistence_and_tree(tmp_path, monkeypatch):
+    monkeypatch.setenv("PA_TX_DIR", str(tmp_path))
+    root = tracing.start_span("rpc.request", name="r")
+    child = tracing.start_span("gate.queue", name="r", parent=root)
+    grand = tracing.start_span("slab.solve", name="r", parent=child.ctx)
+    grand.end()
+    child.end()
+    # root left OPEN: it must surface as an interrupted span (the
+    # crash-stitching input) — from the file reader AND the ring
+    spans = tracing.load_spans(str(tmp_path))
+    assert {s["kind"] for s in spans} == {
+        "rpc.request", "gate.queue", "slab.solve"
+    }
+    by_kind = {s["kind"]: s for s in spans}
+    assert by_kind["rpc.request"]["status"] == "interrupted"
+    assert by_kind["rpc.request"]["dur_s"] is None
+    assert by_kind["gate.queue"]["status"] == "ok"
+    roots, orphans = tracing.span_tree(spans)
+    assert [r["kind"] for r in roots] == ["rpc.request"]
+    assert orphans == []
+    assert tracing.verify_trace(spans, root.trace_id) == []
+    # an orphan IS detected (synthetic span naming a ghost parent)
+    ghost = dict(by_kind["slab.solve"], span_id="f" * 16,
+                 parent_id="e" * 16)
+    problems = tracing.verify_trace(spans + [ghost], root.trace_id)
+    assert any("ORPHAN" in p for p in problems)
+    # a remote-parented root is a root, not an orphan
+    remote = tracing.start_span(
+        "rpc.request", name="q",
+        parent=tracing.mint_trace(), remote=True,
+    )
+    remote.end()
+    mine = tracing.spans_for(remote.trace_id,
+                             spans=tracing.load_spans(str(tmp_path)))
+    roots2, orphans2 = tracing.span_tree(mine)
+    assert len(roots2) == 1 and not orphans2
+    root.end()
+
+
+def test_tracing_kill_switch_is_inert(tmp_path, monkeypatch):
+    monkeypatch.setenv("PA_TX", "0")
+    monkeypatch.setenv("PA_TX_DIR", str(tmp_path))
+    before = telemetry.counter("tx.spans")
+    s = tracing.start_span("rpc.request", name="off")
+    assert s.recording is False
+    s.end()
+    assert telemetry.counter("tx.spans") == before
+    assert tracing.load_spans(str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# one span tree per request, in-process
+# ---------------------------------------------------------------------------
+
+
+def test_gate_request_yields_one_sound_span_tree():
+    A, b, xe, x0 = _poisson((8, 8))
+
+    gate = Gate()
+    gate.register("t", A, kmax=2)
+    h1 = gate.submit("t", b, x0=x0, tol=1e-9, tag="tx-1",
+                     slo_class="interactive")
+    h2 = gate.submit("t", b, x0=x0, tol=1e-9, tag="tx-2")
+    gate.drain()
+    assert h1.result()[1]["converged"]
+    assert h1.trace is not None and h2.trace is not None
+    assert h1.trace.trace_id != h2.trace.trace_id
+    spans = tracing.recorded_spans()
+    for h in (h1, h2):
+        tid = h.trace.trace_id
+        assert tracing.verify_trace(spans, tid) == []
+        mine = [s for s in spans if s["trace_id"] == tid]
+        kinds = {s["kind"] for s in mine}
+        assert {"rpc.request", "gate.queue", "slab.solve",
+                "chunk"} <= kinds
+        roots, orphans = tracing.span_tree(mine)
+        assert len(roots) == 1 and not orphans
+        assert roots[0]["kind"] == "rpc.request"
+        assert roots[0]["status"] == "done"
+        by_id = {s["span_id"]: s for s in mine}
+        for s in mine:
+            if s["kind"] in ("gate.queue", "slab.solve"):
+                assert by_id[s["parent_id"]]["kind"] == "rpc.request"
+            if s["kind"] == "chunk":
+                assert by_id[s["parent_id"]]["kind"] == "slab.solve"
+        # the breakdown is the acceptance shape: queue + solve within
+        # the root, solve dominant for a drained request
+        summ = tracing.trace_summary(mine, tid)
+        assert summ["dominant"] == "slab.solve"
+        assert (
+            summ["by_kind_s"]["gate.queue"]
+            + summ["by_kind_s"]["slab.solve"]
+            <= summ["total_s"] * 1.05 + 5e-3
+        )
+    # the record/span join: record.trace == the root span context,
+    # and terminal events carry the trace ids
+    rec = h1.request.record
+    assert rec.trace == {
+        "trace_id": h1.trace.trace_id, "span_id": h1.trace.span_id,
+    }
+    done = [e for e in rec.events if e.kind == "request_done"]
+    assert done and done[0].details["trace_id"] == h1.trace.trace_id
+
+
+# ---------------------------------------------------------------------------
+# HTTP propagation + the malformed-header fuzz
+# ---------------------------------------------------------------------------
+
+
+def test_http_propagation_and_malformed_traceparent_never_500():
+    A, b, xe, x0 = _poisson((8, 8))
+    gate = Gate(start_workers=True)
+    gate.register("t", A, kmax=4)
+    srv = serve_gate(gate, port=0)
+    try:
+        bg = list(map(float, gather_pvector(b)))
+        body = json.dumps({
+            "tenant": "t", "b": bg, "tol": 1e-9, "maxiter": 50,
+        }).encode()
+
+        def post(headers):
+            req = urllib.request.Request(
+                srv.url + "/v1/solve", data=body,
+                headers={"Content-Type": "application/json", **headers},
+                method="POST",
+            )
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, json.loads(resp.read()), dict(
+                    resp.headers
+                )
+
+        # a VALID traceparent is joined: same trace_id acknowledged in
+        # the payload and echoed in the response header
+        ctx = tracing.mint_trace()
+        status, payload, headers = post(
+            {"traceparent": ctx.traceparent()}
+        )
+        assert status == 202
+        assert payload["trace_id"] == ctx.trace_id
+        echoed = tracing.parse_traceparent(headers.get("traceparent"))
+        assert echoed is not None and echoed.trace_id == ctx.trace_id
+        # ... and the server-side root records the REMOTE parent
+        root = next(
+            s for s in tracing.recorded_spans()
+            if s["trace_id"] == ctx.trace_id
+            and s["kind"] == "rpc.request"
+        )
+        assert root["remote"] and root["parent_id"] == ctx.span_id
+
+        # the fuzz sweep: every malformed header admits (202), mints a
+        # FRESH trace, bumps the counter — never 500s
+        bad0 = telemetry.counter("gate.traceparent_invalid")
+        seen_traces = set()
+        for i, bad in enumerate(_MALFORMED):
+            status, payload, _ = post({"traceparent": bad})
+            assert status == 202, (bad, status, payload)
+            assert payload["trace_id"] != ctx.trace_id, bad
+            assert payload["trace_id"] not in seen_traces, bad
+            seen_traces.add(payload["trace_id"])
+            assert telemetry.counter(
+                "gate.traceparent_invalid"
+            ) == bad0 + i + 1, bad
+        # no header at all: minted, NOT counted as invalid
+        status, payload, _ = post({})
+        assert status == 202 and payload.get("trace_id")
+        assert telemetry.counter(
+            "gate.traceparent_invalid"
+        ) == bad0 + len(_MALFORMED)
+        gate.drain()
+    finally:
+        srv.stop()
+
+
+def test_healthz_readiness_fields():
+    """/healthz is readiness-probe grade: queue depth, resident tenant
+    list, journal epoch, uptime (the ISSUE-14 enrichment — asserted
+    here next to its producer; tests/test_pagate.py keeps the endpoint
+    suite)."""
+    A, b, xe, x0 = _poisson((8, 8))
+    gate = Gate()
+    gate.register("t", A, kmax=2)
+    srv = serve_gate(gate, port=0)
+    try:
+        with urllib.request.urlopen(srv.url + "/healthz") as resp:
+            health = json.loads(resp.read())
+        assert health["ok"] is True
+        assert health["queue_depth"] == 0
+        assert health["resident"] == ["t"]
+        assert health["journal_epoch"] is None  # journal off
+        assert isinstance(health["uptime_s"], float)
+        assert health["uptime_s"] >= 0.0
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# overhead: byte-identical programs, tracing on/off
+# ---------------------------------------------------------------------------
+
+
+def test_tracing_on_block_program_hlo_identical(tmp_path, monkeypatch):
+    """The overhead pin: the compiled block body lowers to
+    byte-identical StableHLO with the span plane fully enabled (PA_TX=1
+    + a persistence dir + live spans open) vs killed — the solver path
+    never reads a PA_TX* flag."""
+    import jax
+
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        TPUBackend,
+        _matrix_operands,
+        device_matrix,
+        make_cg_fn,
+    )
+
+    backend = TPUBackend(devices=jax.devices()[:8])
+    A = pa.prun(
+        lambda parts: assemble_poisson(parts, (6, 6, 6))[0],
+        backend, (2, 2, 2),
+    )
+    dA = device_matrix(A, backend)
+    ops = _matrix_operands(dA)
+    P, W = dA.col_plan.layout.P, dA.col_plan.layout.W
+    zb = np.zeros((P, W, 2))
+
+    def text():
+        fn = make_cg_fn(dA, tol=1e-9, maxiter=50, rhs_batch=2)
+        return fn.jit_fn.lower(zb, zb, zb[..., 0], ops).as_text()
+
+    monkeypatch.setenv("PA_TX", "0")
+    baseline = text()
+    monkeypatch.setenv("PA_TX", "1")
+    monkeypatch.setenv("PA_TX_DIR", str(tmp_path))
+    with tracing.span("rpc.request", name="hlo-pin"):
+        assert text() == baseline
+    assert text() == baseline
+
+
+# ---------------------------------------------------------------------------
+# the CLI smoke
+# ---------------------------------------------------------------------------
+
+
+def test_patx_check_smoke(capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "patx", os.path.join(REPO, "tools", "patx.py")
+    )
+    patx = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(patx)
+    rc = patx.main(["--check"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "patx --check: OK" in out
+
+
+def test_patx_render_list_and_phase_mount(tmp_path, monkeypatch):
+    """patx rendering surface: --list/--slow ranking, the tree render,
+    and --phases mounting solver.phase children under slab.solve from
+    the committed PHASE_PROFILE.json."""
+    import importlib.util
+
+    monkeypatch.setenv("PA_TX_DIR", str(tmp_path))
+    with tracing.span("rpc.request", name="fast") as root:
+        with tracing.span("slab.solve", name="fast", parent=root):
+            pass
+    with tracing.span("rpc.request", name="slowreq") as root2:
+        import time as _t
+
+        with tracing.span("slab.solve", name="slowreq",
+                          parent=root2) as slab:
+            # a chunk child FILLING the slab: the mounted phases are an
+            # alternate decomposition — verify_trace sums children per
+            # KIND, so chunk + solver.phase must not double-count
+            with tracing.span("chunk", name="slowreq", parent=slab):
+                _t.sleep(0.02)
+    spans = tracing.load_spans(str(tmp_path))
+    assert len(tracing.trace_ids(spans)) == 2
+    # --slow ranks the sleeper first
+    summs = sorted(
+        (tracing.trace_summary(spans, t) for t in
+         tracing.trace_ids(spans)),
+        key=lambda r: -r["total_s"],
+    )
+    assert summs[0]["total_s"] > summs[1]["total_s"]
+    # phase mount: synthetic solver.phase children under slab.solve,
+    # scaled to the slab duration with shares preserved
+    profile = json.load(open(os.path.join(REPO, "PHASE_PROFILE.json")))
+    added = tracing.mount_phase_spans(spans, profile)
+    slabs = [s for s in spans if s["kind"] == "slab.solve"]
+    assert len(added) == len(slabs) * len(profile["phases"])
+    for s in slabs:
+        kids = [a for a in added if a["parent_id"] == s["span_id"]]
+        assert {k["kind"] for k in kids} == {"solver.phase"}
+        assert sum(k["dur_s"] for k in kids) == pytest.approx(
+            s["dur_s"], rel=1e-6
+        )
+    # the tree render + verify stay sound with the mount included
+    tid = slabs[0]["trace_id"]
+    assert tracing.verify_trace(spans + added, tid) == []
+    out = tracing.render_trace(spans + added, tid)
+    assert "solver.phase:dot_allgather" in out
+    # the CLI front end agrees (patx <trace_id> on the same dir)
+    spec = importlib.util.spec_from_file_location(
+        "patx", os.path.join(REPO, "tools", "patx.py")
+    )
+    patx = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(patx)
+    assert patx.main([tid, "--dir", str(tmp_path)]) == 0

@@ -28,6 +28,12 @@ Three legs, honestly separated:
   ``metrics_on_off_ratio`` (a host-platform canary band — the
   structural claim is "metrics are host-side and cheap", the
   byte-identical-program pin lives in tests/test_pamon.py).
+* **tracing-on/off marginal** (round 16 / patx) — the same K=8 leg
+  with every request carrying a trace context, span capture on vs
+  killed (``PA_TX=0``): the measured cost of the distributed-tracing
+  plane on the hot path, banded in ``tracing_on_off_ratio`` (same
+  canary convention; the byte-identical-program pin lives in
+  tests/test_patx.py).
 
 The PA_MON-on service legs also FEED the online throughput model
 (`telemetry.throughput`): after the sweep this tool exports the
@@ -69,7 +75,17 @@ METRICS_BANDS = {
     "metrics_on_off_ratio": (0.7, 1.3, "canary"),
 }
 
-METHODOLOGY = "v2-service-mon"
+#: The tracing-on/off requests/s ratio band (round 16 / patx): the K=8
+#: drained leg with every request carrying a trace context, span plane
+#: on vs killed (``PA_TX=0``). Same canary convention as the metrics
+#: marginal — the structural claim (byte-identical programs, host-only
+#: capture) is pinned in tests/test_patx.py; this band keeps the
+#: measured hot-path cost recorded and ledgered.
+TRACING_BANDS = {
+    "tracing_on_off_ratio": (0.7, 1.3, "canary"),
+}
+
+METHODOLOGY = "v3-service-tx"
 
 KS = (1, 4, 8, 16)
 
@@ -77,14 +93,26 @@ KS = (1, 4, 8, 16)
 TRIPS = 40
 
 
-def _service_leg(pa, A, x0, bs, tol, maxiter, kmax):
-    """One drained service run over ``bs``; returns wall seconds."""
+def _service_leg(pa, A, x0, bs, tol, maxiter, kmax, traced=False):
+    """One drained service run over ``bs``; returns wall seconds.
+    ``traced`` submits every request under a fresh trace context (the
+    gate's propagation path) so the span plane's hot-path cost is on
+    the clock — with ``PA_TX=0`` the same submits take the inert
+    path, which is exactly the tracing marginal's A/B."""
     from partitionedarrays_jl_tpu.service import SolveService
+    from partitionedarrays_jl_tpu.telemetry import tracing
 
     svc = SolveService(A, kmax=kmax)
     t0 = time.perf_counter()
     handles = [
-        svc.submit(b, x0=x0, tol=tol, maxiter=maxiter) for b in bs
+        svc.submit(
+            b, x0=x0, tol=tol, maxiter=maxiter,
+            trace=(
+                tracing.mint_trace()
+                if traced and tracing.tracing_enabled() else None
+            ),
+        )
+        for b in bs
     ]
     svc.drain()
     wall = time.perf_counter() - t0
@@ -162,6 +190,40 @@ def measure_metrics_marginal(pa, A, x0, rhs_pool, tol, maxiter, reps=3):
     }
 
 
+def measure_tracing_marginal(pa, A, x0, rhs_pool, tol, maxiter, reps=3):
+    """The K=8 drained leg with per-request trace contexts, span plane
+    on vs killed (``PA_TX=0``): what patx span capture costs on the
+    service hot path (round 16)."""
+    K = 8
+    bs = [rhs_pool[i % len(rhs_pool)] for i in range(K)]
+
+    def leg():
+        return sorted(
+            _service_leg(pa, A, x0, bs, tol, maxiter, kmax=K,
+                         traced=True)
+            for _ in range(reps)
+        )[reps // 2]
+
+    _service_leg(pa, A, x0, bs, tol, maxiter, kmax=K, traced=True)
+    on = leg()
+    prev = os.environ.get("PA_TX")
+    os.environ["PA_TX"] = "0"
+    try:
+        _service_leg(pa, A, x0, bs, tol, maxiter, kmax=K, traced=True)
+        off = leg()
+    finally:
+        if prev is None:
+            os.environ.pop("PA_TX", None)
+        else:
+            os.environ["PA_TX"] = prev
+    return {
+        "K": K,
+        "on_requests_per_s": round(K / on, 6),
+        "off_requests_per_s": round(K / off, 6),
+        "ratio_on_off": round(off / on, 3),
+    }
+
+
 def main():
     import importlib.util
 
@@ -216,6 +278,8 @@ def main():
     rows = measure_rows(pa, A, None, rhs_pool, 1e-300, TRIPS)
     marginal = measure_metrics_marginal(pa, A, None, rhs_pool, 1e-300,
                                         TRIPS)
+    tx_marginal = measure_tracing_marginal(pa, A, None, rhs_pool,
+                                           1e-300, TRIPS)
 
     fingerprint = telemetry.operator_fingerprint(A)
     model = telemetry.throughput_model()
@@ -268,6 +332,7 @@ def main():
         "service_rows": rows,
         "inherited": inherited,
         "metrics_marginal": marginal,
+        "tracing_marginal": tx_marginal,
         "measured_per_rhs": measured_per_rhs,
         "operator_fingerprint": fingerprint,
         "bands": {},
@@ -283,6 +348,12 @@ def main():
         ok = ok and (in_band or kind != "device")
     for key, (lo, hi, kind) in METRICS_BANDS.items():
         v = marginal["ratio_on_off"]
+        rec["bands"][key] = {
+            "lo": lo, "hi": hi, "measured": v,
+            "in_band": lo <= v <= hi, "kind": kind,
+        }
+    for key, (lo, hi, kind) in TRACING_BANDS.items():
+        v = tx_marginal["ratio_on_off"]
         rec["bands"][key] = {
             "lo": lo, "hi": hi, "measured": v,
             "in_band": lo <= v <= hi, "kind": kind,

@@ -305,7 +305,13 @@ def _spawn_server(journal_dir, ckpt_dir, url_file, slab_delay):
     if os.path.exists(url_file):
         os.unlink(url_file)
     env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PA_GATE_JOURNAL_FSYNC="1")
+               PA_GATE_JOURNAL_FSYNC="1",
+               # patx: spans persist next to the journal so the drill
+               # reconstructs ONE stitched trace across the SIGKILL
+               # (PA_TX pinned on — the drill asserts trace ids, so an
+               # operator env with PA_TX=0 must not fail it spuriously)
+               PA_TX="1",
+               PA_TX_DIR=os.path.join(journal_dir, "tx"))
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "serve",
          "--journal-dir", journal_dir, "--checkpoint-dir", ckpt_dir,
@@ -380,6 +386,8 @@ def _drill(slab_delay: float = 0.5, n_requests: int = 4) -> int:
         if not cond:
             failures.append(msg)
 
+    from partitionedarrays_jl_tpu.telemetry import tracing
+
     root = tempfile.mkdtemp(prefix="padur-drill-")
     jd = os.path.join(root, "journal")
     cd = os.path.join(root, "ckpt")
@@ -406,6 +414,7 @@ def _drill(slab_delay: float = 0.5, n_requests: int = 4) -> int:
     print(f"padur drill: starting server (journal={jd})", flush=True)
     proc, url = _spawn_server(jd, cd, uf, slab_delay)
     ids = []
+    traces = {}  # rid -> trace_id acknowledged pre-crash
     try:
         for i in range(n_requests):
             status, payload = _post(url, {
@@ -417,6 +426,11 @@ def _drill(slab_delay: float = 0.5, n_requests: int = 4) -> int:
             })
             expect(status == 202, f"submit {i} must 202 (got {status})")
             ids.append(payload["id"])
+            expect(
+                bool(payload.get("trace_id")),
+                f"submit {i} must acknowledge a trace_id",
+            )
+            traces[payload["id"]] = payload.get("trace_id")
         # land the kill MID-SLAB: wait for a dispatch to be journaled
         # (the slab is then sleeping inside _block_solve), then -9
         _wait_for(
@@ -454,6 +468,11 @@ def _drill(slab_delay: float = 0.5, n_requests: int = 4) -> int:
             expect(
                 poll["state"] in ("done", "failed"),
                 f"{rid}: must reach a terminal state ({poll['state']})",
+            )
+            expect(
+                poll.get("trace_id") == traces[rid],
+                f"{rid}: the recovered request must keep its ORIGINAL "
+                f"trace_id ({traces[rid]} -> {poll.get('trace_id')})",
             )
             if poll["state"] == "done":
                 expect(
@@ -523,6 +542,49 @@ def _drill(slab_delay: float = 0.5, n_requests: int = 4) -> int:
         set(ids) <= terminal,
         f"zero lost: every admitted id must reach a terminal record "
         f"(missing: {set(ids) - terminal})",
+    )
+
+    # -- patx: ONE stitched trace per admitted request ------------------
+    spans = tracing.load_spans(os.path.join(jd, "tx"))
+    interrupted_total = 0
+    for rid in ids:
+        tid = traces[rid]
+        mine = [s for s in spans if s.get("trace_id") == tid]
+        expect(mine, f"{rid}: no spans persisted for trace {tid}")
+        for p in tracing.verify_trace(spans, tid):
+            expect(False, f"{rid}: {p}")  # incl. ZERO orphan spans
+        tids = {s["trace_id"] for s in mine}
+        expect(
+            tids == {tid},
+            f"{rid}: the crash must not fork the trace ({tids})",
+        )
+        interrupted = [
+            s for s in mine if s.get("status") == "interrupted"
+        ]
+        interrupted_total += len(interrupted)
+        # a request the kill caught mid-flight stitches: its post-crash
+        # root span parents to the (interrupted) pre-crash root
+        stitched = [
+            s for s in mine
+            if s["kind"] == "rpc.request" and s.get("attrs", {}).get(
+                "recovered"
+            )
+        ]
+        for s in stitched:
+            expect(
+                s.get("parent_id") in {m["span_id"] for m in mine},
+                f"{rid}: recovered root must parent to the pre-crash "
+                "root span",
+            )
+    expect(
+        interrupted_total >= 1,
+        "the SIGKILL must leave at least one interrupted span "
+        "(something was mid-flight)",
+    )
+    print(
+        f"padur drill: {len(ids)} stitched traces, "
+        f"{interrupted_total} interrupted spans, 0 orphans",
+        flush=True,
     )
 
     for f in failures:

@@ -335,6 +335,23 @@ def _check() -> int:
                f"interactive must be admitted while besteffort sheds "
                f"(got {status})")
         ids.append(payload.get("id"))
+        # the readiness-probe-grade /healthz: depth, residency,
+        # journal epoch, uptime (with the backlog still held)
+        with urllib.request.urlopen(srv.url + "/healthz") as resp:
+            health = json.loads(resp.read())
+        expect(health.get("ok") is True, "healthz must report ok")
+        expect(health.get("queue_depth", 0) >= 4,
+               f"healthz must report the held backlog ({health})")
+        expect(health.get("resident") == ["poisson8"],
+               f"healthz must list the resident tenants ({health})")
+        expect("journal_epoch" in health,
+               "healthz must report the journal epoch (null journal-"
+               "off)")
+        expect(
+            isinstance(health.get("uptime_s"), (int, float))
+            and health["uptime_s"] >= 0.0,
+            f"healthz must report uptime_s ({health})",
+        )
         gate.paused = False
         for rid in ids:
             import time

@@ -118,6 +118,13 @@ def _summarize(path, rec):
     cfg = rec.get("config") or {}
     shown = {k: v for k, v in cfg.items() if k != "pa_env"}
     print(f"  config: {json.dumps(shown, sort_keys=True, default=str)}")
+    trace = rec.get("trace")
+    if trace:
+        print(
+            f"  trace: {trace.get('trace_id')} "
+            f"(span {trace.get('span_id')} — tools/patx.py "
+            f"{trace.get('trace_id')} renders the tree)"
+        )
     res = rec.get("residuals") or []
     if res:
         head = ", ".join(f"{v:.3e}" for v in res[:3])
@@ -212,6 +219,7 @@ def _service_slabs(recs):
         ]
         seen = {}
         unnamed = {}
+        continuation = {}
         t_form = None
         for tag, rec in member_recs:
             t0 = rec.get("started_at") or 0.0
@@ -235,6 +243,21 @@ def _service_slabs(recs):
                     if ev.get("kind") == "column_verdict":
                         if key not in unnamed or abs_t < unnamed[key][0]:
                             unnamed[key] = (abs_t, ev)
+                    # solo-retry CONTINUATION events (the nested solve
+                    # of an ejected member: faults, health errors,
+                    # aborted attempts, recovery restarts) don't name
+                    # the request — window them into the member's
+                    # ejection->terminal interval below instead of
+                    # silently dropping the retry story
+                    elif ev.get("kind") in _CONTINUATION_KINDS:
+                        # per-attempt identity: the iteration joins the
+                        # key (two columns' otherwise-identical typed
+                        # errors are two attempts, not one event)
+                        ckey = key + (ev.get("iteration"),)
+                        if ckey not in continuation or abs_t < (
+                            continuation[ckey][0]
+                        ):
+                            continuation[ckey] = (abs_t, ev)
                     continue
                 if ev.get("kind") == "slab_formed" and not details.get(
                     "topped_up"
@@ -246,9 +269,84 @@ def _service_slabs(recs):
         for key, (abs_t, ev) in unnamed.items():
             if t_form is None or abs_t >= t_form - 1e-3:
                 seen.setdefault(key, (abs_t, ev))
+        t_last = _last_terminal(member_recs)
+        for key, (abs_t, ev) in continuation.items():
+            # inside the slab's life: formation .. last member terminal
+            if t_form is not None and abs_t < t_form - 1e-3:
+                continue
+            if t_last is not None and abs_t > t_last + 1e-3:
+                continue
+            owner = _retry_window_owner(member_recs, abs_t)
+            if owner is not None:
+                ev = dict(ev)
+                ev["details"] = dict(
+                    ev.get("details") or {}, retry_of=owner
+                )
+            seen.setdefault(key, (abs_t, ev))
         events = sorted(seen.values(), key=lambda kv: kv[0])
         out.append((s["order"], member_recs, events))
     return out
+
+
+#: Event kinds a member's solo retry (or its recovery ladder) emits
+#: WITHOUT naming the request — joined into the slab view by their
+#: ejection-window timing (`_retry_window_owner`). Pre-fix, a slab
+#: whose every request was ejected rendered only the bare
+#: formed/ejected/done skeleton: the whole retry story (the aborted
+#: attempts, the faults that caused them, the checkpoint restarts)
+#: was silently dropped as unnamed.
+_CONTINUATION_KINDS = (
+    "fault_injected", "health_error", "solve_aborted", "restart",
+    "checkpoint_save", "checkpoint_restore", "sdc_detection",
+    "sdc_rollback", "sdc_escalation",
+)
+
+
+def _last_terminal(member_recs):
+    """Latest request_done/request_failed time across the members."""
+    t_last = None
+    for tag, rec in member_recs:
+        t0 = rec.get("started_at") or 0.0
+        for ev in rec.get("events") or []:
+            if (
+                ev.get("kind") in ("request_done", "request_failed")
+                and ev.get("label") == tag
+            ):
+                at = t0 + (ev.get("t") or 0.0)
+                t_last = at if t_last is None else max(t_last, at)
+    return t_last
+
+
+def _retry_window_owner(member_recs, abs_t):
+    """The member whose ejection->terminal window contains ``abs_t``
+    (windows are sequential — the verdict loop retries one ejected
+    column at a time — so the nearest preceding ejection wins)."""
+    best = None
+    for tag, rec in member_recs:
+        t0 = rec.get("started_at") or 0.0
+        t_eject = None
+        t_term = None
+        for ev in rec.get("events") or []:
+            details = ev.get("details") or {}
+            at = t0 + (ev.get("t") or 0.0)
+            if (
+                ev.get("kind") == "column_ejected"
+                and details.get("request") == tag
+                and t_eject is None
+            ):
+                t_eject = at
+            if (
+                ev.get("kind") in ("request_done", "request_failed")
+                and ev.get("label") == tag
+            ):
+                t_term = at
+        if t_eject is None or abs_t < t_eject - 1e-3:
+            continue
+        if t_term is not None and abs_t > t_term + 1e-3:
+            continue
+        if best is None or t_eject > best[0]:
+            best = (t_eject, tag)
+    return best[1] if best is not None else None
 
 
 def _service_timeline(recs) -> int:

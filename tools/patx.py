@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""patx — end-to-end distributed request traces (span trees).
+
+Reads the per-process span JSONL the tracing plane persists (set
+``PA_TX_DIR=<dir>`` for the serving process — `tools/pagate.py serve`
+and `tools/padur.py serve` inherit it) and answers the question the
+aggregate planes cannot: where did THIS request's time go, from HTTP
+ingress through the gate's EDF queue, a possible eviction/requeue or
+page-in, the slab, and its chunks — stitched across a crash when the
+gate journals (recovered requests keep their original trace_id).
+
+Usage:
+    python tools/patx.py <trace_id> --dir /tmp/tx     # render the tree
+    python tools/patx.py --list --dir /tmp/tx         # all traces
+    python tools/patx.py --slow 5 --dir /tmp/tx       # worst 5 by total
+    python tools/patx.py <trace_id> --trace out.json  # Perfetto export
+    python tools/patx.py --trace out.json             # ... all traces
+    python tools/patx.py <trace_id> --phases PHASE_PROFILE.json
+                                   # mount solver.phase spans under
+                                   # each slab.solve (measured per-
+                                   # iteration attribution, scaled)
+    python tools/patx.py --check   # tier-1 smoke: ephemeral gate over
+                                   # HTTP -> reconstruct -> assert the
+                                   # span-tree invariants
+
+The Perfetto export (``--trace``) writes spans as complete events plus
+FLOW arrows along every parent->child edge, onto the same timeline
+`tools/patrace.py --trace` uses — records and spans load together.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load(args):
+    from partitionedarrays_jl_tpu.telemetry import tracing
+
+    d = args.dir or os.environ.get("PA_TX_DIR")
+    if not d:
+        print(
+            "patx: no span directory — pass --dir or set PA_TX_DIR "
+            "(spans persist only when it was set for the serving "
+            "process)",
+            file=sys.stderr,
+        )
+        return None
+    spans = tracing.load_spans(d)
+    if not spans:
+        print(f"patx: no spans under {d}", file=sys.stderr)
+        return None
+    return spans
+
+
+def _mount_phases(spans, path):
+    from partitionedarrays_jl_tpu.telemetry import tracing
+
+    profile = json.load(open(path))
+    added = tracing.mount_phase_spans(spans, profile)
+    if not added:
+        print(
+            f"patx: {path} holds no positive phase attribution — "
+            "nothing mounted",
+            file=sys.stderr,
+        )
+    return spans + added
+
+
+def _list(spans, slow=None):
+    from partitionedarrays_jl_tpu.telemetry import tracing
+
+    rows = [
+        tracing.trace_summary(spans, tid)
+        for tid in tracing.trace_ids(spans)
+    ]
+    if slow is not None:
+        rows.sort(key=lambda r: -r["total_s"])
+        rows = rows[:slow]
+    print(f"{'trace_id':32s}  {'spans':>5s}  {'total':>10s}  dominant")
+    for r in rows:
+        mark = " [interrupted]" if r["interrupted"] else ""
+        print(
+            f"{r['trace_id']:32s}  {r['spans']:5d}  "
+            f"{r['total_s'] * 1e3:8.2f}ms  {r['dominant']}{mark}"
+        )
+    return 0
+
+
+def _check() -> int:
+    """Tier-1 smoke: ephemeral HTTP gate -> spans -> invariants."""
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    txd = tempfile.mkdtemp(prefix="patx-check-")
+    os.environ["PA_TX"] = "1"  # the smoke asserts spans exist
+    os.environ["PA_TX_DIR"] = txd
+
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu.frontdoor import (
+        http_solve,
+        serve_gate,
+    )
+    from partitionedarrays_jl_tpu.frontdoor import Gate
+    from partitionedarrays_jl_tpu.models import (
+        assemble_poisson,
+        gather_pvector,
+    )
+    from partitionedarrays_jl_tpu.telemetry import tracing
+
+    failures = []
+
+    def expect(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        gate = Gate(start_workers=True)
+        gate.register("t", A, kmax=2)
+        srv = serve_gate(gate, port=0)
+        try:
+            bg, x0g = gather_pvector(b), gather_pvector(x0)
+            # one client-minted trace, one server-minted
+            tp = tracing.mint_trace().traceparent()
+            out1 = http_solve(srv.url, "t", bg, x0=x0g, tol=1e-9,
+                              tag="patx-1", traceparent=tp)
+            out2 = http_solve(srv.url, "t", bg, x0=x0g, tol=1e-9,
+                              tag="patx-2")
+            expect(out1["state"] == "done", f"solve 1 failed: {out1}")
+            expect(out2["state"] == "done", f"solve 2 failed: {out2}")
+            expect(
+                out1.get("trace_id") == tp.split("-")[1],
+                "the client's traceparent trace_id must be joined, "
+                f"not replaced ({out1.get('trace_id')})",
+            )
+            expect(
+                bool(out2.get("trace_id")),
+                "a submit without traceparent must get a minted trace",
+            )
+            gate.drain()
+            gate.account()
+        finally:
+            srv.stop()
+        return out1["trace_id"], out2["trace_id"]
+
+    tids = pa.prun(driver, pa.sequential, (2, 2))
+    spans = tracing.load_spans(txd)
+    expect(
+        tids[0] != tids[1], "the two requests must be distinct traces"
+    )
+    for tid in tids:
+        mine = [s for s in spans if s["trace_id"] == tid]
+        for p in tracing.verify_trace(spans, tid):
+            expect(False, p)
+        kinds = {s["kind"] for s in mine}
+        expect(
+            {"rpc.request", "gate.queue", "slab.solve", "chunk"}
+            <= kinds,
+            f"trace {tid} missing span kinds (have {sorted(kinds)})",
+        )
+        roots, orphans = tracing.span_tree(mine)
+        expect(len(roots) == 1, f"trace {tid}: want ONE root")
+        expect(not orphans, f"trace {tid}: orphans {orphans}")
+        expect(
+            roots and roots[0]["kind"] == "rpc.request",
+            f"trace {tid}: root must be rpc.request",
+        )
+        by_id = {s["span_id"]: s for s in mine}
+        for s in mine:
+            if s["kind"] == "slab.solve":
+                expect(
+                    by_id[s["parent_id"]]["kind"] == "rpc.request",
+                    "slab.solve must parent to the request root",
+                )
+            if s["kind"] == "chunk":
+                expect(
+                    by_id[s["parent_id"]]["kind"] == "slab.solve",
+                    "chunk must parent to slab.solve",
+                )
+        summ = tracing.trace_summary(mine, tid)
+        expect(
+            summ["dominant"] == "slab.solve",
+            f"trace {tid}: a drained solve's dominant span must be "
+            f"slab.solve (got {summ['dominant']})",
+        )
+    # the client's remote parent must be flagged, never an orphan
+    for f in failures:
+        print(f"patx --check FAILURE: {f}", file=sys.stderr)
+    print("patx --check:", "FAILED" if failures else "OK")
+    return 1 if failures else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_id", nargs="?",
+                    help="trace to render (patx --list shows them)")
+    ap.add_argument("--dir", help="span directory (default PA_TX_DIR)")
+    ap.add_argument("--list", action="store_true", dest="list_",
+                    help="one line per trace")
+    ap.add_argument("--slow", type=int, metavar="N",
+                    help="the N worst traces by total latency")
+    ap.add_argument("--trace", metavar="OUT",
+                    help="Perfetto/Chrome-trace export (flow events "
+                         "link the span edges)")
+    ap.add_argument("--phases", metavar="PROFILE",
+                    help="paprof PhaseProfile JSON to mount as "
+                         "solver.phase children of slab.solve spans")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the selected trace's spans as JSON")
+    ap.add_argument("--check", action="store_true",
+                    help="tier-1 smoke: ephemeral gate -> span-tree "
+                         "invariants")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        return _check()
+
+    spans = _load(args)
+    if spans is None:
+        return 2
+    if args.phases:
+        spans = _mount_phases(spans, args.phases)
+
+    from partitionedarrays_jl_tpu.telemetry import tracing
+
+    if args.list_ or args.slow is not None:
+        return _list(spans, slow=args.slow)
+
+    if args.trace:
+        from partitionedarrays_jl_tpu.telemetry import (
+            write_chrome_trace,
+        )
+
+        events = tracing.trace_chrome_events(
+            spans, trace_id=args.trace_id
+        )
+        write_chrome_trace(args.trace, extra_events=events)
+        n = (
+            1 if args.trace_id is not None
+            else len(tracing.trace_ids(spans))
+        )
+        print(f"wrote {args.trace} ({n} trace(s), flow-linked)")
+        if args.trace_id is None:
+            return 0
+
+    if args.trace_id is None:
+        ap.print_help()
+        return 2
+    mine = [s for s in spans if s["trace_id"] == args.trace_id]
+    if not mine:
+        print(f"patx: no spans for trace {args.trace_id}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(mine, indent=1, sort_keys=True))
+        return 0
+    print(tracing.render_trace(spans, args.trace_id))
+    problems = tracing.verify_trace(spans, args.trace_id)
+    for p in problems:
+        print(f"  WARNING: {p}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
