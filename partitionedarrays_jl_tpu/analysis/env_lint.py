@@ -283,6 +283,21 @@ NON_LOWERING: Dict[str, str] = {
         "the per-process span JSONL lands for tools/patx.py; pure "
         "host I/O policy, never part of a staged program"
     ),
+    "PA_SPEC": (
+        "convergence-observatory master switch (telemetry/spectrum.py)"
+        " — gates HOST-side post-solve spectral estimation, store "
+        "feeding, and anomaly detection on already-downloaded "
+        "rings/histories; the solver path never reads it and the block "
+        "program is byte-identical StableHLO on/off "
+        "(tests/test_paspec.py)"
+    ),
+    "PA_SPEC_ADMIT": (
+        "deadline-feasibility admission switch (telemetry/spectrum.py)"
+        " — pure admission policy: refuses a request typed "
+        "DeadlineInfeasible BEFORE dispatch when the forecast cost "
+        "exceeds the deadline; never touches what any program stages "
+        "(byte-identity pinned in tests/test_paspec.py)"
+    ),
 }
 
 

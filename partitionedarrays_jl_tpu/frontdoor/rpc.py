@@ -46,6 +46,7 @@ from urllib import request as _urlrequest
 
 import numpy as np
 
+from ..parallel.health import DeadlineInfeasible
 from ..service.admission import AdmissionRejected
 from ..telemetry import tracing
 from ..telemetry.registry import registry
@@ -249,6 +250,16 @@ class _Handler(BaseHTTPRequestHandler):
         except AdmissionRejected as e:
             self._json(503, {
                 "error": "AdmissionRejected", "message": str(e),
+                "diagnostics": e.diagnostics,
+            })
+            return
+        except DeadlineInfeasible as e:
+            # paspec admission (PA_SPEC_ADMIT=1): the forecast says the
+            # deadline cannot be met — 422, refused before any solver
+            # work, with the predicted_s/available_s diagnostics on the
+            # wire (distinct from 429 shed and 503 backpressure)
+            self._json(422, {
+                "error": "DeadlineInfeasible", "message": str(e),
                 "diagnostics": e.diagnostics,
             })
             return

@@ -68,7 +68,7 @@ import threading
 import time as _walltime
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..telemetry import tracing
+from ..telemetry import spectrum, tracing
 from ..telemetry.registry import CATALOG, monitoring_enabled, registry
 from ..utils.helpers import check
 from .journal import (
@@ -451,7 +451,15 @@ class Gate:
             # through the submit critical section
             self._shed_span(e, tag, cls, trace)
             raise
-        self.registry.tenant(tenant)  # raise UnknownTenantError early
+        t = self.registry.tenant(tenant)  # raise UnknownTenantError early
+        # paspec deadline-feasibility (PA_SPEC_ADMIT=1): a measured
+        # operator whose forecast cost exceeds the request's deadline
+        # is refused typed DeadlineInfeasible AT THE GATE DOOR — never
+        # enqueued, never dispatched, zero iterations spent (the RPC
+        # surface maps it to 422). Distinct from shed (policy under
+        # overload) and queue-full (backpressure): this is a
+        # prediction. Unmeasured operators always pass.
+        self._check_feasible(t, b, tag, kwargs)
         # the EXPENSIVE part of the admitted record — gathering the
         # global vectors and converting to floats — happens before the
         # gate lock (b/x0 are immutable inputs); only the append itself
@@ -610,6 +618,41 @@ class Gate:
             },
         )
 
+    def _check_feasible(self, tenant, b, tag: str, kwargs: dict) -> None:
+        """The gate half of paspec admission: forecast the request's
+        cost against the tenant operator's measured spectrum +
+        throughput and refuse an infeasible deadline typed
+        (`DeadlineInfeasible`) before it enters the EDF queue. No-op
+        without a deadline or under the default ``PA_SPEC_ADMIT=0``.
+        A computed ``‖b‖`` is stamped into ``kwargs["r0_norm"]`` so
+        the tenant service's dispatch-time re-check (against the
+        REMAINING deadline — gate-queue time is charged) reuses it
+        instead of paying the O(n) reduction twice."""
+        deadline = kwargs.get("deadline")
+        if deadline is None or not spectrum.spec_admit_enabled():
+            return
+        import numpy as np
+
+        from ..service.admission import DEFAULT_TOL
+        from ..telemetry.throughput import operator_fingerprint
+
+        fp = spectrum.spectrum_fingerprint(tenant.A)
+        dt = str(np.dtype(b.dtype))
+        mc = spectrum.minv_class_of(tenant.minv)
+        # unmeasured operators always pass — and must not pay the O(n)
+        # norm the forecast needs
+        if not spectrum.has_spec(fp, dt, mc):
+            return
+        # warm starts (x0) forecast their REMAINING work
+        r0 = spectrum.residual_norm(tenant.A, b, kwargs.get("x0"))
+        if r0 is not None:
+            kwargs["r0_norm"] = r0
+        spectrum.check_deadline_feasible(
+            fp, dt, mc, float(kwargs.get("tol", DEFAULT_TOL)),
+            float(deadline), r0_norm=r0, tag=tag, where="gate",
+            cost_fingerprint=operator_fingerprint(tenant.A),
+        )
+
     def _admitted_payload(self, b, kwargs) -> dict:
         """The data half of the ``admitted`` record — the full request
         payload (global vectors via JSON's exact float round-trip), so
@@ -659,6 +702,10 @@ class Gate:
                     )
                     if st is not None:
                         h.kwargs["x0"] = st["x"]
+                        # the admission-time ‖r0‖ is stale for the
+                        # resumed iterate: drop it so the dispatch-time
+                        # forecast recomputes the REMAINING work
+                        h.kwargs.pop("r0_norm", None)
                         if h.kwargs.get("maxiter") is not None:
                             h.kwargs["maxiter"] = max(
                                 1, int(h.kwargs["maxiter"])

@@ -334,6 +334,9 @@ class OperatorRegistry:
             t.A, minv=t.minv, checkpoint_dir=t.checkpoint_dir,
             clock=self.clock, **t.svc_kwargs,
         )
+        # the tenant name labels the service's forecast-error histogram
+        # (spec.iters_rel_error{tenant=…} — the pamon --conv view)
+        t.svc.name = t.name
         if self.start_workers:
             t.svc.start()
         t.resident = True

@@ -401,6 +401,9 @@ def cg(
         x, info = _cg_host_loop(
             A, b, x0, tol, maxiter, verbose, checkpoint, _resume_state
         )
+        # paspec: spectral estimate + anomaly detection, host-side on
+        # the recorded recurrence, BEFORE finish (events land on rec)
+        telemetry.observe_solve(A, rec, info=info, dtype=b.dtype)
         return x, rec.finish(info)
 
 
@@ -441,6 +444,12 @@ def _cg_host_loop(A, b, x0, tol, maxiter, verbose, checkpoint, _resume_state):
         # makes the while test silently False — guard BEFORE the loop so
         # a poisoned start raises instead of returning converged=False
         check_finite_scalar(rs, "cg", it=0, vectors=(("r", r), ("x", x)))
+    # host α/β recording (the device ring's oracle twin): the spectrum
+    # layer reconstructs the Lanczos tridiagonal from these — two float
+    # appends per iteration, rewound with the SDC rollback
+    it0 = it
+    ab_alpha: list = []
+    ab_beta: list = []
     stag = StagnationDetector("cg") if health and stagnation_raises() else None
     sdc = _SDCGuard("cg", A, b, rs0, health)
     sdc.push({"x": x, "r": r, "p": p}, {"rs": rs, "it": it}, history)
@@ -469,6 +478,8 @@ def _cg_host_loop(A, b, x0, tol, maxiter, verbose, checkpoint, _resume_state):
             rs = rs_new
             history.append(np.sqrt(rs))
             it += 1
+            ab_alpha.append(float(alpha))
+            ab_beta.append(float(beta))
             # periodic true-residual audit: recompute b - A x and cross-
             # check the recurrence residual (catches the drift a FINITE
             # corruption leaves behind); the passing state is pushed onto
@@ -482,6 +493,8 @@ def _cg_host_loop(A, b, x0, tol, maxiter, verbose, checkpoint, _resume_state):
             vecs, meta_r, history = sdc.rollback(e, it)
             x, r, p = vecs["x"], vecs["r"], vecs["p"]
             rs, it = meta_r["rs"], meta_r["it"]
+            del ab_alpha[max(0, it - it0):]
+            del ab_beta[max(0, it - it0):]
             continue
         if stag is not None:
             stag.update(float(np.sqrt(rs)), it)
@@ -497,6 +510,7 @@ def _cg_host_loop(A, b, x0, tol, maxiter, verbose, checkpoint, _resume_state):
             print(f"cg it={it} residual={np.sqrt(rs):.3e}")
     if checkpoint is not None:
         checkpoint.wait()  # the last write must land before we return
+    _attach_host_ab(ab_alpha, ab_beta, it0)
     return x, krylov_info(
         it, history, np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
         tol, b.dtype, floor_warned,
@@ -506,6 +520,21 @@ def _cg_host_loop(A, b, x0, tol, maxiter, verbose, checkpoint, _resume_state):
         ),
         **sdc.info_extra(),
     )
+
+
+def _attach_host_ab(ab_alpha, ab_beta, it0: int) -> None:
+    """Stamp a host loop's recorded α/β recurrence onto the active
+    `SolveRecord` (the device trace ring's oracle twin — the spectrum
+    layer reads either identically). No-op on inert records or
+    zero-iteration solves."""
+    from .. import telemetry
+
+    rec = telemetry.current_record()
+    if rec is None or not rec.enabled or not ab_alpha:
+        return
+    rec.alpha = list(ab_alpha)
+    rec.beta = list(ab_beta)
+    rec.trace_start = int(it0)
 
 
 def gershgorin_bounds(A: PSparseMatrix) -> Tuple[float, float]:
@@ -1519,6 +1548,8 @@ def pcg(
             A, b, x0, minv, apply_minv, tol, maxiter, verbose,
             checkpoint, _resume_state,
         )
+        telemetry.observe_solve(A, rec, info=info, dtype=b.dtype,
+                                minv=minv)
         return x, rec.finish(info)
 
 
@@ -1569,6 +1600,12 @@ def _pcg_host_loop(
     if health and _resume_state is None:
         # see cg: a poisoned start must raise, not silently skip the loop
         check_finite_scalar(rs, "pcg", it=0, vectors=(("r", r), ("x", x)))
+    # host α/β recording (see _cg_host_loop) — for PCG the reconstructed
+    # tridiagonal estimates the spectrum of M⁻¹A, which is the κ that
+    # governs PCG convergence (keyed by minv class in the store)
+    it0 = it
+    ab_alpha: list = []
+    ab_beta: list = []
     stag = StagnationDetector("pcg") if health and stagnation_raises() else None
     sdc = _SDCGuard("pcg", A, b, rs0, health)
     sdc.push({"x": x, "r": r, "p": p}, {"rs": rs, "rz": rz, "it": it}, history)
@@ -1597,6 +1634,8 @@ def _pcg_host_loop(
             rz = rz_new
             history.append(np.sqrt(rs))
             it += 1
+            ab_alpha.append(float(alpha))
+            ab_beta.append(float(beta))
             sdc.audit(
                 x, r, it, {"rs": rs, "rz": rz, "it": it}, {"p": p}, history
             )
@@ -1605,6 +1644,8 @@ def _pcg_host_loop(
             vecs, meta_r, history = sdc.rollback(e, it)
             x, r, p = vecs["x"], vecs["r"], vecs["p"]
             rs, rz, it = meta_r["rs"], meta_r["rz"], meta_r["it"]
+            del ab_alpha[max(0, it - it0):]
+            del ab_beta[max(0, it - it0):]
             continue
         if stag is not None:
             stag.update(float(np.sqrt(rs)), it)
@@ -1621,6 +1662,7 @@ def _pcg_host_loop(
             print(f"pcg it={it} residual={np.sqrt(rs):.3e}")
     if checkpoint is not None:
         checkpoint.wait()
+    _attach_host_ab(ab_alpha, ab_beta, it0)
     return x, krylov_info(
         it, history, np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)),
         tol, b.dtype, floor_warned,

@@ -84,6 +84,7 @@ __all__ = [
     "SolverStagnationError",
     "ExchangeTimeoutError",
     "SolveDeadlineError",
+    "DeadlineInfeasible",
     "ControllerLostError",
     "SilentCorruptionError",
     "PlanSoundnessError",
@@ -167,6 +168,22 @@ class SolveDeadlineError(SolverHealthError):
     failure — but `solve_with_recovery` restarts would be pointless
     (the clock, not the solver, failed), so the service fails the
     request instead of retrying it."""
+
+
+class DeadlineInfeasible(SolverHealthError):
+    """A deadline-carrying request was refused AT ADMISSION because the
+    convergence observatory's forecast says it cannot be met: predicted
+    cost (`telemetry.spectrum.predict_iters` x the throughput model's
+    measured ``s_per_it``) exceeds the deadline budget. Raised only
+    under ``PA_SPEC_ADMIT=1`` and only for spectrally-measured
+    operators — unmeasured operators are always admitted. DISTINCT
+    from its neighbors in the refusal ladder: `SolveDeadlineError` is
+    the deadline EXPIRING after iterations burned, `AdmissionRejected`
+    is queue backpressure, and `LoadShedded` is SLO-class policy — this
+    one is a PREDICTION, made before any solver work, with
+    ``diagnostics`` carrying ``predicted_s`` / ``available_s`` /
+    ``predicted_iters`` / ``s_per_it`` and the spectral inputs
+    (κ̂, measured rate) behind it."""
 
 
 class ControllerLostError(SolverHealthError):

@@ -32,7 +32,12 @@ from .pvector import PVector, _owned, _ghost
 
 
 class PSparseMatrix:
-    __slots__ = ("values", "rows", "cols", "_exchanger", "_blocks", "_device")
+    __slots__ = (
+        "values", "rows", "cols", "_exchanger", "_blocks", "_device",
+        # lazily cached value-sensitive identity (telemetry.spectrum.
+        # spectrum_fingerprint — one O(nnz) digest per operator)
+        "_spec_fingerprint",
+    )
 
     def __init__(
         self,

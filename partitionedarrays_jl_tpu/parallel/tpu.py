@@ -5106,7 +5106,7 @@ def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg",
             },
         )
     converged = bool(np.sqrt(rs) <= tol * max(1.0, np.sqrt(rs0)))
-    return x, krylov_info(
+    info = krylov_info(
         it, residuals, converged, tol, b.dtype, floor_warned,
         final_rel=_final_true_rel(
             A, x, b, np.sqrt(rs) / max(1.0, np.sqrt(rs0)), np.sqrt(rs0),
@@ -5114,6 +5114,16 @@ def _run_krylov(A, b, x0, tol, verbose, solve, minv=None, name="cg",
         ),
         **(info_extra or {}),
     )
+    # paspec: spectral estimate (α/β ring when carried, residual-history
+    # rate always) + anomaly detection — host-side, on the still-active
+    # record so convergence_anomaly events land in it. CG family ONLY:
+    # the store's Lanczos/κ-rate semantics are CG's, and a bicgstab
+    # rate EWMAing into the same key would skew CG forecasts
+    if name in ("cg", "pcg"):
+        telemetry.observe_solve(
+            A, rec, info=info, dtype=b.dtype, minv=minv
+        )
+    return x, info
 
 
 def _final_true_rel(A, x, b, rel_est, rs0_norm, tol, force=False):
@@ -5432,6 +5442,9 @@ def _tpu_block_cg_impl(
         info["sdc"] = sdc_info
     if floor_warned:
         info["tol_below_dtype_floor"] = True
+    # paspec: per-column spectral estimates from the block ring (masked
+    # post-convergence trips truncate), host-side, before rec.finish
+    telemetry.observe_solve(A, rec, info=info, dtype=dt, minv=minv)
     return xs, info
 
 
@@ -5489,10 +5502,30 @@ def _krylov_fn_for(
     # body, the SDC-defended block body, and bicgstab have no ring, and
     # depth saturates at maxiter — a PA_TRACE_ITERS flip must not
     # rebuild a program the flip cannot reach.
+    from .. import telemetry
+
     if method != "cg" or pipelined or (
         rhs_batch is not None and sdccfg is not None
     ):
         trace_ht = 0
+        requested = _trace_config()
+        if requested > 0:
+            # trace-ring exemption HONESTY: a body that cannot carry
+            # the α/β ring must say so — a typed event names the body,
+            # so a missing spectrum is explained, never mysterious
+            # (tools/paspec.py and tools/patrace.py surface it)
+            body = (
+                "pipelined" if pipelined
+                else "sdc-block" if method == "cg"
+                else method
+            )
+            telemetry.emit_event(
+                "trace_unavailable", label=body, requested=requested,
+                method=method,
+                reason="this body carries no alpha/beta trace ring — "
+                       "spectral estimates fall back to the residual "
+                       "history",
+            )
     else:
         trace_ht = int(min(_trace_config(), int(maxiter)))
     key = (
@@ -5500,7 +5533,6 @@ def _krylov_fn_for(
         bool(fused), rhs_batch, sdccfg["key"] if sdccfg else None,
         trace_ht,
     )
-    from .. import telemetry
 
     if key not in dA._cg_cache:
         telemetry.bump("program_cache.miss")

@@ -33,11 +33,18 @@ from typing import Optional
 __all__ = [
     "AdmissionRejected",
     "AdmissionController",
+    "DEFAULT_TOL",
     "queue_depth",
     "slab_kmax",
     "chunk_iters",
     "default_retries",
 ]
+
+#: The service-wide default convergence tolerance — THE one definition
+#: (`SolveService.submit` and the gate's paspec feasibility check both
+#: resolve through it, so the two admission forecasts can never
+#: desynchronize on a default change).
+DEFAULT_TOL = 1e-8
 
 
 class AdmissionRejected(RuntimeError):

@@ -46,12 +46,13 @@ import threading
 import time
 from typing import Callable, Optional
 
-from ..telemetry import tracing
+from ..telemetry import spectrum, tracing
 from ..telemetry.registry import monitoring_enabled, registry
 from ..telemetry.throughput import model as throughput_model
 from ..telemetry.throughput import operator_fingerprint
 from ..utils.helpers import check
 from .admission import (
+    DEFAULT_TOL,
     AdmissionController,
     chunk_iters,
     default_retries,
@@ -114,6 +115,17 @@ class SolveService:
         #: Structural operator identity: the throughput-model key this
         #: service's finished slabs report their measured s_per_it under.
         self.fingerprint = operator_fingerprint(A)
+        #: Tenant name (the front door stamps it at page-in) — the
+        #: ``spec.iters_rel_error{tenant=…}`` label; falls back to the
+        #: fingerprint for unnamed in-process services.
+        self.name: Optional[str] = None
+        #: The spectrum-store preconditioner-class axis of this
+        #: service's solves (paspec forecasts read the same key). The
+        #: VALUE-sensitive spectral identity itself is resolved lazily
+        #: in `_forecast` (spectrum_fingerprint caches its one O(nnz)
+        #: digest on the matrix, surviving service rebuilds) — a
+        #: PA_SPEC=0 deployment must not pay it at page-in.
+        self._minv_class = spectrum.minv_class_of(minv)
         #: Per-instance token qualifying request checkpoint paths:
         #: request ids are process-local monotonic, so a re-built
         #: service (an evicted tenant paged back in) would otherwise
@@ -138,6 +150,8 @@ class SolveService:
         self.stats = {
             "admitted": 0,
             "rejected": 0,
+            "infeasible": 0,
+            "predicted": 0,
             "slabs": 0,
             "completed": 0,
             "failed": 0,
@@ -156,12 +170,13 @@ class SolveService:
         self,
         b,
         x0=None,
-        tol: float = 1e-8,
+        tol: float = DEFAULT_TOL,
         maxiter: Optional[int] = None,
         deadline: Optional[float] = None,
         retries: Optional[int] = None,
         tag: str = "",
         trace=None,
+        r0_norm: Optional[float] = None,
     ) -> SolveRequest:
         """Admit one request (or raise `AdmissionRejected`); returns the
         request, which doubles as the result handle. ``deadline`` is a
@@ -169,7 +184,11 @@ class SolveService:
         ``trace`` is an optional `telemetry.tracing.TraceContext` the
         submitter propagates (the gate stamps its root span's context);
         the service then opens its slab/chunk spans under it and stamps
-        the request record — untraced submits stay span-free."""
+        the request record — untraced submits stay span-free.
+        ``r0_norm`` is an optional precomputed ``‖b‖`` for the paspec
+        forecast (the gate's own feasibility check passes it through,
+        so the O(n) reduction is paid once per request, not per
+        layer)."""
         from .. import telemetry
 
         check(tol > 0.0, "service: tol must be positive")
@@ -180,6 +199,15 @@ class SolveService:
         check(
             deadline is None or float(deadline) > 0.0,
             "service: deadline must be positive seconds",
+        )
+        # paspec admission: forecast the request's cost from the
+        # spectrum store + throughput model (host-side — nothing here
+        # can touch a compiled program). Under PA_SPEC_ADMIT=1 an
+        # infeasible deadline is refused typed HERE, before any
+        # iteration burns; otherwise the forecast only stamps the
+        # record. Unmeasured operators always pass.
+        forecast = self._forecast(
+            b, x0, tol, deadline, tag, r0_norm=r0_norm
         )
         with self._lock:
             tag = tag or f"req-{self._next_id}"
@@ -197,11 +225,18 @@ class SolveService:
             self._next_id += 1
             req.submitted_at = self.clock()
             req.trace = trace
+            req.forecast = forecast
             with tracing.ambient(trace):
                 req.record = telemetry.begin_record(
                     "service-request", request=req.tag, tol=float(tol),
                     maxiter=maxiter, deadline=deadline,
                 )
+                if forecast is not None:
+                    # the prediction rides the record: realized error
+                    # is stamped at the terminal state (_slo_account)
+                    req.record.config["forecast"] = dict(forecast)
+                    self.stats["predicted"] += 1
+                    registry().counter("spec.predictions").inc()
                 self.stats["admitted"] += 1
                 registry().counter("service.admitted").inc()
                 telemetry.emit_event(
@@ -215,6 +250,52 @@ class SolveService:
                 )
             self._cv.notify_all()
             return req
+
+    def _forecast(self, b, x0, tol, deadline, tag,
+                  r0_norm: Optional[float] = None) -> Optional[dict]:
+        """The paspec admission forecast for one request (host-side):
+        predicted iterations + seconds from the spectrum store and the
+        throughput model, or ``None`` while the operator is unmeasured
+        (or ``PA_SPEC=0``). Warm starts forecast their REMAINING work
+        (``‖b − A·x0‖`` — a checkpointed near-converged resubmission
+        must not be cold-forecast). Under ``PA_SPEC_ADMIT=1`` a
+        deadline-carrying request whose predicted cost exceeds its
+        deadline raises the typed `DeadlineInfeasible` — counted in
+        ``stats["infeasible"]``/``spec.infeasible``, never dispatched."""
+        from ..parallel.health import DeadlineInfeasible
+
+        if not spectrum.spec_enabled():
+            return None
+        import numpy as _np
+
+        dt = str(_np.dtype(b.dtype))
+        # lazy: one cached O(nnz) digest per operator, paid at the
+        # first forecast rather than at service construction
+        spec_fp = spectrum.spectrum_fingerprint(self.A)
+        # the common case — an unmeasured operator — must cost nothing:
+        # only a measured spec is worth the O(n) norm below
+        if not spectrum.has_spec(spec_fp, dt, self._minv_class):
+            return None
+        r0 = (
+            float(r0_norm) if r0_norm is not None
+            else spectrum.residual_norm(self.A, b, x0)
+        )
+        if deadline is not None and spectrum.spec_admit_enabled():
+            try:
+                return spectrum.check_deadline_feasible(
+                    spec_fp, dt, self._minv_class, tol,
+                    float(deadline), r0_norm=r0, tag=tag,
+                    where="service",
+                    cost_fingerprint=self.fingerprint,
+                )
+            except DeadlineInfeasible:
+                with self._lock:
+                    self.stats["infeasible"] += 1
+                raise
+        return spectrum.admission_prediction(
+            spec_fp, dt, self._minv_class, tol,
+            r0_norm=r0, cost_fingerprint=self.fingerprint,
+        )
 
     def pending(self) -> int:
         with self._lock:
@@ -595,6 +676,7 @@ class SolveService:
         req.finished_at = self.clock()
         reg = registry()
         elapsed = max(0.0, req.finished_at - req.submitted_at)
+        self._forecast_account(req, reg)
         slack = None
         if req.deadline is not None:
             labels = {"tol_class": _tol_class(req.tol)}
@@ -608,6 +690,32 @@ class SolveService:
         if slack is not None:
             reg.histogram("service.deadline_slack_s").observe(
                 max(0.0, slack)
+            )
+
+    def _forecast_account(self, req, reg) -> None:
+        """Close the forecast loop at the terminal state: realized
+        |predicted − actual| / actual iteration error, observed into
+        the ``spec.iters_rel_error{tenant=…}`` histogram (the pamon
+        --conv feed) and evented on the request record. No-op for
+        unforecast requests or zero-iteration outcomes."""
+        from .. import telemetry
+
+        forecast = getattr(req, "forecast", None)
+        if forecast is None or req.iterations <= 0:
+            return
+        predicted = int(forecast["predicted_iters"])
+        rel = abs(predicted - req.iterations) / max(1, req.iterations)
+        if monitoring_enabled():
+            reg.histogram(
+                "spec.iters_rel_error",
+                labels={"tenant": self.name or self.fingerprint},
+            ).observe(rel)
+        with tracing.ambient(req.trace):
+            telemetry.emit_event(
+                "forecast_checked", label=req.tag,
+                iteration=req.iterations, predicted=predicted,
+                rel_error=rel,
+                predicted_s=forecast.get("predicted_s"),
             )
 
     def _finish(self, req, x, col_info, via: Optional[str] = None) -> None:

@@ -102,6 +102,27 @@ from .commsmatrix import (  # noqa: F401
     render_comms_matrix,
     static_matrix,
 )
+from . import spectrum  # noqa: F401
+from .spectrum import (  # noqa: F401
+    ANOMALY_KINDS,
+    SPECTRUM_SCHEMA_VERSION,
+    SpectrumStore,
+    check_deadline_feasible,
+    detect_anomalies,
+    estimate_solve,
+    lanczos_tridiagonal,
+    measured_rate,
+    observe_solve,
+    poisson_fdm_analytic_extremes,
+    predict_iters,
+    reset_store,
+    residual_norm,
+    ritz_values,
+    spec_admit_enabled,
+    spec_enabled,
+    spectrum_fingerprint,
+)
+from .spectrum import store as spectrum_store  # noqa: F401
 from . import tracing  # noqa: F401
 from .tracing import (  # noqa: F401
     SPAN_KINDS,
@@ -124,7 +145,26 @@ from .ledger import (  # noqa: F401
 )
 
 __all__ = [
+    "ANOMALY_KINDS",
     "ARTIFACT_SCHEMA_VERSION",
+    "SPECTRUM_SCHEMA_VERSION",
+    "SpectrumStore",
+    "check_deadline_feasible",
+    "detect_anomalies",
+    "estimate_solve",
+    "lanczos_tridiagonal",
+    "measured_rate",
+    "observe_solve",
+    "poisson_fdm_analytic_extremes",
+    "predict_iters",
+    "reset_store",
+    "residual_norm",
+    "ritz_values",
+    "spec_admit_enabled",
+    "spec_enabled",
+    "spectrum",
+    "spectrum_fingerprint",
+    "spectrum_store",
     "CATALOG",
     "COMMS_MATRIX_SCHEMA_VERSION",
     "COMM_KINDS",

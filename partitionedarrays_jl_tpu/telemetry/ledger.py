@@ -68,13 +68,20 @@ def _repo_root() -> str:
     )
 
 
+#: Non-``*_BENCH.json`` artifacts the ledger additionally tracks: they
+#: carry the shared envelope and a standard ``bands`` table, so
+#: `extract_metrics`/`check_artifact` handle them unchanged.
+_EXTRA_ARTIFACTS = ("SPECTRUM.json",)
+
+
 def artifact_paths(repo: Optional[str] = None) -> List[str]:
-    """Every committed ``*_BENCH.json`` at the repo root, sorted."""
+    """Every committed ``*_BENCH.json`` at the repo root (plus the
+    banded extras in `_EXTRA_ARTIFACTS`), sorted."""
     repo = repo or _repo_root()
     return sorted(
         os.path.join(repo, f)
         for f in os.listdir(repo)
-        if f.endswith("_BENCH.json")
+        if f.endswith("_BENCH.json") or f in _EXTRA_ARTIFACTS
     )
 
 

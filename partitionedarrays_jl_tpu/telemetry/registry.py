@@ -292,6 +292,29 @@ CATALOG: Dict[str, MetricSpec] = {
               "malformed W3C traceparent headers on POST /v1/solve — "
               "refused at parse, a fresh trace minted instead (a "
               "hostile header can never 500 a submit)"),
+        # -- PR 16 convergence observatory (paspec) -------------------
+        _spec("spec.predictions", "counter", "1",
+              "service/service.py:submit",
+              "requests admitted with an iterations-to-tolerance "
+              "forecast stamped on their record (the operator was "
+              "spectrally measured at submit)"),
+        _spec("spec.infeasible", "counter", "1",
+              "telemetry/spectrum.py:check_deadline_feasible",
+              "deadline-carrying requests refused typed at admission "
+              "because the forecast cost exceeds the deadline "
+              "(PA_SPEC_ADMIT=1; DeadlineInfeasible — distinct from "
+              "deadline expiry, queue-full, and load shedding)"),
+        _spec("spec.anomalies", "counter", "1",
+              "telemetry/spectrum.py:observe_solve",
+              "convergence anomalies detected post-solve over the "
+              "residual trajectory and Ritz drift",
+              labels=("kind",)),
+        _spec("spec.iters_rel_error", "histogram", "fraction",
+              "service/service.py:_slo_account",
+              "per-request |predicted - actual| / actual iteration "
+              "forecast error, labeled by tenant (operator fingerprint "
+              "for unnamed services) — the pamon --conv feed",
+              labels=("tenant",)),
     ]
 }
 

@@ -67,6 +67,15 @@ with its documented outcome, event trail, and metric deltas
 | gate killed mid-solve (kill -9 semantics: state abandoned, no shutdown) | write-ahead journal replay at the next start | Gate.recover() resumes the in-flight request from its chunk-checkpointed iterate (gate.recovered{outcome=resumed}, request_recovered/gate_recovered/checkpoint_restore events) and it COMPLETES; nothing lost, nothing duplicated |
 | torn journal tail (crash mid-append) | per-record CRC32 at replay | tail truncated (journal.truncated + journal_truncated event), clean prefix recovered intact; mid-file corruption raises typed JournalCorruptError instead |
 | duplicate idempotency-key submit | gate key map (journal-rebuilt) | original id + bitwise result returned (gate.idempotent_hits + idempotent_replay event); service.admitted does NOT move — a single solve, across restarts included |
+
+Round 17 (paspec): the convergence observatory adds the PREDICTIVE
+refusal row — overload the scheduler can see COMING instead of
+discovering by burning iterations (docs/observability.md "Convergence
+observatory"):
+
+| condition               | detector            | documented outcome   |
+|-------------------------|---------------------|----------------------|
+| infeasible deadline on a measured operator (PA_SPEC_ADMIT=1) | spectral forecast x measured s_per_it at admission | DeadlineInfeasible (typed, predicted_s/available_s diagnostics) + deadline_infeasible/health_error events + spec.infeasible counter; NEVER dispatched — zero iterations, service.admitted/slabs do not move; distinct by type and metric from queue-full AdmissionRejected, LoadShedded, and post-hoc SolveDeadlineError expiry |
 """
 import numpy as np
 import pytest
@@ -891,6 +900,83 @@ def test_matrix_duplicate_idempotency_key_single_solve(tmp_path):
             m1["gate.idempotent_hits"] + 1
         )
         assert m2["service.admitted"] == m1["service.admitted"]
+        return True
+
+    _run(driver)
+
+
+# ---------------------------------------------------------------------------
+# round 17 — the convergence-observatory (paspec) row
+# ---------------------------------------------------------------------------
+
+
+def test_matrix_infeasible_deadline_refused_at_admission(monkeypatch):
+    """Paspec row: an infeasible-deadline request under PA_SPEC_ADMIT=1
+    is refused typed AT ADMISSION — never dispatched, zero solver
+    iterations burned — with the full event trail and metric deltas,
+    and stays DISTINCT from the queue-full, shed, and expiry rows (its
+    own type, its own counter, its own event kind)."""
+    from partitionedarrays_jl_tpu.parallel.health import (
+        DeadlineInfeasible,
+        SolveDeadlineError,
+    )
+    from partitionedarrays_jl_tpu.service import (
+        AdmissionRejected,
+        SolveService,
+    )
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A, kmax=2)
+        # train: one completed request measures spectrum + throughput
+        h = svc.submit(b, x0=x0, tol=1e-9, tag="train")
+        svc.drain()
+        assert h.result()[1]["converged"]
+        m0 = _metric_state(
+            "spec.infeasible", "service.admitted", "service.completed",
+            "service.deadline_expired",
+            "service.rejected{reason=queue_full}",
+            "events.deadline_infeasible", "events.health_error",
+        )
+        slabs0 = svc.stats["slabs"]
+        monkeypatch.setenv("PA_SPEC_ADMIT", "1")
+        with pytest.raises(DeadlineInfeasible) as ei:
+            svc.submit(b, x0=x0, tol=1e-9, deadline=1e-9, tag="doomed")
+        # typed + diagnosable: the prediction that refused it is on the
+        # error, and the type is NONE of its refusal-ladder neighbors
+        d = ei.value.diagnostics
+        assert d["predicted_s"] > d["available_s"]
+        assert d["predicted_iters"] >= 1 and d["s_per_it"] > 0
+        assert not isinstance(ei.value, SolveDeadlineError)
+        assert not isinstance(ei.value, AdmissionRejected)
+        m1 = _metric_state(
+            "spec.infeasible", "service.admitted", "service.completed",
+            "service.deadline_expired",
+            "service.rejected{reason=queue_full}",
+            "events.deadline_infeasible", "events.health_error",
+        )
+        delta = {k: m1[k] - m0[k] for k in m0}
+        # its own counter and events moved ...
+        assert delta["spec.infeasible"] == 1, delta
+        assert delta["events.deadline_infeasible"] == 1, delta
+        assert delta["events.health_error"] == 1, delta
+        # ... and NOTHING was admitted, dispatched, or mis-binned into
+        # the neighboring refusal rows: zero iterations spent
+        assert delta["service.admitted"] == 0, delta
+        assert delta["service.deadline_expired"] == 0, delta
+        assert delta["service.rejected{reason=queue_full}"] == 0, delta
+        assert svc.stats["slabs"] == slabs0
+        assert svc.stats["infeasible"] == 1
+        # default-off contract: the same hopeless deadline is ADMITTED
+        # with PA_SPEC_ADMIT unset (pre-paspec behavior preserved —
+        # whatever happens next is the post-hoc chunk-boundary expiry
+        # row's business, not admission's)
+        monkeypatch.delenv("PA_SPEC_ADMIT")
+        h2 = svc.submit(b, x0=x0, tol=1e-9, deadline=1e-9, tag="legacy")
+        m2 = _metric_state("service.admitted")
+        assert m2["service.admitted"] == m1["service.admitted"] + 1
+        svc.drain()
+        assert h2.done()
         return True
 
     _run(driver)

@@ -20,6 +20,8 @@ import json
 import os
 import re
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DOC_FILES = [
@@ -577,10 +579,14 @@ def test_perf_ledger_covers_every_bench_artifact_and_equals_sources():
     assert led.get("schema_version") == ARTIFACT_SCHEMA_VERSION
     assert led.get("generated_by") == "pareg"
     assert led.get("platform") and isinstance(led.get("pa_env"), dict)
+    # the tracked set: every *_BENCH.json plus the banded extras the
+    # ledger declares (round 17 added SPECTRUM.json)
     names = sorted(
-        f for f in os.listdir(REPO) if f.endswith("_BENCH.json")
+        os.path.basename(p) for p in ledger.artifact_paths(REPO)
     )
-    assert names, "no committed *_BENCH.json artifacts found"
+    assert names, "no committed bench artifacts found"
+    assert any(n.endswith("_BENCH.json") for n in names)
+    assert "SPECTRUM.json" in names
     assert sorted(led["artifacts"]) == names, (
         "ledger coverage drifted — run tools/pareg.py --update"
     )
@@ -693,3 +699,71 @@ def test_gate_artifact_agrees_with_guard_bands():
         "bench_gate"
     )
     assert rec.get("platform") and isinstance(rec.get("pa_env"), dict)
+
+
+def test_spectrum_artifact_agrees_with_analytic_and_bands():
+    """The committed SPECTRUM.json (round 17 — the convergence
+    observatory) is the real thing: shared artifact envelope, a
+    loadable schema-versioned store, a conformance block whose
+    ANALYTIC eigenvalues equal a fresh closed-form recomputation, a κ̂
+    band whose measured ratio is arithmetically consistent with its
+    own numbers AND the documented [0.5, 1.05] window (Ritz converges
+    from inside — the ratio may never exceed ~1), and >= 3 forecast
+    (operator, tol) pairs with the worst relative error in band. The
+    perf ledger covers it like every bench artifact (the coverage test
+    above picks it up via telemetry.ledger.artifact_paths)."""
+    from partitionedarrays_jl_tpu import telemetry
+    from partitionedarrays_jl_tpu.telemetry import ledger
+
+    path = os.path.join(REPO, "SPECTRUM.json")
+    rec = json.load(open(path))
+    # envelope + schema + store round-trip
+    assert rec.get("schema_version") == telemetry.ARTIFACT_SCHEMA_VERSION
+    assert rec.get("generated_by") == "paspec"
+    assert rec.get("platform") and isinstance(rec.get("pa_env"), dict)
+    assert rec["spectrum_schema_version"] == (
+        telemetry.SPECTRUM_SCHEMA_VERSION
+    )
+    store = telemetry.SpectrumStore.load(rec)
+    conf = rec["conformance"]
+    spec = store.spec(conf["fingerprint"], conf["dtype"],
+                      conf["minv_class"])
+    assert spec is not None and spec["samples"] >= 1
+    # the analytic pin: closed form recomputed fresh, not trusted
+    lo, hi = telemetry.poisson_fdm_analytic_extremes(rec["probe"]["ns"])
+    assert conf["analytic_lam_min"] == lo
+    assert conf["analytic_lam_max"] == hi
+    assert conf["analytic_kappa"] == pytest.approx(hi / lo, rel=1e-12)
+    # Ritz estimates lie INSIDE the analytic spectrum (to rounding)
+    assert conf["estimated_lam_min"] >= 0.99 * lo
+    assert conf["estimated_lam_max"] <= 1.01 * hi
+    band = rec["bands"]["spectrum_kappa_ratio"]
+    ratio = conf["estimated_kappa"] / conf["analytic_kappa"]
+    assert band["measured"] == pytest.approx(ratio, abs=1e-6)
+    assert (band["lo"], band["hi"]) == (0.5, 1.05)
+    assert band["in_band"] is True
+    assert band["lo"] <= band["measured"] <= band["hi"]
+    # the forecast acceptance: >= 3 pairs, worst error banded
+    fband = rec["bands"]["spectrum_forecast_rel_error_max"]
+    pairs = rec["forecast"]
+    assert len(pairs) >= 3
+    errs = [p["rel_error"] for p in pairs]
+    assert all(e is not None for e in errs)
+    assert fband["measured"] == pytest.approx(max(errs), abs=1e-6)
+    assert fband["in_band"] is True and max(errs) <= fband["hi"]
+    for p in pairs:
+        assert p["rel_error"] == pytest.approx(
+            abs(p["predicted"] - p["actual"]) / max(1, p["actual"]),
+            abs=1e-6,
+        )
+    # tighter tol may never forecast FEWER iterations (monotonicity)
+    preds = [p["predicted"] for p in sorted(
+        pairs, key=lambda p: -p["tol"]
+    )]
+    assert preds == sorted(preds)
+    # the ledger folds it in (extract_metrics sees the bands table)
+    assert path in ledger.artifact_paths(REPO)
+    metrics = ledger.extract_metrics("SPECTRUM.json", rec)
+    assert set(metrics) == {
+        "spectrum_kappa_ratio", "spectrum_forecast_rel_error_max"
+    }

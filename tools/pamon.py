@@ -163,6 +163,64 @@ def render_gate(snap, prev=None):
     return "\n".join(lines)
 
 
+def render_conv(snap, prev=None):
+    """The convergence-observatory view (round 17 — paspec): per-tenant
+    predicted-vs-actual iteration forecast error (p50/p90 relative
+    error bracketed from the `spec.iters_rel_error{tenant=…}` histogram
+    buckets) plus the prediction/infeasibility/anomaly counters, with
+    `--watch` deltas against ``prev``. Pure rendering over the existing
+    snapshot."""
+    from partitionedarrays_jl_tpu.telemetry import LatencyHistogram
+
+    counters = snap.get("counters") or {}
+    hists = snap.get("histograms") or {}
+    conv = {
+        name: hsnap for name, hsnap in hists.items()
+        if name.startswith("spec.iters_rel_error{tenant=")
+    }
+    spec_counters = {
+        name: v for name, v in counters.items()
+        if name.startswith("spec.")
+    }
+    if not conv and not spec_counters:
+        return ""
+    lines = ["convergence observatory (paspec):"]
+    lines.append(
+        "  predictions={}  infeasible={}".format(
+            counters.get("spec.predictions", 0),
+            counters.get("spec.infeasible", 0),
+        )
+        + "".join(
+            f"  anomalies[{n.split('kind=', 1)[1].rstrip('}')}]={v}"
+            for n, v in sorted(counters.items())
+            if n.startswith("spec.anomalies{")
+        )
+    )
+    if conv:
+        lines.append(
+            "  forecast error |predicted-actual|/actual "
+            "(quantiles are bucket upper edges):"
+        )
+    prev_h = (prev or {}).get("histograms") or {}
+    for name, hsnap in sorted(conv.items()):
+        tenant = name.split("tenant=", 1)[1].rstrip("}")
+        h = LatencyHistogram.from_snapshot(hsnap)
+        if h.total == 0:
+            lines.append(f"    tenant {tenant:16s} count=0")
+            continue
+        line = (
+            f"    tenant {tenant:16s} count={h.total:<5d} "
+            f"p50<={h.quantile(0.5):.3g} p90<={h.quantile(0.9):.3g} "
+            f"mean={h.mean():.3g}"
+        )
+        if prev is not None and name in prev_h:
+            d = h.delta(prev_h[name])
+            if d["count"]:
+                line += f"  (+{d['count']} since last poll)"
+        lines.append(line)
+    return "\n".join(lines)
+
+
 def render_slo(snap):
     """Deadline attainment per tolerance class + the slack
     distribution."""
@@ -270,6 +328,12 @@ def _run_demo():
         svc.drain()
         for h in handles:
             h.result()
+        # second wave: the operator is now spectrally measured, so
+        # these requests carry forecasts — the --conv view's feed
+        h2 = svc.submit(b, x0=x0, tol=1e-9, deadline=3600.0,
+                        tag="demo-forecast")
+        svc.drain()
+        h2.result()
         return svc.fingerprint, profile, dict(svc.stats)
 
     return pa.prun(driver, pa.sequential, (2, 2))
@@ -303,8 +367,9 @@ def _check() -> int:
     counters = snap["counters"]
     expect(
         counters.get("service.admitted", 0) - before["service.admitted"]
-        == 4,
-        "admitted counter must advance by the demo's 4 admissions",
+        == 5,
+        "admitted counter must advance by the demo's 5 admissions "
+        "(4 first-wave + 1 forecast-wave)",
     )
     expect(
         counters.get("service.rejected{reason=queue_full}", 0)
@@ -314,9 +379,31 @@ def _check() -> int:
     )
     expect(
         counters.get("service.completed", 0)
-        - before["service.completed"] == 4,
-        "completed counter must advance by 4",
+        - before["service.completed"] == 5,
+        "completed counter must advance by 5",
     )
+    # the convergence observatory saw the forecast wave: a prediction
+    # was stamped, the realized-error histogram observed it, and the
+    # --conv view renders it (metrics declared in-CATALOG)
+    from partitionedarrays_jl_tpu.telemetry import CATALOG
+
+    for name in ("spec.predictions", "spec.infeasible",
+                 "spec.anomalies", "spec.iters_rel_error"):
+        expect(name in CATALOG, f"{name} must be declared in CATALOG")
+    expect(counters.get("spec.predictions", 0) >= 1,
+           "the measured-operator wave must stamp a forecast")
+    conv_h = [
+        k for k in snap["histograms"]
+        if k.startswith("spec.iters_rel_error{tenant=")
+    ]
+    expect(
+        conv_h and snap["histograms"][conv_h[0]].get("count", 0) >= 1,
+        "forecast realized-error histogram must have observations",
+    )
+    conv = render_conv(snap)
+    expect("convergence observatory" in conv,
+           "--conv view must render the observatory table")
+    print(conv)
     hists = snap["histograms"]
     for name in ("service.queue_wait_s", "service.total_s",
                  "service.solve_s", "service.slab_wait_s"):
@@ -379,6 +466,9 @@ def main(argv=None):
                     help="raw snapshot JSON")
     ap.add_argument("--slo", action="store_true",
                     help="SLO attainment per tolerance class")
+    ap.add_argument("--conv", action="store_true",
+                    help="convergence observatory: per-tenant "
+                         "predicted-vs-actual forecast error")
     ap.add_argument("--watch", action="store_true",
                     help="with --snapshot: poll and show deltas")
     ap.add_argument("--interval", type=float, default=5.0,
@@ -419,6 +509,9 @@ def main(argv=None):
                 gate = render_gate(snap, prev=prev)
                 if gate:
                     print(gate)
+                if args.conv:
+                    conv = render_conv(snap, prev=prev)
+                    print(conv or "(no forecast observations yet)")
                 if args.slo:
                     print(render_slo(snap))
                 prev = snap
@@ -444,6 +537,9 @@ def main(argv=None):
         gate = render_gate(snap)
         if gate:
             print(gate)
+    if args.conv:
+        conv = render_conv(snap)
+        print(conv or "(no forecast observations yet)")
     if args.slo:
         print(render_slo(snap))
     if args.model is not None:

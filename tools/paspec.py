@@ -1,0 +1,580 @@
+#!/usr/bin/env python
+"""paspec — the convergence observatory CLI: online CG–Lanczos spectral
+estimates, iterations-to-tolerance forecasts, and the
+deadline-feasibility verdict.
+
+The operator console of `telemetry.spectrum` (docs/observability.md,
+"Convergence observatory"). What it answers:
+
+* ``--last`` / ``--list``   reconstruct the Lanczos tridiagonal from a
+                            persisted SolveRecord's α/β ring
+                            (``PA_METRICS_DIR`` records, like patrace):
+                            extremal Ritz values, κ̂, measured rate —
+                            and, when the ring is missing, the typed
+                            ``trace_unavailable`` explanation instead
+                            of a mystery.
+* ``--store``               render the live in-process spectrum store
+                            (after ``--check``).
+* ``--forecast TOL``        with ``--last``: predict
+                            iterations-to-tolerance from the record's
+                            own estimate.
+* ``--check``               tier-1 smoke: solve the conformance Poisson
+                            probe on the virtual device mesh with the
+                            trace ring on, reconstruct the spectrum,
+                            pin κ̂ inside the documented band of the
+                            ANALYTIC value, validate the forecaster
+                            predicted-vs-actual at three tolerances,
+                            and demonstrate the PA_SPEC_ADMIT
+                            feasibility verdict end-to-end (typed
+                            refusal, zero iterations burned). Exit
+                            nonzero on any broken invariant.
+* ``--write [PATH]``        regenerate the committed ``SPECTRUM.json``
+                            from the same probe through the shared
+                            `telemetry.artifacts` writer.
+
+Usage:
+    python tools/paspec.py --check
+    python tools/paspec.py --write            # refresh SPECTRUM.json
+    PA_METRICS_DIR=/tmp/rec python your_solve.py
+    python tools/paspec.py --last --dir /tmp/rec --forecast 1e-8
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: The canonical probe: the conformance Poisson FDM operator whose
+#: interior spectrum is analytic (`poisson_fdm_analytic_extremes`).
+PROBE_NS = (8, 8, 8)
+PROBE_PARTS = (2, 2, 2)
+PROBE_TRAIN_TOL = 1e-9
+PROBE_MAXITER = 200
+PROBE_TRACE = 256
+#: Forecast-validation tolerances (the ">= 3 (operator, tol) pairs"
+#: acceptance line).
+FORECAST_TOLS = (1e-4, 1e-6, 1e-8)
+
+#: Documented bands (docs/observability.md "Convergence observatory"):
+#: Ritz estimates converge from INSIDE the spectrum, so κ̂/κ_analytic
+#: approaches 1 from below — the band admits an under-resolved λmax on
+#: a fast-converging probe and refuses a broken reconstruction.
+KAPPA_RATIO_BAND = (0.5, 1.05)
+#: Max allowed |predicted − actual|/actual over the validation pairs.
+FORECAST_REL_ERROR_MAX = 0.5
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def render_estimate(est, forecast_tol=None, r0_norm=None):
+    if est is None:
+        return "  (no usable alpha/beta ring or residual history)"
+    lines = []
+    if est.get("lam_min") is not None:
+        lines.append(
+            f"  ritz extremes: [{est['lam_min']:.6g}, "
+            f"{est['lam_max']:.6g}]  (k={est['ritz_k']})"
+        )
+        if est.get("kappa") is not None:
+            lines.append(f"  kappa estimate: {est['kappa']:.6g}")
+        else:
+            lines.append("  kappa estimate: — (indefinite Ritz interval)")
+    else:
+        lines.append("  ritz extremes: — (no alpha/beta ring)")
+    if est.get("rate") is not None:
+        lines.append(
+            f"  measured rate: {est['rate']:.6g} per iteration "
+            f"({est['iterations']} iterations)"
+        )
+    if forecast_tol is not None:
+        from partitionedarrays_jl_tpu import telemetry
+
+        spec = {
+            "kappa": est.get("kappa"), "rate": est.get("rate"),
+            "samples": 1,
+        }
+        pred = telemetry.predict_iters(
+            spec, forecast_tol, r0_norm=r0_norm
+        )
+        lines.append(
+            f"  forecast: {pred} iterations to tol={forecast_tol:g}"
+            + ("" if r0_norm is None else f" (|r0|={r0_norm:.3g})")
+        )
+    return "\n".join(lines)
+
+
+def summarize_record(path, rec):
+    from partitionedarrays_jl_tpu import telemetry
+
+    print(f"record: {os.path.basename(path)}")
+    print(
+        f"  solver={rec.get('solver')} status={rec.get('status')} "
+        f"iterations={rec.get('iterations')}"
+    )
+    alpha, beta = rec.get("alpha"), rec.get("beta")
+    unavailable = [
+        ev for ev in rec.get("events") or []
+        if ev.get("kind") == "trace_unavailable"
+    ]
+    if not alpha and unavailable:
+        ev = unavailable[0]
+        print(
+            f"  alpha/beta ring: UNAVAILABLE — body "
+            f"{ev.get('label')!r} cannot carry it "
+            f"({(ev.get('details') or {}).get('reason', '')})"
+        )
+    # a wrapped ring is a TRAILING window: trace_start keys the
+    # submatrix reconstruction (see lanczos_tridiagonal)
+    start = int(rec.get("trace_start") or 0)
+    if alpha and isinstance(alpha[0], list):  # block record: K columns
+        # per-column residual histories are not persisted (only the
+        # worst column's) — per-column estimates are ring-only here
+        for k in range(len(alpha)):
+            est = telemetry.estimate_solve(
+                alpha[k], beta[k] if beta else [], None,
+                trace_start=start,
+            )
+            print(f"  column {k}:")
+            print(render_estimate(est))
+        return
+    est = telemetry.estimate_solve(
+        alpha, beta, rec.get("residuals"), trace_start=start
+    )
+    print(render_estimate(est))
+
+
+def render_store(store_rec):
+    lines = [
+        f"spectrum store (schema "
+        f"{store_rec.get('spectrum_schema_version')}, "
+        f"ewma_alpha={store_rec.get('ewma_alpha')}):"
+    ]
+    entries = store_rec.get("entries") or []
+    if not entries:
+        lines.append("  (no measured entries)")
+    for e in entries:
+        kap = e.get("kappa")
+        rate = e.get("rate")
+        lines.append(
+            f"  {e['fingerprint']} [{e['dtype']}, minv={e['minv_class']}]"
+            f" kappa={'—' if kap is None else f'{kap:.6g}'}"
+            f" rate={'—' if rate is None else f'{rate:.6g}'}"
+            f" samples={e['samples']}"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# the canonical probe (shared by --check and --write)
+# ---------------------------------------------------------------------------
+
+
+def run_probe():
+    """Solve the conformance Poisson probe on the device mesh with the
+    trace ring on; return the measurement dict the checks and the
+    committed artifact both read. The trace-depth env override is
+    restored on exit (in-process callers — tests — must not leak it
+    into later HLO-identity pins)."""
+    prev = os.environ.get("PA_TRACE_ITERS")
+    # FORCE the probe depth (not setdefault): an inherited smaller
+    # depth would wrap the ring mid-probe and the trailing-window
+    # submatrix drops a pair — the κ band wants the full recurrence
+    os.environ["PA_TRACE_ITERS"] = str(PROBE_TRACE)
+    try:
+        return _run_probe_body()
+    finally:
+        if prev is None:
+            os.environ.pop("PA_TRACE_ITERS", None)
+        else:
+            os.environ["PA_TRACE_ITERS"] = prev
+
+
+def _cpu_mesh():
+    """CPU mesh setup — same pattern as tools/paprof.py: force the
+    virtual 8-device host mesh (the dev image may pre-import jax on
+    another platform, so update the config too). Without this the
+    probe needs the test conftest's env to find 8 devices."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_ENABLE_X64"] = "true"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    return jax
+
+
+def _run_probe_body():
+    import numpy as np
+
+    jax = _cpu_mesh()
+
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu import telemetry
+    from partitionedarrays_jl_tpu.models import assemble_poisson
+    from partitionedarrays_jl_tpu.parallel.tpu import TPUBackend, tpu_cg
+
+    backend = TPUBackend(devices=jax.devices()[: int(np.prod(PROBE_PARTS))])
+
+    def probe(parts):
+        A, b, xe, x0 = assemble_poisson(parts, PROBE_NS)
+        return A, b, x0
+
+    A, b, x0 = pa.prun(probe, backend, PROBE_PARTS)
+    telemetry.reset_store()
+    fp = telemetry.spectrum_fingerprint(A)
+    dt = "float64"
+
+    def solve(tol):
+        def driver(parts):
+            x, info = tpu_cg(
+                A, b, x0=x0, tol=tol, maxiter=PROBE_MAXITER
+            )
+            return dict(info), info.record.alpha, info.record.beta
+
+        return pa.prun(driver, backend, PROBE_PARTS)
+
+    info, alpha, beta = solve(PROBE_TRAIN_TOL)
+    est = telemetry.estimate_solve(alpha, beta, info["residuals"])
+    spec = telemetry.spectrum_store().spec(fp, dt, "none")
+    lo, hi = telemetry.poisson_fdm_analytic_extremes(PROBE_NS)
+    forecast = []
+    for tol in FORECAST_TOLS:
+        vinfo, _, _ = solve(tol)
+        r0 = float(vinfo["residuals"][0])
+        pred = telemetry.predict_iters(spec, tol, r0_norm=r0)
+        actual = int(vinfo["iterations"])
+        forecast.append({
+            "tol": tol,
+            "predicted": pred,
+            "actual": actual,
+            "rel_error": (
+                None if pred is None
+                else round(abs(pred - actual) / max(1, actual), 6)
+            ),
+        })
+    return {
+        "fingerprint": fp,
+        "dtype": dt,
+        "minv_class": "none",
+        "train_info": {
+            "iterations": int(info["iterations"]),
+            "converged": bool(info["converged"]),
+            "tol": PROBE_TRAIN_TOL,
+        },
+        "estimate": est,
+        "spec": spec,
+        "analytic": {"lam_min": lo, "lam_max": hi, "kappa": hi / lo},
+        "forecast": forecast,
+        "store_export": telemetry.spectrum_store().export(),
+    }
+
+
+def probe_failures(m):
+    """Invariant checks over one probe measurement (shared by --check
+    and the committed-artifact bands)."""
+    failures = []
+    est = m["estimate"]
+    if est is None or est.get("kappa") is None:
+        failures.append("probe solve yielded no kappa estimate")
+        return failures, None, None
+    ratio = est["kappa"] / m["analytic"]["kappa"]
+    if not (KAPPA_RATIO_BAND[0] <= ratio <= KAPPA_RATIO_BAND[1]):
+        failures.append(
+            f"kappa ratio {ratio:.4f} outside the documented band "
+            f"{KAPPA_RATIO_BAND} (estimated {est['kappa']:.4f} vs "
+            f"analytic {m['analytic']['kappa']:.4f})"
+        )
+    # the Ritz interval must lie INSIDE the analytic spectrum (up to
+    # rounding) — converging from inside is the structural property
+    if est["lam_min"] < 0.99 * m["analytic"]["lam_min"]:
+        failures.append(
+            f"ritz lam_min {est['lam_min']:.6g} below the analytic "
+            f"minimum {m['analytic']['lam_min']:.6g}"
+        )
+    if est["lam_max"] > 1.01 * m["analytic"]["lam_max"]:
+        failures.append(
+            f"ritz lam_max {est['lam_max']:.6g} above the analytic "
+            f"maximum {m['analytic']['lam_max']:.6g}"
+        )
+    errs = [f["rel_error"] for f in m["forecast"]]
+    if any(e is None for e in errs):
+        failures.append("forecaster returned None on a measured spec")
+        return failures, ratio, None
+    worst = max(errs)
+    if worst > FORECAST_REL_ERROR_MAX:
+        failures.append(
+            f"worst forecast rel_error {worst:.3f} > "
+            f"{FORECAST_REL_ERROR_MAX} over {m['forecast']}"
+        )
+    preds = [f["predicted"] for f in m["forecast"]]
+    if preds != sorted(preds):
+        failures.append(
+            f"forecast not monotone in tol: {m['forecast']}"
+        )
+    return failures, ratio, worst
+
+
+def _feasibility_demo(failures):
+    """The admission leg of --check: a trained sequential-backend
+    service refuses an infeasible deadline typed, with ZERO iterations
+    burned, and admits a generous one."""
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu import telemetry
+    from partitionedarrays_jl_tpu.models import assemble_poisson
+    from partitionedarrays_jl_tpu.parallel.health import DeadlineInfeasible
+    from partitionedarrays_jl_tpu.service import SolveService
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A, kmax=2)
+        h = svc.submit(b, x0=x0, tol=1e-9, tag="spec-train")
+        svc.drain()
+        h.result()
+        admitted0 = svc.stats["admitted"]
+        slabs0 = svc.stats["slabs"]
+        inf0 = telemetry.registry().counter_value("spec.infeasible")
+        prev_admit = os.environ.get("PA_SPEC_ADMIT")
+        os.environ["PA_SPEC_ADMIT"] = "1"
+        try:
+            try:
+                svc.submit(b, x0=x0, tol=1e-9, deadline=1e-9,
+                           tag="spec-doomed")
+                failures.append(
+                    "infeasible deadline was admitted under "
+                    "PA_SPEC_ADMIT=1"
+                )
+            except DeadlineInfeasible as e:
+                d = e.diagnostics
+                if not (
+                    d.get("predicted_s") is not None
+                    and d.get("available_s") is not None
+                    and d["predicted_s"] > d["available_s"]
+                ):
+                    failures.append(
+                        f"DeadlineInfeasible diagnostics incomplete: {d}"
+                    )
+            if svc.stats["admitted"] != admitted0 or (
+                svc.stats["slabs"] != slabs0
+            ):
+                failures.append(
+                    "infeasible refusal leaked work into the service "
+                    "(admitted/slab counters moved)"
+                )
+            if telemetry.registry().counter_value(
+                "spec.infeasible"
+            ) != inf0 + 1:
+                failures.append("spec.infeasible counter did not tick")
+            h2 = svc.submit(b, x0=x0, tol=1e-9, deadline=3600.0,
+                            tag="spec-fine")
+            svc.drain()
+            if not h2.result()[1]["converged"]:
+                failures.append("feasible request failed to converge")
+        finally:
+            # restore, never clobber: an in-process caller may already
+            # run with admission on (same discipline as run_probe)
+            if prev_admit is None:
+                os.environ.pop("PA_SPEC_ADMIT", None)
+            else:
+                os.environ["PA_SPEC_ADMIT"] = prev_admit
+        return True
+
+    pa.prun(driver, pa.sequential, (2, 2))
+
+
+def check() -> int:
+    from partitionedarrays_jl_tpu import telemetry
+
+    m = run_probe()
+    failures, ratio, worst = probe_failures(m)
+    print(render_store(m["store_export"]))
+    print(render_estimate(m["estimate"]))
+    print(
+        f"  analytic kappa {m['analytic']['kappa']:.4f}  ratio "
+        f"{'—' if ratio is None else f'{ratio:.4f}'} "
+        f"(band {KAPPA_RATIO_BAND})"
+    )
+    for f in m["forecast"]:
+        print(
+            f"  forecast tol={f['tol']:g}: predicted={f['predicted']} "
+            f"actual={f['actual']} rel_error={f['rel_error']}"
+        )
+    _feasibility_demo(failures)
+    print("  feasibility verdict: typed DeadlineInfeasible refusal, "
+          "zero iterations burned" if not any(
+              "infeasible" in f or "Deadline" in f for f in failures
+          ) else "  feasibility verdict: FAILED")
+    # the new metrics must stay declared (the satellite's in-CATALOG pin)
+    for name in ("spec.predictions", "spec.infeasible",
+                 "spec.anomalies", "spec.iters_rel_error"):
+        if name not in telemetry.CATALOG:
+            failures.append(f"{name} missing from the metric CATALOG")
+    for f in failures:
+        print(f"paspec --check FAILURE: {f}", file=sys.stderr)
+    print("paspec --check:", "FAILED" if failures else "OK")
+    return 1 if failures else 0
+
+
+def write_artifact(path: str, dry_run: bool = False) -> int:
+    from partitionedarrays_jl_tpu import telemetry
+
+    m = run_probe()
+    failures, ratio, worst = probe_failures(m)
+    est = m["estimate"]
+    if est is None or est.get("kappa") is None:
+        # no usable estimate: report the probe failure instead of
+        # crashing on the conformance block below
+        for f in failures:
+            print(f"paspec --write FAILURE: {f}", file=sys.stderr)
+        return 1
+    rec = dict(m["store_export"])
+    rec.update({
+        "probe": {
+            "model": "poisson_fdm",
+            "ns": list(PROBE_NS),
+            "parts": list(PROBE_PARTS),
+            "train_tol": PROBE_TRAIN_TOL,
+            "maxiter": PROBE_MAXITER,
+            "trace_iters": PROBE_TRACE,
+            "forecast_tols": list(FORECAST_TOLS),
+        },
+        "conformance": {
+            "fingerprint": m["fingerprint"],
+            "dtype": m["dtype"],
+            "minv_class": m["minv_class"],
+            "train_iterations": m["train_info"]["iterations"],
+            "analytic_lam_min": m["analytic"]["lam_min"],
+            "analytic_lam_max": m["analytic"]["lam_max"],
+            "analytic_kappa": m["analytic"]["kappa"],
+            "estimated_lam_min": est["lam_min"],
+            "estimated_lam_max": est["lam_max"],
+            "estimated_kappa": est["kappa"],
+            "measured_rate": est["rate"],
+        },
+        "forecast": m["forecast"],
+        "bands": {
+            "spectrum_kappa_ratio": {
+                "kind": "structural",
+                "lo": KAPPA_RATIO_BAND[0],
+                "hi": KAPPA_RATIO_BAND[1],
+                "measured": None if ratio is None else round(ratio, 6),
+                "in_band": (
+                    None if ratio is None
+                    else bool(KAPPA_RATIO_BAND[0] <= ratio
+                              <= KAPPA_RATIO_BAND[1])
+                ),
+            },
+            "spectrum_forecast_rel_error_max": {
+                "kind": "structural",
+                "lo": 0.0,
+                "hi": FORECAST_REL_ERROR_MAX,
+                "measured": None if worst is None else round(worst, 6),
+                "in_band": (
+                    None if worst is None
+                    else bool(worst <= FORECAST_REL_ERROR_MAX)
+                ),
+            },
+        },
+    })
+    telemetry.write(path, rec, tool="paspec", dry_run=dry_run)
+    for f in failures:
+        print(f"paspec --write FAILURE: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="tier-1 smoke: probe, kappa band, forecast, "
+                         "feasibility verdict")
+    ap.add_argument("--write", nargs="?", const=os.path.join(
+        REPO, "SPECTRUM.json"), metavar="PATH",
+        help="regenerate SPECTRUM.json (default: committed path)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="with --write: print instead of writing")
+    ap.add_argument("--last", action="store_true",
+                    help="spectral summary of the newest persisted "
+                         "record")
+    ap.add_argument("--list", action="store_true", dest="list_",
+                    help="one spectral-availability line per record")
+    ap.add_argument("--store", action="store_true",
+                    help="render the committed SPECTRUM.json store")
+    ap.add_argument("--forecast", type=float, metavar="TOL",
+                    help="with --last: iterations-to-TOL forecast")
+    ap.add_argument("--dir", help="records directory (PA_METRICS_DIR)")
+    ap.add_argument("--json", action="store_true", dest="json_",
+                    help="raw JSON output where applicable")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        return check()
+    if args.write is not None:
+        return write_artifact(args.write, dry_run=args.dry_run)
+    if args.store:
+        rec = json.load(open(os.path.join(REPO, "SPECTRUM.json")))
+        if args.json_:
+            print(json.dumps(rec, indent=1, sort_keys=True))
+        else:
+            print(render_store(rec))
+        return 0
+
+    if args.last or args.list_:
+        from partitionedarrays_jl_tpu import telemetry
+
+        d = args.dir or os.environ.get("PA_METRICS_DIR")
+        if not d:
+            print("paspec: pass --dir or set PA_METRICS_DIR",
+                  file=sys.stderr)
+            return 2
+        paths = telemetry.list_persisted_records(d)
+        if not paths:
+            print(f"paspec: no records in {d}", file=sys.stderr)
+            return 2
+        if args.list_:
+            for p in paths:
+                rec = telemetry.load_record(p)
+                alpha = rec.get("alpha")
+                avail = (
+                    "ring" if alpha
+                    else "unavailable" if any(
+                        ev.get("kind") == "trace_unavailable"
+                        for ev in rec.get("events") or []
+                    )
+                    else "no-ring"
+                )
+                print(
+                    f"{os.path.basename(p)}  solver={rec.get('solver')} "
+                    f"it={rec.get('iterations')} trace={avail}"
+                )
+            return 0
+        rec = telemetry.load_record(paths[-1])
+        if args.forecast is not None:
+            est = telemetry.estimate_solve(
+                rec.get("alpha"), rec.get("beta"), rec.get("residuals"),
+                trace_start=int(rec.get("trace_start") or 0),
+            )
+            summarize_record(paths[-1], rec)
+            res = rec.get("residuals") or []
+            print(render_estimate(
+                est, forecast_tol=args.forecast,
+                r0_norm=res[0] if res else None,
+            ).splitlines()[-1])
+        else:
+            summarize_record(paths[-1], rec)
+        return 0
+
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

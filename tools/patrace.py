@@ -141,6 +141,22 @@ def _summarize(path, rec):
         start = rec.get("trace_start") or 0
         window = f", iterations {start}..{start + n - 1}" if start else ""
         print(f"  alpha/beta trace: {shape} (PA_TRACE_ITERS ring{window})")
+    else:
+        # trace-ring exemption honesty (round 17 — paspec): a body that
+        # cannot carry the ring says so via the typed event — surface
+        # it here so a missing spectrum is explained, not mysterious
+        unavailable = [
+            ev for ev in rec.get("events") or []
+            if ev.get("kind") == "trace_unavailable"
+        ]
+        if unavailable:
+            ev = unavailable[0]
+            det = ev.get("details") or {}
+            print(
+                f"  alpha/beta trace: UNAVAILABLE — body "
+                f"{ev.get('label')!r} (requested depth "
+                f"{det.get('requested')}; {det.get('reason', '')})"
+            )
     err = rec.get("error")
     if err:
         print(f"  error: {err.get('type')}: {err.get('message')}")
