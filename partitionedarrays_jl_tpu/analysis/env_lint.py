@@ -291,6 +291,21 @@ NON_LOWERING: Dict[str, str] = {
         "program is byte-identical StableHLO on/off "
         "(tests/test_paspec.py)"
     ),
+    "PA_FLEET_REPLICAS": (
+        "gate-fleet replica count (frontdoor/fleet.py) — how many "
+        "gate PROCESSES tools/pafleet.py launches; pure host-side "
+        "process topology, no staged program ever reads it"
+    ),
+    "PA_FLEET_LEASE_S": (
+        "fleet lease heartbeat period (frontdoor/fleet.py) — failure-"
+        "detection cadence for the per-replica lease files; host-side "
+        "liveness bookkeeping only"
+    ),
+    "PA_GATE_JOURNAL_KEEP": (
+        "journal retention depth (frontdoor/journal.py) — how many "
+        "fully-recovered epochs of host-side JSONL segments survive "
+        "pruning; disk-hygiene policy, never part of a staged program"
+    ),
     "PA_SPEC_ADMIT": (
         "deadline-feasibility admission switch (telemetry/spectrum.py)"
         " — pure admission policy: refuses a request typed "

@@ -33,21 +33,43 @@ budgets, PR 9 pamon metrics/SLO accounting, PR 10 adaptive K):
   recorded results, in-flight requests resume from chunk-checkpointed
   iterates (deadline clock resumed), queued requests re-enter EDF —
   zero lost, zero duplicated (tools/padur.py --drill is the proof).
+* `frontdoor.fleet`    — the round-16 (pafleet) replication layer: N
+  gate replicas behind rendezvous tenant routing, CRC'd lease-file
+  heartbeats, journal-backed peer failover (``Gate.adopt`` replays a
+  dead peer's journal into a survivor — zero lost, zero duplicated,
+  one stitched trace across the hop), and shed-forwarding (HTTP 307
+  to a peer with headroom before 429 backoff; `http_solve` follows).
+  Journal retention (``PA_GATE_JOURNAL_KEEP``) prunes fully-recovered
+  epochs with a typed refusal otherwise.
 
 CLI: ``tools/pagate.py serve|submit|loadgen`` (``--check`` is the
 tier-1 smoke); durability drills: ``tools/padur.py`` (``--check``
-tier-1, ``--drill`` the SIGKILL harness under ``-m slow``); bench:
+tier-1, ``--drill`` the SIGKILL harness under ``-m slow``); fleet:
+``tools/pafleet.py serve|kill|--check|--drill``; bench:
 ``tools/bench_gate.py`` -> ``GATE_BENCH.json``.
-Protocol docs: docs/service.md (Front door), docs/resilience.md
-(Durability).
+Protocol docs: docs/service.md (Front door, Gate fleet),
+docs/resilience.md (Durability).
 """
+from .fleet import (  # noqa: F401
+    FleetMap,
+    FleetMember,
+    LeaseCorruptError,
+    fleet_lease_s,
+    fleet_replicas,
+    read_lease,
+    rendezvous_rank,
+    route,
+    write_lease,
+)
 from .journal import (  # noqa: F401
     JournalCorruptError,
+    JournalRetentionError,
     RecoveredError,
     RequestJournal,
     journal_enabled,
     journal_env_dir,
     journal_fsync,
+    journal_keep,
     read_journal,
 )
 from .rpc import (  # noqa: F401
@@ -75,10 +97,14 @@ from .tenancy import (  # noqa: F401
 )
 
 __all__ = [
+    "FleetMap",
+    "FleetMember",
     "Gate",
     "GateHandle",
     "GateServer",
     "JournalCorruptError",
+    "JournalRetentionError",
+    "LeaseCorruptError",
     "LoadShedded",
     "OperatorRegistry",
     "RecoveredError",
@@ -86,17 +112,24 @@ __all__ = [
     "Tenant",
     "TenantBudgetError",
     "UnknownTenantError",
+    "fleet_lease_s",
+    "fleet_replicas",
     "gate_classes",
     "gate_port",
     "http_solve",
     "journal_enabled",
     "journal_env_dir",
     "journal_fsync",
+    "journal_keep",
     "mem_budget",
     "operator_footprint_bytes",
     "read_journal",
+    "read_lease",
+    "rendezvous_rank",
+    "route",
     "serve_gate",
     "serve_until_signalled",
     "shed_classes",
     "shed_depth",
+    "write_lease",
 ]

@@ -46,6 +46,13 @@ pinned in tests/test_padur.py):
 * ``PA_GATE_JOURNAL_FSYNC`` (default ``1``) — fsync every appended
   record before the caller proceeds; ``0`` trades the power-loss
   guarantee for speed (tests, tmpfs).
+* ``PA_GATE_JOURNAL_KEEP`` (default unset = keep everything) —
+  segment retention: after a recovery, prune the segment files of
+  fully-recovered prior epochs down to the newest ``KEEP`` epochs
+  (mirroring the checkpoint layer's ``KEEP_GENERATIONS=2``). Pruning
+  an epoch that NO later recovery has replayed would drop acknowledged
+  live state, so `RequestJournal.prune` refuses that typed
+  (`JournalRetentionError`) instead of guessing.
 """
 from __future__ import annotations
 
@@ -58,20 +65,26 @@ from typing import List, Optional, Tuple
 __all__ = [
     "JOURNAL_SCHEMA_VERSION",
     "JournalCorruptError",
+    "JournalRetentionError",
     "RecoveredError",
     "RequestJournal",
     "journal_enabled",
     "journal_env_dir",
     "journal_fsync",
+    "journal_keep",
     "read_journal",
 ]
 
 JOURNAL_SCHEMA_VERSION = 1
 
 #: Record kinds the gate appends (docs/resilience.md documents each).
+#: ``adopted`` is the fleet hop (pafleet): per-rid markers a surviving
+#: replica writes INTO a dead peer's journal when it takes the peer's
+#: live requests over, plus the adopter-side summary — a restarted
+#: peer's recovery sees the marker and refuses to re-solve.
 RECORD_KINDS = (
     "epoch", "admitted", "dispatched", "chunk", "completed", "failed",
-    "shed", "shutdown", "recovered",
+    "shed", "shutdown", "recovered", "adopted",
 )
 
 
@@ -91,12 +104,33 @@ def journal_fsync() -> bool:
     return os.environ.get("PA_GATE_JOURNAL_FSYNC", "1") != "0"
 
 
+def journal_keep() -> Optional[int]:
+    """``PA_GATE_JOURNAL_KEEP``: how many journal epochs (generations)
+    to retain at a post-recovery prune, including the current one.
+    Unset/empty/``0``/malformed = None = keep everything (the
+    pre-retention behavior)."""
+    raw = os.environ.get("PA_GATE_JOURNAL_KEEP", "").strip()
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    return max(1, n) if n > 0 else None
+
+
 class JournalCorruptError(RuntimeError):
     """A journal record that is NOT the torn tail failed its CRC or
     would not parse — acknowledged history has been damaged (bit rot,
     a concurrent writer, manual editing). Deliberately distinct from
     the torn-tail case, which is the expected crash artifact and is
     truncated with an event instead of raised."""
+
+
+class JournalRetentionError(RuntimeError):
+    """A prune would drop segment files of an epoch NO later recovery
+    has replayed — acknowledged live state (queued/in-flight requests,
+    unserved results) would be lost. Retention only ages out history
+    that a ``recovered`` record in a LATER epoch proves was folded into
+    a live gate; everything younger is refused typed."""
 
 
 class RecoveredError(RuntimeError):
@@ -246,6 +280,9 @@ class RequestJournal:
             default=-1,
         )
         self._segment_n = 0
+        #: True once THIS epoch appended a ``recovered`` record — the
+        #: retention frontier extends to the current epoch then.
+        self._recovered_marked = False
         self._fh = open(self._segment_path(), "ab")
         _fsync_dir(self.directory)
         self.append("epoch", epoch=self.epoch,
@@ -286,6 +323,8 @@ class RequestJournal:
             if self.fsync and (_sync is None or _sync):
                 os.fsync(self._fh.fileno())
             registry().counter("journal.appends").inc()
+            if kind == "recovered":
+                self._recovered_marked = True
             if self._fh.tell() >= self.segment_bytes:
                 self._rotate()
             return rec
@@ -306,6 +345,77 @@ class RequestJournal:
 
     def segments(self) -> List[str]:
         return _segments(self.directory)
+
+    def _recovered_frontier(self) -> int:
+        """The newest epoch proven replayed-from: the max epoch holding
+        a ``recovered`` record (every epoch BELOW it was folded into a
+        live gate by that recovery). 0 = no recovery ever ran."""
+        frontier = 0
+        cur = 0
+        for rec in self.prior_records:
+            kind = rec.get("kind")
+            if kind == "epoch":
+                cur = int(rec.get("epoch", cur))
+            elif kind == "recovered":
+                frontier = max(frontier, cur)
+        if self._recovered_marked:
+            frontier = max(frontier, self.epoch)
+        return frontier
+
+    def prune(self, keep: Optional[int] = None) -> List[str]:
+        """Retention (``PA_GATE_JOURNAL_KEEP``): drop the segment files
+        of the OLDEST epochs until at most ``keep`` epochs (including
+        the current one) remain on disk — mirroring the checkpoint
+        layer's ``KEEP_GENERATIONS`` convention. Only fully-recovered
+        epochs (strictly below the `_recovered_frontier`) may be
+        dropped; an epoch no later recovery has replayed still holds
+        acknowledged live state, so dropping it raises the typed
+        `JournalRetentionError` and NOTHING is unlinked. Returns the
+        pruned file paths (counted under ``journal.pruned`` and evented
+        ``journal_pruned``). ``keep=None`` reads the env knob; env
+        unset means retention is off and this is a no-op."""
+        from ..telemetry import emit_event
+        from ..telemetry.registry import registry
+
+        keep = journal_keep() if keep is None else max(1, int(keep))
+        if keep is None:
+            return []
+        with self._lock:
+            by_epoch: dict = {}
+            for seg in _segments(self.directory):
+                name = os.path.basename(seg)
+                try:
+                    epoch = int(name.split("-")[1])
+                except (IndexError, ValueError):
+                    continue  # not a segment file we own
+                by_epoch.setdefault(epoch, []).append(seg)
+            epochs = sorted(by_epoch)
+            drop = epochs[:-keep] if len(epochs) > keep else []
+            if not drop:
+                return []
+            frontier = self._recovered_frontier()
+            unrecovered = [e for e in drop if e >= frontier]
+            if unrecovered:
+                raise JournalRetentionError(
+                    f"journal {self.directory}: pruning to KEEP={keep} "
+                    f"would drop epoch(s) {unrecovered} that no later "
+                    "recovery has replayed (recovered frontier: "
+                    f"{frontier or 'none'}) — their admitted requests "
+                    "and results are still live state; run recover() "
+                    "first or raise PA_GATE_JOURNAL_KEEP"
+                )
+            pruned: List[str] = []
+            for epoch in drop:
+                for seg in by_epoch[epoch]:
+                    os.unlink(seg)
+                    pruned.append(seg)
+            _fsync_dir(self.directory)
+        registry().counter("journal.pruned").inc(len(pruned))
+        emit_event(
+            "journal_pruned", label=self.directory,
+            epochs=[int(e) for e in drop], files=len(pruned), keep=keep,
+        )
+        return pruned
 
     def close(self) -> None:
         with self._lock:

@@ -26,8 +26,18 @@ Endpoints (the request-handle lifecycle is submit-poll-fetch):
   ``{x, info}`` once done or ``{error, message}`` once failed.
 * ``GET /v1/tenants`` — the residency table (resident/evicted,
   footprint vs budget).
-* ``GET /healthz`` — liveness + queue depth.
+* ``GET /healthz`` — liveness + queue depth + shed watermark (fleet
+  peers read headroom here before forwarding).
 * ``GET /metrics`` — the pamon Prometheus text exposition.
+* ``GET /metrics.json`` — the registry snapshot as JSON (the
+  ``pamon --fleet`` per-replica feed).
+
+Fleet (frontdoor.fleet): with a ``peer_picker`` installed on the
+server, a `LoadShedded` overload becomes an HTTP 307 redirect to a
+peer replica with headroom (``Location`` + ``forwarded_to``) instead
+of a 429 — `http_solve` follows it with the same body, idempotency
+key, and traceparent, so forwarding can neither double-solve nor fork
+the trace. Solo gates (no picker) keep the 429 behavior bit-for-bit.
 
 `serve_gate` wires a pump thread (EDF dispatch + SLO accounting) next
 to the HTTP threads; `tools/pagate.py` is the CLI
@@ -126,6 +136,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "ok": True,
                 "tenants": len(gate.registry._tenants),
                 "queue_depth": gate.depth(),
+                # fleet peers forward shed traffic only to a replica
+                # with advertised headroom (depth < its OWN watermark)
+                "shed_watermark": gate.watermark,
                 "classes": list(gate.classes),
                 "resident": sorted(
                     r["tenant"] for r in gate.residency()
@@ -142,6 +155,11 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             self._text(200, registry().to_prometheus(),
                        "text/plain; version=0.0.4")
+        elif self.path == "/metrics.json":
+            # the machine-readable registry snapshot (pamon --fleet
+            # reads every replica's counters through this — each
+            # replica process has its OWN registry)
+            self._json(200, registry().snapshot())
         elif self.path == "/v1/tenants":
             self._json(200, {
                 "tenants": gate.residency(),
@@ -237,6 +255,42 @@ class _Handler(BaseHTTPRequestHandler):
                 **kwargs,
             )
         except LoadShedded as e:
+            # fleet shed-forwarding: before telling the client to back
+            # off, ask the fleet for a peer with headroom (the picker
+            # reads peer /healthz depths) and redirect the SUBMIT there
+            # — 307 preserves the POST method + body, so the peer sees
+            # the identical request (same idempotency key, same
+            # traceparent: one stitched trace across the hop) and a
+            # forwarded duplicate can never double-solve
+            peer = None
+            picker = getattr(self.server, "peer_picker", None)
+            if picker is not None:
+                try:
+                    peer = picker()
+                except Exception:
+                    peer = None  # a broken picker degrades to 429
+            if peer:
+                from .. import telemetry
+
+                registry().counter("fleet.forwarded").inc()
+                telemetry.emit_event(
+                    "fleet_forwarded", label=peer,
+                    slo_class=body.get("slo_class"),
+                )
+                self._json(
+                    307,
+                    {"error": "LoadShedded", "message": str(e),
+                     "forwarded_to": peer,
+                     "retry_after_s": e.retry_after_s,
+                     "diagnostics": e.diagnostics},
+                    headers={
+                        "Location": peer.rstrip("/") + "/v1/solve",
+                        "Retry-After": max(
+                            1, int(round(e.retry_after_s))
+                        ),
+                    },
+                )
+                return
             self._json(
                 429,
                 {"error": "LoadShedded", "message": str(e),
@@ -308,6 +362,11 @@ class GateServer(ThreadingHTTPServer):
         #: the OLDEST terminal handles are pruned past this; live
         #: handles are never dropped.
         self.max_handles = max(1, int(max_handles))
+        #: Fleet hook (frontdoor.fleet.FleetMember.pick_peer): a
+        #: zero-arg callable returning a peer base URL with headroom,
+        #: or None — consulted on `LoadShedded` to 307-forward instead
+        #: of 429. Solo gates leave it None (behavior unchanged).
+        self.peer_picker = None
         self._hlock = threading.Lock()
         self._stop = threading.Event()
         self._pump: Optional[threading.Thread] = None
@@ -437,6 +496,15 @@ def http_solve(base_url: str, tenant: str, b, x0=None,
     * a 429 `LoadShedded` honors the server's measured ``Retry-After``
       (capped at ``retry_cap_s``) before resubmitting, up to
       ``retries`` times — no hand-rolled sleeps in callers;
+    * a 503 `AdmissionRejected` (queue-full/draining backpressure) is
+      retried the same way — exponential backoff (no server hint)
+      under the same ``timeout_s`` budget;
+    * a 307 fleet shed-forward is FOLLOWED (always, independent of
+      ``retries``; hop cap 4): the submit reposts the identical body
+      to the peer in ``Location`` and subsequent polls go to the peer
+      — carrying the same idempotency key and traceparent, so a
+      forwarded duplicate never double-solves and the trace stays one
+      tree across the hop;
     * pair ``retries`` with ``idempotency_key`` and a retried submit
       can NEVER double-solve: the gate returns the original id (and
       bitwise result) for a replayed key.
@@ -511,18 +579,44 @@ def http_solve(base_url: str, tenant: str, b, x0=None,
 
     status, sub, headers = _post()
     shed_tries = 0
-    while (
-        status == 429 and shed_tries < retries
-        and time.monotonic() < deadline_at
-    ):
-        # honor the measured Retry-After (capped) before resubmitting
-        ra = (
-            sub.get("retry_after_s")
-            or headers.get("Retry-After") or 1.0
-        )
-        sleep(min(max(0.0, float(ra)), retry_cap_s))
-        shed_tries += 1
-        status, sub, headers = _post()
+    hops = 0
+    while True:
+        if (
+            status == 307 and headers.get("Location")
+            and hops < 4 and time.monotonic() < deadline_at
+        ):
+            # fleet shed-forward: the replica redirected this SUBMIT
+            # to a peer with headroom — rebase and repost the SAME
+            # body (same idempotency key + traceparent, so the hop
+            # cannot double-solve and the trace stays one tree). The
+            # polls follow the new base too: the peer owns the handle.
+            # Hop cap 4 bounds redirect ping-pong in a thrashing fleet.
+            loc = headers["Location"]
+            base_url = (
+                loc[: -len("/v1/solve")]
+                if loc.endswith("/v1/solve") else loc
+            )
+            hops += 1
+            status, sub, headers = _post()
+            continue
+        if (
+            status in (429, 503) and shed_tries < retries
+            and time.monotonic() < deadline_at
+        ):
+            # 429 LoadShedded carries the server's measured
+            # Retry-After; 503 AdmissionRejected (queue-full/draining
+            # backpressure) is equally transient but unhinted —
+            # exponential backoff under the same timeout_s budget
+            ra = (
+                sub.get("retry_after_s")
+                or headers.get("Retry-After")
+                or 0.05 * 2 ** shed_tries
+            )
+            sleep(min(max(0.0, float(ra)), retry_cap_s))
+            shed_tries += 1
+            status, sub, headers = _post()
+            continue
+        break
     if status not in (200, 202):
         sub["http_status"] = status
         if headers.get("Retry-After"):

@@ -76,6 +76,7 @@ from .journal import (
     RequestJournal,
     journal_enabled,
     journal_env_dir,
+    journal_keep,
 )
 from .tenancy import OperatorRegistry
 
@@ -302,6 +303,7 @@ class Gate:
         clock: Optional[Callable[[], float]] = None,
         start_workers: bool = False,
         journal_dir: Optional[str] = None,
+        rid_namespace: Optional[str] = None,
     ):
         self.registry = OperatorRegistry(
             mem_budget_bytes=mem_budget_bytes,
@@ -341,9 +343,16 @@ class Gate:
         #: old client still polls (journaled gates use the journal's
         #: monotonic epoch instead, so recovered ids stay resolvable).
         self._epoch_token = secrets.token_hex(3)
+        #: Fleet replicas prefix their rids (``<ns>-r<epoch>-<n>``) so
+        #: ids stay collision-safe when a survivor ADOPTS a dead peer's
+        #: handles next to its own (two solo gates both mint ``r1-0``).
+        self.rid_namespace = (
+            str(rid_namespace) if rid_namespace else None
+        )
         self._handles: Dict[str, GateHandle] = {}  # rid -> handle
         self._idem: Dict[str, str] = {}  # idempotency key -> rid
         self._recovered = False  # recover() is one-shot
+        self._adopted_dirs: set = set()  # adopt() is per-dir idempotent
         if self.journal is not None:
             self.registry.on_page_in = self._install_chunk_hook
         # an eviction's drained requests re-enter the EDF queue and
@@ -355,7 +364,8 @@ class Gate:
             self.journal.epoch if self.journal is not None
             else self._epoch_token
         )
-        return f"r{epoch}-{seq}"
+        rid = f"r{epoch}-{seq}"
+        return f"{self.rid_namespace}-{rid}" if self.rid_namespace else rid
 
     def handle(self, rid: str) -> Optional[GateHandle]:
         """The handle for a (possibly pre-restart) request id, or None
@@ -1028,22 +1038,25 @@ class Gate:
             self.registry.on_page_in = self._install_chunk_hook
             for name, t in self.registry._tenants.items():
                 self._install_chunk_hook(name, t)
-        states: Dict[str, dict] = {}
-        order: List[str] = []
-        for rec in self.journal.prior_records:
-            kind, rid = rec.get("kind"), rec.get("rid")
-            if kind == "admitted":
-                if rid not in states:
-                    order.append(rid)
-                states[rid] = {"admitted": rec}
-            elif rid in states and kind in (
-                "dispatched", "chunk", "completed", "failed"
-            ):
-                states[rid][kind] = rec
+        keep = journal_keep()
+        states, order = self._fold_records(self.journal.prior_records)
         summary = {
             "completed": 0, "failed": 0, "resumed": 0,
-            "requeued": 0, "expired": 0,
+            "requeued": 0, "expired": 0, "adopted_away": 0,
         }
+        if keep is not None:
+            # Retention compaction: every still-live rid (no terminal,
+            # no adoption marker) gets its ``admitted`` record COPIED
+            # into the current epoch BEFORE replay, so pruning the
+            # prior epochs cannot orphan a request the gate still owes.
+            # Copies precede any terminal this replay writes (fold
+            # order: admitted must come first). Terminal history in
+            # pruned epochs ages out with them — that is the
+            # documented idempotency-replay horizon.
+            for rid in order:
+                if not ({"completed", "failed", "adopted"}
+                        & states[rid].keys()):
+                    self._rejournal_admitted(states[rid]["admitted"])
         for rid in order:
             outcome = self._recover_one(rid, states[rid])
             summary[outcome] += 1
@@ -1057,10 +1070,134 @@ class Gate:
         telemetry.emit_event(
             "gate_recovered", label=self.journal.directory, **summary
         )
+        if keep is not None:
+            self.journal.prune(keep)
         return summary
 
-    def _recover_one(self, rid: str, st: dict) -> str:
-        """Recover one journaled request; returns its outcome key."""
+    @staticmethod
+    def _fold_records(records) -> tuple:
+        """Fold a journal's record stream into per-rid state dicts
+        (admission-ordered). Lifecycle records whose ``admitted`` lives
+        in a pruned epoch are orphans and are skipped — retention
+        compaction re-copies live admissions forward precisely so this
+        never drops an owed request."""
+        states: Dict[str, dict] = {}
+        order: List[str] = []
+        for rec in records:
+            kind, rid = rec.get("kind"), rec.get("rid")
+            if kind == "admitted":
+                if rid not in states:
+                    order.append(rid)
+                states[rid] = {"admitted": rec}
+            elif rid in states and kind in (
+                "dispatched", "chunk", "completed", "failed", "adopted"
+            ):
+                states[rid][kind] = rec
+        return states, order
+
+    def _rejournal_admitted(self, adm: dict) -> None:
+        """Append a copy of an ``admitted`` record into THIS gate's
+        current epoch (journal bookkeeping keys are re-minted)."""
+        payload = {
+            k: v for k, v in adm.items()
+            if k not in ("kind", "seq", "crc", "wall")
+        }
+        self.journal.append("admitted", **payload)
+
+    def adopt(self, journal_dir: str, source: str = "peer") -> dict:
+        """Adopt a DEAD peer replica's journal into this live gate —
+        the fleet failover half of `recover()` (frontdoor.fleet decides
+        WHEN via lease staleness; this method is the mechanism):
+
+        * terminal requests (completed/failed) become poll-servable
+          handles replaying the peer's recorded results — NOT
+          re-journaled (the peer journal stays their durable home, so
+          the journal union keeps one terminal record per rid);
+        * live requests (queued/dispatched/chunk-checkpointed) are
+          first re-journaled ``admitted`` into THIS gate's journal
+          (write-ahead: if the survivor also dies, ITS recovery re-owns
+          them), then marked ``adopted`` in the PEER's journal (a
+          restarted peer folds the marker into a typed
+          ``AdoptedByPeer`` refusal instead of double-solving), then
+          resubmitted exactly as `recover()` would — same checkpoint
+          resume, deadline-clock, and trace-stitching rules (the
+          admitted record carries trace_id/root_span_id, so the
+          adopting replica's spans join the client's original trace);
+        * live requests whose tenant is not registered HERE are
+          skipped, not failed — they stay un-adopted in the peer
+          journal for a replica that can serve them.
+
+        Per-dir idempotent (a repeat adopt of the same journal dir is a
+        no-op) and rid-idempotent (a rid already held here — e.g. a
+        previous partial adoption — is skipped). Counted per-outcome
+        under ``fleet.adopted`` and evented ``request_adopted`` /
+        ``fleet_adopted``. Requires a journaling gate with a distinct
+        journal dir (adopting your OWN journal is `recover()`'s job and
+        refuses here)."""
+        from .. import telemetry
+
+        check(
+            self.journal is not None,
+            "gate: adopt() needs this gate to journal — a non-durable "
+            "survivor could lose the adopted requests it acknowledged",
+        )
+        peer_dir = os.path.abspath(journal_dir)
+        check(
+            peer_dir != os.path.abspath(self.journal.directory),
+            "gate: adopt() got this gate's OWN journal dir — replaying "
+            "your own journal is recover(), not adoption",
+        )
+        if peer_dir in self._adopted_dirs:
+            return {"skipped_dir": peer_dir}
+        self._adopted_dirs.add(peer_dir)
+        peer = RequestJournal(peer_dir)
+        try:
+            states, order = self._fold_records(peer.prior_records)
+            summary = {
+                "completed": 0, "failed": 0, "resumed": 0,
+                "requeued": 0, "expired": 0, "skipped": 0,
+            }
+            for rid in order:
+                st = states[rid]
+                live = not (
+                    {"completed", "failed", "adopted"} & st.keys()
+                )
+                if "adopted" in st or rid in self._handles:
+                    summary["skipped"] += 1
+                    continue
+                if live:
+                    tenant = st["admitted"].get("tenant")
+                    if tenant not in self.registry._tenants:
+                        summary["skipped"] += 1
+                        continue
+                    self._rejournal_admitted(st["admitted"])
+                    peer.append(
+                        "adopted", rid=rid,
+                        by=self.rid_namespace or "survivor",
+                        source=source,
+                    )
+                outcome = self._recover_one(
+                    rid, st, adopted_from=peer_dir
+                )
+                summary[outcome] += 1
+                registry().counter(
+                    "fleet.adopted", labels={"outcome": outcome}
+                ).inc()
+                telemetry.emit_event(
+                    "request_adopted", label=rid, outcome=outcome,
+                    source=peer_dir,
+                )
+        finally:
+            peer.close()
+        telemetry.emit_event(
+            "fleet_adopted", label=peer_dir, **summary
+        )
+        return summary
+
+    def _recover_one(self, rid: str, st: dict,
+                     adopted_from: Optional[str] = None) -> str:
+        """Recover one journaled request; returns its outcome key.
+        ``adopted_from`` tags the fleet-failover path (`adopt()`)."""
         import numpy as np
 
         from ..models.solvers import scatter_pvector_values
@@ -1071,6 +1208,20 @@ class Gate:
         key = adm.get("idempotency_key")
         if key:
             self._idem[key] = rid
+        if "adopted" in st:
+            # a peer replica took this request while we were down —
+            # refuse typed instead of double-solving it (the adopter's
+            # journal is its durable home now)
+            rec = st["adopted"]
+            h = self._terminal_handle(adm, rid, outcome="adopted_away")
+            h._error = RecoveredError(
+                "AdoptedByPeer",
+                f"request {rid}: replica {rec.get('by')!r} adopted "
+                "this request after a missed lease — poll the "
+                "adopting replica (or resubmit with the same "
+                "idempotency key through the fleet router)",
+            )
+            return "adopted_away"
         if "completed" in st:
             rec = st["completed"]
             h = self._terminal_handle(adm, rid, outcome="completed")
@@ -1169,7 +1320,9 @@ class Gate:
             # trace_id and parents its new root to the pre-crash root
             # span — one tree across the kill, zero orphans (the old
             # root survives as an interrupted span in PA_TX_DIR)
-            h.span_root = self._recovered_root(adm, rid, outcome)
+            h.span_root = self._recovered_root(
+                adm, rid, outcome, adopted_from=adopted_from
+            )
             h.trace = (
                 h.span_root.ctx if h.span_root.recording else None
             )
@@ -1183,15 +1336,23 @@ class Gate:
             self._queue.sort(key=_edf_key)
         return outcome
 
-    def _recovered_root(self, adm: dict, rid: str, outcome: str):
+    def _recovered_root(self, adm: dict, rid: str, outcome: str,
+                        adopted_from: Optional[str] = None):
         """A post-recovery root span continuing the journaled trace
-        (fresh trace when the pre-crash gate ran with PA_TX=0)."""
+        (fresh trace when the pre-crash gate ran with PA_TX=0). With a
+        shared PA_TX_DIR across a fleet, an adopted request's new root
+        lands in the SAME trace as the dead replica's spans — one tree
+        across the replica hop."""
         tid = adm.get("trace_id") or None
+        extra = (
+            {"adopted_from": adopted_from} if adopted_from else {}
+        )
         return tracing.start_span(
             "rpc.request", name=adm.get("tag") or rid,
             trace_id=tid,
             parent_id=adm.get("root_span_id") if tid else None,
             recovered=outcome, rid=rid, tenant=adm.get("tenant"),
+            **extra,
         )
 
     def _terminal_handle(self, adm: dict, rid: str,

@@ -315,6 +315,29 @@ CATALOG: Dict[str, MetricSpec] = {
               "forecast error, labeled by tenant (operator fingerprint "
               "for unnamed services) — the pamon --conv feed",
               labels=("tenant",)),
+        # -- PR 18 gate fleet (pafleet) -------------------------------
+        _spec("fleet.forwarded", "counter", "1",
+              "frontdoor/rpc.py:do_POST",
+              "shed submits 307-redirected to a peer replica with "
+              "headroom instead of 429 backoff (the peer admits the "
+              "identical body: same idempotency key, same trace)"),
+        _spec("fleet.adopted", "counter", "1",
+              "frontdoor/scheduler.py:adopt",
+              "a dead peer's journaled requests adopted by this "
+              "survivor, by outcome (same keys as gate.recovered, "
+              "plus skipped for already-adopted/unservable rids)",
+              labels=("outcome",)),
+        _spec("fleet.lease_missed", "counter", "1",
+              "frontdoor/fleet.py:check_peers",
+              "peer replicas declared dead after a stale lease "
+              "(> 3x PA_FLEET_LEASE_S) — each increments once and "
+              "triggers journal adoption by the ranked survivor"),
+        _spec("journal.pruned", "counter", "1",
+              "frontdoor/journal.py:prune",
+              "journal segment files unlinked by retention "
+              "(PA_GATE_JOURNAL_KEEP) — only epochs at or behind the "
+              "recovered frontier; otherwise typed "
+              "JournalRetentionError and nothing is dropped"),
     ]
 }
 

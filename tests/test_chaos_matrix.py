@@ -68,6 +68,16 @@ with its documented outcome, event trail, and metric deltas
 | torn journal tail (crash mid-append) | per-record CRC32 at replay | tail truncated (journal.truncated + journal_truncated event), clean prefix recovered intact; mid-file corruption raises typed JournalCorruptError instead |
 | duplicate idempotency-key submit | gate key map (journal-rebuilt) | original id + bitwise result returned (gate.idempotent_hits + idempotent_replay event); service.admitted does NOT move — a single solve, across restarts included |
 
+Round 16 (pafleet): the REPLICATION rows — faults hitting the fleet
+layer, each with its documented outcome, event trail, and metric
+deltas (docs/service.md Gate fleet):
+
+| condition               | detector            | documented outcome   |
+|-------------------------|---------------------|----------------------|
+| replica killed (kill -9 semantics: lease goes stale) | peer lease watcher | the rendezvous-ranked survivor adopts the dead replica's journal (fleet.lease_missed + fleet_lease_missed, fleet.adopted{outcome=…} + request_adopted/fleet_adopted) and completes its live requests under their ORIGINAL rids — zero lost; the victim's journal carries the adopted marker, so a restarted victim refuses typed (AdoptedByPeer) — zero duplicated; ONE stitched trace across the hop |
+| overload on one replica with peer headroom | shed-forward peer picker | HTTP 307 to the shallowest live-leased peer (fleet.forwarded + fleet_forwarded) instead of 429; `http_solve` follows with the same idempotency key + traceparent, the request solves on the peer, one stitched trace |
+| torn/corrupt lease file | lease CRC at the reader | typed LeaseCorruptError from check_peers — REFUSED takeover (no adoption, no adopted marker, fleet.lease_missed does NOT move); pick_peer degrades to None (429 fallback), never a false forward |
+
 Round 17 (paspec): the convergence observatory adds the PREDICTIVE
 refusal row — overload the scheduler can see COMING instead of
 discovering by burning iterations (docs/observability.md "Convergence
@@ -900,6 +910,300 @@ def test_matrix_duplicate_idempotency_key_single_solve(tmp_path):
             m1["gate.idempotent_hits"] + 1
         )
         assert m2["service.admitted"] == m1["service.admitted"]
+        return True
+
+    _run(driver)
+
+
+# ---------------------------------------------------------------------------
+# round 16 — the fleet (pafleet) rows
+# ---------------------------------------------------------------------------
+
+
+def test_matrix_fleet_replica_death_peer_adopts_journal(tmp_path):
+    """Fleet row 1: a replica dies with kill -9 semantics (state
+    abandoned, lease goes stale) while holding a queued request — the
+    documented outcome is journal-backed peer failover: the
+    rendezvous-ranked survivor counts the missed lease, adopts the
+    victim's journal, and completes the request under its ORIGINAL rid
+    bitwise-equal to the solo solve (zero lost); the adopted marker in
+    the victim's journal makes a restarted victim refuse typed
+    (AdoptedByPeer — zero duplicated), exactly one completed record
+    exists across the journal union, and patx stitches ONE trace
+    across the replica hop."""
+    import os
+    import time
+
+    from partitionedarrays_jl_tpu.frontdoor import (
+        Gate,
+        RecoveredError,
+        fleet,
+        read_journal,
+    )
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        x_direct, _ = cg(A, b, x0=x0, tol=1e-9)
+        fd = str(tmp_path / "fleet")
+        g0dir = os.path.join(fd, "g0")
+        os.makedirs(g0dir)
+        victim = Gate(journal_dir=g0dir, rid_namespace="g0")
+        victim.register("t", A, kmax=4)
+        h = victim.submit("t", b, x0=x0, tol=1e-9, tag="orphaned",
+                          idempotency_key="fleet-key")
+        fleet.write_lease(
+            os.path.join(g0dir, fleet.LEASE_NAME), "g0", depth=1
+        )
+        # ---- kill -9: the victim is abandoned mid-queue ----
+        survivor = Gate(
+            journal_dir=os.path.join(fd, "g1"), rid_namespace="g1"
+        )
+        survivor.register("t", A, kmax=4)
+        member = fleet.FleetMember(fd, "g1", survivor, lease_s=0.05)
+        member.heartbeat()
+        m0 = _metric_state(
+            "fleet.lease_missed", "fleet.adopted{outcome=requeued}",
+            "service.admitted",
+        )
+        ev0 = telemetry.counter("events.fleet_lease_missed")
+        eva0 = telemetry.counter("events.request_adopted")
+        time.sleep(0.2)  # > 3 x lease_s: the victim's lease is stale
+        adopted = member.check_peers()
+        assert set(adopted) == {"g0"}, adopted
+        assert adopted["g0"]["requeued"] == 1, adopted
+        m1 = _metric_state(
+            "fleet.lease_missed", "fleet.adopted{outcome=requeued}",
+            "service.admitted",
+        )
+        d = {k: m1[k] - m0[k] for k in m0}
+        assert d["fleet.lease_missed"] == 1, d
+        assert d["fleet.adopted{outcome=requeued}"] == 1, d
+        assert telemetry.counter("events.fleet_lease_missed") == ev0 + 1
+        assert telemetry.counter("events.request_adopted") == eva0 + 1
+        # the sweep is once-per-death: a second pass adopts nothing
+        assert member.check_peers() == {}
+        # the ORIGINAL rid completes on the survivor, bitwise
+        survivor.drain()
+        x, info = survivor.handle(h.rid).result()
+        assert info["converged"]
+        np.testing.assert_array_equal(
+            gather_pvector(x), gather_pvector(x_direct)
+        )
+        # zero lost, zero duplicated: one completed record across the
+        # union, and the victim's journal carries the adopted marker
+        union = read_journal(g0dir) + read_journal(
+            os.path.join(fd, "g1")
+        )
+        completed = [
+            r for r in union
+            if r.get("kind") == "completed" and r.get("rid") == h.rid
+        ]
+        assert len(completed) == 1, "exactly one solve fleet-wide"
+        assert any(
+            r.get("kind") == "adopted" and r.get("rid") == h.rid
+            and r.get("by") == "g1"
+            for r in read_journal(g0dir)
+        )
+        # a RESTARTED victim folds the marker and refuses typed —
+        # never a second solve (service.admitted moved exactly once)
+        back = Gate(journal_dir=g0dir, rid_namespace="g0")
+        back.register("t", A, kmax=4)
+        s = back.recover()
+        assert s["adopted_away"] == 1, s
+        with pytest.raises(RecoveredError, match="adopted") as ei:
+            back.handle(h.rid).result()
+        assert ei.value.error_type == "AdoptedByPeer"
+        m2 = _metric_state("service.admitted")
+        assert m2["service.admitted"] == m0["service.admitted"] + 1
+        # an idempotent resubmit against the survivor replays the
+        # original rid (the key map crossed the hop with the journal)
+        assert survivor.submit(
+            "t", b, idempotency_key="fleet-key"
+        ).rid == h.rid
+        # patx continuity: ONE trace — the adopted root parents into
+        # the victim's interrupted root, zero orphans
+        from partitionedarrays_jl_tpu.telemetry import tracing
+
+        survivor.account()
+        tid = h.trace.trace_id
+        spans = tracing.recorded_spans()
+        assert tracing.verify_trace(spans, tid) == []
+        mine = [s for s in spans if s["trace_id"] == tid]
+        roots = [s for s in mine if s["kind"] == "rpc.request"]
+        pre = [s for s in roots if not s["attrs"].get("recovered")]
+        post = [s for s in roots if s["attrs"].get("recovered")]
+        # the survivor's adoption AND the restarted victim's
+        # adopted_away terminal each stitch a recovered root — both
+        # must parent into the single interrupted pre-crash root
+        assert len(pre) == 1 and len(post) >= 1
+        assert all(s["parent_id"] == pre[0]["span_id"] for s in post)
+        assert any(
+            s["attrs"].get("adopted_from") == g0dir for s in post
+        )
+        _, orphans = tracing.span_tree(mine)
+        assert not orphans
+        return True
+
+    _run(driver)
+
+
+def test_matrix_fleet_shed_forward_redirect(tmp_path):
+    """Fleet row 2: overload on one replica while a live-leased peer
+    has headroom — the documented outcome is a 307 shed-forward
+    (fleet.forwarded + fleet_forwarded) instead of the 429: the client
+    reposts the identical body to the peer, the request SOLVES there
+    (rid carries the peer's namespace), and the whole exchange — the
+    shed refusal on the owner plus the solve on the peer — is ONE
+    stitched trace."""
+    import os
+
+    from partitionedarrays_jl_tpu.frontdoor import (
+        Gate,
+        fleet,
+        http_solve,
+        serve_gate,
+    )
+    from partitionedarrays_jl_tpu.models.solvers import gather_pvector
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        fd = str(tmp_path / "fleet")
+        g0 = Gate(shed_watermark=1, rid_namespace="g0")
+        g0.register("t", A, kmax=4)
+        g1 = Gate(rid_namespace="g1", start_workers=True)
+        g1.register("t", A, kmax=4)
+        srv0, srv1 = serve_gate(g0, port=0), serve_gate(g1, port=0)
+        try:
+            m0f = fleet.FleetMember(fd, "g0", g0, server=srv0,
+                                    lease_s=30.0)
+            m1f = fleet.FleetMember(fd, "g1", g1, server=srv1,
+                                    lease_s=30.0)
+            m0f.heartbeat()
+            m1f.heartbeat()
+            m0f.map.write_url("g0", srv0.url)
+            m1f.map.write_url("g1", srv1.url)
+            srv0.peer_picker = m0f.pick_peer
+            # build g0's backlog past the watermark with dispatch held
+            g0.paused = True
+            held = g0.submit("t", b, x0=x0, tol=1e-9,
+                             slo_class="interactive", tag="held")
+            m0 = _metric_state(
+                "fleet.forwarded", "gate.shed{slo_class=besteffort}",
+            )
+            ev0 = telemetry.counter("events.fleet_forwarded")
+            bg, x0g = gather_pvector(b), gather_pvector(x0)
+            out = http_solve(
+                srv0.url, "t", bg, x0=x0g, tol=1e-9,
+                slo_class="besteffort", tag="forwarded",
+                idempotency_key="fwd-key",
+            )
+            assert out["state"] == "done", out
+            assert out["id"].startswith("g1-"), (
+                "the solve must land on the PEER's rid namespace"
+            )
+            m1 = _metric_state(
+                "fleet.forwarded", "gate.shed{slo_class=besteffort}",
+            )
+            d = {k: m1[k] - m0[k] for k in m0}
+            assert d["fleet.forwarded"] == 1, d
+            assert d["gate.shed{slo_class=besteffort}"] == 1, (
+                "the shed still counts — forwarding rides ON the "
+                "refusal, it does not hide it"
+            )
+            assert telemetry.counter("events.fleet_forwarded") == (
+                ev0 + 1
+            )
+            # one stitched trace: the owner's shed span AND the peer's
+            # request tree share the client's trace id, zero orphans
+            from partitionedarrays_jl_tpu.telemetry import tracing
+
+            g1.account()
+            tid = out["trace_id"]
+            spans = tracing.recorded_spans()
+            assert tracing.verify_trace(spans, tid) == []
+            mine = [s for s in spans if s["trace_id"] == tid]
+            kinds = {s["kind"] for s in mine}
+            assert "gate.shed" in kinds, "the refusal is in-trace"
+            assert "rpc.request" in kinds, "the peer solve is in-trace"
+            _, orphans = tracing.span_tree(mine)
+            assert not orphans
+            # the held request was untouched by the forward
+            g0.paused = False
+            g0.drain()
+            assert held.result()[1]["converged"]
+        finally:
+            srv0.stop(drain=False)
+            srv1.stop(drain=False)
+        return True
+
+    _run(driver)
+
+
+def test_matrix_fleet_torn_lease_refuses_takeover(tmp_path):
+    """Fleet row 3: a peer's lease file is torn (crash or disk fault
+    mid-write straight to the final name) — the documented outcome is
+    the typed `LeaseCorruptError` REFUSING takeover: a corrupt lease
+    is evidence of unknown state, not of death, and a false takeover
+    (two replicas solving one journal) is the one unrecoverable
+    outcome. No adoption happens, no adopted marker lands, the
+    fleet.lease_missed/fleet.adopted counters do NOT move, and
+    pick_peer degrades to None (the 429 fallback) instead of
+    forwarding into the unknown."""
+    import os
+
+    from partitionedarrays_jl_tpu.frontdoor import (
+        Gate,
+        LeaseCorruptError,
+        fleet,
+        read_journal,
+    )
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        fd = str(tmp_path / "fleet")
+        g0dir = os.path.join(fd, "g0")
+        os.makedirs(g0dir)
+        victim = Gate(journal_dir=g0dir, rid_namespace="g0")
+        victim.register("t", A, kmax=4)
+        victim.submit("t", b, x0=x0, tol=1e-9, tag="in-limbo")
+        lease_path = os.path.join(g0dir, fleet.LEASE_NAME)
+        fleet.write_lease(lease_path, "g0", depth=1)
+        raw = open(lease_path).read()
+        open(lease_path, "w").write(raw[: len(raw) // 2])  # torn
+        survivor = Gate(
+            journal_dir=os.path.join(fd, "g1"), rid_namespace="g1"
+        )
+        survivor.register("t", A, kmax=4)
+        member = fleet.FleetMember(fd, "g1", survivor, lease_s=0.05)
+        member.heartbeat()
+        m0 = _metric_state("fleet.lease_missed")
+        a0 = sum(
+            v for k, v in telemetry.registry().snapshot()[
+                "counters"
+            ].items() if k.startswith("fleet.adopted")
+        )
+        with pytest.raises(LeaseCorruptError, match="refusing"):
+            member.check_peers()
+        m1 = _metric_state("fleet.lease_missed")
+        a1 = sum(
+            v for k, v in telemetry.registry().snapshot()[
+                "counters"
+            ].items() if k.startswith("fleet.adopted")
+        )
+        assert m1["fleet.lease_missed"] == m0["fleet.lease_missed"], (
+            "a corrupt lease is NOT a missed lease"
+        )
+        assert a1 == a0, "no adoption on a refused takeover"
+        assert not any(
+            r.get("kind") == "adopted" for r in read_journal(g0dir)
+        ), "no adopted marker may land on a refusal"
+        assert member.pick_peer() is None, (
+            "forwarding degrades to the 429 fallback, never a guess"
+        )
+        # a fresh heartbeat heals the lease and the fleet resumes:
+        # g0 is live again, so the sweep finds nothing stale
+        fleet.write_lease(lease_path, "g0", depth=1)
+        assert member.check_peers() == {}
         return True
 
     _run(driver)

@@ -694,6 +694,43 @@ def test_gate_artifact_agrees_with_guard_bands():
         ev["page_in_overhead_s"]
         - max(0.0, ev["cold_solve_s"] - ev["warm_solve_s"])
     ) <= 2e-6, ev  # fields round independently of their difference
+    # round 18's saturation leg: an open-loop offered-load curve with
+    # a measured knee — the knee is the LAST level that met the SLO
+    # (all done, interactive attainment >= target, sustained/offered
+    # >= ratio target), and the knee bands are derived from it, not
+    # asserted independently
+    sat = rec["saturation"]
+    assert sat["probe_base_rps"] > 0
+    curve = sat["curve"]
+    assert [lv["capacity_multiple"] for lv in curve] == list(
+        sat["levels_capacity_multiples"]
+    )
+    for lv in curve:
+        assert lv["requests"] == sat["requests_per_level"]
+        assert lv["offered_rps"] > 0 and lv["window_s"] > 0
+        want_sust = lv["sustained_rps"] / lv["offered_rps"]
+        # fields round to 6 decimals independently of their quotient
+        assert abs(lv["sustained_ratio"] - want_sust) <= 1e-4, lv
+        want_ok = (
+            lv["done"] == lv["requests"]
+            and lv["attainment"]["interactive"]
+            >= sat["attainment_target"]
+            and lv["sustained_ratio"] >= sat["sustain_ratio_target"]
+        )
+        assert lv["meets_slo"] == want_ok, lv
+        # pamon saw every completed request of the window
+        assert lv["pamon_count"] == lv["done"], lv
+        assert lv["pamon_p99_s"] >= lv["pamon_p50_s"], lv
+    knee = sat["knee"]
+    assert knee is not None, "the committed curve must exhibit a knee"
+    ok_levels = [lv for lv in curve if lv["meets_slo"]]
+    assert ok_levels and knee == ok_levels[-1]
+    assert rec["bands"]["saturation_knee_rps"]["measured"] == (
+        knee["offered_rps"]
+    )
+    assert rec["bands"]["saturation_attainment_at_knee"]["measured"] == (
+        knee["attainment"]["interactive"]
+    )
     # the shared artifact envelope
     assert rec.get("schema_version") and rec.get("generated_by") == (
         "bench_gate"

@@ -1,0 +1,421 @@
+"""pafleet — the replicated gate fleet
+(`partitionedarrays_jl_tpu.frontdoor.fleet` + `Gate.adopt` + journal
+retention + the fleet-aware `http_solve`).
+
+The contracts pinned here:
+
+* **Rendezvous routing** — `route(tenant, replicas)` is deterministic
+  from any client (no shared state) and minimally disruptive: on
+  membership change only the tenants whose top-ranked replica changed
+  move; a dead replica's adopter is unique and deterministic.
+* **Lease files** — CRC'd canonical JSON published by atomic
+  tmp+rename: round-trips verbatim, and a torn or bit-flipped lease
+  raises the typed `LeaseCorruptError` — corruption refuses takeover
+  instead of triggering a false one.
+* **Journal retention** (``PA_GATE_JOURNAL_KEEP``) — `prune` drops
+  only epochs a LATER ``recovered`` record proves replayed; dropping
+  an unrecovered epoch raises the typed `JournalRetentionError` and
+  unlinks nothing. A gate restarting under the knob compacts live
+  requests into the current epoch first, so a SECOND restart recovers
+  them from the retained set alone; terminal history ages out (the
+  documented idempotency-replay horizon).
+* **Client resilience (satellite bugfix)** — `http_solve(retries=N)`
+  now retries a 503 `AdmissionRejected` with exponential backoff
+  under the same ``timeout_s`` budget it already used for 429 (the
+  prior behavior returned the raw 503 payload on the first try);
+  ``retries=0`` stays one-shot. A 307 shed-forward is FOLLOWED (hop
+  cap 4) — the resubmit and all subsequent polls go to the peer.
+
+The cross-replica failover/forward/torn-lease rows live in
+tests/test_chaos_matrix.py; the full kill -9 fleet drill (subprocess,
+SIGKILL one replica mid-load) runs under the ``slow`` marker via
+``tools/pafleet.py --drill``.
+"""
+import json
+import os
+import urllib.error
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu import telemetry
+from partitionedarrays_jl_tpu.frontdoor import (
+    Gate,
+    JournalRetentionError,
+    LeaseCorruptError,
+    RequestJournal,
+    http_solve,
+    journal_keep,
+    read_lease,
+    rendezvous_rank,
+    route,
+    write_lease,
+)
+from partitionedarrays_jl_tpu.models import (
+    assemble_poisson,
+    gather_pvector,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _poisson(grid=(8, 8)):
+    return pa.prun(
+        lambda parts: assemble_poisson(parts, grid), pa.sequential, (2, 2)
+    )
+
+
+def _counter(name, labels=None):
+    return telemetry.registry().counter(name, labels=labels).value
+
+
+# ---------------------------------------------------------------------------
+# rendezvous routing
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_deterministic_and_minimal_movement():
+    """Routing is a pure function of (key, membership): stable across
+    calls and input orderings; growing the fleet moves ONLY tenants
+    captured by the new replica; shrinking it moves ONLY the dead
+    replica's tenants; the adopter of a dead replica is rank[0] among
+    survivors — unique, no election."""
+    reps = ["g0", "g1", "g2"]
+    tenants = [f"tenant-{i}" for i in range(200)]
+    owners = {t: route(t, reps) for t in tenants}
+    assert owners == {t: route(t, list(reversed(reps))) for t in tenants}
+    # every replica actually owns someone (sha256 spreads the keys)
+    assert {owners[t] for t in tenants} == set(reps)
+    # growth: a tenant either stays put or moves TO the new replica
+    for t in tenants:
+        after = route(t, reps + ["g3"])
+        assert after == owners[t] or after == "g3", (t, owners[t], after)
+    # shrink: only g1's tenants move
+    for t in tenants:
+        after = route(t, ["g0", "g2"])
+        if owners[t] != "g1":
+            assert after == owners[t], (t, owners[t], after)
+    # the dead replica's adopter is deterministic and total-ordered
+    ranked = rendezvous_rank("g1", ["g0", "g2"])
+    assert sorted(ranked) == ["g0", "g2"]
+    assert ranked == rendezvous_rank("g1", ["g2", "g0"])
+
+
+# ---------------------------------------------------------------------------
+# lease files
+# ---------------------------------------------------------------------------
+
+
+def test_lease_roundtrip_torn_and_crc_flip_typed(tmp_path):
+    path = str(tmp_path / "lease.json")
+    assert read_lease(path) is None, "absent lease reads as None"
+    write_lease(path, "g0", depth=3)
+    got = read_lease(path)
+    assert got["replica"] == "g0" and got["depth"] == 3
+    assert got["wall"] > 0
+    raw = open(path).read()
+    # torn write (crash mid-write straight to the final name): typed
+    open(path, "w").write(raw[: len(raw) // 2])
+    with pytest.raises(LeaseCorruptError, match="unparseable"):
+        read_lease(path)
+    # valid JSON whose payload no longer matches its CRC: typed too
+    rec = json.loads(raw)
+    rec["depth"] = 999
+    open(path, "w").write(json.dumps(rec))
+    with pytest.raises(LeaseCorruptError, match="CRC"):
+        read_lease(path)
+    # a fresh heartbeat heals the file
+    write_lease(path, "g0", depth=0)
+    assert read_lease(path)["depth"] == 0
+
+
+# ---------------------------------------------------------------------------
+# journal retention (PA_GATE_JOURNAL_KEEP)
+# ---------------------------------------------------------------------------
+
+
+def test_journal_keep_parsing(monkeypatch):
+    for raw, want in (
+        (None, None), ("", None), ("0", None), ("-3", None),
+        ("junk", None), ("1", 1), ("2", 2), ("7", 7),
+    ):
+        if raw is None:
+            monkeypatch.delenv("PA_GATE_JOURNAL_KEEP", raising=False)
+        else:
+            monkeypatch.setenv("PA_GATE_JOURNAL_KEEP", raw)
+        assert journal_keep() == want, (raw, want)
+
+
+def test_prune_refuses_unrecovered_epoch_then_prunes(tmp_path):
+    jd = str(tmp_path / "j")
+    j1 = RequestJournal(jd, fsync=False)
+    j1.append("admitted", rid="r1-0", tenant="t")
+    j1.close()
+    j2 = RequestJournal(jd, fsync=False)  # epoch 2
+    before = sorted(j2.segments())
+    # epoch 1 has no later `recovered` record: live state, typed refusal
+    with pytest.raises(JournalRetentionError, match="epoch"):
+        j2.prune(1)
+    assert sorted(j2.segments()) == before, "refusal unlinks NOTHING"
+    # a recovery in this epoch proves epoch 1 was folded in
+    j2.append("recovered", completed=0, requeued=1)
+    p0 = _counter("journal.pruned")
+    ev0 = telemetry.counter("events.journal_pruned")
+    pruned = j2.prune(1)
+    assert pruned, "epoch 1's segments must be dropped"
+    epochs = {
+        int(os.path.basename(s).split("-")[1]) for s in j2.segments()
+    }
+    assert epochs == {j2.epoch}
+    assert _counter("journal.pruned") == p0 + len(pruned)
+    assert telemetry.counter("events.journal_pruned") == ev0 + 1
+    # idempotent: nothing left to prune
+    assert j2.prune(1) == []
+    j2.close()
+
+
+def test_gate_retention_recovers_live_from_retained_set(
+    tmp_path, monkeypatch
+):
+    """Under ``PA_GATE_JOURNAL_KEEP=1`` a recovering gate compacts
+    live requests into the current epoch BEFORE pruning the old ones,
+    so a second crash-recovery needs only the retained set; terminal
+    history ages out (the documented idempotency-replay horizon)."""
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        jd = str(tmp_path / "j")
+        g1 = Gate(journal_dir=jd)
+        g1.register("t", A, kmax=4)
+        hdone = g1.submit("t", b, x0=x0, tol=1e-9, tag="old-done")
+        g1.drain()
+        hdone.result()
+        hq = g1.submit("t", b, x0=x0, tol=1e-9, tag="live-queued")
+        # ---- crash; restart under retention ----
+        monkeypatch.setenv("PA_GATE_JOURNAL_KEEP", "1")
+        ev0 = telemetry.counter("events.journal_pruned")
+        g2 = Gate(journal_dir=jd)
+        g2.register("t", A, kmax=4)
+        summary = g2.recover()
+        assert summary["completed"] == 1 and summary["requeued"] == 1
+        assert telemetry.counter("events.journal_pruned") == ev0 + 1
+        epochs = {
+            int(os.path.basename(s).split("-")[1])
+            for s in g2.journal.segments()
+        }
+        assert epochs == {g2.journal.epoch}, (
+            "only the current epoch survives KEEP=1"
+        )
+        g2.drain()
+        x2 = gather_pvector(g2.handle(hq.rid).result()[0])
+        # ---- second crash: only the retained set exists on disk ----
+        g3 = Gate(journal_dir=jd)
+        g3.register("t", A, kmax=4)
+        s3 = g3.recover()
+        assert s3["completed"] == 1, s3
+        # a recovered terminal serves its RECORDED result (gathered)
+        np.testing.assert_array_equal(
+            np.asarray(g3.handle(hq.rid).result()[0]), x2
+        )
+        # the pre-retention terminal aged out with its epoch
+        assert g3.handle(hdone.rid) is None, (
+            "terminal history beyond KEEP is the documented horizon"
+        )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+# ---------------------------------------------------------------------------
+# http_solve: the 503 retry bugfix + 307 shed-forward follow
+# (injected failures — no real server; idiom shared with test_padur)
+# ---------------------------------------------------------------------------
+
+
+class _FakeResponse:
+    def __init__(self, status, payload):
+        self.status = status
+        self._payload = payload
+
+    def read(self):
+        return json.dumps(self._payload).encode()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class _FakeHTTPError(urllib.error.HTTPError):
+    def __init__(self, url, code, payload, headers=None):
+        import email.message
+
+        msg = email.message.Message()
+        for k, v in (headers or {}).items():
+            msg[k] = str(v)
+        super().__init__(url, code, "err", msg, None)
+        self._payload = payload
+
+    def read(self):
+        return json.dumps(self._payload).encode()
+
+
+_DONE = {"id": "r1-0", "state": "done", "x": [1.0, 2.0],
+         "info": {"converged": True, "iterations": 3,
+                  "status": "converged"}}
+
+
+def test_http_solve_retries_503_with_backoff():
+    """THE satellite bugfix: a 503 `AdmissionRejected` (queue-full /
+    draining backpressure — no Retry-After hint) retries with
+    exponential backoff under ``timeout_s`` instead of returning the
+    raw error payload on the first try."""
+    sleeps = []
+    script = [
+        _FakeHTTPError("u", 503, {"error": "AdmissionRejected",
+                                  "message": "queue full"}),
+        _FakeHTTPError("u", 503, {"error": "AdmissionRejected",
+                                  "message": "queue full"}),
+        _FakeResponse(202, {"id": "r1-0", "state": "gate-queued"}),
+        _FakeResponse(200, _DONE),
+    ]
+
+    def opener(req):
+        ev = script.pop(0)
+        if isinstance(ev, Exception):
+            raise ev
+        return ev
+
+    out = http_solve(
+        "http://fake", "t", [0.0, 0.0], tol=1e-9, retries=3,
+        opener=opener, sleep=sleeps.append, poll_s=0.0, timeout_s=60.0,
+    )
+    assert out["state"] == "done" and out["x"] == [1.0, 2.0]
+    assert not script, "every scripted exchange must be consumed"
+    # no server hint -> exponential client backoff: 0.05, then 0.1
+    assert sleeps[:2] == [0.05, 0.1], sleeps
+
+
+def test_http_solve_503_exhausts_retries_typed():
+    """Past ``retries`` the typed payload surfaces (never an endless
+    loop), and ``retries=0`` keeps the one-shot contract unchanged."""
+    def opener_503(req):
+        raise _FakeHTTPError(
+            "u", 503, {"error": "AdmissionRejected", "message": "full"}
+        )
+
+    out = http_solve("http://fake", "t", [0.0], retries=2,
+                     opener=opener_503, sleep=lambda s: None,
+                     timeout_s=60.0)
+    assert out["http_status"] == 503
+    assert out["error"] == "AdmissionRejected"
+    out0 = http_solve(
+        "http://fake", "t", [0.0], opener=opener_503,
+        sleep=lambda s: (_ for _ in ()).throw(
+            AssertionError("retries=0 must not sleep")),
+    )
+    assert out0["http_status"] == 503
+
+
+def test_http_solve_follows_shed_forward_307():
+    """A fleet shed-forward (307 + ``Location``) is followed
+    independent of ``retries``: the submit reposts the identical body
+    to the peer and every subsequent poll goes to the peer too."""
+    urls = []
+    script = [
+        _FakeHTTPError(
+            "u", 307,
+            {"error": "LoadShedded", "forwarded_to": "http://peer:9"},
+            {"Location": "http://peer:9/v1/solve", "Retry-After": "1"},
+        ),
+        _FakeResponse(202, {"id": "g1-r1-0", "state": "gate-queued"}),
+        _FakeResponse(200, dict(_DONE, id="g1-r1-0")),
+    ]
+    bodies = []
+
+    def opener(req):
+        urls.append(req.full_url)
+        if req.data is not None:
+            bodies.append(json.loads(req.data))
+        ev = script.pop(0)
+        if isinstance(ev, Exception):
+            raise ev
+        return ev
+
+    out = http_solve(
+        "http://fake", "t", [0.0, 0.0], tol=1e-9,
+        idempotency_key="fwd-key", opener=opener,
+        sleep=lambda s: None, poll_s=0.0,
+    )
+    assert out["state"] == "done" and not script
+    assert urls == [
+        "http://fake/v1/solve",          # the shedding replica
+        "http://peer:9/v1/solve",        # the forwarded resubmit
+        "http://peer:9/v1/solve/g1-r1-0",  # polls follow the peer
+    ]
+    # the peer sees the IDENTICAL body: same idempotency key, so a
+    # forwarded duplicate can never double-solve
+    assert bodies[0] == bodies[1]
+    assert bodies[1]["idempotency_key"] == "fwd-key"
+
+
+def test_http_solve_redirect_hop_cap():
+    """A thrashing fleet that ping-pongs redirects is bounded: after 4
+    hops the typed 307 payload surfaces instead of looping."""
+    calls = []
+
+    def opener(req):
+        calls.append(req.full_url)
+        raise _FakeHTTPError(
+            "u", 307, {"error": "LoadShedded"},
+            {"Location": "http://peer:9/v1/solve"},
+        )
+
+    out = http_solve("http://fake", "t", [0.0], opener=opener,
+                     sleep=lambda s: None)
+    assert out["http_status"] == 307
+    assert len(calls) == 5, "initial POST + 4 followed hops, no more"
+
+
+# ---------------------------------------------------------------------------
+# CLI: the tier-1 smoke + the subprocess drill
+# ---------------------------------------------------------------------------
+
+
+def _load_pafleet():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "pafleet", os.path.join(REPO, "tools", "pafleet.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pafleet_check_smoke(capsys):
+    """tools/pafleet.py --check: routing + failover adoption +
+    shed-forward + retention, in-process (tier-1)."""
+    pafleet = _load_pafleet()
+    rc = pafleet.main(["--check"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "pafleet --check: OK" in out
+
+
+@pytest.mark.slow
+def test_fleet_drill_sigkill_failover_full(capsys):
+    """THE acceptance drill: two serving replicas under concurrent
+    `http_solve` load, SIGKILL the tenant's owner mid-flight, and the
+    survivor adopts its journal — every admitted request completes
+    bitwise-equal to its solo solve or fails typed, none duplicated,
+    one stitched trace per request across the replica hop, per-class
+    SLO attainment reported from the survivor
+    (tools/pafleet.py --drill)."""
+    pafleet = _load_pafleet()
+    rc = pafleet.main(["--drill"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "pafleet --drill: OK" in out

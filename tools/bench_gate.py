@@ -2,7 +2,7 @@
 """Front-door load bench -> GATE_BENCH.json (ROADMAP item 1's
 acceptance artifact).
 
-Three legs over the demo gate (two Poisson operators under a memory
+Four legs over the demo gate (two Poisson operators under a memory
 budget that fits only ONE resident at a time — every tenant switch is
 a forced page-out/page-in):
 
@@ -20,12 +20,31 @@ a forced page-out/page-in):
 * **eviction-cost leg** — the same solve on a resident tenant (warm)
   vs right after a page-out (cold: fresh `SolveService` + lazy
   re-stage + solve); the difference is the measured price of paging.
+* **saturation leg (v2, pafleet)** — an OPEN-LOOP arrival sweep: per
+  offered-load level, one `http_solve` client per request fires at its
+  scheduled arrival time regardless of completions (the PR 12 retry
+  client IS the loadgen: a shed 429 / backpressure 503 backs off and
+  resubmits under its own budget), classes rotating so the lowest
+  class genuinely crosses the watermark at overload. Levels are
+  multiples of the machine's PROBED warm capacity (0.25x / 1x / 4x),
+  so the sweep brackets the knee on any host. Per level the leg
+  records offered vs sustained throughput, per-class attainment from
+  the ``gate.slo.*`` deltas, and p50/p99 from the pamon
+  ``service.total_s`` histogram snapshot delta (the same buckets
+  ``tools/pamon.py`` renders) cross-checked against client-side
+  walls; the knee is the highest level that still completes every
+  request, keeps interactive attainment at target, and sustains
+  >= ``SATURATION_SUSTAIN_RATIO`` of the offered rate.
 * **bands** — ``interactive_attainment`` must meet the 0.9 target
   WHILE shedding is active (the ROADMAP acceptance line, measured not
   asserted), every shed must land on the lowest class
-  (``besteffort_shed_share``), and the eviction round-trip ratio is a
-  structural canary. All canary-kind: they gate on every platform
-  (tools/pareg.py --check), and none is a device-throughput claim.
+  (``besteffort_shed_share``), the eviction round-trip ratio is a
+  structural canary, and the saturation knee must exist
+  (``saturation_knee_rps`` > 0) with interactive attainment at the
+  knee still at target (``saturation_attainment_at_knee``). All
+  canary-kind: they gate on every platform (tools/pareg.py --check),
+  and none is a device-throughput claim — the knee's absolute rps is
+  recorded but only its existence and its SLO are banded.
 
 ``--dry-run`` prints without writing.
 """
@@ -49,9 +68,14 @@ GATE_BANDS = {
     "interactive_attainment": (0.9, 1.0, "canary"),
     "besteffort_shed_share": (0.999, 1.0, "canary"),
     "eviction_roundtrip_ratio": (0.8, 500.0, "canary"),
+    # the knee is machine-relative (levels are multiples of probed
+    # capacity), so the band only asserts it EXISTS and keeps SLO —
+    # never an absolute-throughput claim
+    "saturation_knee_rps": (1e-3, 1e9, "canary"),
+    "saturation_attainment_at_knee": (0.9, 1.0, "canary"),
 }
 
-METHODOLOGY = "v1-gate-load"
+METHODOLOGY = "v2-gate-load-saturation"
 
 #: The interactive class's SLO attainment target the overload leg must
 #: meet while shedding is active (the band's lower edge).
@@ -62,6 +86,13 @@ CLIENTS = 3
 #: backlog; phase 2 submits (besteffort, interactive) at full depth.
 REQUESTS_PER_CLIENT = 4
 CLASSES = ("interactive", "batch", "besteffort")
+
+#: Saturation sweep: offered levels as multiples of the probed warm
+#: capacity, requests per level, and the sustained/offered ratio a
+#: level must hold to count as "keeping up" for the knee.
+SATURATION_LEVELS = (0.25, 1.0, 4.0)
+SATURATION_REQUESTS = 10
+SATURATION_SUSTAIN_RATIO = 0.7
 
 
 def _post(url, payload):
@@ -237,6 +268,178 @@ def run_multi_client(gate, srv, systems):
     }
 
 
+def _hist_window(before: dict, after: dict):
+    """`LatencyHistogram` of just the observations landing between two
+    snapshots of the same histogram (exact bucket-count differences;
+    the window's min/max are unknowable from snapshots, so quantiles
+    read pure bucket edges — still conservative upper bounds)."""
+    from partitionedarrays_jl_tpu.telemetry.histogram import (
+        LatencyHistogram,
+    )
+
+    h = LatencyHistogram()
+    b0 = {
+        int(i): int(c) for i, c in (before.get("buckets") or {}).items()
+    }
+    for i, c in (after.get("buckets") or {}).items():
+        d = int(c) - b0.get(int(i), 0)
+        if d:
+            h.counts[int(i)] += d
+    h.total = int(after["count"]) - int(before["count"])
+    h.sum = float(after["sum"]) - float(before["sum"])
+    return h
+
+
+def run_saturation(gate, srv, systems):
+    """The open-loop saturation sweep (see module docstring). Returns
+    the record fragment with the per-level curve and the knee."""
+    from partitionedarrays_jl_tpu import telemetry
+    from partitionedarrays_jl_tpu.frontdoor import http_solve
+    from partitionedarrays_jl_tpu.models.solvers import gather_pvector
+
+    reg = telemetry.registry()
+
+    def counters():
+        return reg.snapshot()["counters"]
+
+    def hist_snap():
+        return reg.snapshot()["histograms"].get(
+            "service.total_s",
+            {"count": 0, "sum": 0.0, "buckets": {}},
+        )
+
+    def settle():
+        # terminal requests are SLO-accounted on the pump's next tick
+        for _ in range(1000):
+            gate.account()
+            with gate._lock:
+                if not gate._inflight:
+                    break
+            time.sleep(0.005)
+
+    # one tenant only: the sweep measures the gate+service pipeline,
+    # not the paging path (the overload leg already forces evictions)
+    tenant = min(systems, key=lambda n: systems[n][0].rows.ngids)
+    _A, bvec, _xe, x0 = systems[tenant]
+    b = gather_pvector(bvec).tolist()
+    x0 = gather_pvector(x0).tolist()
+
+    def one(cls, tag):
+        t0 = time.perf_counter()
+        out = http_solve(
+            srv.url, tenant, b, x0=x0, tol=1e-9, deadline=600.0,
+            slo_class=cls, tag=tag, poll_s=0.002, timeout_s=120.0,
+            retries=8, retry_cap_s=0.5,
+        )
+        return out, time.perf_counter() - t0
+
+    # -- capacity probe: warm resident + compiled, then min-of-3 warm
+    # HTTP round-trips define this machine's base rate; levels are
+    # MULTIPLES of it, so the sweep brackets the knee on any host
+    one("interactive", "sat-warm")
+    base_s = min(
+        one("interactive", f"sat-probe-{k}")[1] for k in range(3)
+    )
+    settle()
+    base_rps = 1.0 / max(base_s, 1e-6)
+
+    levels = []
+    n = SATURATION_REQUESTS
+    for mult in SATURATION_LEVELS:
+        rps = base_rps * mult
+        interval = 1.0 / rps
+        before_c, before_h = counters(), hist_snap()
+        results = [None] * n
+        start = time.perf_counter() + 0.05
+
+        def client(i):
+            # open-loop: fire at the scheduled arrival slot no matter
+            # what earlier requests are doing
+            time.sleep(max(0.0, start + i * interval - time.perf_counter()))
+            results[i] = one(
+                CLASSES[i % len(CLASSES)], f"sat-{mult}-{i}"
+            )
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        window_s = time.perf_counter() - start
+        settle()
+        after_c, after_h = counters(), hist_snap()
+
+        walls = sorted(w for _o, w in results)
+        done = sum(
+            1 for o, _w in results if o.get("state") == "done"
+        )
+        hist = _hist_window(before_h, after_h)
+        attainment = {}
+        for cls in CLASSES:
+            req = (
+                after_c.get(f"gate.slo.requests{{slo_class={cls}}}", 0)
+                - before_c.get(
+                    f"gate.slo.requests{{slo_class={cls}}}", 0
+                )
+            )
+            hit = (
+                after_c.get(f"gate.slo.hits{{slo_class={cls}}}", 0)
+                - before_c.get(f"gate.slo.hits{{slo_class={cls}}}", 0)
+            )
+            attainment[cls] = (
+                round(hit / req, 6) if req else None
+            )
+        shed = sum(
+            after_c.get(f"gate.shed{{slo_class={cls}}}", 0)
+            - before_c.get(f"gate.shed{{slo_class={cls}}}", 0)
+            for cls in CLASSES
+        )
+        sustained_rps = done / window_s if window_s > 0 else 0.0
+        sustained_ratio = sustained_rps / rps
+        ia = attainment["interactive"]
+        meets = (
+            done == n
+            and ia is not None and ia >= ATTAINMENT_TARGET
+            and sustained_ratio >= SATURATION_SUSTAIN_RATIO
+        )
+        levels.append({
+            "capacity_multiple": mult,
+            "offered_rps": round(rps, 3),
+            "requests": n,
+            "done": done,
+            "shed_retries": shed,
+            "window_s": round(window_s, 6),
+            "sustained_rps": round(sustained_rps, 3),
+            "sustained_ratio": round(sustained_ratio, 6),
+            # pamon is the primary read: service.total_s bucket deltas
+            "pamon_count": hist.total,
+            "pamon_p50_s": hist.quantile(0.5),
+            "pamon_p99_s": hist.quantile(0.99),
+            # client-side cross-check (includes queueing + retries)
+            "client_p50_s": round(walls[len(walls) // 2], 6),
+            "client_p99_s": round(walls[-1], 6),
+            "attainment": attainment,
+            "meets_slo": meets,
+        })
+    knee = None
+    for lv in levels:
+        if lv["meets_slo"]:
+            knee = lv
+    return {
+        "tenant": tenant,
+        "probe_base_s": round(base_s, 6),
+        "probe_base_rps": round(base_rps, 3),
+        "levels_capacity_multiples": list(SATURATION_LEVELS),
+        "requests_per_level": n,
+        "sustain_ratio_target": SATURATION_SUSTAIN_RATIO,
+        "attainment_target": ATTAINMENT_TARGET,
+        "curve": levels,
+        "knee": knee,
+    }
+
+
 def run_eviction_cost(gate, systems):
     """Warm vs post-eviction (cold) solve wall on the larger tenant."""
     name = max(systems, key=lambda n: systems[n][0].rows.ngids)
@@ -264,8 +467,8 @@ def run_eviction_cost(gate, systems):
     }
 
 
-def main():
-    argv = sys.argv[1:]
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
     dry = "--dry-run" in argv
 
     import importlib.util
@@ -283,6 +486,7 @@ def main():
     srv = serve_gate(gate, port=0)
     try:
         multi = run_multi_client(gate, srv, systems)
+        sat = run_saturation(gate, srv, systems)
         evict = run_eviction_cost(gate, systems)
     finally:
         srv.stop()
@@ -299,6 +503,13 @@ def main():
             if multi["shed_total"] else None
         ),
         "eviction_roundtrip_ratio": evict["ratio"],
+        "saturation_knee_rps": (
+            sat["knee"]["offered_rps"] if sat["knee"] else None
+        ),
+        "saturation_attainment_at_knee": (
+            sat["knee"]["attainment"]["interactive"]
+            if sat["knee"] else None
+        ),
     }
     rec = {
         "methodology": METHODOLOGY,
@@ -316,7 +527,15 @@ def main():
             "attainment from the pamon gate.slo.* registry deltas, "
             "cross-checked against client-side outcomes; eviction "
             "cost = cold (page-in + lazy re-stage + solve) vs warm "
-            "min-of-3 solve wall on the larger tenant"
+            "min-of-3 solve wall on the larger tenant; saturation = "
+            f"open-loop arrival sweep at {SATURATION_LEVELS} x the "
+            f"probed warm capacity, {SATURATION_REQUESTS} http_solve "
+            "retry clients per level fired at scheduled arrival slots "
+            "(classes rotating), p50/p99 from the service.total_s "
+            "histogram snapshot delta, attainment from gate.slo.* "
+            "deltas; knee = highest level completing every request "
+            "with interactive attainment at target and sustained/"
+            f"offered >= {SATURATION_SUSTAIN_RATIO}"
         ),
         "tenants": [
             {
@@ -331,6 +550,7 @@ def main():
         "budget_bytes": gate.registry.budget,
         "shed_watermark": gate.watermark,
         "multi_client": multi,
+        "saturation": sat,
         "eviction_cost": evict,
         "bands": {},
     }

@@ -40,7 +40,7 @@ DEMO_TENANTS = {"poisson8": (8, 8), "poisson12": (12, 12)}
 
 def build_demo_gate(budget: str = "one", shed_watermark: int = 4,
                     start_workers: bool = True, checkpoint_dir=None,
-                    journal_dir=None):
+                    journal_dir=None, rid_namespace=None):
     """The demo registry: both Poisson tenants under a budget. With
     ``budget="one"`` only the larger tenant fits resident at a time
     (every tenant switch is a page-out/page-in); ``"all"`` fits both;
@@ -80,7 +80,7 @@ def build_demo_gate(budget: str = "one", shed_watermark: int = 4,
     gate = Gate(
         mem_budget_bytes=budget_bytes, shed_watermark=shed_watermark,
         start_workers=start_workers, checkpoint_dir=checkpoint_dir,
-        journal_dir=journal_dir,
+        journal_dir=journal_dir, rid_namespace=rid_namespace,
     )
     for name, (A, b, xe, x0) in systems.items():
         gate.register(name, A, kmax=4)

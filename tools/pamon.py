@@ -14,7 +14,12 @@ The operator console of the `telemetry.registry` metrics plane
   poll;
 * the committed model — ``--model [PATH]`` renders
   ``THROUGHPUT_MODEL.json`` (default: the repo's committed artifact),
-  the online-measured per-RHS curve that feeds adaptive K.
+  the online-measured per-RHS curve that feeds adaptive K;
+* a live fleet — ``--fleet FLEET_DIR`` renders one row per gate
+  replica (lease state/age, queue depth, residency, and the
+  admitted/shed/forwarded/adopted/lease_missed counters read from
+  each replica's ``/metrics.json``); ``--watch`` polls and shows
+  per-replica deltas.
 
 Output modes: the default table, ``--prom`` (Prometheus text
 exposition), ``--json`` (the raw snapshot), ``--slo`` (deadline
@@ -26,6 +31,7 @@ Usage:
     python tools/pamon.py --snapshot metrics.json --watch --interval 2
     python tools/pamon.py --model --json
     python tools/pamon.py --snapshot metrics.json --prom
+    python tools/pamon.py --fleet /tmp/fleet --watch --interval 2
 """
 import argparse
 import json
@@ -159,6 +165,116 @@ def render_gate(snap, prev=None):
                 f"+{row.get('hits_d', 0)} hit, "
                 f"+{row.get('shed_d', 0)} shed since last poll)"
             )
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def _fleet_fetch(fleet_dir):
+    """Per-replica rows for ``--fleet``: url + lease state from the
+    fleet dir, ``/healthz`` + ``/metrics.json`` over HTTP. Never
+    raises — a dead, unreachable, or lease-corrupt replica is a
+    rendered state, not a crash."""
+    import urllib.request
+
+    from partitionedarrays_jl_tpu.frontdoor import fleet as _fleet
+
+    fm = _fleet.FleetMap(fleet_dir)
+    lease_s = _fleet.fleet_lease_s()
+    rows = {}
+    for r in fm.replicas():
+        row = {
+            "url": fm.url(r), "lease": "absent",
+            "health": {}, "counters": {}, "gauges": {},
+        }
+        try:
+            lease = fm.lease(r)
+            if lease is not None:
+                age = time.time() - float(lease.get("wall", 0.0))
+                row["lease_age_s"] = age
+                row["lease"] = (
+                    "STALE" if age > 3 * lease_s else "live"
+                )
+        except _fleet.LeaseCorruptError:
+            row["lease"] = "CORRUPT"
+        if row["url"]:
+            try:
+                with urllib.request.urlopen(
+                    row["url"] + "/healthz", timeout=2.0
+                ) as resp:
+                    row["health"] = json.loads(resp.read())
+                with urllib.request.urlopen(
+                    row["url"] + "/metrics.json", timeout=2.0
+                ) as resp:
+                    snap = json.loads(resp.read())
+                row["counters"] = snap.get("counters") or {}
+                row["gauges"] = snap.get("gauges") or {}
+            except (OSError, ValueError):
+                row["down"] = True
+        else:
+            row["down"] = True
+        rows[r] = row
+    return rows
+
+
+def _fleet_row_vals(row):
+    """The counted columns of one fleet row (summed over labels)."""
+    c = row.get("counters") or {}
+
+    def tot(name):
+        return sum(
+            v for k, v in c.items()
+            if k == name or k.startswith(name + "{")
+        )
+
+    return {
+        "admitted": tot("service.admitted"),
+        "shed": tot("gate.shed"),
+        "forwarded": tot("fleet.forwarded"),
+        "adopted": tot("fleet.adopted"),
+        "lease_missed": tot("fleet.lease_missed"),
+    }
+
+
+def render_fleet(rows, prev=None):
+    """The fleet view (round 16 — pafleet): one row per replica —
+    liveness, lease state/age, queue depth, tenant residency, and the
+    admitted/shed/forwarded/adopted/lease_missed counters (summed over
+    labels), with deltas against ``prev`` in watch mode. Pure
+    rendering over each replica's own ``/metrics.json`` registry —
+    the fleet collects nothing new for this view."""
+    if not rows:
+        return "(fleet dir has no replicas)"
+    lines = ["gate fleet (pafleet):"]
+    for r in sorted(rows):
+        row = rows[r]
+        lease = row["lease"]
+        if "lease_age_s" in row:
+            lease += f"({row['lease_age_s']:.1f}s)"
+        if row.get("down"):
+            lines.append(f"  {r:8s} DOWN lease={lease}")
+            continue
+        g = row.get("gauges") or {}
+        depth = row.get("health", {}).get(
+            "queue_depth", g.get("gate.queue_depth", 0)
+        )
+        resident = sum(
+            1 for k, v in g.items()
+            if k.startswith("gate.tenant_resident{") and v
+        )
+        vals = _fleet_row_vals(row)
+        line = (
+            f"  {r:8s} UP   lease={lease:14s} depth={depth:<4g} "
+            f"resident={resident} "
+            + " ".join(f"{k}={v}" for k, v in vals.items())
+        )
+        if prev is not None and r in prev and not prev[r].get("down"):
+            pvals = _fleet_row_vals(prev[r])
+            deltas = [
+                f"+{vals[k] - pvals[k]} {k}"
+                for k in vals if vals[k] != pvals[k]
+            ]
+            if deltas:
+                line += "  (" + ", ".join(deltas) + " since last poll)"
         lines.append(line)
     return "\n".join(lines)
 
@@ -469,6 +585,10 @@ def main(argv=None):
     ap.add_argument("--conv", action="store_true",
                     help="convergence observatory: per-tenant "
                          "predicted-vs-actual forecast error")
+    ap.add_argument("--fleet", metavar="FLEET_DIR",
+                    help="per-replica fleet view: lease state, depth, "
+                         "admitted/shed/forwarded/adopted from each "
+                         "replica's /metrics.json (--watch for deltas)")
     ap.add_argument("--watch", action="store_true",
                     help="with --snapshot: poll and show deltas")
     ap.add_argument("--interval", type=float, default=5.0,
@@ -479,6 +599,22 @@ def main(argv=None):
 
     if args.check:
         return _check()
+
+    if args.fleet:
+        prev = None
+        i = 0
+        while True:
+            rows = _fleet_fetch(args.fleet)
+            if args.watch:
+                print(f"--- pamon fleet poll {i} ---")
+            print(render_fleet(rows, prev=prev))
+            if not args.watch:
+                return 0
+            prev = rows
+            i += 1
+            if args.iterations and i >= args.iterations:
+                return 0
+            time.sleep(args.interval)
 
     if args.model is not None and not (args.demo or args.snapshot):
         rec = json.load(open(args.model))
