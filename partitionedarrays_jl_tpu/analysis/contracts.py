@@ -39,6 +39,8 @@ from .program_report import (
 COPY_BUDGETS: Dict[str, int] = {
     "standard": 40,
     "fused": 40,
+    "sstep2": 40,
+    "overlap": 40,
 }
 
 
@@ -350,6 +352,69 @@ def _check_memory_budget(reports, cases):
     return out
 
 
+def _check_sstep_gather_collapse(reports, cases):
+    """ISSUE 17's headline invariant: the s-step (CA-CG) body's solve
+    loop — ONE outer trip covering s textbook iterations — carries
+    exactly ONE dot `all_gather` (the (2s+1)×(2s+1) Gram block
+    reduction), where the standard body pays 2 scalar gathers PER
+    iteration (2s per s). If a second gather creeps into the while
+    region, the communication-avoiding claim is structurally dead no
+    matter what the bench says."""
+    from ..telemetry.comms import expected_from_report
+
+    out = []
+    for name, case in cases.items():
+        if case.get("tags", {}).get("body") != "sstep":
+            continue
+        rep = reports.get(name)
+        if rep is None or rep.dialect != "stablehlo":
+            continue
+        got = expected_from_report(rep)["per_iteration"]["all_gather"][
+            "ops"
+        ]
+        if got != 1:
+            out.append(Violation(
+                "sstep-gather-collapse", [name],
+                "the s-step solve loop must carry exactly ONE dot "
+                "all_gather per outer trip (the Gram block reduction "
+                "that replaces 2s scalar gathers)",
+                expected=1, found=got,
+            ))
+    return out
+
+
+def _check_overlap_parity(reports, cases):
+    """The overlap body reorders the SpMV schedule only (interior
+    compute against the in-flight halo) — per-kind collective ops AND
+    payload bytes must match the standard body it reorders exactly.
+    An inventory change means the 'overlap' stopped being a schedule
+    and became a different algorithm."""
+    out = []
+    for name, case in cases.items():
+        tags = case.get("tags", {})
+        base = tags.get("overlap_off")
+        if not tags.get("overlap") or not base:
+            continue
+        if name not in reports or base not in reports:
+            continue
+        ron, roff = reports[name], reports[base]
+        con, coff = _counts(ron), _counts(roff)
+        bon = {k: ron.collective_bytes.get(k, 0) for k in COLLECTIVE_KINDS}
+        boff = {
+            k: roff.collective_bytes.get(k, 0) for k in COLLECTIVE_KINDS
+        }
+        if con != coff or bon != boff:
+            out.append(Violation(
+                "overlap-collective-parity", [name, base],
+                "overlap body changes the collective inventory — it "
+                "must reorder the standard body's schedule, not its "
+                "communication",
+                expected={"ops": coff, "bytes": boff},
+                found={"ops": con, "bytes": bon},
+            ))
+    return out
+
+
 def _check_copy_budget(reports, cases):
     """The PR 2 buffer-copy canary: the compiled body's ``copy`` count
     is the structural signature of XLA's while-carry copies — the
@@ -401,6 +466,15 @@ CONTRACTS: List[Contract] = [
              "no infeed/outfeed/non-SPMD custom-call inside any while "
              "region",
              _check_no_host_transfer_in_loop),
+    Contract("sstep-gather-collapse",
+             "the s-step solve loop carries exactly ONE dot all_gather "
+             "per outer trip — the CA-CG block reduction (ISSUE 17)",
+             _check_sstep_gather_collapse),
+    Contract("overlap-collective-parity",
+             "overlap body matches the standard body's per-kind "
+             "collective ops and bytes — a schedule, not an algorithm "
+             "(ISSUE 17)",
+             _check_overlap_parity),
     Contract("copy-budget",
              "compiled copy-op count within the pinned per-body budget "
              "(the PR 2 buffer-copy-anomaly canary)",

@@ -70,6 +70,8 @@ MEMORY_BUDGETS: Dict[str, int] = {
     "block_k4_fused_abft": 80_000,
     "strict_standard": 59_000,
     "fused_f32": 12_000,
+    "sstep2": 22_000,
+    "overlap": 16_000,
 }
 
 

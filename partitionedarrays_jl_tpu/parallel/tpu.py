@@ -400,6 +400,22 @@ class DeviceExchangePlan:
         self.perms = tuple(self.perms)
 
 
+class WidenedDeviceExchangePlan(DeviceExchangePlan):
+    """The depth-s widened generic plan (s-step CG, ISSUE 17): the SAME
+    round structure and index matrices as the depth-1 plan — s-step
+    ships its aggregated ghost region as ``ghost_depth`` re-runs of
+    these rounds per outer trip, each carrying a 2-lane basis-pair slab
+    — tagged with the depth so comms accounting and the plan audit can
+    name the aggregation. `verify_plan` dispatches through the base
+    class: all five soundness checks run on the same structure."""
+
+    __slots__ = ("ghost_depth",)
+
+    def __init__(self, exchanger, layout, depth: int):
+        super().__init__(exchanger, layout)
+        self.ghost_depth = int(depth)
+
+
 def _shard_exchange(plan, combine: str, abft: bool = False):
     """Per-shard halo exchange body (used inside shard_map): R static
     `ppermute` rounds. `combine='set'` for owner->ghost halo updates,
@@ -591,6 +607,82 @@ def _resolve_fused(fused, pipelined: bool) -> bool:
     if fused is None:
         return _fused_cg_enabled() and not pipelined
     return bool(fused)
+
+
+def _sstep_env() -> int:
+    """The ONE resolution of the communication-avoiding s-step CG depth
+    (``PA_TPU_SSTEP``, default 0 = off; 1 is the degenerate form — the
+    textbook standard body). An s >= 2 selects the CA-CG body
+    (`make_cg_fn(sstep=s)`): s Krylov basis vectors per outer while
+    trip, ONE block all_gather carrying the whole Gram payload in place
+    of the 2s per-iteration scalar gathers. Strict-bits keeps the
+    textbook body as the oracle — the env resolves to 0 there (an
+    EXPLICIT ``sstep=`` >= 2 under strict-bits refuses typed instead,
+    see `_check_body_conflicts`). Lowering-affecting: folded into
+    `_lowering_env_key`, so every staged-matrix/program cache rekeys on
+    a flip."""
+    try:
+        v = int(os.environ.get("PA_TPU_SSTEP", "0") or "0")
+    except ValueError:
+        raise ValueError(
+            "PA_TPU_SSTEP must be an integer s-step depth (iterations "
+            "per outer step)"
+        )
+    if strict_bits():
+        return 0
+    return max(0, v)
+
+
+def _overlap_env() -> bool:
+    """The ONE resolution of the explicit interior/boundary overlap
+    SpMV form (``PA_TPU_OVERLAP=1``, default off). The overlap body
+    splits `_spmv_body`'s tail into interior rows (no ghost reads,
+    fenced with `optimization_barrier` so the compiler schedules them
+    against the in-flight ppermute rounds) and boundary rows finished
+    on halo arrival. The split changes the SCHEDULE, not the
+    arithmetic — values are bitwise identical to the standard tail, so
+    the mode stays available under strict-bits (and the bitwise pin in
+    tests/test_sstep.py proves it). Lowering-affecting: folded into
+    `_lowering_env_key`."""
+    return os.environ.get("PA_TPU_OVERLAP", "0") == "1"
+
+
+def _resolve_sstep(sstep) -> int:
+    """The ONE resolution of the s-step depth: an explicit ``sstep``
+    wins; ``None`` takes the env default (`_sstep_env`). Normalized so
+    0 and 1 both mean "the textbook standard body" (1 is the degenerate
+    s-step — identical program)."""
+    s = _sstep_env() if sstep is None else int(sstep)
+    return max(0, s)
+
+
+def _resolve_overlap(overlap) -> bool:
+    """The ONE resolution of the overlap-body choice: explicit wins,
+    ``None`` takes the env default (`_overlap_env`)."""
+    if overlap is None:
+        return _overlap_env()
+    return bool(overlap)
+
+
+def _sstep_resolve_env(pipelined, precond, rhs_batch, fused, have_sdc):
+    """Mirror `make_cg_fn`'s ENV-driven body resolution for callers
+    that must know the concrete body before building (the program cache
+    key in `_krylov_fn_for`, the telemetry body label in `tpu_cg`):
+    returns ``(eff_sstep, fused)``. The env-requested s-step body wins
+    over the env-default fused body (an EXPLICIT ``fused=True`` still
+    reaches `make_cg_fn`'s typed conflict), and every composition the
+    s-step body refuses — pipelined, precond, block, SDC — resolves to
+    depth 0 here exactly as `make_cg_fn`'s fallback does."""
+    s_env = _sstep_env()
+    if (
+        s_env >= 2 and not pipelined and not precond
+        and rhs_batch is None
+    ):
+        if fused is None:
+            fused = False
+        if not fused and not have_sdc:
+            return s_env, _resolve_fused(fused, pipelined)
+    return 0, _resolve_fused(fused, pipelined)
 
 
 def _trace_config() -> int:
@@ -788,19 +880,48 @@ def device_layout(rows: PRange, padded: bool = False) -> DeviceLayout:
     return cache[key]
 
 
-def device_exchange_plan(rows: PRange, padded: bool = False):
-    from .tpu_box import BoxExchangePlan
+def device_exchange_plan(rows: PRange, padded: bool = False,
+                         depth: int = 1):
+    """Build (and cache on ``rows``) the device halo-exchange plan.
 
+    ``depth`` >= 2 returns the WIDENED plan variant for the s-step CG
+    body (ISSUE 17): the same round structure and slot indices as the
+    depth-1 plan, tagged with ``ghost_depth = depth`` — the s-step
+    outer trip re-runs this plan once per basis level, so the
+    aggregated ghost traffic it ships per trip is ``depth`` ×  the
+    per-level slab (each level a 2-lane ``(W, 2)`` pair payload).
+    Depth 1 is the exact pre-s-step object: the SAME cached instance,
+    byte-identical plan fingerprint (the tests/test_sstep.py regression
+    pin). Graph-distance-``s`` ghost widening (the matrix-powers-kernel
+    exchange that would collapse the per-level rounds into one) is the
+    named follow-up — the widened-plan type is where it lands.
+
+    The PR 8 plan verifier passes widened plans unchanged: they are
+    subclasses of the depth-1 plan types, so `verify_plan` dispatches
+    to the same five checks over the same index structure."""
+    from .tpu_box import BoxExchangePlan, WidenedBoxExchangePlan
+
+    depth = max(1, int(depth))
     cache = getattr(rows, "_device_plan", None)
     if cache is None:
         cache = rows._device_plan = {}
     layout = device_layout(rows, padded)
-    key = (padded, layout.box_info is not None)
+    key = (padded, layout.box_info is not None, depth)
     if key not in cache:
         if layout.box_info is not None:
-            plan = BoxExchangePlan(layout, layout.box_info)
-        else:
+            plan = (
+                BoxExchangePlan(layout, layout.box_info)
+                if depth == 1
+                else WidenedBoxExchangePlan(
+                    layout, layout.box_info, depth=depth
+                )
+            )
+        elif depth == 1:
             plan = DeviceExchangePlan(rows.exchanger, layout)
+        else:
+            plan = WidenedDeviceExchangePlan(
+                rows.exchanger, layout, depth=depth
+            )
         if _plan_verify_enabled():
             # opt-in construction-time soundness gate (PA_PLAN_VERIFY=1):
             # a malformed plan raises the typed PlanSoundnessError HERE,
@@ -821,6 +942,7 @@ class DeviceMatrix:
 
     __slots__ = (
         "oo_vals", "oo_cols", "oh_vals", "oh_cols", "oh_rows", "oh_nnz",
+        "oo_nnz",
         "dia_offsets", "dia_vals", "pallas_plan",
         "dia_mode", "dia_cb", "dia_no", "dia_codes", "dia_kk", "dia_code_row",
         "dia_cls_pattern",
@@ -934,7 +1056,13 @@ class DeviceMatrix:
         check(row_layout.no_max == no_max, "rows layout mismatch")
         self.rows, self.cols = A.rows, A.cols
         self.row_layout, self.col_layout = row_layout, col_layout
-        self.col_plan = device_exchange_plan(A.cols, self.padded)
+        # s-step mode stages the depth-s widened column plan (same
+        # rounds/indices, ghost_depth tag) — `_lowering_env_key` carries
+        # _sstep_env(), so a flip restages rather than serving this plan
+        _s = _sstep_env()
+        self.col_plan = device_exchange_plan(
+            A.cols, self.padded, depth=_s if _s >= 2 else 1
+        )
         self.backend = backend
         L_oh = max((int(m.row_lengths().max()) if m.nnz else 0 for m in oh), default=0)
         L_oh = max(L_oh, 1)
@@ -998,6 +1126,14 @@ class DeviceMatrix:
         # O(surface) and O(volume) serial work; an empty block (single
         # part, or interior-only coupling) skips the gather entirely.
         self.oh_nnz = sum(m.nnz for m in oh)
+        # interior/boundary nnz split — the structural attribution input
+        # of the overlap body's `boundary_spmv` phase (telemetry.profile).
+        # On the no-split DIA fast path `oo` is never materialized: the
+        # owned share is the full local nnz minus the extracted A_oh side.
+        self.oo_nnz = (
+            sum(m.nnz for m in oo) if oo is not None
+            else sum(m.nnz for m in full) - self.oh_nnz
+        )
         self.ohb_rows = self.ohb_cols = self.ohb_vals = self.ohb_bs = None
         self.oh_vals = self.oh_cols = self.oh_rows = None
         self._cg_cache = {}
@@ -1903,6 +2039,12 @@ def _lowering_env_key() -> tuple:
         # RESOLVED guard pair re-runs admission on a real flip
         # (tests/test_static_analysis.py pins the re-guard).
         _ell_guard_env(),
+        # the s-step / overlap body modes (ISSUE 17): like the fused
+        # flag, the body choice itself is re-resolved per program, but
+        # s-step ALSO changes the staged matrix (the depth-s widened
+        # column exchange plan attaches at staging), so both key here
+        _sstep_env(),
+        _overlap_env(),
     )
 
 
@@ -2146,6 +2288,36 @@ def _pdot_extra_factory(o0: int, no_max: int):
     return pdotx
 
 
+def _pgram_factory(o0: int, no_max: int):
+    """The s-step CG block reduction: ``pgram(V) -> G`` where ``V`` is
+    the owned-region Krylov basis slab ``(no_max, m)`` (m = 2s+1
+    columns) and ``G = Vᵀ V`` the replicated ``(m, m)`` Gram matrix —
+    every inner product the s inner iterations need, shipped on ONE
+    all_gather of the per-part ``(m, m)`` partial in place of the 2s
+    scalar gathers the standard body pays (`_pdot_owned_factory`'s
+    stacked-partial move, widened from a pair of lanes to the whole
+    moment payload). The cross-part fold is the same deterministic
+    part-order sum as `_pdot_factory`. s-step never runs under
+    strict-bits (the textbook body stays the oracle — `_sstep_env`), so
+    there is no fixed-tree variant here. HIGHEST precision on the
+    local partial: the Gram entries feed every α/β in the trip, and the
+    MXU's bf16 passes would poison the whole recurrence."""
+    import jax
+    import jax.numpy as jnp
+
+    def pgram(V):
+        Vo = V[o0 : o0 + no_max] if o0 else V[:no_max]
+        partial_ = jnp.einsum(
+            "wi,wj->ij", Vo, Vo,
+            preferred_element_type=V.dtype,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        allp = jax.lax.all_gather(partial_, "parts")
+        return jnp.sum(allp, axis=0)
+
+    return pgram
+
+
 def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") -> Callable:
     """Compiled halo update: (P, W) sharded array -> same with ghosts
     current (combine='set') or owners accumulated (combine='add', reverse
@@ -2258,11 +2430,27 @@ def _matrix_operands(dA: DeviceMatrix) -> dict:
 
 
 def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False,
-               abft: bool = False, audit: bool = False):
+               abft: bool = False, audit: bool = False,
+               overlap: Optional[bool] = None):
     """Per-shard overlapped SpMV: pack+permute the halo, compute the A_oo
     partial on pre-exchange owned values (independent of the collective —
     XLA overlaps them), then unpack and add the A_oh ghost contribution
     on the compact boundary-row set.
+
+    ``overlap`` (default: `_overlap_env()` — ``PA_TPU_OVERLAP=1``)
+    makes the interior/boundary split EXPLICIT in the lowered program
+    (AsyncSparse, arXiv:2604.17834): the interior (A_oo) result — which
+    reads no ghost slots — is fenced behind an `optimization_barrier`
+    issued before the exchange's ppermute rounds complete, and the
+    boundary (A_oh) finish is fenced to run only after the
+    barrier-joined (interior, halo) pair — so the compiler's schedule
+    computes interior rows while the halo is in flight and finishes
+    boundary rows on arrival, instead of relying on XLA's implicit
+    latency hiding. The barriers change the SCHEDULE, never the
+    arithmetic: every value is bitwise identical to the default tail
+    (pinned under strict-bits by tests/test_sstep.py), and the
+    per-kind collective inventory is identical to the standard body
+    (the palint ``overlap-collective-parity`` contract).
 
     With ``axpy=True`` the returned body has the signature
     ``body(xv, m, xacc, pprev, alpha) -> (y, xacc')`` and ALSO applies
@@ -2312,6 +2500,7 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False,
     layout = dA.row_layout
     no_max = layout.no_max
     o0, g0 = layout.o0, layout.g0
+    overlap = _resolve_overlap(overlap)
 
     strict = strict_bits()  # captured at trace/build time
 
@@ -2580,7 +2769,20 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False,
         """Shared SpMV tail: halo-exchange the operand, embed the A_oo
         product in the row frame, add the boundary (A_oh) contribution.
         Returns (y, exchanged operand, exchange checksum delta, scale) —
-        the checksum pair is None unless ``abft``."""
+        the checksum pair is None unless ``abft``.
+
+        With ``overlap`` the interior product is fenced ahead of the
+        exchange and barrier-joined with the arrived halo before the
+        boundary finish — an explicit interior-rows / ppermute-in-flight
+        / boundary-rows-on-arrival schedule with identical values."""
+        if overlap:
+            # fence the ghost-free interior result so it is a scheduling
+            # unit independent of the in-flight ppermute rounds (values
+            # pass through the barrier bit-unchanged)
+            if full is not None:
+                full = jax.lax.optimization_barrier(full)
+            else:
+                partial_ = jax.lax.optimization_barrier(partial_)
         if abft:
             xv, exd, exs = exch(xv, m["si"], m["sm"], m["ri"])
         else:
@@ -2596,6 +2798,11 @@ def _spmv_body(dA: DeviceMatrix, axpy: bool = False, pfold: bool = False,
             y = jnp.zeros((layout.W,) + tail, dtype=xv.dtype).at[
                 o0 : o0 + no_max
             ].set(partial_)
+        if overlap and dA.oh_nnz:
+            # barrier-join: the boundary finish reads BOTH the interior
+            # embedding and the arrived halo — fencing the pair makes
+            # "finish boundary rows on arrival" explicit in the HLO
+            y, xv = jax.lax.optimization_barrier((y, xv))
         if dA.oh_nnz:
             # ghost contribution only on the boundary rows (padded rows
             # target the trash slot with exact-zero values)
@@ -2748,7 +2955,8 @@ def make_spmv_fn(dA: DeviceMatrix) -> Callable:
 def make_cg_fn(
     dA: DeviceMatrix, tol: float, maxiter: int, precond: bool = False,
     pipelined: bool = False, fused: Optional[bool] = None,
-    rhs_batch: Optional[int] = None,
+    rhs_batch: Optional[int] = None, sstep: Optional[int] = None,
+    overlap: Optional[bool] = None,
 ) -> Callable:
     """The whole CG solve as ONE compiled shard_map program:
     `lax.while_loop` whose body does the overlapped SpMV, deterministic
@@ -2800,10 +3008,77 @@ def make_cg_fn(
     operands become (P, W, K) slabs, the operator streams once per K
     columns (`_spmv_body`'s rank-polymorphic lowerings), and every
     column runs the textbook single-vector recurrence with per-column
-    scalars — see `make_block_cg_fn`, to which this delegates."""
+    scalars — see `make_block_cg_fn`, to which this delegates.
+
+    ``sstep=s`` (default: ``PA_TPU_SSTEP`` via `_sstep_env`; s <= 1 is
+    the textbook body) selects the communication-avoiding s-step/CA-CG
+    body: each outer while trip builds the s-deep Krylov basis
+    ``[p, Ap, …, Aˢp, r, Ar, …, Aˢ⁻¹r]`` by s levels of a PAIR SpMV
+    over the stacked ``(W, 2)`` operand (one halo exchange per level,
+    shipping the 2-lane slab through the depth-s widened plan — the
+    aggregated s-step ghost region), computes the whole (2s+1)-column
+    Gram payload with ONE block all_gather (`_pgram_factory`), runs the
+    s inner iterations as scalar recurrences in basis COORDINATES, and
+    materializes x/r/p once at trip end. Collective count per s
+    iterations: s exchanges + 1 dot all_gather, vs the standard body's
+    s exchanges + 2s gathers — the latency-floor attack (ROADMAP item
+    1; the palint ``sstep-gather-collapse`` contract pins the 1).
+    Monomial-basis conditioning degrades like κ̂ˢ, so choose s from the
+    measured spectrum (`telemetry.suggest_s`); the inner recurrences
+    re-associate the dots, so the trajectory is NOT bitwise the
+    textbook one for s >= 2 (s = 1 builds the identical standard
+    program). Single-RHS, unpreconditioned, unfused, SDC-off only —
+    explicit conflicting forms refuse with the typed
+    `LoweringConflictError`; env-driven conflicts fall back to the
+    textbook body with a stderr note (the pipelined-SDC precedent).
+
+    ``overlap`` (default: ``PA_TPU_OVERLAP`` via `_overlap_env`)
+    threads the explicit interior/boundary overlap SpMV tail
+    (`_spmv_body(overlap=True)`) through whichever body is selected —
+    it changes the schedule, never the values, and composes with every
+    form including ``sstep``."""
     import jax
     import jax.numpy as jnp
     shard_map = _shard_map()
+
+    sstep_explicit = sstep is not None
+    sstep = _resolve_sstep(sstep)
+    overlap = _resolve_overlap(overlap)
+
+    def _conflict(other: str):
+        # unconditional typed refusal (not check()): silently picking a
+        # body would change the program the caller asked for
+        from .health import LoweringConflictError
+
+        raise LoweringConflictError(
+            "make_cg_fn: the s-step (communication-avoiding) body does "
+            f"not compose with {other} — drop sstep or {other}",
+            diagnostics={"conflict": ("sstep", other)},
+        )
+
+    def _sstep_env_fallback(other: str) -> int:
+        # env-driven s-step meeting an incompatible form: the explicit
+        # request wins, s-step reverts to the textbook body — say so
+        # (the pipelined-SDC precedent: a user counting on the env var
+        # must know which body ran)
+        import sys
+
+        print(
+            "[partitionedarrays_jl_tpu] make_cg_fn: PA_TPU_SSTEP is set "
+            f"but this program uses {other} — the s-step body does not "
+            "compose with it; building the textbook body instead",
+            file=sys.stderr,
+            flush=True,
+        )
+        return 0
+
+    if sstep >= 2 and strict_bits():
+        # only reachable with an EXPLICIT sstep (the env resolves to 0
+        # under strict-bits): the textbook body is the strict oracle
+        _conflict("strict_bits (the textbook body is the bitwise oracle)")
+    if sstep >= 2 and fused:
+        # an explicit fused=True; the env default yields to s-step below
+        _conflict("fused")
 
     if rhs_batch is not None:
         if pipelined:
@@ -2813,11 +3088,30 @@ def make_cg_fn(
                 "make_cg_fn: the pipelined (lag-1) form is single-RHS "
                 "only — drop pipelined or rhs_batch"
             )
+        if sstep >= 2:
+            if sstep_explicit:
+                _conflict("rhs_batch")
+            _sstep_env_fallback("rhs_batch (block CG)")
         return make_block_cg_fn(
-            dA, tol, maxiter, rhs_batch, precond=precond, fused=fused
+            dA, tol, maxiter, rhs_batch, precond=precond, fused=fused,
+            overlap=overlap,
         )
 
-    fused = _resolve_fused(fused, pipelined)
+    if sstep >= 2:
+        if pipelined:
+            if sstep_explicit:
+                _conflict("pipelined")
+            sstep = _sstep_env_fallback("the pipelined (lag-1) form")
+        elif precond:
+            if sstep_explicit:
+                _conflict("precond")
+            sstep = _sstep_env_fallback("preconditioning")
+        else:
+            # the s-step body IS an unfused body: the PA_TPU_FUSED_CG
+            # default yields (an explicit fused=True refused above)
+            fused = False
+    if sstep < 2:
+        fused = _resolve_fused(fused, pipelined)
     if fused and pipelined:
         # unconditional (not check()): the two bodies place the x update
         # differently — silently picking one would change the program
@@ -2833,6 +3127,13 @@ def make_cg_fn(
     # its in-kernel x placement has no audit/rollback generalization
     # (docs/resilience.md).
     sdccfg = _sdc_config(maxiter)
+    if sstep >= 2 and sdccfg is not None:
+        # the s-step coordinate recurrences have no checksum/audit
+        # generalization this round; the defense wins over an env-driven
+        # s-step request (safety first), an explicit one refuses typed
+        if sstep_explicit:
+            _conflict("the SDC defense (PA_TPU_ABFT/PA_HEALTH_AUDIT_*)")
+        sstep = _sstep_env_fallback("the SDC defense (ABFT/audit)")
     if pipelined and sdccfg is not None:
         # say it out loud: the lowering still pays ABFT's side costs
         # (generic exchange plan, staged checksum row) but this body
@@ -2857,11 +3158,14 @@ def make_cg_fn(
     # byte-identical to the pre-telemetry one; the pipelined body is
     # trace-exempt (the same precedent as its SDC exemption).
     Ht = 0 if pipelined else int(min(_trace_config(), maxiter))
-    body_spmv = _spmv_body(dA, abft=abft_on)
-    body_axpy = _spmv_body(dA, axpy=True) if pipelined else None
+    body_spmv = _spmv_body(dA, abft=abft_on, overlap=overlap)
+    body_axpy = (
+        _spmv_body(dA, axpy=True, overlap=overlap) if pipelined else None
+    )
     body_pfold = (
         _spmv_body(
-            dA, pfold=True, abft=abft_on, audit=sdccfg is not None
+            dA, pfold=True, abft=abft_on, audit=sdccfg is not None,
+            overlap=overlap,
         )
         if fused
         else None
@@ -2891,6 +3195,7 @@ def make_cg_fn(
     pdot = _pdot_factory(o0, no_max)
     odot1, odot2 = _pdot_owned_factory(no_max)
     dox = _pdot_extra_factory(0, no_max) if sdccfg is not None else None
+    pgram = _pgram_factory(0, no_max) if sstep >= 2 else None
     ops = _matrix_operands(dA)
     specs = jax.tree.map(lambda _: spec, ops)
     strict = strict_bits()
@@ -2903,6 +3208,19 @@ def make_cg_fn(
     # per-iteration residual history, fixed-shape for the while_loop carry
     # (capped: a convergence curve beyond this many entries is truncated)
     H = int(min(maxiter + 1, 4096))
+
+    # s-step basis-shift matrix (static): with monomial columns ordered
+    # [p, Ap, .., A^s p, r, Ar, .., A^{s-1} r], multiplying coordinates
+    # by B is "apply A" — a degree bump inside each block. The last
+    # column of each block has no in-span image; the recurrences never
+    # need it (p_j has degree ≤ s-1 when w = A p_j is formed).
+    B_shift = None
+    if sstep >= 2:
+        B_shift = np.zeros((2 * sstep + 1, 2 * sstep + 1))
+        for _i in range(sstep):
+            B_shift[_i + 1, _i] = 1.0
+        for _i in range(sstep - 1):
+            B_shift[sstep + 2 + _i, sstep + 1 + _i] = 1.0
 
     @jax.jit
     def fn(b, x0, mv, m):
@@ -3456,6 +3774,103 @@ def make_cg_fn(
                     ),)
                 return out
 
+            if sstep >= 2:
+                # ---- communication-avoiding s-step (CA-CG) loop ----
+                # One outer while trip = s textbook iterations. The trip
+                # builds the monomial Krylov basis by s levels of a PAIR
+                # SpMV on the stacked (W, 2) [p | r] operand (one halo
+                # exchange per level, both lanes on one wire round),
+                # ships the ENTIRE inner-product workload as one Gram
+                # all_gather, then runs the s α/β recurrences on basis
+                # COORDINATES (m = 2s+1 scalars each) — zero collectives
+                # — and materializes x/r/p with three owned-region GEMVs
+                # at trip end. Residual norms come from the coordinate
+                # quadratic form r_cᵀ G r_c (clamped at 0: near
+                # convergence the re-associated form can round a hair
+                # negative); convergence is checked once per trip, so a
+                # solve can run up to s-1 iterations past tolerance —
+                # `iterations` stays honest (trips × s).
+                slf2 = slice(o0, o0 + no_max)
+                m_dim = 2 * sstep + 1
+                hp = jax.lax.Precision.HIGHEST
+
+                def gemv(V, c):
+                    return jnp.einsum(
+                        "wm,m->w", V, c,
+                        preferred_element_type=V.dtype, precision=hp,
+                    )
+
+                def step_ss(state):
+                    if Ht:
+                        x, r_, p_, _rz, rs_, it, hist_, ab = state
+                    else:
+                        x, r_, p_, _rz, rs_, it, hist_ = state
+                        ab = None
+                    # s basis levels: cur carries [Aʲp | Aʲr] in the
+                    # cols layout; the body returns the rows-range
+                    # product, so each level re-embeds the owned rows
+                    # (ghost slots zero — the next level's exchange
+                    # refills them from the owners, exactly like the
+                    # textbook body's per-iteration p update)
+                    cur = jnp.stack([p_, r_], axis=-1)
+                    pcols = [p_[slf2]]
+                    rcols = [r_[slf2]]
+                    for lev in range(sstep):
+                        y_lv, _ = body_spmv(cur, mats)
+                        yo = y_lv[slf2]
+                        pcols.append(yo[:, 0])
+                        if lev < sstep - 1:
+                            rcols.append(yo[:, 1])
+                            cur = (
+                                jnp.zeros(
+                                    (p_.shape[0], 2), dtype=p_.dtype
+                                ).at[slf2].set(yo)
+                            )
+                    V = jnp.stack(pcols + rcols, axis=-1)
+                    G = pgram(V)  # the ONE dot all_gather of the trip
+                    Bs = jnp.asarray(B_shift, dtype=bv.dtype)
+                    p_c = jnp.zeros((m_dim,), bv.dtype).at[0].set(1.0)
+                    r_c = (
+                        jnp.zeros((m_dim,), bv.dtype)
+                        .at[sstep + 1].set(1.0)
+                    )
+                    x_c = jnp.zeros((m_dim,), bv.dtype)
+                    rs_j = rs_
+                    hist2, ab2 = hist_, ab
+                    for j in range(sstep):
+                        w = Bs @ p_c  # coords of A p_j (in-span by deg)
+                        alpha = rs_j / (p_c @ (G @ w))
+                        x_c = x_c + alpha * p_c
+                        r_c = r_c - alpha * w
+                        rs_new = jnp.maximum(r_c @ (G @ r_c), 0.0)
+                        beta = rs_new / rs_j
+                        p_c = r_c + beta * p_c
+                        hist2 = hist2.at[
+                            jnp.minimum(it + j + 1, H - 1)
+                        ].set(jnp.sqrt(rs_new))
+                        if Ht:
+                            ab2 = ab2.at[(it + j) % Ht].set(
+                                jnp.stack([alpha, beta])
+                            )
+                        rs_j = rs_new
+                    x2 = x.at[slf2].add(gemv(V, x_c))
+                    r2 = r_.at[slf2].set(gemv(V, r_c))
+                    p2 = p_.at[slf2].set(gemv(V, p_c))
+                    out = (x2, r2, p2, rs_j, rs_j, it + sstep, hist2)
+                    if Ht:
+                        out = out + (ab2,)
+                    return out
+
+                init_ss = (xv, r, p, rz0, rs0, jnp.int32(0), hist)
+                if Ht:
+                    init_ss = init_ss + (
+                        jnp.zeros((Ht, 2), dtype=bv.dtype),
+                    )
+                fin = jax.lax.while_loop(cond, step_ss, init_ss)
+                x, rs, it, hist = fin[0], fin[4], fin[5], fin[6]
+                out = (x[None], rs, rs0, it, hist)
+                return out + ((fin[7],) if Ht else ())
+
             if not pipelined:
                 init_s = (xv, r, p, rz0, rs0, jnp.int32(0), hist)
                 if Ht:
@@ -3544,6 +3959,7 @@ def make_cg_fn(
         precond=bool(precond), pipelined=bool(pipelined),
         fused=bool(fused), rhs_batch=None,
         sdc=sdccfg is not None, abft=abft_on,
+        sstep=int(sstep) if sstep >= 2 else 0, overlap=bool(overlap),
     )
     return run
 
@@ -3551,6 +3967,7 @@ def make_cg_fn(
 def make_block_cg_fn(
     dA: DeviceMatrix, tol: float, maxiter: int, rhs_batch: int,
     precond: bool = False, fused: Optional[bool] = None,
+    overlap: Optional[bool] = None,
 ) -> Callable:
     """Block (multi-RHS) CG: ONE compiled shard_map program solving
     ``A X = B`` for K = ``rhs_batch`` right-hand sides against the SAME
@@ -3608,9 +4025,13 @@ def make_block_cg_fn(
     # α/β slot per trip) — same precedent as the pipelined body's SDC
     # exemption, noted in docs/observability.md.
     Ht = 0 if sdccfg is not None else int(min(_trace_config(), maxiter))
-    body_spmv = _spmv_body(dA, abft=abft_on)
+    overlap = _resolve_overlap(overlap)
+    body_spmv = _spmv_body(dA, abft=abft_on, overlap=overlap)
     body_pfold = (
-        _spmv_body(dA, pfold=True, abft=abft_on, audit=sdccfg is not None)
+        _spmv_body(
+            dA, pfold=True, abft=abft_on, audit=sdccfg is not None,
+            overlap=overlap,
+        )
         if fused
         else None
     )
@@ -4197,6 +4618,7 @@ def make_block_cg_fn(
     run.comms_kwargs = dict(
         precond=bool(precond), pipelined=False, fused=bool(fused),
         rhs_batch=K, sdc=sdccfg is not None, abft=abft_on,
+        sstep=0, overlap=bool(overlap),
     )
     return run
 
@@ -5156,8 +5578,16 @@ def tpu_cg(
     backend = b.values.backend
     check(isinstance(backend, TPUBackend), "tpu_cg needs a TPU-backend PVector")
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
-    fused = _resolve_fused(fused, pipelined)
-    body = "pipelined" if pipelined else ("fused" if fused else "standard")
+    _sdc0 = None if pipelined else _sdc_config(int(maxiter))
+    eff_sstep, fused = _sstep_resolve_env(
+        pipelined, minv is not None, None, fused, _sdc0 is not None
+    )
+    body = (
+        "pipelined" if pipelined
+        else f"sstep{eff_sstep}" if eff_sstep
+        else "fused" if fused
+        else "standard"
+    )
     name = "pcg" if minv is not None else "cg"
     with telemetry.solve_scope(
         name, backend="tpu", tol=float(tol), maxiter=int(maxiter),
@@ -5485,16 +5915,25 @@ def _krylov_fn_for(
     precond: bool = False, pipelined: bool = False,
     fused: Optional[bool] = None, rhs_batch: Optional[int] = None,
 ):
-    if method == "cg":
-        # the cache key must be the CONCRETE body choice (the env mode is
-        # also part of _lowering_env_key, which rekeys the DeviceMatrix
-        # itself on a flip)
-        fused = _resolve_fused(fused, pipelined)
     # the SDC config (audit period, budgets, tolerance overrides, the
     # device fault clause) is resolved at build time — key it so an env
     # flip rebuilds the program instead of serving a stale defense
     # (pipelined programs are SDC-exempt and must not retrace on flips)
     sdccfg = None if pipelined else _sdc_config(int(maxiter))
+    # env-driven s-step / overlap: the cache key must hold the CONCRETE
+    # body choice, so mirror make_cg_fn's resolution order — the s-step
+    # body wins over an env-default fused, and every composition it
+    # refuses (pipelined/precond/block/SDC) falls back to the standard
+    # depth (make_cg_fn prints the fallback note when it builds)
+    eff_sstep = 0
+    if method == "cg":
+        # the cache key must be the CONCRETE body choice (the env mode is
+        # also part of _lowering_env_key, which rekeys the DeviceMatrix
+        # itself on a flip)
+        eff_sstep, fused = _sstep_resolve_env(
+            pipelined, precond, rhs_batch, fused, sdccfg is not None
+        )
+    eff_overlap = _overlap_env()
     # the trace-ring depth changes the traced program (an extra carry),
     # so it joins the key through the same helper make_cg_fn resolves
     # it with (_trace_config — a registered env-key site). Key the
@@ -5531,7 +5970,7 @@ def _krylov_fn_for(
     key = (
         method, float(tol), int(maxiter), bool(precond), bool(pipelined),
         bool(fused), rhs_batch, sdccfg["key"] if sdccfg else None,
-        trace_ht,
+        trace_ht, eff_sstep, eff_overlap,
     )
 
     if key not in dA._cg_cache:
@@ -5627,6 +6066,8 @@ _MATRIX_BASE_ENV = {
     "PA_TPU_GMG_BOX": None,
     "PA_TPU_GMG_STENCIL": None,
     "PA_TRACE_ITERS": None,
+    "PA_TPU_SSTEP": None,
+    "PA_TPU_OVERLAP": None,
 }
 
 
@@ -5666,6 +6107,16 @@ def lowering_matrix(fast: bool = False):
                    "abft_off": "standard_nobox"}),
         dict(name="standard_f32", env={}, kwargs={"fused": False},
              dtype="f32", tags={"body": "standard", "staged": "f32"}),
+        # the ISSUE 17 perf bodies: s-step (CA-CG, one Gram gather per
+        # s iterations — the sstep-gather-collapse contract) and the
+        # interior/boundary overlap schedule (collective parity with
+        # the standard body it reorders — overlap-collective-parity)
+        dict(name="sstep2", env={"PA_TPU_SSTEP": "2"}, kwargs={},
+             dtype="f64", tags={"body": "sstep", "s": 2}),
+        dict(name="overlap", env={"PA_TPU_OVERLAP": "1"},
+             kwargs={"fused": False}, dtype="f64",
+             tags={"body": "standard", "overlap": True,
+                   "overlap_off": "standard"}),
     ]
     if fast:
         return cases

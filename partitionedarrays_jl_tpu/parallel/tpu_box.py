@@ -355,6 +355,30 @@ class BoxExchangePlan:
         return BoxExchangePlan(self.layout, self.info, not self.reverse_mode)
 
 
+class WidenedBoxExchangePlan(BoxExchangePlan):
+    """The depth-s widened box plan (s-step CG, tpu.py ISSUE 17): the
+    SAME direction slices and unpack segments as the depth-1 plan —
+    the s-step outer trip re-runs them once per basis level with a
+    2-lane ``(W, 2)`` pair slab, so the aggregated ghost region shipped
+    per trip is ``ghost_depth`` × the per-level payload — tagged with
+    the depth for comms accounting and the plan audit. `verify_plan`
+    dispatches through the base class (isinstance), so all five
+    soundness checks run unchanged on the widened variant."""
+
+    __slots__ = ("ghost_depth",)
+
+    def __init__(self, layout, info: BoxInfo, depth: int,
+                 reverse_mode: bool = False):
+        super().__init__(layout, info, reverse_mode)
+        self.ghost_depth = int(depth)
+
+    def reverse(self) -> "WidenedBoxExchangePlan":
+        return WidenedBoxExchangePlan(
+            self.layout, self.info, self.ghost_depth,
+            not self.reverse_mode,
+        )
+
+
 def shard_box_exchange(plan: BoxExchangePlan, combine: str):
     """Per-shard exchange body with the SAME signature as tpu.py's
     `_shard_exchange` bodies: body(xv, si, sm, ri) — the three index

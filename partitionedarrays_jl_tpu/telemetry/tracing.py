@@ -644,6 +644,12 @@ def mount_phase_spans(spans: List[dict], profile: dict) -> List[dict]:
     wall clock (sequential, attribution shares preserved) — one view
     then runs HTTP ingress → `dot_allgather`. Returns the ADDED
     spans; callers concatenate."""
+    cases = profile.get("profiles")
+    if isinstance(cases, dict) and cases:
+        # the schema-v2 committed container (one profile per lowering
+        # case, round 17): mount the standard body's attribution — the
+        # slab spans carry no body label to dispatch on
+        profile = cases.get("standard") or next(iter(cases.values()))
     phases = profile.get("phases") or {}
     per_it = {
         p: float(v.get("s_per_it") or 0.0) for p, v in phases.items()

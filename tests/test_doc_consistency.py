@@ -499,6 +499,73 @@ def test_obs_artifact_agrees_with_guard_bands():
         assert "real TPUs" in rec["note"]
 
 
+def test_sstep_artifact_agrees_with_guard_bands():
+    """The committed s-step/overlap A/B artifact (round 17) and the
+    bench guard must agree: identical device-knee band bounds (the
+    >= 1.15x s-step acceptance), speedup rows self-consistent with the
+    per-body marginals, the suggest_s policy block reproducible from
+    the committed SPECTRUM.json through `telemetry.suggest_s`, and the
+    docs/performance.md claims tied to the artifact. Device-kind bands
+    gate only records measured on real TPUs — a cpu-platform record is
+    the structural canary (its note must say so) and carries the wide
+    canary sanity bands instead."""
+    from partitionedarrays_jl_tpu import telemetry
+
+    bench_sstep = _load_tool("bench_sstep")
+    rec = json.load(open(os.path.join(REPO, "SSTEP_BENCH.json")))
+    assert rec["methodology"] == bench_sstep.METHODOLOGY
+    assert rec["sstep"] == bench_sstep.SSTEP
+    for key, (lo, hi, kind) in bench_sstep.SSTEP_BANDS.items():
+        band = rec["bands"].get(key)
+        assert band is not None, f"artifact missing band {key}"
+        assert (band["lo"], band["hi"], band["kind"]) == (lo, hi, kind), (
+            key, band,
+        )
+    std = rec["bodies"]["standard"]["s_per_it"]
+    for body, row in rec["bodies"].items():
+        if body == "standard":
+            continue
+        ratio = std / row["s_per_it"]
+        assert abs(row["speedup_vs_standard"] - ratio) <= (
+            1e-3 * ratio
+        ), (body, row)
+    # the policy block must be what telemetry.suggest_s derives from
+    # the committed spectrum store today (artifact and policy cannot
+    # drift apart silently)
+    spec_rec = json.load(open(os.path.join(REPO, "SPECTRUM.json")))
+    by_key = {
+        (e["fingerprint"], e["dtype"], e["minv_class"]): e
+        for e in spec_rec["entries"]
+    }
+    assert rec["suggest_s"], "artifact lost its suggest_s policy block"
+    for row in rec["suggest_s"]:
+        e = by_key[(row["fingerprint"], row["dtype"], row["minv_class"])]
+        pol = telemetry.suggest_s(
+            {"kappa": e.get("kappa"), "rate": e.get("rate"),
+             "samples": e.get("samples", 1)},
+            e["dtype"], tol=1e-8,
+        )
+        assert row["suggested_s"] == pol["s"], row
+        assert row["policy"] == pol["policy"]
+        assert row["gather_factor"] == pol["gather_factor"]
+    if rec["platform"] == "tpu":
+        assert rec["bands_ok_device"] is True
+    else:
+        assert rec["bands_ok_device"] is None
+        assert "real TPUs" in rec["note"]
+        for key, (lo, hi, kind) in bench_sstep.CANARY_BANDS.items():
+            band = rec["bands"].get(key)
+            assert band is not None, f"canary record missing band {key}"
+            assert band["kind"] == kind and band["in_band"] is True
+    # the docs claim the knee the artifact enforces
+    perf = open(os.path.join(REPO, "docs", "performance.md")).read()
+    assert "SSTEP_BENCH.json" in perf
+    knee = bench_sstep.SSTEP_BANDS["sstep2_speedup_vs_standard"][0]
+    assert f"{knee:.2f}" in perf, (
+        "docs/performance.md must state the device knee the band pins"
+    )
+
+
 def test_memory_footprint_artifact_agrees_with_budgets():
     """The committed static-memory footprint table (the paplan
     tentpole's admission-budget artifact, written by

@@ -81,6 +81,13 @@ def test_phase_profile_sums_in_band_and_reconciles(fixture_Ab,
     monkeypatch.setenv("PA_PROF_TRACE", "0")
     A, backend = fixture_Ab
     profile = prof.capture_phase_profile(A, backend, reps=3)
+    # a loaded host (the full tier-1 suite around this test) can push
+    # one capture round out of band on pure timer jitter — the same
+    # bounded re-capture discipline as paprof's CLI entry points
+    for _retry in range(2):
+        if profile is None or profile["in_band"]:
+            break
+        profile = prof.capture_phase_profile(A, backend, reps=3)
     assert profile is not None
     assert profile["phase_schema_version"] == prof.PHASE_SCHEMA_VERSION
     assert profile["method"] == "split-timer"
@@ -123,16 +130,27 @@ def test_phase_profile_sums_in_band_and_reconciles(fixture_Ab,
 
 def test_phase_trace_events_merge_shape(fixture_Ab):
     """The patrace merge feed: spans for every phase, synthetic
-    iterations consecutive, args carrying the attribution identity."""
-    committed = json.load(open(os.path.join(REPO, "PHASE_PROFILE.json")))
-    events = prof.phase_trace_events(committed, iterations=2)
-    spans = [e for e in events if e.get("cat") == "phase"]
-    assert len(spans) == 2 * len(prof.PHASES)
-    assert {e["name"] for e in spans} == set(prof.PHASES)
-    ts = [e["ts"] for e in spans]
-    assert ts == sorted(ts)
-    assert all(
-        e["args"]["case"] == committed["case"] for e in spans
+    iterations consecutive, args carrying the attribution identity.
+    The committed artifact is the schema-2 multi-case container; the
+    overlap entry additionally carries its boundary_spmv phase."""
+    rec = json.load(open(os.path.join(REPO, "PHASE_PROFILE.json")))
+    for case in ("standard", "overlap"):
+        committed = rec["profiles"][case]
+        phases = prof.profile_phases(committed)
+        events = prof.phase_trace_events(committed, iterations=2)
+        spans = [e for e in events if e.get("cat") == "phase"]
+        assert len(spans) == 2 * len(phases)
+        assert {e["name"] for e in spans} == set(phases)
+        ts = [e["ts"] for e in spans]
+        assert ts == sorted(ts)
+        assert all(
+            e["args"]["case"] == committed["case"] for e in spans
+        )
+    assert prof.PHASE_BOUNDARY in prof.profile_phases(
+        rec["profiles"]["overlap"]
+    )
+    assert prof.PHASE_BOUNDARY not in prof.profile_phases(
+        rec["profiles"]["standard"]
     )
 
 
@@ -245,13 +263,35 @@ def test_committed_comms_matrix_matches_fresh_static_derivation():
 
 
 def test_committed_phase_profile_is_reconciled():
-    """PHASE_PROFILE.json: schema-versioned, internally reconciled,
-    in its own recorded band, carrying the shared artifact envelope."""
+    """PHASE_PROFILE.json (the schema-2 container): every committed
+    case internally reconciled and in its own recorded band, the
+    envelope on the container, and the case set covering the full
+    lowering matrix through `phase_case_of` (the ISSUE-17 bugfix: the
+    artifact used to commit only the fused body)."""
     rec = json.load(open(os.path.join(REPO, "PHASE_PROFILE.json")))
     assert rec["phase_schema_version"] == prof.PHASE_SCHEMA_VERSION
-    assert prof.reconcile_phases(rec) == []
-    assert rec["in_band"] is True
-    assert rec["fingerprint"] == "g36-p4"
+    profiles = rec["profiles"]
+    assert set(profiles) == {
+        "standard", "fused", "block_k1_fused", "block_k4_fused",
+        "sstep2", "overlap",
+    }
+    for case, p in profiles.items():
+        assert p["case"] == case
+        assert prof.reconcile_phases(p) == [], case
+        assert p["in_band"] is True, case
+        assert p["fingerprint"] == "g36-p4"
+    # the s-step entry is attributed per TRIP (unit = s); the overlap
+    # entry names its boundary attribution
+    assert profiles["sstep2"]["unit"] == 2
+    assert profiles["overlap"]["boundary_attribution"] == (
+        "structural-nnz-split"
+    )
+    # every lowering-matrix case must map onto a committed entry —
+    # paprof --check's coverage gate, pinned here against the artifact
+    from partitionedarrays_jl_tpu.parallel.tpu import lowering_matrix
+
+    for case in lowering_matrix():
+        assert prof.phase_case_of(case["name"]) in profiles, case["name"]
     assert rec.get("schema_version") == telemetry.ARTIFACT_SCHEMA_VERSION
     assert rec.get("generated_by") == "paprof"
     assert rec.get("platform") and isinstance(rec.get("pa_env"), dict)

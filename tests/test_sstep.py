@@ -1,0 +1,304 @@
+"""pastep — the communication-avoiding s-step CG body and the
+interior/boundary overlap SpMV schedule (round 17).
+
+Four contracts, each pinned here:
+
+* **Degenerate identity.** ``sstep=1`` is DEFINED as the textbook
+  standard body (`_resolve_sstep` normalizes 0 and 1 to the same
+  program) — pinned as lowered-program identity under strict-bits, the
+  strongest possible bitwise claim: identical StableHLO text implies
+  bit-identical trajectories.
+* **Schedule-only overlap.** The overlap body splits the SpMV tail into
+  interior rows (fenced against the in-flight halo rounds) and boundary
+  rows finished on arrival — it changes WHEN, never WHAT. Pinned as a
+  bitwise run-to-run comparison under strict-bits on the 4-part
+  fixture: identical residual bits, identical solution bits.
+* **Gather collapse + refusal matrix.** The s >= 2 body replaces the
+  textbook 2 scalar all_gathers per iteration with ONE block gather per
+  trip (asserted on lowered HLO, the test_fused_cg A/B discipline), and
+  every composition it cannot honor refuses typed when EXPLICIT
+  (`LoweringConflictError`) or falls back with a stderr note when
+  env-driven — the pipelined-SDC precedent.
+* **Widened plans.** The depth-s exchange plan is the depth-1 plan's
+  round structure tagged ``ghost_depth`` — both plan families (generic
+  and box) pass all five PR 8 plan-verifier checks, the depth-1 plan
+  stays the SAME cached instance, and the host plan's
+  `canonical_exchange_fingerprint` is untouched.
+
+Plus the `suggest_s` policy arithmetic (telemetry.spectrum): stability
+budget, unmeasured degradation to s=1, and the gather-count forecast
+the paspec CLI leg surfaces.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu import telemetry
+from partitionedarrays_jl_tpu.analysis import collective_counts, lower_text
+from partitionedarrays_jl_tpu.analysis import plan_verifier as pv
+from partitionedarrays_jl_tpu.models import assemble_poisson, gather_pvector
+from partitionedarrays_jl_tpu.parallel.health import LoweringConflictError
+from partitionedarrays_jl_tpu.parallel.tpu import (
+    DeviceVector,
+    TPUBackend,
+    WidenedDeviceExchangePlan,
+    _b_on_cols_layout,
+    _matrix_operands,
+    device_exchange_plan,
+    device_matrix,
+    make_cg_fn,
+    tpu_cg,
+)
+from partitionedarrays_jl_tpu.parallel.tpu_box import WidenedBoxExchangePlan
+
+
+def _backend(n=4):
+    import jax
+
+    return TPUBackend(devices=jax.devices()[:n])
+
+
+def _staged(parts=(2, 2), ns=(8, 8)):
+    """A staged 4-part system: (dA, db, dx0, ops) ready for
+    make_cg_fn lowering — the test_fused_cg HLO idiom."""
+    backend = _backend()
+
+    def driver(p):
+        A, b, xe, x0 = assemble_poisson(p, ns)
+        return A, b
+
+    A, b = pa.prun(driver, backend, parts)
+    dA = device_matrix(A, backend)
+    db = _b_on_cols_layout(b, dA)
+    dx0 = DeviceVector.from_pvector(
+        pa.PVector.full(0.0, A.cols), backend, dA.col_layout
+    )
+    return dA, db, dx0, _matrix_operands(dA)
+
+
+# ---------------------------------------------------------------------------
+# bitwise: s=1 and overlap against the textbook body under strict-bits
+# ---------------------------------------------------------------------------
+
+
+def test_sstep1_is_the_textbook_program_under_strict(monkeypatch):
+    """``sstep=1`` (the degenerate depth) lowers to the IDENTICAL
+    StableHLO as the standard body under strict-bits — program identity
+    is the bitwise claim, with no run needed."""
+    monkeypatch.setenv("PA_TPU_STRICT_BITS", "1")
+    dA, db, dx0, ops = _staged()
+    one = make_cg_fn(dA, tol=1e-9, maxiter=100, sstep=1)
+    std = make_cg_fn(dA, tol=1e-9, maxiter=100)
+    t1 = lower_text(one, db.data, dx0.data, db.data, ops)
+    t0 = lower_text(std, db.data, dx0.data, db.data, ops)
+    assert t1 == t0
+
+
+def test_overlap_body_bitwise_identical_under_strict(monkeypatch):
+    """PA_TPU_OVERLAP=1 under strict-bits: the interior/boundary split
+    reorders the schedule only — residual history and solution are
+    bit-for-bit the standard body's on the 4-part fixture."""
+    monkeypatch.setenv("PA_TPU_STRICT_BITS", "1")
+
+    def run():
+        def driver(parts):
+            A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+            x, info = tpu_cg(A, b, x0=x0, tol=1e-10, maxiter=200)
+            return gather_pvector(x), info
+
+        return pa.prun(driver, _backend(), (2, 2))
+
+    x_std, inf_std = run()
+    monkeypatch.setenv("PA_TPU_OVERLAP", "1")
+    x_ovl, inf_ovl = run()
+    assert inf_std["converged"] and inf_ovl["converged"]
+    assert inf_ovl["iterations"] == inf_std["iterations"]
+    rs = np.asarray(inf_std["residuals"], dtype=np.float64)
+    ro = np.asarray(inf_ovl["residuals"], dtype=np.float64)
+    assert ro.tobytes() == rs.tobytes()
+    assert np.asarray(x_ovl).tobytes() == np.asarray(x_std).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# s >= 2: gather collapse on lowered HLO; convergence on the real solve
+# ---------------------------------------------------------------------------
+
+
+def test_sstep2_program_collapses_gathers():
+    """The s-step program carries ONE block all_gather per s-iteration
+    trip where the textbook body pays 2 scalar gathers per iteration —
+    strictly fewer all_gathers in the lowered program (the collective
+    budget palint pins per lowering-matrix case)."""
+    dA, db, dx0, ops = _staged()
+    ca = make_cg_fn(dA, tol=1e-9, maxiter=100, sstep=2)
+    std = make_cg_fn(dA, tol=1e-9, maxiter=100, fused=False)
+    cc = collective_counts(ca, db.data, dx0.data, db.data, ops)
+    cu = collective_counts(std, db.data, dx0.data, db.data, ops)
+    assert cu["all_gather"] > 0
+    assert cc["all_gather"] < cu["all_gather"], (cc, cu)
+
+
+def test_sstep2_converges_and_matches_standard(monkeypatch):
+    """PA_TPU_SSTEP=2 end to end through `tpu_cg`: the body label says
+    so, the solve converges, and the solution matches the textbook
+    body's to rounding (the monomial basis at s=2 is far inside the
+    f64 stability budget on this operator)."""
+
+    def run():
+        def driver(parts):
+            A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+            x, info = tpu_cg(A, b, x0=x0, tol=1e-9, maxiter=400)
+            return gather_pvector(x), info
+
+        return pa.prun(driver, _backend(), (2, 2))
+
+    monkeypatch.setenv("PA_TPU_FUSED_CG", "0")
+    x_std, inf_std = run()
+    monkeypatch.setenv("PA_TPU_SSTEP", "2")
+    x_ca, inf_ca = run()
+    assert inf_std["cg_body"] == "standard"
+    assert inf_ca["cg_body"] == "sstep2"
+    assert inf_std["converged"] and inf_ca["converged"]
+    assert inf_ca["iterations"] <= 2 * inf_std["iterations"]
+    np.testing.assert_allclose(
+        np.asarray(x_ca), np.asarray(x_std), atol=1e-7
+    )
+
+
+# ---------------------------------------------------------------------------
+# refusal matrix: explicit conflicts refuse typed, env conflicts fall back
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {"fused": True},
+        {"rhs_batch": 2},
+        {"pipelined": True},
+        {"precond": True},
+    ],
+    ids=["fused", "rhs_batch", "pipelined", "precond"],
+)
+def test_explicit_sstep_conflicts_refuse_typed(kwargs):
+    dA, _, _, _ = _staged()
+    with pytest.raises(LoweringConflictError) as ei:
+        make_cg_fn(dA, tol=1e-9, maxiter=50, sstep=2, **kwargs)
+    assert ei.value.diagnostics["conflict"][0] == "sstep"
+
+
+def test_explicit_sstep_refuses_under_strict_bits(monkeypatch):
+    dA, _, _, _ = _staged()
+    monkeypatch.setenv("PA_TPU_STRICT_BITS", "1")
+    with pytest.raises(LoweringConflictError):
+        make_cg_fn(dA, tol=1e-9, maxiter=50, sstep=2)
+
+
+def test_explicit_sstep_refuses_with_sdc_defense(monkeypatch):
+    dA, _, _, _ = _staged()
+    monkeypatch.setenv("PA_TPU_ABFT", "1")
+    with pytest.raises(LoweringConflictError) as ei:
+        make_cg_fn(dA, tol=1e-9, maxiter=50, sstep=2)
+    assert "SDC" in ei.value.diagnostics["conflict"][1]
+
+
+def test_env_sstep_falls_back_with_note(monkeypatch, capfd):
+    """Env-driven PA_TPU_SSTEP meeting an incompatible explicit form:
+    the explicit request wins, the builder reverts to the textbook body
+    and says so on stderr (the pipelined-SDC precedent)."""
+    dA, _, _, _ = _staged()
+    monkeypatch.setenv("PA_TPU_SSTEP", "2")
+    fn = make_cg_fn(dA, tol=1e-9, maxiter=50, precond=True)
+    assert fn is not None
+    err = capfd.readouterr().err
+    assert "PA_TPU_SSTEP" in err and "does not compose" in err
+
+
+# ---------------------------------------------------------------------------
+# widened plans: both families pass all five checks; depth 1 untouched
+# ---------------------------------------------------------------------------
+
+
+def test_widened_plans_pass_all_five_checks(monkeypatch):
+    assert len(pv.PLAN_CHECKS) == 5
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (6, 6))
+        rows = A.cols
+        ref = pv.referenced_ghosts(A)
+        canon_before = pv.canonical_exchange_fingerprint(
+            rows.exchanger, rows.partition
+        )
+
+        # box family (the default on a cartesian partition)
+        wide = device_exchange_plan(rows, depth=2)
+        assert isinstance(wide, WidenedBoxExchangePlan)
+        assert wide.ghost_depth == 2
+        assert pv.verify_plan(wide, referenced=ref) == []
+        base = device_exchange_plan(rows)
+        # depth 1 is the exact pre-s-step object: the SAME cached
+        # instance, and the widened plan shares its slot/round structure
+        assert base is device_exchange_plan(rows, depth=1)
+        assert not isinstance(base, WidenedBoxExchangePlan)
+        assert pv.plan_fingerprint(wide) == pv.plan_fingerprint(base)
+
+        # generic family (PA_TPU_BOX=0 reads the host lids)
+        monkeypatch.setenv("PA_TPU_BOX", "0")
+        rows._device_plan = {}
+        for attr in ("_device_layout", "_box_info"):
+            if hasattr(rows, attr):
+                delattr(rows, attr)
+        gwide = device_exchange_plan(rows, depth=2)
+        assert isinstance(gwide, WidenedDeviceExchangePlan)
+        assert gwide.ghost_depth == 2
+        assert pv.verify_plan(gwide, referenced=ref) == []
+        gbase = device_exchange_plan(rows, depth=1)
+        assert gbase is device_exchange_plan(rows)
+        assert pv.plan_fingerprint(gwide) == pv.plan_fingerprint(gbase)
+
+        # widening staged nothing into the HOST plan: the canonical
+        # (layout-independent) fingerprint is untouched
+        assert pv.canonical_exchange_fingerprint(
+            rows.exchanger, rows.partition
+        ) == canon_before
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+# ---------------------------------------------------------------------------
+# suggest_s: the spectrum-driven depth policy
+# ---------------------------------------------------------------------------
+
+
+def test_suggest_s_policy_arithmetic():
+    # unmeasured spec degrades to the always-safe s=1
+    out = telemetry.suggest_s(None, dtype="float64")
+    assert out["s"] == 1 and out["policy"] == "unmeasured-default"
+
+    # hopeless conditioning clamps to 1; a perfectly conditioned
+    # operator rides the cap
+    assert telemetry.suggest_s({"kappa": 1e300})["s"] == 1
+    assert telemetry.suggest_s({"kappa": 0.9})["s"] == telemetry.SSTEP_MAX
+
+    # the stability budget is dtype-aware: same kappa, wider eps,
+    # shallower depth — and the exact floors are pinned
+    assert telemetry.sstep_stability_limit(40.0, "float64") == 7
+    assert telemetry.sstep_stability_limit(40.0, "float32") == 2
+
+    out = telemetry.suggest_s(
+        {"kappa": 40.0, "rate": 0.5, "samples": 8}, dtype="float64",
+        tol=1e-8,
+    )
+    assert out["policy"] == "largest-stable"
+    assert out["s"] == 7 and out["gather_factor"] == 14
+    assert len(out["candidates"]) == telemetry.SSTEP_MAX
+    assert all(c["gather_factor"] == 2 * c["s"] for c in out["candidates"])
+    assert all(
+        c["stable"] == (c["s"] <= 7) for c in out["candidates"]
+    )
+    fc = out["forecast"]
+    assert fc["standard_gathers"] == 2 * fc["predicted_iters"]
+    assert fc["sstep_gathers"] == math.ceil(fc["predicted_iters"] / 7)

@@ -244,7 +244,9 @@ EXPECTED_LOWERING_FLAGS = {
     "PA_TPU_GMG_BOX",
     "PA_TPU_GMG_STENCIL",
     "PA_TPU_OH_BUCKETS",
+    "PA_TPU_OVERLAP",
     "PA_TPU_SD",
+    "PA_TPU_SSTEP",
     "PA_TPU_STRICT_BITS",
     "PA_TRACE_ITERS",
 }

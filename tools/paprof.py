@@ -28,14 +28,20 @@ item 3). Legs:
                           exchange cost matrix of the fixture operator
                           and print/write it.
 * ``--write``             regenerate the committed PHASE_PROFILE.json
-                          and COMMS_MATRIX.json (the comms matrix on
-                          the generic index plan — ``PA_TPU_BOX=0`` —
+                          (schema v2: ONE profile per committed body
+                          case — standard, fused, block_k1/k4, and the
+                          ISSUE-17 sstep2 / overlap bodies) and
+                          COMMS_MATRIX.json (the comms matrix on the
+                          generic index plan — ``PA_TPU_BOX=0`` —
                           where per-round timings are truly measured,
-                          not proportionally attributed).
+                          not proportionally attributed). ``--check``
+                          fails when any lowering-matrix CG case maps
+                          to no committed phase entry.
 
-Options: ``--case standard|fused`` (body form; default the shipped
-default), ``--k K`` (block width), ``--n N`` (grid edge, default 6),
-``--trace 0|1|auto`` (override PA_PROF_TRACE).
+Options: ``--case standard|fused|block_k1_fused|block_k4_fused|
+sstep2|overlap`` (body form; default the shipped default), ``--k K``
+(block width), ``--n N`` (grid edge, default 6), ``--trace 0|1|auto``
+(override PA_PROF_TRACE).
 
 Usage:
     python tools/paprof.py --check
@@ -86,15 +92,34 @@ def _fixture(jax, n: int):
     return pa.prun(driver, backend, (2, 2)), backend
 
 
+#: The committed PHASE_PROFILE.json entries: every lowering-matrix CG
+#: case maps onto one of these via `profile.phase_case_of` (the
+#: --check coverage gate). kwargs feed `capture_phase_profile`.
+_COMMITTED_CASES = {
+    "standard": dict(fused=False),
+    "fused": dict(fused=True),
+    "block_k1_fused": dict(fused=True, rhs_batch=1),
+    "block_k4_fused": dict(fused=True, rhs_batch=4),
+    "sstep2": dict(fused=False, sstep=2),
+    "overlap": dict(fused=False, overlap=True),
+}
+
+
+def _case_kwargs(case, k):
+    if case is None:
+        return dict(rhs_batch=k or None)
+    kw = dict(_COMMITTED_CASES[case])
+    if k:
+        kw["rhs_batch"] = k
+    return kw
+
+
 def _capture(jax, args):
     from partitionedarrays_jl_tpu.telemetry import profile as prof
 
     A, backend = _fixture(jax, args.n)
-    fused = (
-        None if args.case is None else (args.case == "fused")
-    )
     return prof.capture_phase_profile(
-        A, backend, fused=fused, rhs_batch=args.k or None
+        A, backend, **_case_kwargs(args.case, args.k)
     )
 
 
@@ -114,6 +139,13 @@ def _check(args) -> int:
 
     A, backend = _fixture(jax, args.n)
     profile = prof.capture_phase_profile(A, backend)
+    # a loaded host (the tier-1 suite runs this in-process) can push
+    # one capture round out of band on pure timer jitter — same
+    # re-capture discipline as _write_committed, bounded
+    for _retry in range(2):
+        if profile is None or profile["in_band"]:
+            break
+        profile = prof.capture_phase_profile(A, backend)
     expect(profile is not None,
            "capture returned None (PA_PROF=0 in the environment?)")
     if profile is not None:
@@ -153,8 +185,36 @@ def _check(args) -> int:
                 f"{rec.get(schema_key)!r} != {version}",
             )
             if name == "PHASE_PROFILE.json":
-                for m in prof.reconcile_phases(rec):
-                    expect(False, f"committed {name}: {m}")
+                profiles = rec.get("profiles") or {}
+                expect(
+                    isinstance(profiles, dict) and profiles,
+                    f"committed {name}: no 'profiles' container "
+                    "(schema v2 is multi-case)",
+                )
+                for cname, p in sorted(profiles.items()):
+                    expect(
+                        p.get("case") == cname,
+                        f"committed {name}: entry {cname!r} records "
+                        f"case {p.get('case')!r}",
+                    )
+                    for m in prof.reconcile_phases(p):
+                        expect(False, f"committed {name}[{cname}]: {m}")
+                # coverage: every lowering-matrix CG case must map onto
+                # a committed phase entry (the ISSUE-17 bugfix — the
+                # matrix can never grow a body paprof has not profiled)
+                from partitionedarrays_jl_tpu.parallel.tpu import (
+                    lowering_matrix,
+                )
+
+                for case in lowering_matrix():
+                    key = prof.phase_case_of(case["name"])
+                    expect(
+                        key in profiles,
+                        f"committed {name}: lowering-matrix case "
+                        f"{case['name']!r} has no committed phase "
+                        f"entry (wants {key!r}; run tools/paprof.py "
+                        "--write)",
+                    )
 
     for f in failures:
         print(f"paprof --check FAILURE: {f}", file=sys.stderr)
@@ -173,13 +233,38 @@ def _write_committed() -> int:
     )
 
     A, backend = _fixture(jax, 6)
-    profile = prof.capture_phase_profile(A, backend)
-    if profile is None:
-        print("paprof --write: PA_PROF=0 — nothing captured",
-              file=sys.stderr)
-        return 1
+    profiles = {}
+    for cname, kw in _COMMITTED_CASES.items():
+        print(f"paprof --write: capturing {cname} ...", flush=True)
+        # wall-clock marginals on a shared host jitter; the committed
+        # artifact records a clean capture, so re-capture (fresh body
+        # total AND fresh chains) up to 3 times before giving up
+        p = bad = None
+        for _ in range(3):
+            p = prof.capture_phase_profile(A, backend, **kw)
+            if p is None:
+                print("paprof --write: PA_PROF=0 — nothing captured",
+                      file=sys.stderr)
+                return 1
+            bad = prof.reconcile_phases(p)
+            if not bad:
+                break
+        if p["case"] != cname:
+            print(f"paprof --write: case {cname!r} captured as "
+                  f"{p['case']!r}", file=sys.stderr)
+            return 1
+        if bad:
+            print(f"paprof --write: {cname} does not reconcile: {bad}",
+                  file=sys.stderr)
+            return 1
+        profiles[cname] = p
     artifacts.write(
-        os.path.join(REPO, "PHASE_PROFILE.json"), profile, tool="paprof"
+        os.path.join(REPO, "PHASE_PROFILE.json"),
+        {
+            "phase_schema_version": prof.PHASE_SCHEMA_VERSION,
+            "profiles": profiles,
+        },
+        tool="paprof",
     )
     # the committed matrix rides the GENERIC index plan: its per-round
     # timings are individually measured (the box plan's fused slice
@@ -205,7 +290,9 @@ def main(argv=None):
                     help="measure the exchange cost matrix")
     ap.add_argument("--write", action="store_true",
                     help="regenerate the committed artifacts")
-    ap.add_argument("--case", choices=("standard", "fused"),
+    ap.add_argument("--case",
+                    choices=("standard", "fused", "block_k1_fused",
+                             "block_k4_fused", "sstep2", "overlap"),
                     help="CG body form (default: shipped default)")
     ap.add_argument("--k", type=int, default=0,
                     help="block width (rhs_batch; 0 = single RHS)")
