@@ -415,6 +415,96 @@ def _check_overlap_parity(reports, cases):
     return out
 
 
+def _check_twolevel_fabric_budget(reports, cases):
+    """ISSUE 18's headline invariant, per fabric tier. The node-aware
+    two-level plan exists to spend FEWER slow-fabric messages: its
+    aggregated node tier must run exactly one wire round's edge per
+    ordered (node, node) pair — strictly fewer slow-fabric messages
+    than the flat plan's when the cost model chose aggregation — and
+    ship no more slow-fabric wire slots than the flat plan budgeted.
+    The lowered program's solve loop must carry exactly the schedule's
+    wire-round count of `collective_permute` ops (the staged gather/
+    scatter hops are copies, not extra collectives), and every
+    non-permute collective kind must match the flat baseline exactly
+    (aggregation reroutes the halo; it must not touch the dots).
+    Consumes the ``fabric`` attachment `plan_verifier.audit_case` adds
+    to two-level plan audits; skips silently without audits."""
+    from ..telemetry.comms import expected_from_report
+
+    out = []
+    for name, case in cases.items():
+        tags = case.get("tags", {})
+        if not tags.get("twolevel"):
+            continue
+        audit = case.get("plan_audit")
+        fabric = (audit or {}).get("fabric")
+        if fabric is not None:
+            slow_flat = fabric["flat_slow_edges"]
+            pairs = fabric["node_pairs"]
+            if fabric["node_tier_edges"] != pairs:
+                out.append(Violation(
+                    "twolevel-fabric-budget", [name],
+                    "node-tier wire edges != ordered (node, node) "
+                    "pairs — the slow fabric must carry exactly one "
+                    "aggregated message per pair",
+                    expected=pairs, found=fabric["node_tier_edges"],
+                ))
+            used = bool((fabric.get("decision") or {}).get("use"))
+            if pairs > slow_flat or (used and pairs >= slow_flat > 0):
+                out.append(Violation(
+                    "twolevel-fabric-budget", [name],
+                    "aggregation does not reduce the slow-fabric "
+                    "message count below the flat plan's",
+                    expected=f"< {slow_flat} node pairs"
+                    if used else f"<= {slow_flat} node pairs",
+                    found=pairs,
+                ))
+            if fabric["node_tier_wire_slots"] > fabric[
+                "flat_slow_wire_slots"
+            ]:
+                out.append(Violation(
+                    "twolevel-fabric-budget", [name],
+                    "node-tier wire slots exceed the flat plan's "
+                    "slow-fabric slot budget — aggregation may pack, "
+                    "never widen",
+                    expected=f"<= {fabric['flat_slow_wire_slots']}",
+                    found=fabric["node_tier_wire_slots"],
+                ))
+            rep = reports.get(name)
+            if rep is not None and rep.dialect == "stablehlo":
+                got = expected_from_report(rep)["per_iteration"][
+                    "collective_permute"
+                ]["ops"]
+                if got != fabric["wire_rounds"]:
+                    out.append(Violation(
+                        "twolevel-fabric-budget", [name],
+                        "solve-loop collective_permute ops != the "
+                        "two-level schedule's wire-round count — a "
+                        "staging hop leaked onto the wire (or a wire "
+                        "round vanished)",
+                        expected=fabric["wire_rounds"], found=got,
+                    ))
+        base = tags.get("twolevel_off")
+        if base and name in reports and base in reports:
+            ron, roff = reports[name], reports[base]
+            for kind in COLLECTIVE_KINDS:
+                if kind == "collective_permute":
+                    continue
+                con = ron.collectives.get(kind, 0)
+                coff = roff.collectives.get(kind, 0)
+                bon = ron.collective_bytes.get(kind, 0)
+                boff = roff.collective_bytes.get(kind, 0)
+                if con != coff or bon != boff:
+                    out.append(Violation(
+                        "twolevel-fabric-budget", [name, base],
+                        f"two-level body changes the {kind} inventory "
+                        "— aggregation reroutes the halo permutes only",
+                        expected={"ops": coff, "bytes": boff},
+                        found={"ops": con, "bytes": bon},
+                    ))
+    return out
+
+
 def _check_copy_budget(reports, cases):
     """The PR 2 buffer-copy canary: the compiled body's ``copy`` count
     is the structural signature of XLA's while-carry copies — the
@@ -475,6 +565,13 @@ CONTRACTS: List[Contract] = [
              "collective ops and bytes — a schedule, not an algorithm "
              "(ISSUE 17)",
              _check_overlap_parity),
+    Contract("twolevel-fabric-budget",
+             "the node-aware plan's slow-fabric tier carries one "
+             "aggregated message per (node, node) pair within the flat "
+             "plan's slot budget, the loop's permute ops equal the "
+             "schedule's wire rounds, and non-permute collectives match "
+             "the flat baseline (ISSUE 18)",
+             _check_twolevel_fabric_budget),
     Contract("copy-budget",
              "compiled copy-op count within the pinned per-body budget "
              "(the PR 2 buffer-copy-anomaly canary)",

@@ -72,6 +72,7 @@ MEMORY_BUDGETS: Dict[str, int] = {
     "fused_f32": 12_000,
     "sstep2": 22_000,
     "overlap": 16_000,
+    "twolevel": 30_000,
 }
 
 

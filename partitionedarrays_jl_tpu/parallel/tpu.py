@@ -356,6 +356,30 @@ def _color_edges(edges):
     return rounds
 
 
+def _exchange_edges(exchanger: Exchanger, layout) -> list:
+    """The directed slot-level neighbor edges of an Exchanger over a
+    device layout: ``(src, dst, snd_slots, rcv_slots)`` per edge — the
+    shared input of the flat plan's coloring and the two-level plan's
+    tiered schedule (both deliver exactly these slots)."""
+    P = layout.P
+    edges = []
+    parts_snd = exchanger.parts_snd.part_values()
+    parts_rcv = exchanger.parts_rcv.part_values()
+    lids_snd = exchanger.lids_snd.part_values()
+    lids_rcv = exchanger.lids_rcv.part_values()
+    for p in range(P):
+        for j, q in enumerate(np.asarray(parts_snd[p])):
+            q = int(q)
+            hits = np.nonzero(np.asarray(parts_rcv[q]) == p)[0]
+            check(len(hits) == 1, "device plan: inconsistent neighbor graphs")
+            i = int(hits[0])
+            snd_slots = layout.lid_slots[p][lids_snd[p][j]]
+            rcv_slots = layout.lid_slots[q][lids_rcv[q][i]]
+            check(len(snd_slots) == len(rcv_slots), "device plan: edge size mismatch")
+            edges.append((p, q, snd_slots, rcv_slots))
+    return edges
+
+
 class DeviceExchangePlan:
     """Static halo-exchange program: R `ppermute` rounds with pack/unpack
     index matrices (the compiled form of an Exchanger)."""
@@ -364,21 +388,7 @@ class DeviceExchangePlan:
 
     def __init__(self, exchanger: Exchanger, layout: DeviceLayout):
         P, W = layout.P, layout.W
-        edges = []
-        parts_snd = exchanger.parts_snd.part_values()
-        parts_rcv = exchanger.parts_rcv.part_values()
-        lids_snd = exchanger.lids_snd.part_values()
-        lids_rcv = exchanger.lids_rcv.part_values()
-        for p in range(P):
-            for j, q in enumerate(np.asarray(parts_snd[p])):
-                q = int(q)
-                hits = np.nonzero(np.asarray(parts_rcv[q]) == p)[0]
-                check(len(hits) == 1, "device plan: inconsistent neighbor graphs")
-                i = int(hits[0])
-                snd_slots = layout.lid_slots[p][lids_snd[p][j]]
-                rcv_slots = layout.lid_slots[q][lids_rcv[q][i]]
-                check(len(snd_slots) == len(rcv_slots), "device plan: edge size mismatch")
-                edges.append((p, q, snd_slots, rcv_slots))
+        edges = _exchange_edges(exchanger, layout)
         rounds = _color_edges(edges)
         self.layout = layout
         self.R = len(rounds)
@@ -416,6 +426,194 @@ class WidenedDeviceExchangePlan(DeviceExchangePlan):
         self.ghost_depth = int(depth)
 
 
+class TwoLevelRound:
+    """One round of a two-level staged schedule: a tier tag, the
+    (possibly empty) ppermute pairs, and ragged per-round (P, L_r)
+    pack/mask/unpack index rows into the COMBINED frame
+    ``[xv (W) | stage (S) | stage trash]``. An empty ``perm`` marks an
+    intra-part copy round (local gather into / scatter out of the
+    node representative's stage region) — no wire traffic at all."""
+
+    __slots__ = ("tier", "perm", "snd_idx", "snd_mask", "rcv_idx")
+
+    def __init__(self, tier, perm, snd_idx, snd_mask, rcv_idx):
+        self.tier = tier
+        self.perm = tuple(perm)
+        self.snd_idx = snd_idx
+        self.snd_mask = snd_mask
+        self.rcv_idx = rcv_idx
+
+
+#: Tier vocabulary of a two-level schedule, in execution order for the
+#: aggregated (slow-fabric) path; "direct" rounds are the untouched
+#: fast-fabric ppermutes and run first.
+TWOLEVEL_TIERS = ("direct", "local_out", "gather", "node", "scatter",
+                  "local_in")
+
+
+class TwoLevelDeviceExchangePlan(DeviceExchangePlan):
+    """Node-aware two-level exchange plan (ISSUE 18, the TAPSpMV split
+    of arXiv:1612.08060 mapped onto mesh axes): messages crossing the
+    slow fabric are aggregated through ONE per-node representative part
+    — intra-node gather of the outbound slow-fabric slots into the
+    representative's stage region, one representative-to-representative
+    transfer per ordered (node, node) pair, intra-node scatter on
+    arrival — while same-node (fast-fabric) neighbors keep their direct
+    ppermute rounds.
+
+    The base-class state (``snd_idx``/``rcv_idx``/``perms``/``R``/``L``
+    built by ``super().__init__``) is the flat LOGICAL-DELIVERY view:
+    exactly the slots the schedule must deliver, so all five PR 8 plan
+    verifier checks run on it unchanged and
+    `canonical_exchange_fingerprint` (exchanger-derived) is invariant
+    across flat <-> two-level construction. The EXECUTED schedule lives
+    in ``tl_rounds``: ragged per-round index rows into the combined
+    frame ``[xv | stage (stage_width) | stage trash]``, each round
+    either a ppermute (non-empty ``perm``) or an intra-part copy. Every
+    hop is a pure copy — delivered ghost values are bitwise identical
+    to the flat plan's (the strict-bits trajectory pin in
+    tests/test_twolevel.py).
+
+    Aggregated message layout: per ordered (node a, node b) pair the
+    member messages are ordered by (sender, receiver) part id, packed
+    contiguously into rep(a)'s stage out-block and mirrored at the same
+    offsets in rep(b)'s stage in-block — both representatives derive
+    the layout from the same host-side plan, so no metadata crosses the
+    wire."""
+
+    __slots__ = ("node_of", "node_reps", "stage_width", "tl_rounds",
+                 "decision")
+
+    def __init__(self, exchanger, layout, node_of, decision=None):
+        super().__init__(exchanger, layout)
+        P, W = layout.P, layout.W
+        node_of = tuple(int(n) for n in node_of)
+        check(len(node_of) == P, "two-level plan: node map length != P")
+        self.node_of = node_of
+        reps = {}
+        for p, n in enumerate(node_of):
+            reps.setdefault(n, p)
+        self.node_reps = reps
+        edges = _exchange_edges(exchanger, layout)
+        fast = [e for e in edges if node_of[e[0]] == node_of[e[1]]]
+        slow = [e for e in edges if node_of[e[0]] != node_of[e[1]]]
+        # group slow messages per ordered (node, node) pair, member
+        # order fixed by (sender, receiver) part ids (docstring)
+        pairs = {}
+        for e in sorted(slow, key=lambda e: (node_of[e[0]], node_of[e[1]],
+                                             e[0], e[1])):
+            pairs.setdefault((node_of[e[0]], node_of[e[1]]), []).append(e)
+        # stage allocation: contiguous out/in block per pair on each
+        # representative; non-representative parts stage nothing
+        cursor = [0] * P
+        out_at, in_at = {}, {}
+        for ab, msgs in pairs.items():
+            a, b = ab
+            n_ab = sum(len(s) for _, _, s, _ in msgs)
+            out_at[ab] = cursor[reps[a]]
+            cursor[reps[a]] += n_ab
+            in_at[ab] = cursor[reps[b]]
+            cursor[reps[b]] += n_ab
+        self.stage_width = S = max(cursor)
+        strash = W + S
+        local_out, local_in = [], []   # (part, snd_slots, rcv_slots)
+        gather_by, scatter_by = {}, {}  # merged per (src, dst) edge
+        node_edges = []
+        for ab, msgs in pairs.items():
+            a, b = ab
+            ra, rb = reps[a], reps[b]
+            o, i = out_at[ab], in_at[ab]
+            node_snd, node_rcv = [], []
+            for p, q, snd, rcv in msgs:
+                k = len(snd)
+                out_slots = W + o + np.arange(k, dtype=INDEX_DTYPE)
+                in_slots = W + i + np.arange(k, dtype=INDEX_DTYPE)
+                snd = np.asarray(snd, dtype=INDEX_DTYPE)
+                rcv = np.asarray(rcv, dtype=INDEX_DTYPE)
+                if p == ra:
+                    local_out.append((p, snd, out_slots))
+                else:
+                    g = gather_by.setdefault((p, ra), ([], []))
+                    g[0].append(snd)
+                    g[1].append(out_slots)
+                if q == rb:
+                    local_in.append((q, in_slots, rcv))
+                else:
+                    s = scatter_by.setdefault((rb, q), ([], []))
+                    s[0].append(in_slots)
+                    s[1].append(rcv)
+                node_snd.append(out_slots)
+                node_rcv.append(in_slots)
+                o += k
+                i += k
+            node_edges.append((ra, rb, np.concatenate(node_snd),
+                               np.concatenate(node_rcv)))
+
+        def _round(tier, entries, permuted):
+            L_r = max(len(e[2]) for e in entries)
+            si = np.zeros((P, L_r), dtype=INDEX_DTYPE)
+            smk = np.zeros((P, L_r), dtype=bool)
+            ri = np.full((P, L_r), strash, dtype=INDEX_DTYPE)
+            perm = []
+            for src, dst, snd, rcv in entries:
+                k = len(snd)
+                si[src, :k] = snd
+                smk[src, :k] = True
+                ri[dst, :k] = rcv
+                if permuted:
+                    perm.append((src, dst))
+            return TwoLevelRound(tier, tuple(perm), si, smk, ri)
+
+        def _local_round(tier, copies):
+            per = {}
+            for p, snd, rcv in copies:
+                s, r = per.setdefault(p, ([], []))
+                s.append(snd)
+                r.append(rcv)
+            entries = [
+                (p, p, np.concatenate(s), np.concatenate(r))
+                for p, (s, r) in sorted(per.items())
+            ]
+            return _round(tier, entries, permuted=False)
+
+        tl = []
+        for edges_r in _color_edges(fast):
+            tl.append(_round("direct", edges_r, permuted=True))
+        if pairs:
+            if local_out:
+                tl.append(_local_round("local_out", local_out))
+            gathers = [
+                (p, ra, np.concatenate(s), np.concatenate(r))
+                for (p, ra), (s, r) in sorted(gather_by.items())
+            ]
+            for edges_r in _color_edges(gathers):
+                tl.append(_round("gather", edges_r, permuted=True))
+            for edges_r in _color_edges(node_edges):
+                tl.append(_round("node", edges_r, permuted=True))
+            scatters = [
+                (rb, q, np.concatenate(s), np.concatenate(r))
+                for (rb, q), (s, r) in sorted(scatter_by.items())
+            ]
+            for edges_r in _color_edges(scatters):
+                tl.append(_round("scatter", edges_r, permuted=True))
+            if local_in:
+                tl.append(_local_round("local_in", local_in))
+        self.tl_rounds = tuple(tl)
+        self.decision = dict(decision or {})
+
+    @property
+    def wire_rounds(self) -> int:
+        """Rounds that actually hit the wire (non-empty perm) — the
+        executed ppermute count comms accounting must mirror."""
+        return sum(1 for rd in self.tl_rounds if rd.perm)
+
+    def fabric_of_round(self, rd) -> str:
+        """The fabric tier a schedule round's wire traffic rides:
+        ``node`` rounds cross the slow fabric, every other permuted
+        tier stays on the fast one (intra-node)."""
+        return "dcn" if rd.tier == "node" else "ici"
+
+
 def _shard_exchange(plan, combine: str, abft: bool = False):
     """Per-shard halo exchange body (used inside shard_map): R static
     `ppermute` rounds. `combine='set'` for owner->ghost halo updates,
@@ -444,6 +642,38 @@ def _shard_exchange(plan, combine: str, abft: bool = False):
     import jax.numpy as jnp
 
     from .tpu_box import BoxExchangePlan, shard_box_exchange
+
+    if isinstance(plan, TwoLevelDeviceExchangePlan):
+        # the staged two-level schedule (ISSUE 18). ABFT and the 'add'
+        # assembly reverse keep the flat plan (_twolevel_env resolves
+        # off under ABFT; make_exchange_fn builds the flat reverse), so
+        # this body only ever runs the owner->ghost 'set' direction.
+        check(not abft, "ABFT exchange checksums require the flat plan")
+        check(combine == "set",
+              "two-level exchange serves the owner->ghost direction only")
+        W = plan.layout.W
+        S = plan.stage_width
+        tl = plan.tl_rounds
+        strash = W + S
+
+        def body_twolevel(xv, si, sm, ri):
+            # combined frame [xv | stage | stage trash]; every hop is a
+            # pure copy, so the delivered ghosts are bitwise the flat
+            # plan's values
+            pad = jnp.zeros((S + 1,) + xv.shape[1:], dtype=xv.dtype)
+            cv = jnp.concatenate([xv, pad], axis=0)
+            for r, rd in enumerate(tl):
+                mask = sm[r].reshape(sm[r].shape + (1,) * (cv.ndim - 1))
+                buf = jnp.where(mask, cv[si[r]], 0)
+                if rd.perm:
+                    buf = jax.lax.ppermute(buf, "parts", perm=rd.perm)
+                cv = cv.at[ri[r]].set(buf)
+                # keep both trash slots clean (padding invariants)
+                cv = cv.at[plan.layout.trash].set(0)
+                cv = cv.at[strash].set(0)
+            return cv[:W]
+
+        return body_twolevel
 
     if isinstance(plan, BoxExchangePlan):
         check(not abft, "ABFT exchange checksums require the generic plan")
@@ -664,6 +894,76 @@ def _resolve_overlap(overlap) -> bool:
     return bool(overlap)
 
 
+def _twolevel_env() -> str:
+    """The ONE resolution of the node-aware two-level exchange mode
+    (``PA_TPU_TWOLEVEL`` in {0, 1, auto}, default 0 = flat; ISSUE 18).
+    ``1`` aggregates every slow-fabric message through the per-node
+    representatives whenever the node map shows >= 2 nodes with
+    cross-node edges; ``auto`` lets the measured cost model
+    (`telemetry.commsmatrix.twolevel_decision` over the committed
+    COMMS_MATRIX.json fabric fits) decide per neighbor graph whether
+    aggregation pays. Strict-bits keeps the flat plan as the bitwise
+    oracle and ABFT pins the flat plan (its per-round checksum lanes
+    are built on it) — the env resolves to ``0`` under either, the
+    PR 17 refusal/fallback convention. Lowering-affecting: folded into
+    `_lowering_env_key`, so every staged-matrix/program cache rekeys
+    on a flip."""
+    v = (os.environ.get("PA_TPU_TWOLEVEL", "0") or "0").strip().lower()
+    if v not in ("0", "1", "auto"):
+        raise ValueError("PA_TPU_TWOLEVEL must be 0, 1 or auto")
+    if strict_bits() or _abft_enabled():
+        return "0"
+    return v
+
+
+def _node_map_env() -> str:
+    """Raw ``PA_TPU_NODE_MAP`` spec (comma-separated part -> node ids,
+    e.g. ``0,0,1,1``) — the explicit fabric-topology override. Empty =
+    derive the map from the backend's device process indices
+    (`_resolve_node_map`). Keyed via `_lowering_env_key` (the raw
+    string) so a remapped topology restages."""
+    return (os.environ.get("PA_TPU_NODE_MAP", "") or "").strip()
+
+
+def _comms_matrix_env() -> str:
+    """``PA_TPU_COMMS_MATRIX``: path of the measured comms-matrix
+    record the ``auto`` cost model fits its per-fabric latency/
+    bandwidth model from (empty = the committed COMMS_MATRIX.json next
+    to the package when present, else the documented
+    DEFAULT_FABRIC_MODEL constants). Keyed via `_lowering_env_key`: a
+    different measurement feed can flip the auto decision, which
+    changes the staged plan."""
+    return (os.environ.get("PA_TPU_COMMS_MATRIX", "") or "").strip()
+
+
+def _resolve_node_map(P: int, backend=None):
+    """The ONE resolution of the part -> node map: the explicit
+    ``PA_TPU_NODE_MAP`` spec wins (length-P validated); otherwise the
+    backend's device ``process_index`` per mesh slot (the real
+    multi-host fabric boundary); ``None`` when neither names >= 1 node
+    (callers keep the flat plan)."""
+    spec = _node_map_env()
+    if spec:
+        try:
+            nodes = tuple(int(t) for t in spec.split(","))
+        except ValueError:
+            raise ValueError(
+                "PA_TPU_NODE_MAP must be a comma-separated part->node "
+                "map, e.g. 0,0,1,1"
+            )
+        if len(nodes) != P:
+            raise ValueError(
+                f"PA_TPU_NODE_MAP names {len(nodes)} parts but the mesh "
+                f"has {P}"
+            )
+        return nodes
+    if backend is not None:
+        devs = backend.devices()[:P]
+        if len(devs) == P:
+            return tuple(int(d.process_index) for d in devs)
+    return None
+
+
 def _sstep_resolve_env(pipelined, precond, rhs_batch, fused, have_sdc):
     """Mirror `make_cg_fn`'s ENV-driven body resolution for callers
     that must know the concrete body before building (the program cache
@@ -880,8 +1180,53 @@ def device_layout(rows: PRange, padded: bool = False) -> DeviceLayout:
     return cache[key]
 
 
+def _twolevel_plan_request(rows: PRange, layout, depth: int, backend):
+    """Resolve whether THIS plan build goes two-level: returns
+    ``(node_of, decision)`` — ``node_of`` None keeps the flat plan.
+
+    The PR 17 refusal/fallback conventions: strict-bits/ABFT already
+    resolved the env to "0" (`_twolevel_env`); an s-step widened plan
+    (depth >= 2) falls back to the flat widened plan with a stderr note
+    (two-level x matrix-powers aggregation is the named follow-up); a
+    single-node map or a neighbor graph with no cross-node edges keeps
+    the flat plan silently (there is nothing to aggregate). Mode
+    ``auto`` additionally asks the measured cost model
+    (`telemetry.commsmatrix.twolevel_decision`) whether aggregation
+    pays on this graph."""
+    import sys
+
+    mode = _twolevel_env()
+    if mode == "0":
+        return None, None
+    if depth >= 2:
+        sys.stderr.write(
+            "partitionedarrays_jl_tpu: PA_TPU_TWOLEVEL requested but the "
+            f"depth-{depth} s-step widened plan stays flat (two-level "
+            "aggregation of the matrix-powers exchange is the named "
+            "follow-up)\n"
+        )
+        return None, None
+    node_of = _resolve_node_map(layout.P, backend)
+    if node_of is None or len(set(node_of)) < 2:
+        return None, None
+    edges = _exchange_edges(rows.exchanger, layout)
+    profile = [(p, q, len(s)) for p, q, s, _ in edges]
+    if not any(node_of[p] != node_of[q] for p, q, _ in profile):
+        return None, None
+    from ..telemetry.commsmatrix import twolevel_decision
+
+    decision = twolevel_decision(
+        profile, node_of, matrix_path=_comms_matrix_env() or None
+    )
+    decision["mode"] = mode
+    if mode == "auto" and not decision["use"]:
+        return None, decision
+    decision["use"] = True
+    return node_of, decision
+
+
 def device_exchange_plan(rows: PRange, padded: bool = False,
-                         depth: int = 1):
+                         depth: int = 1, backend=None):
     """Build (and cache on ``rows``) the device halo-exchange plan.
 
     ``depth`` >= 2 returns the WIDENED plan variant for the s-step CG
@@ -898,17 +1243,37 @@ def device_exchange_plan(rows: PRange, padded: bool = False,
 
     The PR 8 plan verifier passes widened plans unchanged: they are
     subclasses of the depth-1 plan types, so `verify_plan` dispatches
-    to the same five checks over the same index structure."""
-    from .tpu_box import BoxExchangePlan, WidenedBoxExchangePlan
+    to the same five checks over the same index structure.
+
+    ``backend`` (optional) feeds the two-level node map default
+    (device ``process_index`` per mesh slot) when
+    ``PA_TPU_TWOLEVEL`` != 0 and no explicit ``PA_TPU_NODE_MAP`` is
+    set — see `_twolevel_plan_request` for the full selection rule."""
+    from .tpu_box import (
+        BoxExchangePlan,
+        TwoLevelBoxExchangePlan,
+        WidenedBoxExchangePlan,
+    )
 
     depth = max(1, int(depth))
     cache = getattr(rows, "_device_plan", None)
     if cache is None:
         cache = rows._device_plan = {}
     layout = device_layout(rows, padded)
-    key = (padded, layout.box_info is not None, depth)
+    node_of, decision = _twolevel_plan_request(rows, layout, depth, backend)
+    key = (padded, layout.box_info is not None, depth, node_of)
     if key not in cache:
-        if layout.box_info is not None:
+        if node_of is not None:
+            plan = (
+                TwoLevelBoxExchangePlan(
+                    rows.exchanger, layout, node_of, decision=decision
+                )
+                if layout.box_info is not None
+                else TwoLevelDeviceExchangePlan(
+                    rows.exchanger, layout, node_of, decision=decision
+                )
+            )
+        elif layout.box_info is not None:
             plan = (
                 BoxExchangePlan(layout, layout.box_info)
                 if depth == 1
@@ -1061,7 +1426,8 @@ class DeviceMatrix:
         # _sstep_env(), so a flip restages rather than serving this plan
         _s = _sstep_env()
         self.col_plan = device_exchange_plan(
-            A.cols, self.padded, depth=_s if _s >= 2 else 1
+            A.cols, self.padded, depth=_s if _s >= 2 else 1,
+            backend=backend,
         )
         self.backend = backend
         L_oh = max((int(m.row_lengths().max()) if m.nnz else 0 for m in oh), default=0)
@@ -2045,6 +2411,14 @@ def _lowering_env_key() -> tuple:
         # column exchange plan attaches at staging), so both key here
         _sstep_env(),
         _overlap_env(),
+        # the node-aware two-level exchange tier (ISSUE 18): the mode,
+        # the raw topology override, and the cost-model feed path all
+        # change which column exchange plan stages, so all three key —
+        # a remapped node topology or a different measured matrix
+        # restages instead of serving the stale schedule
+        _twolevel_env(),
+        _node_map_env(),
+        _comms_matrix_env(),
     )
 
 
@@ -2327,9 +2701,14 @@ def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") ->
 
     from .tpu_box import BoxExchangePlan
 
-    plan = device_exchange_plan(rows, _padded_for(backend))
+    plan = device_exchange_plan(rows, _padded_for(backend), backend=backend)
     if combine == "add":
-        if isinstance(plan, BoxExchangePlan):
+        if isinstance(plan, TwoLevelDeviceExchangePlan):
+            # assembly reverse stays on the flat plan (aggregation only
+            # serves the owner->ghost forward direction; the reverse
+            # 'add' accumulation order is the flat plan's contract)
+            plan = DeviceExchangePlan(rows.exchanger.reverse(), plan.layout)
+        elif isinstance(plan, BoxExchangePlan):
             plan = plan.reverse()
         else:
             # reverse plan: swap pack/unpack roles
@@ -2341,17 +2720,26 @@ def make_exchange_fn(rows: PRange, backend: TPUBackend, combine: str = "set") ->
     @jax.jit
     def fn(x, si, sm, ri):
         def shard_fn(xs, sis, sms, ris):
-            return body(xs[0], sis[0], sms[0], ris[0])[None]
+            # tree-mapped: the two-level plan ships ragged per-round
+            # tuples where the flat/box plans ship single arrays
+            pick = lambda t: jax.tree.map(lambda v: v[0], t)
+            return body(xs[0], pick(sis), pick(sms), pick(ris))[None]
 
+        tspec = lambda t: jax.tree.map(lambda _: spec, t)
         return shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(spec, spec, spec, spec),
+            in_specs=(spec, tspec(si), tspec(sm), tspec(ri)),
             out_specs=spec,
             check_vma=False,
         )(x, si, sm, ri)
 
-    if isinstance(plan, BoxExchangePlan):
+    if isinstance(plan, TwoLevelDeviceExchangePlan):
+        P = plan.layout.P
+        si = tuple(_stage(backend, rd.snd_idx, P) for rd in plan.tl_rounds)
+        sm = tuple(_stage(backend, rd.snd_mask, P) for rd in plan.tl_rounds)
+        ri = tuple(_stage(backend, rd.rcv_idx, P) for rd in plan.tl_rounds)
+    elif isinstance(plan, BoxExchangePlan):
         # everything is compiled in; tiny dummies keep the fn signature —
         # except the reverse path's sm slot, which carries the real
         # segment mask (orphan slab slots must not accumulate into owners)
@@ -2400,7 +2788,14 @@ def _matrix_operands(dA: DeviceMatrix) -> dict:
         return dA._ops_cache
     plan = dA.col_plan
     P = plan.layout.P
-    if isinstance(plan, BoxExchangePlan):
+    if isinstance(plan, TwoLevelDeviceExchangePlan):
+        # staged schedule: one ragged (P, L_r) leaf per round — tuples
+        # flow through the operand pytree exactly like the sd_i/sd_v
+        # width-bucket chunks, and the body indexes si[r] per round
+        si = tuple(_stage(dA.backend, rd.snd_idx, P) for rd in plan.tl_rounds)
+        sm = tuple(_stage(dA.backend, rd.snd_mask, P) for rd in plan.tl_rounds)
+        ri = tuple(_stage(dA.backend, rd.rcv_idx, P) for rd in plan.tl_rounds)
+    elif isinstance(plan, BoxExchangePlan):
         si, sm, ri = _box_dummy_operands(
             dA.backend, P, variants=plan.info.variants
         )
@@ -6068,6 +6463,9 @@ _MATRIX_BASE_ENV = {
     "PA_TRACE_ITERS": None,
     "PA_TPU_SSTEP": None,
     "PA_TPU_OVERLAP": None,
+    "PA_TPU_TWOLEVEL": None,
+    "PA_TPU_NODE_MAP": None,
+    "PA_TPU_COMMS_MATRIX": None,
 }
 
 
@@ -6117,6 +6515,17 @@ def lowering_matrix(fast: bool = False):
              kwargs={"fused": False}, dtype="f64",
              tags={"body": "standard", "overlap": True,
                    "overlap_off": "standard"}),
+        # the ISSUE 18 node-aware tier: two-level exchange over an
+        # explicit 2-node map of the 8-part probe, A/B'd against the
+        # flat generic plan it rewrites (twolevel-fabric-budget +
+        # collective-parity contracts key off these tags)
+        dict(name="twolevel",
+             env={"PA_TPU_TWOLEVEL": "1",
+                  "PA_TPU_NODE_MAP": "0,0,0,0,1,1,1,1",
+                  "PA_TPU_BOX": "0"},
+             kwargs={"fused": False}, dtype="f64",
+             tags={"body": "standard", "plan": "twolevel",
+                   "twolevel": True, "twolevel_off": "standard_nobox"}),
     ]
     if fast:
         return cases

@@ -379,6 +379,35 @@ class WidenedBoxExchangePlan(BoxExchangePlan):
         )
 
 
+from .tpu import TwoLevelDeviceExchangePlan  # noqa: E402 — cycle-safe:
+# tpu.py defers ALL of its tpu_box imports into function bodies, so this
+# module-level import never re-enters a half-initialized module.
+
+
+class TwoLevelBoxExchangePlan(TwoLevelDeviceExchangePlan):
+    """The box-family two-level sibling (tpu.py ISSUE 18): built from
+    the exchanger over the BOX layout (whose ghost region is reordered
+    into direction segments), NOT a `BoxExchangePlan` subclass — the
+    slice bodies cannot redirect slow-fabric slots through a stage, so
+    the two-level schedule keeps the index-vector form over the box
+    layout's slot maps (``DeviceLayout.lid_slots`` carries the segment
+    reorder, so the staged schedule delivers into the box frame's real
+    ghost segments). Same-node directions still ride direct ppermute
+    rounds; only cross-node messages take the gather/node/scatter
+    detour. `verify_plan` dispatches through the two-level base: the
+    five flat checks run on the logical-delivery view, the staged-
+    schedule simulation on ``tl_rounds``."""
+
+    __slots__ = ()
+
+    def __init__(self, exchanger, layout, node_of, decision=None):
+        from ..utils.helpers import check as _check
+
+        _check(layout.box_info is not None,
+               "TwoLevelBoxExchangePlan requires a box layout")
+        super().__init__(exchanger, layout, node_of, decision=decision)
+
+
 def shard_box_exchange(plan: BoxExchangePlan, combine: str):
     """Per-shard exchange body with the SAME signature as tpu.py's
     `_shard_exchange` bodies: body(xv, si, sm, ri) — the three index

@@ -60,11 +60,20 @@ def _exchange_inventory(dA, abft: bool, K: int, itemsize: int):
     column plan: the generic index plan runs R `ppermute` rounds of the
     padded max-edge slab (ABFT: one checksum slot wider); the box plan
     runs one `ppermute` per geometric direction, each shipping that
-    direction's segment slab."""
+    direction's segment slab; the two-level plan runs one `ppermute`
+    per WIRE round of its staged schedule (direct + gather + node +
+    scatter — local copy rounds ship nothing), each shipping that
+    round's ragged lane slab."""
+    from ..parallel.tpu import TwoLevelDeviceExchangePlan
     from ..parallel.tpu_box import BoxExchangePlan
 
     plan = dA.col_plan
-    if isinstance(plan, BoxExchangePlan):
+    if isinstance(plan, TwoLevelDeviceExchangePlan):
+        sizes = [rd.snd_idx.shape[-1] for rd in plan.tl_rounds
+                 if rd.perm]
+        if not sizes:
+            return 0, 0
+    elif isinstance(plan, BoxExchangePlan):
         sizes = [d.size for d in plan.info.dirs]
     else:
         if plan.R == 0:

@@ -66,6 +66,7 @@ __all__ = [
     "PHASE_SCHEMA_VERSION",
     "PHASES",
     "PHASE_BOUNDARY",
+    "PHASE_HALO_SPLIT",
     "PHASE_SUM_BAND",
     "PHASE_SUM_BAND_WIDE",
     "prof_enabled",
@@ -101,14 +102,30 @@ PHASES = ("spmv_local", "halo_exchange", "dot_allgather", "axpy_sweep")
 #: boundary share is exactly the non-overlappable compute).
 PHASE_BOUNDARY = "boundary_spmv"
 
+#: The two-level (node-aware) plans' replacement of ``halo_exchange``
+#: (ISSUE 18): the fast-fabric rounds (direct neighbors + the
+#: gather/scatter staging hops) vs the aggregated slow-fabric
+#: representative-to-representative rounds — so the node-tier win is
+#: ATTRIBUTED per fabric, not asserted. Each is measured as its own
+#: tier-restricted exchange chain.
+PHASE_HALO_SPLIT = ("halo_ici", "halo_dcn_agg")
+
 
 def profile_phases(profile: dict) -> tuple:
     """The phase keys of one profile, canonical order: the four shared
-    axes, plus ``boundary_spmv`` when the overlap body recorded it."""
-    extra = tuple(
-        p for p in (PHASE_BOUNDARY,) if p in profile.get("phases", {})
-    )
-    return PHASES + extra
+    axes — with ``halo_exchange`` replaced by the per-fabric split when
+    a two-level profile recorded it — plus ``boundary_spmv`` when the
+    overlap body recorded it."""
+    ph = profile.get("phases", {})
+    out = []
+    for p in PHASES:
+        if p == "halo_exchange" and PHASE_HALO_SPLIT[0] in ph:
+            out.extend(PHASE_HALO_SPLIT)
+        else:
+            out.append(p)
+    if PHASE_BOUNDARY in ph:
+        out.append(PHASE_BOUNDARY)
+    return tuple(out)
 
 #: Pinned acceptance band for attributed_sum / measured_total. The
 #: split chains re-pay per-phase loop-carry and buffer-roundtrip costs
@@ -173,22 +190,35 @@ def lowering_descriptor(dA) -> Dict[str, str]:
         a_oo = "bsr"
     else:
         a_oo = "ell"
-    plan = "box" if isinstance(dA.col_plan, BoxExchangePlan) else "generic"
+    cp = dA.col_plan
+    if hasattr(cp, "tl_rounds"):
+        plan = (
+            "twolevel-box" if cp.layout.box_info is not None
+            else "twolevel"
+        )
+    elif isinstance(cp, BoxExchangePlan):
+        plan = "box"
+    else:
+        plan = "generic"
     return {"a_oo": a_oo, "plan": plan}
 
 
 def phase_case_name(fused: bool, rhs_batch: Optional[int] = None,
                     abft: bool = False, sstep: int = 0,
-                    overlap: bool = False) -> str:
+                    overlap: bool = False,
+                    twolevel: bool = False) -> str:
     """The palint lowering-matrix case name this profile is keyed by
     (`parallel.tpu.lowering_matrix` naming: body form + K + mode; the
-    ISSUE-17 bodies key as ``sstep{s}`` / ``overlap``)."""
+    ISSUE-17 bodies key as ``sstep{s}`` / ``overlap``, the ISSUE-18
+    node-aware plan as ``twolevel``)."""
     if int(sstep) >= 2:
         return f"sstep{int(sstep)}"
     body = "fused" if fused else "standard"
     name = f"block_k{int(rhs_batch)}_{body}" if rhs_batch else body
     if overlap:
         name = "overlap" if name == "standard" else name + "_overlap"
+    if twolevel:
+        name = "twolevel" if name == "standard" else name + "_twolevel"
     return name + ("_abft" if abft else "")
 
 
@@ -201,6 +231,8 @@ def phase_case_of(name: str) -> str:
     rounding, not the phase structure."""
     if name.startswith("sstep"):
         return "sstep2"
+    if name == "twolevel" or name.endswith("_twolevel"):
+        return "twolevel"
     if name == "overlap" or name.endswith("_overlap"):
         return "overlap"
     for k in ("block_k1", "block_k4"):
@@ -302,22 +334,61 @@ def _phase_chains(dA, rhs_batch: Optional[int]) -> Dict[str, Callable]:
         # on the previous step's permute, so nothing is loop-invariant
         return xv.at[o0].add(xv[g0] * eps)
 
-    @functools.partial(jax.jit, static_argnums=2)
-    def exch_chain(xv, m, k):
-        def shard_fn(xs, ms):
-            mm = _shard_ops(jax, ms)
+    def _exchange_chain(body):
+        @functools.partial(jax.jit, static_argnums=2)
+        def chain(xv, m, k):
+            def shard_fn(xs, ms):
+                mm = _shard_ops(jax, ms)
 
-            def step(_, v):
-                return _feedback(
-                    exch_body(v, mm["si"], mm["sm"], mm["ri"])
+                def step(_, v):
+                    return _feedback(
+                        body(v, mm["si"], mm["sm"], mm["ri"])
+                    )
+
+                return jax.lax.fori_loop(0, k, step, xs[0])[None]
+
+            return shard_map(
+                shard_fn, mesh=mesh, in_specs=(spec, specs),
+                out_specs=spec, check_vma=False,
+            )(xv, m).sum()
+
+        return chain
+
+    exch_chain = _exchange_chain(exch_body)
+
+    def _tier_body(fabric):
+        # the two-level per-fabric halo share (PHASE_HALO_SPLIT): the
+        # same staged body as `_shard_exchange`'s two-level branch, but
+        # executing only the schedule rounds whose traffic rides this
+        # fabric — node rounds are the slow-fabric aggregate, every
+        # other round (direct ppermutes, gather/scatter staging hops
+        # and the wire-free local copies) is the fast-fabric share
+        plan = dA.col_plan
+        tl = plan.tl_rounds
+        Wp, S = plan.layout.W, plan.stage_width
+        strash = Wp + S
+        idxs = [
+            r for r, rd in enumerate(tl)
+            if plan.fabric_of_round(rd) == fabric
+        ]
+
+        def body(xv, si, sm, ri):
+            pad = jnp.zeros((S + 1,) + xv.shape[1:], dtype=xv.dtype)
+            cv = jnp.concatenate([xv, pad], axis=0)
+            for r in idxs:
+                rd = tl[r]
+                mask = sm[r].reshape(
+                    sm[r].shape + (1,) * (cv.ndim - 1)
                 )
+                buf = jnp.where(mask, cv[si[r]], 0)
+                if rd.perm:
+                    buf = jax.lax.ppermute(buf, "parts", perm=rd.perm)
+                cv = cv.at[ri[r]].set(buf)
+                cv = cv.at[plan.layout.trash].set(0)
+                cv = cv.at[strash].set(0)
+            return cv[:Wp]
 
-            return jax.lax.fori_loop(0, k, step, xs[0])[None]
-
-        return shard_map(
-            shard_fn, mesh=mesh, in_specs=(spec, specs),
-            out_specs=spec, check_vma=False,
-        )(xv, m).sum()
+        return body
 
     spmv_body = _spmv_body(dA)
 
@@ -381,12 +452,18 @@ def _phase_chains(dA, rhs_batch: Optional[int]) -> Dict[str, Callable]:
             check_vma=False,
         )(xv).sum()
 
-    return {
+    chains = {
         "exchange": lambda k: float(exch_chain(x, ops, k)),
         "spmv": lambda k: float(spmv_chain(x, ops, k)),
         "dot": lambda k: float(dot_chain(x, k)),
         "axpy": lambda k: float(axpy_chain(x, k)),
     }
+    if hasattr(dA.col_plan, "tl_rounds"):
+        ici_chain = _exchange_chain(_tier_body("ici"))
+        dcn_chain = _exchange_chain(_tier_body("dcn"))
+        chains["halo_ici"] = lambda k: float(ici_chain(x, ops, k))
+        chains["halo_dcn"] = lambda k: float(dcn_chain(x, ops, k))
+    return chains
 
 
 def _body_chain(dA, b, x0, fused, precond, rhs_batch,
@@ -534,6 +611,10 @@ def capture_phase_profile(
     dA = device_matrix(A, backend)
     dtype = np.float64
     fused_resolved = _resolve_fused(fused, False)
+    # the node-aware plan (ISSUE 18) is env-selected at device_matrix
+    # time (PA_TPU_TWOLEVEL / PA_TPU_NODE_MAP); when it staged, the
+    # halo phase splits per fabric tier (PHASE_HALO_SPLIT)
+    twolevel_on = hasattr(dA.col_plan, "tl_rounds")
 
     bvec = PVector.full(1.0, A.cols, dtype=dtype)
     zvec = PVector.full(0.0, A.cols, dtype=dtype)
@@ -570,7 +651,10 @@ def capture_phase_profile(
 
     method = "split-timer"
     fractions = None
-    if prof_trace_mode() != "0":
+    # the trace path buckets every collective-permute span into one
+    # halo bucket — it cannot attribute per fabric tier, so two-level
+    # profiles always take the split-timer's tier-restricted chains
+    if prof_trace_mode() != "0" and not twolevel_on:
         fn = make_cg_fn(
             dA, tol=0.0, maxiter=k2, fused=fused, precond=precond,
             rhs_batch=rhs_batch, sstep=(sstep or None), overlap=overlap,
@@ -600,12 +684,26 @@ def capture_phase_profile(
             t_spmv = _marginal_s(chains["spmv"], k1, k2, reps)
             t_dot1 = _marginal_s(chains["dot"], k1, k2, reps)
             t_axpy = _marginal_s(chains["axpy"], k1, k2, reps)
-            cand = {
-                "halo_exchange": sc * t_exch,
+            if twolevel_on:
+                # per-fabric halo attribution: each tier measured as
+                # its own restricted chain (the aggregation's staging
+                # hops and local copies are fast-fabric work)
+                halo = {
+                    "halo_ici": sc * _marginal_s(
+                        chains["halo_ici"], k1, k2, reps
+                    ),
+                    "halo_dcn_agg": sc * _marginal_s(
+                        chains["halo_dcn"], k1, k2, reps
+                    ),
+                }
+            else:
+                halo = {"halo_exchange": sc * t_exch}
+            cand = dict(halo)
+            cand.update({
                 "spmv_local": sc * max(t_spmv - t_exch, 0.0),
                 "dot_allgather": n_gathers * t_dot1,
                 "axpy_sweep": t_axpy,
-            }
+            })
             r = sum(cand.values()) / measured if measured > 0 else (
                 float("inf")
             )
@@ -646,15 +744,48 @@ def capture_phase_profile(
         }
 
     phase_comms = {
-        "halo_exchange": {
-            k: _entry(k, k == "collective_permute") for k in COMM_KINDS
-        },
         "dot_allgather": {
             k: _entry(k, k == "all_gather") for k in COMM_KINDS
         },
         "spmv_local": {k: _entry(k, False) for k in COMM_KINDS},
         "axpy_sweep": {k: _entry(k, False) for k in COMM_KINDS},
     }
+    if twolevel_on:
+        # split the one halo update's permute inventory per fabric:
+        # the slow-fabric share is the node-tier wire rounds' ragged
+        # lane slabs, the fast-fabric share is the exact remainder —
+        # the two sum to the per-iteration inventory by construction,
+        # so `reconcile_phases`'s per-kind sum still balances
+        plan = dA.col_plan
+        Kcols = int(rhs_batch) if rhs_batch else 1
+        isz = int(np.dtype(dtype).itemsize)
+        dcn_sizes = [
+            rd.snd_idx.shape[-1] for rd in plan.tl_rounds
+            if rd.perm and plan.fabric_of_round(rd) == "dcn"
+        ]
+        dcn_ops = len(dcn_sizes)
+        dcn_bytes = sum(s * Kcols * isz for s in dcn_sizes)
+        pi = per_it["collective_permute"]
+
+        def _permute_split(ops, nbytes):
+            return {
+                k: {
+                    "ops": ops if k == "collective_permute" else 0,
+                    "bytes": nbytes if k == "collective_permute" else 0,
+                }
+                for k in COMM_KINDS
+            }
+
+        phase_comms["halo_ici"] = _permute_split(
+            pi["ops"] - dcn_ops, pi["bytes"] - dcn_bytes
+        )
+        phase_comms["halo_dcn_agg"] = _permute_split(
+            dcn_ops, dcn_bytes
+        )
+    else:
+        phase_comms["halo_exchange"] = {
+            k: _entry(k, k == "collective_permute") for k in COMM_KINDS
+        }
     if overlap_on:
         # boundary compute owns no collective: the halo it waits on is
         # already attributed to halo_exchange
@@ -669,12 +800,20 @@ def capture_phase_profile(
 
     attributed = sum(phase_s.values())
     ratio = attributed / measured if measured > 0 else float("inf")
-    plist = PHASES + ((PHASE_BOUNDARY,) if overlap_on else ())
+    plist = []
+    for p in PHASES:
+        if p == "halo_exchange" and twolevel_on:
+            plist.extend(PHASE_HALO_SPLIT)
+        else:
+            plist.append(p)
+    if overlap_on:
+        plist.append(PHASE_BOUNDARY)
+    plist = tuple(plist)
     profile = {
         "phase_schema_version": PHASE_SCHEMA_VERSION,
         "case": phase_case_name(
             fused_resolved, rhs_batch, bool(comms_kwargs.get("abft")),
-            sstep=sstep, overlap=overlap_on,
+            sstep=sstep, overlap=overlap_on, twolevel=twolevel_on,
         ),
         "fingerprint": operator_fingerprint(A),
         "lowering": lowering_descriptor(dA),

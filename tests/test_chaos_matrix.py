@@ -86,6 +86,14 @@ observatory"):
 | condition               | detector            | documented outcome   |
 |-------------------------|---------------------|----------------------|
 | infeasible deadline on a measured operator (PA_SPEC_ADMIT=1) | spectral forecast x measured s_per_it at admission | DeadlineInfeasible (typed, predicted_s/available_s diagnostics) + deadline_infeasible/health_error events + spec.infeasible counter; NEVER dispatched — zero iterations, service.admitted/slabs do not move; distinct by type and metric from queue-full AdmissionRejected, LoadShedded, and post-hoc SolveDeadlineError expiry |
+
+Round 18 (panode): the two-level exchange adds the STAGED-SCHEDULE
+row — a corruption class the five flat plan checks are blind to,
+because the flat logical-delivery view stays sound:
+
+| condition               | detector            | documented outcome   |
+|-------------------------|---------------------|----------------------|
+| two-level schedule with a mutated representative slot (scatter lane redirected into stage trash) | schedule simulation in verify_twolevel_plan | PlanSoundnessError (typed, coverage diagnostics) + plan_defect/health_error events, BEFORE any solve runs |
 """
 import numpy as np
 import pytest
@@ -467,6 +475,58 @@ def test_matrix_corrupted_plan_caught_statically(monkeypatch):
         # the static catch is narrated (one plan_defect event per
         # failing check class + the health_error every typed failure
         # emits) and happened BEFORE any solve — no new SolveRecord
+        assert telemetry.counter("events.plan_defect") == (
+            before + len(ei.value.diagnostics["checks"])
+        )
+        assert telemetry.counter("events.health_error") == health_before + 1
+        assert telemetry.last_record() is last
+        return True
+
+    _run(driver)
+
+
+def test_matrix_corrupted_twolevel_schedule_caught_statically(monkeypatch):
+    """panode row (ISSUE 18): a corrupted TWO-LEVEL schedule — a
+    representative's scatter lane redirected into the stage trash, so
+    the flat logical-delivery view stays perfectly sound and only the
+    staged schedule drops the delivery — is exactly the defect class
+    the five flat checks are blind to. The schedule simulation in
+    `verify_twolevel_plan` catches it statically: typed
+    `PlanSoundnessError` with check diagnostics, the ``plan_defect``
+    event trail, and NO solve ever started. The clean two-level build
+    passes the same ``PA_PLAN_VERIFY=1`` construction gate first."""
+    from partitionedarrays_jl_tpu.analysis import plan_verifier as pv
+    from partitionedarrays_jl_tpu.parallel.health import PlanSoundnessError
+    from partitionedarrays_jl_tpu.parallel.tpu import device_exchange_plan
+
+    monkeypatch.setenv("PA_PLAN_VERIFY", "1")
+    monkeypatch.setenv("PA_TPU_BOX", "0")  # the generic two-level plan
+    monkeypatch.setenv("PA_TPU_TWOLEVEL", "1")
+    monkeypatch.setenv("PA_TPU_NODE_MAP", "0,0,1,1")
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        rows = A.cols
+        # the clean build verifies sound AT the construction gate
+        plan = device_exchange_plan(rows)
+        assert hasattr(plan, "tl_rounds")
+        rd = next(r for r in plan.tl_rounds if r.tier == "scatter")
+        dst = int(rd.perm[0][1])
+        strash = plan.layout.W + plan.stage_width
+        lane = int(np.argmax(rd.rcv_idx[dst] != strash))
+        assert rd.rcv_idx[dst, lane] != strash
+        rd.rcv_idx[dst, lane] = strash
+        before = telemetry.counter("events.plan_defect")
+        health_before = telemetry.counter("events.health_error")
+        last = telemetry.last_record()
+        with pytest.raises(PlanSoundnessError) as ei:
+            pv.check_plan(plan, context="chaos-twolevel")
+        assert "coverage" in ei.value.diagnostics["checks"]
+        d = ei.value.diagnostics["defects"][0]
+        assert d["part"] is not None and d["check"]
+        assert ei.value.diagnostics["context"] == "chaos-twolevel"
+        # narrated (one plan_defect event per failing check class + the
+        # health_error) and BEFORE any solve — no new SolveRecord
         assert telemetry.counter("events.plan_defect") == (
             before + len(ei.value.diagnostics["checks"])
         )

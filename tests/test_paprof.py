@@ -273,7 +273,7 @@ def test_committed_phase_profile_is_reconciled():
     profiles = rec["profiles"]
     assert set(profiles) == {
         "standard", "fused", "block_k1_fused", "block_k4_fused",
-        "sstep2", "overlap",
+        "sstep2", "overlap", "twolevel",
     }
     for case, p in profiles.items():
         assert p["case"] == case
@@ -286,6 +286,12 @@ def test_committed_phase_profile_is_reconciled():
     assert profiles["overlap"]["boundary_attribution"] == (
         "structural-nnz-split"
     )
+    # the twolevel entry attributes the halo per FABRIC tier (ISSUE
+    # 18): both split phases present, the merged halo_exchange absent
+    tl_phases = profiles["twolevel"]["phases"]
+    for ph in prof.PHASE_HALO_SPLIT:
+        assert ph in tl_phases, ph
+    assert "halo_exchange" not in tl_phases
     # every lowering-matrix case must map onto a committed entry —
     # paprof --check's coverage gate, pinned here against the artifact
     from partitionedarrays_jl_tpu.parallel.tpu import lowering_matrix

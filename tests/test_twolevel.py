@@ -1,0 +1,329 @@
+"""panode — the node-aware two-level exchange plan (round 18).
+
+The ISSUE-18 acceptance contracts, each pinned here:
+
+* **Same delivery, different schedule.** The two-level plan's
+  base-class state IS the flat logical-delivery view: all five PR 8
+  plan-verifier checks pass on it unchanged (both plan families), the
+  logical index arrays equal the flat plan's bit-for-bit, and the
+  host plan's `canonical_exchange_fingerprint` is invariant across
+  flat <-> two-level construction.
+* **Bitwise identity.** Every schedule hop is a pure copy, so the CG
+  trajectory with the two-level plan on is bit-for-bit the flat
+  plan's on the 4-part conformance fixture — residual history AND
+  solution. Under strict-bits the env resolves to the flat plan (the
+  bitwise oracle), pinned as lowered-program identity.
+* **Measured, not guessed.** ``PA_TPU_TWOLEVEL=auto`` builds the
+  two-level plan only where `twolevel_decision`'s cost model says
+  aggregation pays (node pairs < slow edges); a chain topology whose
+  aggregation buys nothing keeps the flat plan.
+* **One fabric view (the bench_ici threading bugfix).** A node map
+  set through ``PA_TPU_NODE_MAP`` reaches BOTH plan construction and
+  the comms-matrix edge labels — `classify_edge`'s ``node_of``
+  priority beats the backend's process indices, and
+  `tools/bench_ici.comms_record` commits the same view the plan was
+  built from.
+"""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.analysis import plan_verifier as pv
+from partitionedarrays_jl_tpu.models import assemble_poisson, gather_pvector
+from partitionedarrays_jl_tpu.parallel.tpu import (
+    TPUBackend,
+    TWOLEVEL_TIERS,
+    TwoLevelDeviceExchangePlan,
+    _matrix_operands,
+    device_exchange_plan,
+    device_matrix,
+    make_cg_fn,
+    tpu_cg,
+)
+from partitionedarrays_jl_tpu.telemetry import commsmatrix as cmx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _backend(n=4):
+    import jax
+
+    return TPUBackend(devices=jax.devices()[:n])
+
+
+# ---------------------------------------------------------------------------
+# plan soundness: five checks, logical-view equality, fingerprints
+# ---------------------------------------------------------------------------
+
+
+def test_twolevel_generic_plan_passes_checks_and_keeps_delivery(
+    monkeypatch,
+):
+    assert len(pv.PLAN_CHECKS) == 5
+    monkeypatch.setenv("PA_TPU_BOX", "0")
+
+    def driver(parts):
+        A, _b, _xe, _x0 = assemble_poisson(parts, (8, 8))
+        rows = A.cols
+        ref = pv.referenced_ghosts(A)
+        canon = pv.canonical_exchange_fingerprint(
+            rows.exchanger, rows.partition
+        )
+        flat = device_exchange_plan(rows)
+        assert not hasattr(flat, "tl_rounds")
+
+        monkeypatch.setenv("PA_TPU_TWOLEVEL", "1")
+        monkeypatch.setenv("PA_TPU_NODE_MAP", "0,0,1,1")
+        plan = device_exchange_plan(rows)
+        assert isinstance(plan, TwoLevelDeviceExchangePlan)
+        assert plan is not flat
+        # all five checks on the logical view + the schedule simulation
+        assert pv.verify_plan(plan, referenced=ref) == []
+        # the logical-delivery view IS the flat plan's, bit for bit
+        assert plan.perms == flat.perms
+        for attr in ("snd_idx", "snd_mask", "rcv_idx"):
+            assert np.array_equal(
+                getattr(plan, attr), getattr(flat, attr)
+            ), attr
+        # two-level construction staged nothing into the HOST plan
+        assert pv.canonical_exchange_fingerprint(
+            rows.exchanger, rows.partition
+        ) == canon
+        # schedule structure: known tiers only, the node tier crosses
+        # the slow fabric and everything else stays fast
+        tiers = [rd.tier for rd in plan.tl_rounds]
+        assert set(tiers) <= set(TWOLEVEL_TIERS)
+        assert "node" in tiers
+        for rd in plan.tl_rounds:
+            fabric = plan.fabric_of_round(rd)
+            assert fabric == ("dcn" if rd.tier == "node" else "ici")
+        assert plan.wire_rounds == sum(
+            1 for rd in plan.tl_rounds if rd.perm
+        )
+        assert plan.node_of == (0, 0, 1, 1)
+        assert plan.decision["use"] is True
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+def test_twolevel_box_plan_passes_checks(monkeypatch):
+    """The box-family sibling: the default cartesian partition keeps
+    its box structure and still aggregates through the node tier."""
+    from partitionedarrays_jl_tpu.parallel.tpu_box import (
+        TwoLevelBoxExchangePlan,
+    )
+
+    def driver(parts):
+        A, _b, _xe, _x0 = assemble_poisson(parts, (8, 8))
+        rows = A.cols
+        ref = pv.referenced_ghosts(A)
+        monkeypatch.setenv("PA_TPU_TWOLEVEL", "1")
+        monkeypatch.setenv("PA_TPU_NODE_MAP", "0,0,1,1")
+        plan = device_exchange_plan(rows)
+        assert isinstance(plan, TwoLevelBoxExchangePlan)
+        assert hasattr(plan, "tl_rounds")
+        assert pv.verify_plan(plan, referenced=ref) == []
+        assert "node" in {rd.tier for rd in plan.tl_rounds}
+        return True
+
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+# ---------------------------------------------------------------------------
+# bitwise identity: trajectory pin + the strict-bits oracle
+# ---------------------------------------------------------------------------
+
+
+def test_twolevel_solve_bitwise_identical_on_4part_fixture(monkeypatch):
+    """The staged detour is pure copies: CG with the two-level plan on
+    is bit-for-bit the flat generic plan's solve — residual history
+    and gathered solution — on the 4-part conformance fixture."""
+    monkeypatch.setenv("PA_TPU_BOX", "0")
+
+    def run():
+        def driver(parts):
+            A, b, _xe, x0 = assemble_poisson(parts, (8, 8))
+            x, info = tpu_cg(A, b, x0=x0, tol=1e-10, maxiter=200)
+            return gather_pvector(x), info
+
+        return pa.prun(driver, _backend(), (2, 2))
+
+    x_flat, inf_flat = run()
+    monkeypatch.setenv("PA_TPU_TWOLEVEL", "1")
+    monkeypatch.setenv("PA_TPU_NODE_MAP", "0,0,1,1")
+    x_two, inf_two = run()
+    assert inf_flat["converged"] and inf_two["converged"]
+    assert inf_two["iterations"] == inf_flat["iterations"]
+    rf = np.asarray(inf_flat["residuals"], dtype=np.float64)
+    rt = np.asarray(inf_two["residuals"], dtype=np.float64)
+    assert rt.tobytes() == rf.tobytes()
+    assert np.asarray(x_two).tobytes() == np.asarray(x_flat).tobytes()
+
+
+def test_strict_bits_keeps_the_flat_plan_as_oracle(monkeypatch):
+    """Strict-bits resolves PA_TPU_TWOLEVEL to 0 (the PR 17 refusal
+    convention): the plan stays flat and the lowered CG program is
+    byte-identical StableHLO with the env on or off — program
+    identity, the strongest bitwise claim."""
+    monkeypatch.setenv("PA_TPU_STRICT_BITS", "1")
+    monkeypatch.setenv("PA_TPU_BOX", "0")
+    monkeypatch.setenv("PA_TPU_NODE_MAP", "0,0,1,1")
+    backend = _backend()
+
+    def text():
+        def driver(parts):
+            A, _b, _xe, _x0 = assemble_poisson(parts, (6, 6))
+            return A
+
+        A = pa.prun(driver, backend, (2, 2))
+        dA = device_matrix(A, backend)
+        assert not hasattr(dA.col_plan, "tl_rounds")
+        ops = _matrix_operands(dA)
+        P, W = dA.col_plan.layout.P, dA.col_plan.layout.W
+        z = np.zeros((P, W))
+        fn = make_cg_fn(dA, tol=1e-9, maxiter=50, fused=False)
+        return fn.jit_fn.lower(z, z, z, ops).as_text()
+
+    off = text()
+    monkeypatch.setenv("PA_TPU_TWOLEVEL", "1")
+    on = text()
+    assert on == off
+
+
+# ---------------------------------------------------------------------------
+# auto mode: the cost model decides per neighbor graph
+# ---------------------------------------------------------------------------
+
+
+def test_auto_mode_builds_only_where_aggregation_pays(monkeypatch):
+    monkeypatch.setenv("PA_TPU_BOX", "0")
+    monkeypatch.setenv("PA_TPU_TWOLEVEL", "auto")
+
+    def pays(parts):
+        # (2, 4) rows split across 2 nodes: 8 slow edges -> 2 pairs
+        A, _b, _xe, _x0 = assemble_poisson(parts, (8, 8))
+        plan = device_exchange_plan(A.cols)
+        assert hasattr(plan, "tl_rounds")
+        d = plan.decision
+        assert d["mode"] == "auto" and d["use"] is True
+        assert d["node_pair_edges"] < d["slow_edges_flat"]
+        assert d["twolevel_modeled_s"] < d["flat_modeled_s"]
+        return True
+
+    def declines(parts):
+        # a 1D chain: ONE cross-node boundary, 2 slow edges, 2 ordered
+        # node pairs — aggregation merges nothing, the flat plan stays
+        A, _b, _xe, _x0 = assemble_poisson(parts, (16, 8))
+        plan = device_exchange_plan(A.cols)
+        assert not hasattr(plan, "tl_rounds")
+        return True
+
+    monkeypatch.setenv("PA_TPU_NODE_MAP", "0,0,0,0,1,1,1,1")
+    assert pa.prun(pays, pa.sequential, (2, 4))
+    assert pa.prun(declines, pa.sequential, (8, 1))
+
+
+# ---------------------------------------------------------------------------
+# the fabric hook threads (bench_ici bugfix regression)
+# ---------------------------------------------------------------------------
+
+
+def test_node_map_threads_plan_and_matrix(monkeypatch):
+    """ONE node map, both consumers: the plan the env selects and the
+    matrix record's fabric labels derive from the same
+    ``PA_TPU_NODE_MAP`` — `classify_edge`'s ``node_of`` priority beats
+    the backend's process indices (on this single-process host every
+    edge would otherwise label ici)."""
+    monkeypatch.setenv("PA_TPU_BOX", "0")
+    monkeypatch.setenv("PA_TPU_TWOLEVEL", "1")
+    monkeypatch.setenv("PA_TPU_NODE_MAP", "0,0,1,1")
+    backend = _backend()
+    node_of = [0, 0, 1, 1]
+
+    def driver(parts):
+        A, _b, _xe, _x0 = assemble_poisson(parts, (8, 8))
+        return A
+
+    A = pa.prun(driver, backend, (2, 2))
+    dA = device_matrix(A, backend)
+    plan = dA.col_plan
+    assert hasattr(plan, "tl_rounds")
+    assert tuple(plan.node_of) == tuple(node_of)
+    # the two-level matrix labels through the plan's own map: every
+    # edge's fabric is node-arithmetic on the SAME node_of
+    m = cmx.static_matrix(plan, np.float64, backend=backend)
+    assert m["plan"] == "twolevel"
+    assert m["node_of"] == node_of
+    for e in m["edges"]:
+        want = (
+            "self" if e["src"] == e["dst"]
+            else "ici" if node_of[e["src"]] == node_of[e["dst"]]
+            else "dcn"
+        )
+        assert e["fabric"] == want, e
+    assert m["fabric_summary"]["dcn"]["edges"] == sum(
+        1 for rd in plan.tl_rounds
+        if rd.perm and rd.tier == "node" for _ in rd.perm
+    )
+    # node_of priority over the backend's (single-process) view
+    assert cmx.classify_edge(
+        0, 3, backend=backend, P=4, node_of=node_of
+    ) == "dcn"
+    assert cmx.classify_edge(0, 3, backend=backend, P=4) == "ici"
+
+
+def test_bench_ici_comms_record_threads_the_hook(monkeypatch):
+    """The ported bench: `tools/bench_ici.comms_record` commits a
+    schema-v2 matrix labeled by the SAME fabric hook plan construction
+    consumed — the two-level path through the plan's own node map, the
+    flat path through the `classify_edge` override (the regression:
+    the old bench recorded no matrix, so a custom hook could reach the
+    plan but never the committed record)."""
+    # import the tool module without executing its __main__ leg; it
+    # pins JAX_PLATFORMS/XLA_FLAGS at import — snapshot and restore
+    saved = {
+        k: os.environ.get(k) for k in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "bench_ici", os.path.join(REPO, "tools", "bench_ici.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    backend = _backend(8)
+    nmap = "0,0,0,0,1,1,1,1"
+    monkeypatch.setenv("PA_TPU_BOX", "0")
+    monkeypatch.setenv("PA_TPU_NODE_MAP", nmap)
+
+    # flat path: the map reaches the record through the classify
+    # override (the plan itself stays flat with PA_TPU_TWOLEVEL unset)
+    monkeypatch.delenv("PA_TPU_TWOLEVEL", raising=False)
+    m_flat = mod.comms_record(pa, backend)
+    assert m_flat["comms_matrix_schema_version"] == (
+        cmx.COMMS_MATRIX_SCHEMA_VERSION
+    )
+    assert m_flat["plan"] == "generic"
+    assert m_flat["static_check"] == []
+    assert m_flat["fabric_summary"]["dcn"]["edges"] > 0
+
+    # two-level path: the same map built the plan AND labels the record
+    monkeypatch.setenv("PA_TPU_TWOLEVEL", "1")
+    m_two = mod.comms_record(pa, backend)
+    assert m_two["plan"] == "twolevel"
+    assert m_two["node_of"] == [int(t) for t in nmap.split(",")]
+    assert m_two["static_check"] == []
+    # one fabric view: the flat record's slow-edge count is what the
+    # plan's decision said it was aggregating
+    assert m_flat["fabric_summary"]["dcn"]["edges"] == (
+        m_two["decision"]["slow_edges_flat"]
+    )
