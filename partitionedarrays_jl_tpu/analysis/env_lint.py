@@ -313,6 +313,18 @@ NON_LOWERING: Dict[str, str] = {
         "exceeds the deadline; never touches what any program stages "
         "(byte-identity pinned in tests/test_paspec.py)"
     ),
+    "PA_ELASTIC": (
+        "elastic degraded-mode switch (parallel/elastic.py) — host-side "
+        "recovery POLICY: whether a PartLossError shrinks the partition "
+        "and resumes or escalates typed; every program on the shrunken "
+        "partition is built through the ordinary staging path with its "
+        "own keys, nothing staged reads the flag"
+    ),
+    "PA_ELASTIC_MIN_PARTS": (
+        "elastic shrink floor (parallel/elastic.py) — host-side policy "
+        "bound on how small the survivor grid may get before the loss "
+        "escalates instead; same staging story as PA_ELASTIC"
+    ),
 }
 
 

@@ -2341,6 +2341,12 @@ def solve_with_recovery(
                 A, b, method, ckpt, max_restarts, minv, x0, tol,
                 maxiter, verbose,
             )
+        # grow-back: a clean full-capacity solve after an elastic
+        # shrink (this one, if it did not itself run degraded) emits
+        # elastic_restore and clears the degraded marker
+        from ..parallel import elastic
+
+        elastic.note_recovered(int(A.rows.partition.num_parts), info)
         return x, rec.finish(info)
 
 
@@ -2352,8 +2358,9 @@ def _solve_with_recovery_host(
     import sys
 
     from .. import telemetry
+    from ..parallel import elastic
     from ..parallel.checkpoint import load_solver_state
-    from ..parallel.health import SolverHealthError
+    from ..parallel.health import PartLossError, SolverHealthError
 
     restarts = 0
     failures = []
@@ -2380,6 +2387,23 @@ def _solve_with_recovery_host(
             _fold_sdc(info.get("sdc"))
             info["recovery"] = ledger
             return x, info
+        except PartLossError as e:
+            # a dead part is PERSISTENT: same-partition restarts can
+            # never see its contribution again, so no restart budget is
+            # burned here — either the elastic tier reshapes onto the
+            # survivors (PA_ELASTIC=1) or the loss escalates typed to
+            # the caller's checkpoint tier
+            failures.append(
+                {"type": type(e).__name__, "message": str(e),
+                 "diagnostics": e.diagnostics}
+            )
+            _fold_sdc(e.diagnostics.get("sdc"))
+            if not elastic.elastic_enabled():
+                raise
+            return elastic.shrink_and_resume(
+                A, b, method, minv, ckpt, x0, tol, maxiter, verbose,
+                e, ledger, failures, restarts,
+            )
         except SolverHealthError as e:
             failures.append(
                 {"type": type(e).__name__, "message": str(e),
@@ -2461,8 +2485,9 @@ def _solve_with_recovery_chunked(
     unchunked one."""
     import sys
 
+    from ..parallel import elastic
     from ..parallel.checkpoint import load_solver_state
-    from ..parallel.health import SolverHealthError
+    from ..parallel.health import PartLossError, SolverHealthError
 
     maxiter = maxiter if maxiter is not None else 4 * A.rows.ngids
     chunk = max(1, int(every)) if ckpt is not None else maxiter
@@ -2488,6 +2513,23 @@ def _solve_with_recovery_chunked(
                 verbose=verbose, **kw,
             )
             _fold_sdc(info.get("sdc"))
+        except PartLossError as e:
+            # persistent loss — see the host path: no restart budget,
+            # shrink-and-resume (PA_ELASTIC=1) or typed escalation;
+            # the elastic resume continues from the retained iterate
+            # (the last checkpointed one wins inside shrink_and_resume)
+            failures.append(
+                {"type": type(e).__name__, "message": str(e),
+                 "diagnostics": e.diagnostics}
+            )
+            _fold_sdc(e.diagnostics.get("sdc"))
+            if not elastic.elastic_enabled():
+                raise
+            return elastic.shrink_and_resume(
+                A, b, method, minv, ckpt, x, tol,
+                max(1, maxiter - done), verbose,
+                e, ledger, failures, restarts,
+            )
         except SolverHealthError as e:
             failures.append(
                 {"type": type(e).__name__, "message": str(e),

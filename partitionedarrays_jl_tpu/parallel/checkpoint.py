@@ -40,6 +40,20 @@ from .psparse import PSparseMatrix
 from .pvector import PVector, _owned
 
 
+class CheckpointShapeError(RuntimeError):
+    """A solver-state checkpoint written at one part count was asked to
+    restore at a DIFFERENT part count with the elastic tier disabled.
+    The serialized format itself is partition-independent — the generic
+    loaders (`load_pvector`/`load_checkpoint`/the sharded formats)
+    restore onto any partition, always — but a mid-run SOLVER-state
+    restore across part counts changes the partition under a live
+    recurrence, which is an elastic-tier decision, not something a
+    resume should do silently. Raised by `load_solver_state` (and so
+    `models.solvers.resume_solve`) naming both part counts; set
+    ``PA_ELASTIC=1`` (parallel/elastic.py) to opt into cross-part-count
+    degraded-mode restores."""
+
+
 class CheckpointCorruptError(RuntimeError):
     """No clean generation of a checkpoint could be read: every retained
     generation has a missing, truncated, or bit-rotted (CRC-mismatched)
@@ -673,6 +687,13 @@ class SolverCheckpointer:
         self.wait()  # one writer at a time; surfaces a prior failure
         objs = {k: v.copy() for k, v in vectors.items()}
         meta = _json_safe_meta(meta)
+        # record the writing run's part count: load_solver_state refuses
+        # a cross-part-count restore TYPED (CheckpointShapeError) unless
+        # the elastic tier opted in — older checkpoints without the key
+        # are simply not checked
+        for v in vectors.values():
+            meta.setdefault("nparts", int(v.rows.partition.num_parts))
+            break
         from ..telemetry import emit_event
 
         emit_event(
@@ -737,9 +758,45 @@ def load_solver_state(
     """Restore a solver-state checkpoint written by `SolverCheckpointer`
     onto ``ranges`` (any partition of the same global sizes), or None
     when ``directory`` holds no complete checkpoint yet — the caller
-    then restarts from scratch instead of failing."""
+    then restarts from scratch instead of failing.
+
+    A checkpoint that RECORDS its writing part count (every
+    `SolverCheckpointer` write does) restores onto a different part
+    count only under ``PA_ELASTIC=1`` — otherwise the mismatch raises
+    the typed `CheckpointShapeError` naming both counts, so a resume
+    can never silently repartition a live recurrence (the generic
+    `load_checkpoint` path stays partition-independent and ungated)."""
     if not os.path.isfile(os.path.join(directory, "manifest.json")):
         return None
+    with open(os.path.join(directory, "manifest.json")) as f:
+        _manifest = json.load(f)
+    src_parts = (_manifest.get("meta") or {}).get("nparts")
+    tgt_parts = next(
+        (
+            int(r.num_parts)
+            for r in ranges.values()
+            if isinstance(r, PRange)
+        ),
+        None,
+    )
+    if (
+        src_parts is not None
+        and tgt_parts is not None
+        and int(src_parts) != tgt_parts
+    ):
+        from .elastic import elastic_enabled
+
+        if not elastic_enabled():
+            raise CheckpointShapeError(
+                f"solver-state checkpoint {directory!r} was written at "
+                f"{int(src_parts)} parts but the restore target has "
+                f"{tgt_parts} parts — cross-part-count solver restores "
+                "are an elastic-tier decision; set PA_ELASTIC=1 to opt "
+                "into degraded-mode redistribution (parallel/elastic.py)"
+            )
+        from ..telemetry import registry
+
+        registry().counter("elastic.crosspart_restores").inc()
     st = load_checkpoint(directory, ranges)
     from ..telemetry import emit_event
 

@@ -1,0 +1,353 @@
+"""Elastic degraded-mode solves: shrink onto the survivors, resume,
+grow back.
+
+The recovery ladder below this tier (health guards -> in-memory
+rollback -> checkpoint restart, PR 9/10) assumes the PART GRID
+survives the fault: every restart replays on the same partition. A
+lost part (`PartLossError` — one TPU core / mesh shard gone for good)
+breaks that assumption: no number of same-partition restarts will ever
+see its exchange contribution again, so burning the restart budget on
+it just converts a detectable loss into a timeout loop.
+
+Under ``PA_ELASTIC=1`` this module gives `solve_with_recovery` a
+fourth rung instead:
+
+1. **shrink** — rebuild a ghost-free row partition over the surviving
+   part grid (`survivor_rows`, the first grid axis with more than one
+   part is decremented until the dead part id falls out of the grid)
+   and migrate A, b, and the iterate onto it gid-keyed
+   (`repartition_psparse` / `repartition_pvector`, the P -> P'
+   cross-count path). Every exchange plan of the shrunken system is
+   DERIVED on the new partition and statically verified — all five
+   `plan_verifier` checks run unconditionally here, not only under
+   ``PA_PLAN_VERIFY``.
+2. **re-admit** — the shrunken system is re-checked against the tenant
+   memory budget (``PA_GATE_MEM_BUDGET``): fewer parts means wider
+   per-part rows, and a footprint that fit at P parts may not fit at
+   P'. A refusal is the usual typed `TenantBudgetError`.
+3. **resume** — the last checkpointed iterate x_k restores CROSS part
+   count (`load_solver_state` under ``PA_ELASTIC=1``; the gid-keyed
+   checkpoint format is partition-independent), and Krylov restarts
+   cold from x_k on the new partition. The resumed trajectory is
+   bitwise the cold solve a fresh caller would run on the survivors
+   from the same x_k — elasticity adds routing, never arithmetic.
+4. **grow back** — the degraded state is remembered module-wide; the
+   next `solve_with_recovery` that completes at the original part
+   count emits ``elastic_restore`` and clears it.
+
+``PA_ELASTIC_MIN_PARTS`` floors the shrink (default 1): a loss that
+cannot be excluded without dropping below the floor escalates the
+original typed error to the caller's checkpoint tier.
+
+Observability: one stitched trail per shrink — an ``elastic_shrink``
+event + ``elastic.shrink{reason=...}`` counter + a
+``tenant.repartition`` trace span around the migration; cross-count
+restores bump ``elastic.crosspart_restores`` (checkpoint.py). The
+chaos drill `tools/paelastic.py --drill` exercises the whole ladder on
+the 8-part fixture.
+"""
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "elastic_enabled",
+    "elastic_min_parts",
+    "shrink_shape",
+    "shrink_system",
+    "survivor_rows",
+    "shrink_and_resume",
+    "note_recovered",
+    "degraded_state",
+]
+
+
+def elastic_enabled() -> bool:
+    """``PA_ELASTIC=1`` opts into elastic degraded-mode solves (and
+    into cross-part-count solver-state restores — see
+    `checkpoint.load_solver_state`). Off by default: part loss is a
+    typed escalation, not a silent reshape."""
+    return os.environ.get("PA_ELASTIC", "0") == "1"
+
+
+def elastic_min_parts() -> int:
+    """``PA_ELASTIC_MIN_PARTS``: the smallest part count a shrink may
+    produce (default 1). Below the floor the loss escalates instead."""
+    try:
+        return max(1, int(os.environ.get("PA_ELASTIC_MIN_PARTS", "1")))
+    except ValueError:
+        return 1
+
+
+# module-wide degraded marker: set by a shrink, cleared by the first
+# full-capacity solve afterwards (grow-back). One slot — nested
+# degradation overwrites with the deepest shrink, which is the one
+# grow-back must undo.
+_DEGRADED: dict = {}
+
+
+def degraded_state() -> dict:
+    """A copy of the current degraded marker ({} when at capacity)."""
+    return dict(_DEGRADED)
+
+
+def shrink_shape(shape, dead_part: Optional[int] = None) -> Tuple[int, ...]:
+    """The survivor grid: decrement the first axis with more than one
+    part — once, or (with ``dead_part``) until that part id falls off
+    the grid, so a re-run of the same fault spec is inert on the
+    survivors (out-of-grid clauses never fire — faults.py). Raises
+    ``ValueError`` at a 1-part grid or when the exclusion would drop
+    below ``PA_ELASTIC_MIN_PARTS``."""
+    shape = tuple(int(s) for s in shape)
+
+    def _dec(s: Tuple[int, ...]) -> Tuple[int, ...]:
+        for i, n in enumerate(s):
+            if n > 1:
+                return s[:i] + (n - 1,) + s[i + 1 :]
+        raise ValueError("shrink_shape: cannot shrink a 1-part grid")
+
+    floor = elastic_min_parts()
+    out = _dec(shape)
+    while dead_part is not None and math.prod(out) > dead_part:
+        if math.prod(out) <= floor:
+            raise ValueError(
+                f"shrink_shape: excluding dead part {dead_part} from grid "
+                f"{shape} would drop below PA_ELASTIC_MIN_PARTS={floor}"
+            )
+        out = _dec(out)
+    if math.prod(out) < floor:
+        raise ValueError(
+            f"shrink_shape: {shape} -> {out} is below "
+            f"PA_ELASTIC_MIN_PARTS={floor}"
+        )
+    return out
+
+
+def survivor_rows(rows, shape=None):
+    """A ghost-free 1-D block row partition of ``rows``'s global index
+    space over the survivor grid ``shape`` (default: one
+    `shrink_shape` step). Deliberately uniform — the elastic tier
+    re-derives layout, it never patches the casualty's plan."""
+    from .backends import get_part_ids
+    from .prange import uniform_partition
+
+    if shape is None:
+        shape = shrink_shape(rows.partition.shape)
+    parts = get_part_ids(rows.partition.backend, tuple(shape))
+    return uniform_partition(parts, rows.ngids)
+
+
+def shrink_system(
+    A,
+    b,
+    x=None,
+    shape=None,
+    kmax: int = 1,
+    reason: str = "part_loss",
+    dead_part: Optional[int] = None,
+):
+    """Migrate (A, b[, x]) onto the survivor grid and re-admit.
+
+    Returns ``(A2, b2, x2, info)`` — ``x2`` is None iff ``x`` was.
+    The migration runs under a ``tenant.repartition`` trace span,
+    emits one ``elastic_shrink`` event, bumps
+    ``elastic.shrink{reason=...}``, re-checks the shrunken footprint
+    against ``PA_GATE_MEM_BUDGET`` (typed `TenantBudgetError` on
+    refusal — wider rows per part may no longer fit), and statically
+    verifies the derived column-exchange plan with ALL five
+    `plan_verifier` checks regardless of ``PA_PLAN_VERIFY``."""
+    from .repartition import repartition_psparse, repartition_pvector
+    from ..analysis.plan_verifier import check_plan
+    from ..frontdoor.tenancy import (
+        TenantBudgetError,
+        mem_budget,
+        operator_footprint_bytes,
+    )
+    from ..telemetry import emit_event, registry
+    from ..telemetry.tracing import span
+
+    from_parts = int(A.rows.partition.num_parts)
+    new_rows = survivor_rows(A.rows, shape=shape)
+    to_parts = int(new_rows.partition.num_parts)
+    with span(
+        "tenant.repartition",
+        name=f"shrink {from_parts}->{to_parts}",
+        from_parts=from_parts,
+        to_parts=to_parts,
+        reason=reason,
+    ):
+        A2 = repartition_psparse(A, new_rows)
+        b2 = repartition_pvector(b, A2.rows)
+        x2 = None if x is None else repartition_pvector(x, A2.cols)
+        # every plan of the shrunken system is freshly derived — verify
+        # it statically before a single exchange runs on it (the five
+        # PR 8 checks; unconditional, the degraded path has no second
+        # chance to catch an unsound plan cheaply)
+        check_plan(
+            A2.cols.exchanger,
+            parts=A2.cols.partition.part_values(),
+            context="elastic.shrink",
+        )
+    budget = mem_budget()
+    fp = int(operator_footprint_bytes(A2, kmax))
+    if budget and fp > budget:
+        raise TenantBudgetError(
+            f"elastic shrink {from_parts}->{to_parts} parts: footprint "
+            f"{fp} B at the survivor layout exceeds PA_GATE_MEM_BUDGET="
+            f"{budget} B — wider per-part rows no longer fit",
+            diagnostics={
+                "footprint_bytes": fp,
+                "budget_bytes": budget,
+                "from_parts": from_parts,
+                "to_parts": to_parts,
+                "reason": reason,
+            },
+        )
+    registry().counter("elastic.shrink", labels={"reason": reason}).inc()
+    emit_event(
+        "elastic_shrink",
+        label=reason,
+        from_parts=from_parts,
+        to_parts=to_parts,
+        dead_part=dead_part,
+        footprint_bytes=fp,
+    )
+    info = {
+        "from_parts": from_parts,
+        "to_parts": to_parts,
+        "shape": [int(s) for s in new_rows.partition.shape],
+        "dead_part": dead_part,
+        "reason": reason,
+        "footprint_bytes": fp,
+    }
+    _DEGRADED.clear()
+    _DEGRADED.update(info)
+    return A2, b2, x2, info
+
+
+def shrink_and_resume(
+    A,
+    b,
+    method: str,
+    minv,
+    ckpt,
+    x0,
+    tol: float,
+    maxiter: Optional[int],
+    verbose: bool,
+    error,
+    ledger: dict,
+    failures: list,
+    restarts: int,
+):
+    """The `solve_with_recovery` elastic rung: shrink onto the
+    survivors, restore the last checkpointed iterate CROSS part count
+    (or migrate the in-memory one), and run Krylov cold from it on the
+    new partition — bitwise the solve a fresh caller would start there
+    from the same iterate. Returns the standard ``(x, info)`` with the
+    cumulative recovery ledger plus ``info["elastic"]``; the returned
+    ``x`` rides the SHRUNKEN column range (degraded-mode result).
+
+    A `pcg` resume passes ``minv`` through unchanged — elastic shrink
+    needs a partition-independent preconditioner (one built against
+    the old partition's layout will reject the migrated operands)."""
+    from ..telemetry import emit_event
+    from .checkpoint import load_solver_state
+    from .health import PartLossError
+
+    dead = None
+    if error is not None and getattr(error, "diagnostics", None):
+        dead = error.diagnostics.get("part")
+    try:
+        shape = shrink_shape(A.rows.partition.shape, dead_part=dead)
+    except ValueError as ve:
+        # cannot exclude the casualty above the floor — the elastic
+        # tier declines; the original typed error escalates
+        if error is not None:
+            error.diagnostics["elastic_declined"] = str(ve)
+            raise error
+        raise
+    A2, b2, x2, shrink = shrink_system(
+        A, b, x0, shape=shape, reason="part_loss", dead_part=dead
+    )
+    source = {
+        "failure": type(error).__name__ if error is not None else
+        PartLossError.__name__,
+        "from": "elastic_shrink",
+        "from_parts": shrink["from_parts"],
+        "to_parts": shrink["to_parts"],
+    }
+    ckpt_it = None
+    if ckpt is not None:
+        try:
+            ckpt.wait()  # let an in-flight write land first
+        except Exception:
+            pass
+        if ckpt.has_state():
+            from .checkpoint import CheckpointCorruptError
+            from ..models.solvers import _solver_state_ranges
+
+            try:
+                st = load_solver_state(
+                    ckpt.directory, _solver_state_ranges(A2, b2)
+                )
+            except CheckpointCorruptError as ce:
+                st = None
+                source["checkpoint_corrupt"] = str(ce)
+            if st is not None:
+                # iterate-only by design: the recurrence state (r, p,
+                # scalars) is partition-independent too, but a Krylov
+                # restart from x_k is what the bitwise-equals-cold-solve
+                # contract pins — resuming conjugacy across a reshape
+                # would make the degraded trajectory unique
+                x2 = st["x"]
+                ckpt_it = int(st.get("meta", {}).get("it", 0))
+                source["from"] = "elastic_shrink_checkpoint"
+                source["checkpoint_iteration"] = ckpt_it
+                ledger["checkpoint_restarts"] += 1
+    ledger["restart_sources"].append(source)
+    emit_event(
+        "restart", label=source["failure"], attempt=restarts, **source
+    )
+    from ..models.solvers import cg, pcg
+
+    kwargs = dict(
+        tol=tol, maxiter=maxiter, verbose=verbose, checkpoint=ckpt
+    )
+    if method == "pcg":
+        x, info = pcg(A2, b2, x0=x2, minv=minv, **kwargs)
+    else:
+        x, info = cg(A2, b2, x0=x2, **kwargs)
+    info["restarts"] = restarts
+    if failures:
+        info["failures"] = failures
+    info["recovery"] = ledger
+    info["elastic"] = dict(shrink, checkpoint_iteration=ckpt_it)
+    return x, info
+
+
+def note_recovered(nparts: int, info: Optional[dict] = None) -> None:
+    """Grow-back bookkeeping, called on every successful
+    `solve_with_recovery` exit: a solve completing at (or above) the
+    pre-shrink part count while the degraded marker is set means
+    capacity returned — emit ``elastic_restore`` and clear the
+    marker. A solve that itself ran degraded (``info["elastic"]``)
+    never clears it."""
+    if not _DEGRADED:
+        return
+    if info is not None and "elastic" in info:
+        return
+    if int(nparts) >= int(_DEGRADED.get("from_parts", 0)):
+        from ..telemetry import emit_event
+
+        emit_event(
+            "elastic_restore",
+            label="grow_back",
+            from_parts=int(_DEGRADED.get("to_parts", 0)),
+            to_parts=int(nparts),
+        )
+        _DEGRADED.clear()

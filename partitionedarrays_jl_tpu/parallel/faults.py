@@ -27,6 +27,16 @@ Spec grammar — ``;``-separated clauses, each ``kind@key=val,key=val``:
                        the slowest sender), so the sleep applies to the
                        call; `part` gates whether the clause fires
             controller part's controller dies: ControllerLostError
+            part_loss  part `part` is DEAD: every matched exchange
+                       raises PartLossError naming it. Persistent by
+                       nature (pair with `after` — a dead core stays
+                       dead, so every later exchange on a partition
+                       containing the part fails the same way); a part
+                       id is REQUIRED, and the out-of-grid inertness
+                       below is the recovery story: a shrunken
+                       survivor grid no longer contains the dead id,
+                       so the resumed degraded solve runs clean
+                       (parallel/elastic.py, PA_ELASTIC=1)
     part    sending part id, or ``*`` (default: any part). An id outside
             the run's part grid matches nothing (the clause is inert).
     call    global exchange-call index this clause fires at (``*`` = every
@@ -80,7 +90,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..utils.table import Table
-from .health import ControllerLostError
+from .health import ControllerLostError, PartLossError
 
 __all__ = [
     "FaultClause",
@@ -92,7 +102,7 @@ __all__ = [
     "device_fault_clause",
 ]
 
-_KINDS = ("nan", "bitflip", "drop", "delay", "controller")
+_KINDS = ("nan", "bitflip", "drop", "delay", "controller", "part_loss")
 
 
 @dataclass(frozen=True)
@@ -153,6 +163,11 @@ class FaultSpec:
                     kw[key] = float(val)
                 else:
                     raise ValueError(f"fault spec: unknown key {key!r}")
+            if kind == "part_loss" and kw.get("part") is None:
+                raise ValueError(
+                    f"fault spec: part_loss needs an explicit part id "
+                    f"in {raw!r} — 'any part died' is not a fault model"
+                )
             clauses.append(FaultClause(kind=kind, **kw))
         return cls(clauses)
 
@@ -303,6 +318,23 @@ def exchange_faults_hook(data_snd, parts_snd):
 
     nparts = data_snd.num_parts
     for c in live:
+        if c.kind == "part_loss":
+            # out-of-grid inertness is THE elastic recovery contract:
+            # after a shrink the survivor grid no longer contains the
+            # dead part id, so this clause stops firing and the
+            # resumed degraded solve completes clean
+            if not (0 <= c.part < nparts):
+                continue
+            state.record(kind="part_loss", call=call, part=c.part)
+            raise PartLossError(
+                f"part {c.part} lost at exchange call {call} — its "
+                "contribution will never arrive (persistent, unlike a "
+                "timeout)",
+                diagnostics={
+                    "call": call, "part": c.part, "nparts": nparts,
+                    "injected": True,
+                },
+            )
         if c.kind == "controller":
             # same out-of-grid inertness as every other clause kind (the
             # spec grammar: an id outside this run's part grid matches

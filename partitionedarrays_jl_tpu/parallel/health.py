@@ -86,6 +86,7 @@ __all__ = [
     "SolveDeadlineError",
     "DeadlineInfeasible",
     "ControllerLostError",
+    "PartLossError",
     "SilentCorruptionError",
     "PlanSoundnessError",
     "LoweringConflictError",
@@ -199,6 +200,22 @@ class DeadlineInfeasible(SolverHealthError):
 class ControllerLostError(SolverHealthError):
     """A controller process died mid-run (chaos runs: a `controller`
     fault clause; multi-host runs: surfaced by the runtime)."""
+
+
+class PartLossError(SolverHealthError):
+    """A PART (one TPU core / mesh shard) died mid-run — its exchange
+    contribution will never arrive again (chaos runs: a `part_loss`
+    fault clause; real runs: surfaced by the runtime when a device
+    drops out of the mesh). DISTINCT from `ExchangeTimeoutError`,
+    which is ONE missed deadline and survivable by a restart on the
+    same partition: a lost part is PERSISTENT, so every restart on the
+    original partition fails the same way. `solve_with_recovery`
+    therefore never burns restart budget on it — under ``PA_ELASTIC=1``
+    the elastic tier (`parallel/elastic.py`) rebuilds the partition
+    over the survivors and resumes from the last checkpointed iterate;
+    otherwise it escalates immediately (typed) to the caller's
+    checkpoint tier. ``diagnostics["part"]`` names the dead part and
+    ``diagnostics["call"]`` the exchange call it died at."""
 
 
 class SilentCorruptionError(SolverHealthError):

@@ -1,4 +1,5 @@
-"""In-memory redistribution onto a different partition (load balancing).
+"""In-memory redistribution onto a different partition (load balancing
+and elastic shrink/grow).
 
 The reference stops at gather-to-MAIN + scatter (reference:
 src/Interfaces.jl:2664-2748); here redistribution is scalable: owned
@@ -6,6 +7,24 @@ data migrates directly between old and new owners through the same
 variable-length Table exchange that powers COO assembly — no global
 image, no MAIN bottleneck. The checkpoint layer (checkpoint.py) is the
 disk-mediated sibling of this module.
+
+Two routing paths, chosen by part count:
+
+* **same part grid** (any ownership layout): the assembly Exchanger
+  carries (gid, value) pairs old-owner -> new-owner — the wire path.
+* **different part count** (P -> P′, the elastic tier's shrink/grow —
+  parallel/elastic.py): PData over different part grids cannot share an
+  exchange plan, so owned entries are owner-split gid-keyed on the host
+  (stable argsort + searchsorted per source part — the same routing
+  the sharded checkpoint loaders run per shard), exactly one owner per
+  gid, then each target part fills its block. Host-side by the same
+  contract as the checkpoint sibling: redistribution is a recovery /
+  rebalancing hop, not an inner-loop operation.
+
+Both paths thread the SOURCE dtype explicitly: a part owning zero rows
+migrates an empty array, and deriving the output dtype from it would
+promote f32 data to f64 (the PR 3 f64-poisoning class, pinned in
+tests/test_repartition.py).
 """
 from __future__ import annotations
 
@@ -25,22 +44,20 @@ from ..utils.helpers import check
 
 
 def repartition_pvector(v: PVector, new_rows: PRange) -> PVector:
-    """Redistribute a PVector onto `new_rows`: same global index space
-    and the same part grid, any other ownership layout (rebalancing
-    across a different number of parts needs a checkpoint round-trip —
-    see checkpoint.py). Owned values travel old-owner -> new-owner via
-    the assembly exchange; ghost entries of the result are filled by a
-    halo update, so the returned vector is ready for SpMV against
+    """Redistribute a PVector onto `new_rows`: same global index space,
+    ANY new partition — a different ownership layout on the same part
+    grid (rebalancing) or a different part count entirely (elastic
+    shrink/grow, P -> P′). Owned values travel old-owner -> new-owner
+    (via the assembly exchange on a shared grid, via the gid-keyed host
+    owner split across grids); ghost entries of the result are filled
+    by a halo update, so the returned vector is ready for SpMV against
     operators over `new_rows`."""
     check(
         v.rows.ngids == new_rows.ngids,
         f"repartition: {v.rows.ngids} gids -> {new_rows.ngids}",
     )
-    check(
-        v.rows.partition.num_parts == new_rows.partition.num_parts,
-        "repartition runs within one part grid; use the checkpoint layer "
-        "to change the part count",
-    )
+    if v.rows.partition.num_parts != new_rows.partition.num_parts:
+        return _repartition_pvector_crosscount(v, new_rows)
 
     def _owned_pairs(iset: AbstractIndexSet, vals):
         g = np.asarray(iset.oid_to_gid)
@@ -54,9 +71,10 @@ def repartition_pvector(v: PVector, new_rows: PRange) -> PVector:
     rows_t = add_gids(new_rows, I)
     J = map_parts(lambda i: np.zeros(len(i), dtype=np.int64), I)
     I2, _J2, V2 = assemble_coo(I, J, V, rows_t)
+    dtype = v.dtype  # NOT the migrated array's: empty parts poison f64
 
     def _fill(iset: AbstractIndexSet, gi, vi):
-        out = np.zeros(iset.num_lids, dtype=np.asarray(vi).dtype)
+        out = np.zeros(iset.num_lids, dtype=dtype)
         lids = iset.gids_to_lids(np.asarray(gi))
         own = lids >= 0
         # the shipped-away copies were zeroed by assemble_coo; only the
@@ -72,11 +90,58 @@ def repartition_pvector(v: PVector, new_rows: PRange) -> PVector:
     return out
 
 
+def _repartition_pvector_crosscount(v: PVector, new_rows: PRange) -> PVector:
+    """The P -> P′ path: gid-keyed owner split on the host (see module
+    docstring). Every gid has exactly one source owner and one target
+    owner, so the fill is a permutation — bitwise, no arithmetic."""
+    from .checkpoint import _owner_fn
+
+    nparts_t = new_rows.partition.num_parts
+    owner = _owner_fn(new_rows)
+    tgt_g = [[] for _ in range(nparts_t)]
+    tgt_v = [[] for _ in range(nparts_t)]
+    for iset, vals in zip(
+        v.rows.partition.part_values(), v.values.part_values()
+    ):
+        g = np.asarray(iset.oid_to_gid)
+        w = _owned(iset, np.asarray(vals))
+        own = owner(g)
+        order = np.argsort(own, kind="stable")
+        bounds = np.searchsorted(own[order], np.arange(nparts_t + 1))
+        for t in range(nparts_t):
+            sel = order[bounds[t] : bounds[t + 1]]
+            if len(sel):
+                tgt_g[t].append(g[sel])
+                tgt_v[t].append(w[sel])
+    dtype = v.dtype  # threaded explicitly: empty-owned parts stay f32
+
+    def _fill_part(t: int, iset: AbstractIndexSet):
+        out = np.zeros(iset.num_lids, dtype=dtype)
+        if tgt_g[t]:
+            g = np.concatenate(tgt_g[t])
+            out[iset.gids_to_lids(g)] = np.concatenate(tgt_v[t])
+        return out
+
+    vals = new_rows.partition._like(
+        [
+            _fill_part(t, iset)
+            for t, iset in enumerate(new_rows.partition.part_values())
+        ]
+    )
+    out = PVector(vals, new_rows)
+    if new_rows.ghost:
+        exchange_pvector(out)
+    return out
+
+
 def repartition_psparse(A: PSparseMatrix, new_rows: PRange) -> PSparseMatrix:
     """Redistribute a PSparseMatrix onto the ghost-free partition
-    `new_rows` (same part grid): owned-row triplets migrate to their new
-    row owners and recompress through the standard assembly pipeline;
-    the column ghost layer is rediscovered from the migrated columns.
+    `new_rows` — same part grid or a different part count (P -> P′):
+    owned-row triplets migrate to their new row owners and recompress
+    through the standard assembly pipeline; the column ghost layer is
+    rediscovered from the migrated columns (so every exchange plan of
+    the result is DERIVED on the new partition, never patched — the
+    elastic tier statically verifies them, parallel/elastic.py).
     Matrices holding nonzero unassembled ghost-row contributions are
     rejected (assemble() first)."""
     check(
@@ -84,16 +149,52 @@ def repartition_psparse(A: PSparseMatrix, new_rows: PRange) -> PSparseMatrix:
         f"repartition: {A.rows.ngids} rows -> {new_rows.ngids}",
     )
     check(
-        A.rows.partition.num_parts == new_rows.partition.num_parts,
-        "repartition runs within one part grid; use the checkpoint layer "
-        "to change the part count",
-    )
-    check(
         not new_rows.ghost,
         "repartition_psparse needs a ghost-free target partition",
     )
     kept = psparse_owned_triplets(A)
+    if A.rows.partition.num_parts != new_rows.partition.num_parts:
+        return _repartition_psparse_crosscount(A, kept, new_rows)
     I = map_parts(lambda t: t[0], kept)
     J = map_parts(lambda t: t[1], kept)
     V = map_parts(lambda t: t[2], kept)
+    return assemble_matrix_from_coo(I, J, V, new_rows)
+
+
+def _repartition_psparse_crosscount(
+    A: PSparseMatrix, kept: AbstractPData, new_rows: PRange
+) -> PSparseMatrix:
+    """The P -> P′ path for matrices: owner-split the owned-row global
+    triplets by the target row owner (host, gid-keyed), then assemble on
+    the new grid — pre-routed, so the assembly exchange moves nothing."""
+    from .checkpoint import _owner_fn
+
+    nparts_t = new_rows.partition.num_parts
+    owner = _owner_fn(new_rows)
+    tgt = [([], [], []) for _ in range(nparts_t)]
+    for gi, gj, gv in kept.part_values():
+        gi = np.asarray(gi)
+        gj = np.asarray(gj)
+        gv = np.asarray(gv)
+        own = owner(gi)
+        order = np.argsort(own, kind="stable")
+        bounds = np.searchsorted(own[order], np.arange(nparts_t + 1))
+        for t in range(nparts_t):
+            sel = order[bounds[t] : bounds[t + 1]]
+            if len(sel):
+                tgt[t][0].append(gi[sel])
+                tgt[t][1].append(gj[sel])
+                tgt[t][2].append(gv[sel])
+
+    def _cat(chunks, dtype):
+        return (
+            np.concatenate(chunks) if chunks else np.zeros(0, dtype=dtype)
+        )
+
+    part = new_rows.partition
+    I = part._like([_cat(tgt[t][0], np.int64) for t in range(nparts_t)])
+    J = part._like([_cat(tgt[t][1], np.int64) for t in range(nparts_t)])
+    # the value dtype is threaded from the SOURCE matrix: a target part
+    # receiving nothing must not materialize an f64 empty block
+    V = part._like([_cat(tgt[t][2], A.dtype) for t in range(nparts_t)])
     return assemble_matrix_from_coo(I, J, V, new_rows)

@@ -338,6 +338,17 @@ CATALOG: Dict[str, MetricSpec] = {
               "(PA_GATE_JOURNAL_KEEP) — only epochs at or behind the "
               "recovered frontier; otherwise typed "
               "JournalRetentionError and nothing is dropped"),
+        _spec("elastic.shrink", "counter", "1",
+              "parallel/elastic.py:shrink_system",
+              "elastic degraded-mode shrinks: the system was migrated "
+              "onto a smaller survivor part grid (PA_ELASTIC=1) — one "
+              "increment per shrink, labelled by what forced it",
+              labels=("reason",)),
+        _spec("elastic.crosspart_restores", "counter", "1",
+              "parallel/checkpoint.py:load_solver_state",
+              "solver-state checkpoints restored onto a DIFFERENT part "
+              "count than they were written at (allowed only under "
+              "PA_ELASTIC=1; otherwise typed CheckpointShapeError)"),
     ]
 }
 

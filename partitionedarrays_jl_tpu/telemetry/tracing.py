@@ -106,7 +106,7 @@ TX_SCHEMA_VERSION = 1
 #: tracing — each kind's open/close seam is documented there).
 SPAN_KINDS = (
     "rpc.request", "gate.queue", "gate.shed", "tenant.page_in",
-    "slab.solve", "chunk", "solver.phase",
+    "slab.solve", "chunk", "solver.phase", "tenant.repartition",
 )
 
 #: In-memory retention of finished spans (the cross-process story lives

@@ -94,6 +94,15 @@ because the flat logical-delivery view stays sound:
 | condition               | detector            | documented outcome   |
 |-------------------------|---------------------|----------------------|
 | two-level schedule with a mutated representative slot (scatter lane redirected into stage trash) | schedule simulation in verify_twolevel_plan | PlanSoundnessError (typed, coverage diagnostics) + plan_defect/health_error events, BEFORE any solve runs |
+
+Round 19 (paelastic): part LOSS — a casualty no same-partition restart
+can ever outwait (its exchange contribution is gone for good), so the
+recovery ladder forks on ``PA_ELASTIC`` instead of burning budget:
+
+| condition               | detector            | documented outcome   |
+|-------------------------|---------------------|----------------------|
+| part loss, PA_ELASTIC=1 | exchange choke point (part_loss clause) | elastic shrink onto the survivor grid + resume from the last chunk checkpoint: elastic_shrink/checkpoint_restore/restart events, elastic.shrink{reason=part_loss} + elastic.crosspart_restores deltas, a tenant.repartition span, info["elastic"] ledger — and the NEXT full-capacity solve emits elastic_restore (grow back) |
+| part loss, PA_ELASTIC=0 | exchange choke point (part_loss clause) | typed PartLossError escalates IMMEDIATELY to the caller's checkpoint tier — zero restarts attempted (no silent same-partition retry loop), no restart events, restart budget untouched |
 """
 import numpy as np
 import pytest
@@ -1341,6 +1350,137 @@ def test_matrix_infeasible_deadline_refused_at_admission(monkeypatch):
         assert m2["service.admitted"] == m1["service.admitted"] + 1
         svc.drain()
         assert h2.done()
+        return True
+
+    _run(driver)
+
+
+# ---------------------------------------------------------------------------
+# round 19 — the part-loss (paelastic) rows
+# ---------------------------------------------------------------------------
+
+
+def test_matrix_part_loss_elastic_shrinks_and_resumes(
+    tmp_path, monkeypatch
+):
+    """Paelastic row 1: a lost part under PA_ELASTIC=1 shrinks the
+    partition over the survivors and resumes from the last chunk
+    checkpoint — one stitched event trail + metric deltas + the
+    tenant.repartition span, and the next full-capacity solve
+    announces grow-back."""
+    from partitionedarrays_jl_tpu.parallel import elastic
+    from partitionedarrays_jl_tpu.models.solvers import solve_with_recovery
+    from partitionedarrays_jl_tpu.telemetry.tracing import (
+        clear_spans,
+        recorded_spans,
+    )
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        x_clean, _ = cg(A, b, x0=x0, tol=1e-9)
+        elastic._DEGRADED.clear()
+        m0 = _metric_state(
+            "elastic.shrink{reason=part_loss}",
+            "elastic.crosspart_restores",
+            "events.elastic_shrink", "events.elastic_restore",
+        )
+        clear_spans()
+        monkeypatch.setenv("PA_ELASTIC", "1")
+        with inject_faults("part_loss@part=3,after=6", seed=1):
+            x, info = solve_with_recovery(
+                A, b, x0=x0, checkpoint_dir=str(tmp_path), every=3,
+                tol=1e-9,
+            )
+        monkeypatch.delenv("PA_ELASTIC")
+        # the elastic ledger: 4 -> 2 survivors, resumed from the last
+        # chunk checkpoint, converged to the clean answer — and NO
+        # restart budget burned on the casualty
+        el = info["elastic"]
+        assert el["from_parts"] == 4 and el["to_parts"] == 2
+        assert el["dead_part"] == 3
+        assert el["checkpoint_iteration"] and el["checkpoint_iteration"] > 0
+        assert info["converged"] and info["restarts"] == 0
+        assert (
+            np.abs(gather_pvector(x) - gather_pvector(x_clean)).max()
+            < 1e-7
+        )
+        srcs = info["recovery"]["restart_sources"]
+        assert [s["from"] for s in srcs] == ["elastic_shrink_checkpoint"]
+        assert info["recovery"]["checkpoint_restarts"] == 1
+        # the stitched trail: every stage narrates ...
+        rec = telemetry.last_record("solve_with_recovery")
+        assert _has_event(rec, "fault_injected", "part_loss")
+        assert _has_event(rec, "health_error", "PartLossError")
+        assert _has_event(rec, "elastic_shrink", "part_loss")
+        assert _has_event(rec, "checkpoint_restore")
+        assert _has_event(rec, "restart", "PartLossError")
+        # ... and counts (event log and metrics plane agree)
+        m1 = _metric_state(
+            "elastic.shrink{reason=part_loss}",
+            "elastic.crosspart_restores",
+            "events.elastic_shrink", "events.elastic_restore",
+        )
+        assert m1["elastic.shrink{reason=part_loss}"] \
+            - m0["elastic.shrink{reason=part_loss}"] == 1
+        assert m1["elastic.crosspart_restores"] \
+            - m0["elastic.crosspart_restores"] == 1
+        assert m1["events.elastic_shrink"] \
+            - m0["events.elastic_shrink"] == 1
+        assert m1["events.elastic_restore"] \
+            - m0["events.elastic_restore"] == 0
+        spans = [
+            s for s in recorded_spans()
+            if s["kind"] == "tenant.repartition"
+        ]
+        assert len(spans) == 1
+        assert spans[0]["attrs"]["from_parts"] == 4
+        assert spans[0]["attrs"]["to_parts"] == 2
+        # grow back: capacity returned — the next full-grid solve says so
+        x2, info2 = solve_with_recovery(A, b, x0=x0, tol=1e-9)
+        rec2 = telemetry.last_record("solve_with_recovery")
+        assert _has_event(rec2, "elastic_restore", "grow_back")
+        assert not elastic.degraded_state()
+        return True
+
+    _run(driver)
+
+
+def test_matrix_part_loss_without_elastic_escalates_typed(monkeypatch):
+    """Paelastic row 2: with PA_ELASTIC=0 a lost part escalates as a
+    typed PartLossError to the caller's checkpoint tier IMMEDIATELY —
+    no same-partition retry loop, zero restarts attempted, no restart
+    events — because the casualty's contribution can never arrive."""
+    from partitionedarrays_jl_tpu.parallel.health import PartLossError
+    from partitionedarrays_jl_tpu.models.solvers import solve_with_recovery
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        monkeypatch.delenv("PA_ELASTIC", raising=False)
+        m0 = _metric_state("events.restart", "events.elastic_shrink")
+        with inject_faults("part_loss@part=3,after=6", seed=1):
+            with pytest.raises(PartLossError) as ei:
+                solve_with_recovery(A, b, x0=x0, tol=1e-9, max_restarts=2)
+        # typed + diagnosable: the dead part and the exchange call are
+        # on the error, and the loss is NOT a timeout
+        from partitionedarrays_jl_tpu.parallel.health import (
+            ExchangeTimeoutError,
+        )
+
+        assert ei.value.diagnostics["part"] == 3
+        assert ei.value.diagnostics["call"] == 6
+        assert not isinstance(ei.value, ExchangeTimeoutError)
+        # the aborted record carries the whole story ...
+        aborted = telemetry.last_record("solve_with_recovery")
+        assert aborted.status == "raised"
+        assert _has_event(aborted, "fault_injected", "part_loss")
+        assert _has_event(aborted, "health_error", "PartLossError")
+        # ... and NO restart was attempted or narrated: the budget was
+        # not burned spinning on a permanent casualty
+        assert not _has_event(aborted, "restart")
+        m1 = _metric_state("events.restart", "events.elastic_shrink")
+        assert m1["events.restart"] - m0["events.restart"] == 0
+        assert m1["events.elastic_shrink"] \
+            - m0["events.elastic_shrink"] == 0
         return True
 
     _run(driver)

@@ -599,9 +599,13 @@ def test_pcg_recovery_with_jacobi(tmp_path):
     assert pa.prun(driver, pa.sequential, (2, 2))
 
 
-def test_resume_onto_different_part_count(tmp_path):
+def test_resume_onto_different_part_count(tmp_path, monkeypatch):
     """The checkpoint is partition-independent: a 4-part run's solver
-    state resumes on 3 parts and still converges to the PDE solution."""
+    state resumes on 3 parts and still converges to the PDE solution.
+    Since the elastic round, the solver-state tier gates the part-count
+    mismatch behind PA_ELASTIC=1 (typed CheckpointShapeError otherwise
+    — tests/test_paelastic.py pins the refusal)."""
+    monkeypatch.setenv("PA_ELASTIC", "1")
     d = str(tmp_path / "ck")
     ref = {}
 

@@ -1,0 +1,287 @@
+"""Elastic degraded-mode solves (parallel/elastic.py + the P -> P'
+cross-part-count repartition/checkpoint tentpole): shrink-shape
+arithmetic, cross-count checkpoint round trips, plan-fingerprint
+invariants of repartitioned systems, the tenant-budget re-check at the
+shrunken footprint, and the tools/paelastic.py drill wiring. The
+part-loss x PA_ELASTIC recovery rows live in test_chaos_matrix.py
+(round 19)."""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.models import (
+    assemble_poisson,
+    cg,
+    gather_pvector,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# shrink shapes + survivor partitions
+# ---------------------------------------------------------------------------
+
+
+def test_shrink_shape_rules(monkeypatch):
+    """First >1 axis decrements; a dead part id is shrunk OUT of the
+    grid (so a re-run of the same fault spec is inert on the
+    survivors); the PA_ELASTIC_MIN_PARTS floor refuses typed."""
+    assert pa.shrink_shape((4, 2)) == (3, 2)
+    assert pa.shrink_shape((1, 3)) == (1, 2)
+    assert pa.shrink_shape((4,)) == (3,)
+    # dead part 5 is a valid id on (3,2)=6 — keep shrinking to (2,2)=4
+    assert pa.shrink_shape((4, 2), dead_part=5) == (2, 2)
+    with pytest.raises(ValueError):
+        pa.shrink_shape((1, 1))
+    monkeypatch.setenv("PA_ELASTIC_MIN_PARTS", "6")
+    with pytest.raises(ValueError):
+        pa.shrink_shape((4, 2), dead_part=3)
+
+
+def test_survivor_rows_ghost_free_and_verified():
+    """The survivor partition is ghost-free 1-D blocks over the new
+    grid, and a system repartitioned onto it carries a derived column
+    plan that passes ALL five static checks."""
+    from partitionedarrays_jl_tpu.analysis.plan_verifier import check_plan
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        rows6 = pa.survivor_rows(A.rows, shape=(3, 2))
+        assert not rows6.ghost
+        assert rows6.partition.num_parts == 6
+        assert rows6.ngids == A.rows.ngids
+        A6 = pa.repartition_psparse(A, rows6)
+        check_plan(
+            A6.cols.exchanger,
+            parts=A6.cols.partition.part_values(),
+            context="test_survivor_rows",
+        )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (4, 2))
+
+
+# ---------------------------------------------------------------------------
+# cross-part-count checkpoint round trips (the tentpole contract)
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip_8_4_8_bitwise(tmp_path, monkeypatch):
+    """8 -> 4 -> 8: a solver-state checkpoint written at 8 parts
+    restores onto 4 (under PA_ELASTIC=1), re-saves, and restores back
+    onto the original 8-part partition BITWISE — the gid-keyed format
+    is partition-independent, elasticity adds routing, never
+    arithmetic."""
+    d8 = str(tmp_path / "p8")
+    d4 = str(tmp_path / "p4")
+    monkeypatch.setenv("PA_ELASTIC", "1")
+
+    def save8(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        x_ref, _ = cg(A, b, x0=x0, tol=1e-9)
+        ck = pa.SolverCheckpointer(d8, every=1)
+        ck.save_state({"x": x_ref}, {"method": "cg", "it": 9, "tol": 1e-9})
+        ck.wait()
+        return gather_pvector(x_ref)
+
+    g_ref = pa.prun(save8, pa.sequential, (4, 2))
+
+    def hop4(parts):
+        rows = pa.uniform_partition(parts, 64)
+        st = pa.load_solver_state(d8, {"x": rows})
+        assert int(st["meta"]["it"]) == 9
+        ck = pa.SolverCheckpointer(d4, every=1)
+        ck.save_state({"x": st["x"]}, dict(st["meta"]))
+        ck.wait()
+        return gather_pvector(st["x"])
+
+    g4 = pa.prun(hop4, pa.sequential, (2, 2))
+    np.testing.assert_array_equal(g4, g_ref)
+
+    def back8(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        st = pa.load_solver_state(d4, {"x": A.cols})
+        assert int(st["meta"]["it"]) == 9
+        return gather_pvector(st["x"])
+
+    g8 = pa.prun(back8, pa.sequential, (4, 2))
+    np.testing.assert_array_equal(g8, g_ref)
+
+
+def test_solver_state_cross_count_refused_without_elastic(
+    tmp_path, monkeypatch
+):
+    """Satellite 2: the SOLVER-STATE restore path refuses a mismatched
+    part count with PA_ELASTIC unset — typed `CheckpointShapeError`
+    naming BOTH part counts and the escape hatch. The generic
+    load_checkpoint/load_pvector loaders stay ungated (pinned by
+    test_checkpoint.py's cross-partition round trips)."""
+    d = str(tmp_path / "ck")
+    monkeypatch.delenv("PA_ELASTIC", raising=False)
+
+    def save4(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        ck = pa.SolverCheckpointer(d, every=1)
+        ck.save_state({"x": x0}, {"method": "cg", "it": 2, "tol": 1e-9})
+        ck.wait()
+        return True
+
+    assert pa.prun(save4, pa.sequential, (2, 2))
+
+    def load2(parts):
+        rows = pa.uniform_partition(parts, 64)
+        with pytest.raises(pa.CheckpointShapeError) as ei:
+            pa.load_solver_state(d, {"x": rows})
+        msg = str(ei.value)
+        assert "4 parts" in msg and "2 parts" in msg
+        assert "PA_ELASTIC" in msg
+        # the escape hatch works in the same process
+        os.environ["PA_ELASTIC"] = "1"
+        try:
+            st = pa.load_solver_state(d, {"x": rows})
+        finally:
+            os.environ.pop("PA_ELASTIC", None)
+        assert st is not None and int(st["meta"]["it"]) == 2
+        return True
+
+    assert pa.prun(load2, pa.sequential, 2)
+
+    def load4(parts):
+        # SAME part count stays ungated with PA_ELASTIC unset
+        rows = pa.uniform_partition(parts, 64)
+        st = pa.load_solver_state(d, {"x": rows})
+        assert st is not None and int(st["meta"]["it"]) == 2
+        return True
+
+    assert pa.prun(load4, pa.sequential, (2, 2))
+
+
+# ---------------------------------------------------------------------------
+# plan-fingerprint invariants across shrink/grow
+# ---------------------------------------------------------------------------
+
+
+def test_repartitioned_plans_distinct_but_canonical_fingerprint_survives():
+    """Satellite 3: a shrink DERIVES a genuinely different plan
+    (`plan_fingerprint`-distinct — fewer parts, different slots) that
+    still passes every static check; and a full shrink/grow cycle back
+    onto the original partition preserves the LAYOUT-INDEPENDENT
+    `canonical_exchange_fingerprint` — the same global columns cross
+    the same edges, however the ghost lids got renumbered."""
+    from partitionedarrays_jl_tpu.analysis.plan_verifier import (
+        canonical_exchange_fingerprint,
+        check_plan,
+        plan_fingerprint,
+    )
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        f_orig = plan_fingerprint(A.cols.exchanger)
+        c_orig = canonical_exchange_fingerprint(
+            A.cols.exchanger, A.cols.partition
+        )
+        rows6 = pa.survivor_rows(A.rows, shape=(3, 2))
+        A6 = pa.repartition_psparse(A, rows6)
+        f6 = plan_fingerprint(A6.cols.exchanger)
+        assert f6 != f_orig
+        check_plan(
+            A6.cols.exchanger,
+            parts=A6.cols.partition.part_values(),
+            context="shrunken",
+        )
+        # grow back onto the ORIGINAL ghost-free row partition
+        A8 = pa.repartition_psparse(A6, A.rows)
+        check_plan(
+            A8.cols.exchanger,
+            parts=A8.cols.partition.part_values(),
+            context="grown-back",
+        )
+        c_back = canonical_exchange_fingerprint(
+            A8.cols.exchanger, A8.cols.partition
+        )
+        assert c_back == c_orig
+        # and the operator itself survived the cycle bitwise
+        np.testing.assert_array_equal(
+            pa.gather_psparse(A8).toarray(), pa.gather_psparse(A).toarray()
+        )
+        return True
+
+    assert pa.prun(driver, pa.sequential, (4, 2))
+
+
+# ---------------------------------------------------------------------------
+# the tenant-budget re-check at the shrunken footprint
+# ---------------------------------------------------------------------------
+
+
+def test_shrink_rechecks_memory_budget(monkeypatch):
+    """Service integration: elastic shrink re-checks the tenant memory
+    budget at the NEW footprint (fewer parts => wider per-part rows) —
+    an impossible budget refuses typed with both part counts in the
+    diagnostics, and nothing half-migrated escapes."""
+    from partitionedarrays_jl_tpu.frontdoor.tenancy import TenantBudgetError
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        monkeypatch.setenv("PA_GATE_MEM_BUDGET", "1")
+        with pytest.raises(TenantBudgetError) as ei:
+            pa.shrink_system(A, b)
+        d = ei.value.diagnostics
+        assert d["from_parts"] == 8 and d["to_parts"] == 6
+        assert d["footprint_bytes"] > d["budget_bytes"]
+        monkeypatch.delenv("PA_GATE_MEM_BUDGET")
+        # with headroom the same shrink admits and marks degraded
+        from partitionedarrays_jl_tpu.parallel import elastic
+
+        elastic._DEGRADED.clear()
+        A2, b2, x2, info = pa.shrink_system(A, b)
+        assert info["to_parts"] == 6 and x2 is None
+        assert elastic.degraded_state()["to_parts"] == 6
+        elastic._DEGRADED.clear()
+        return True
+
+    assert pa.prun(driver, pa.sequential, (4, 2))
+
+
+# ---------------------------------------------------------------------------
+# CLI: the tier-1 smoke + the full drill
+# ---------------------------------------------------------------------------
+
+
+def _load_paelastic():
+    spec = importlib.util.spec_from_file_location(
+        "paelastic", os.path.join(REPO, "tools", "paelastic.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_paelastic_check_smoke(capsys):
+    """tools/paelastic.py --check: shrink shapes, cross-count round
+    trip + f32 dtype pin, typed refusal, one small shrink-and-resume
+    (tier-1)."""
+    paelastic = _load_paelastic()
+    rc = paelastic.main(["--check"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "paelastic --check: OK" in out
+
+
+@pytest.mark.slow
+def test_paelastic_drill_full(capsys):
+    """THE acceptance drill: part 6 dies mid-solve on the 8-part
+    fixture, the run shrinks to 6 survivors, resumes from the last
+    chunk checkpoint within tolerance, BITWISE equals the cold solve
+    from the same x_k, narrates the whole trail, and grows back
+    (tools/paelastic.py --drill; --dry-run keeps the committed
+    ELASTIC_BENCH.json untouched)."""
+    paelastic = _load_paelastic()
+    rc = paelastic.main(["--drill", "--dry-run"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "paelastic --drill: OK" in out

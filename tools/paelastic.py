@@ -1,0 +1,481 @@
+#!/usr/bin/env python
+"""paelastic — elastic degraded-mode drills (part loss -> shrink ->
+resume -> grow back).
+
+The proof harness of `partitionedarrays_jl_tpu.parallel.elastic`: a
+solve that loses a part mid-run must NOT burn its restart budget on a
+casualty that can never answer again. Under ``PA_ELASTIC=1`` the
+recovery driver rebuilds the partition over the survivors, migrates
+A/b gid-keyed (the P -> P' cross-count repartition path), restores the
+last checkpointed iterate CROSS part count, statically re-verifies
+every derived exchange plan, and resumes — bitwise the cold solve a
+fresh caller would start on the survivors from the same iterate. With
+``PA_ELASTIC=0`` the loss escalates as a typed `PartLossError`.
+
+Usage:
+    python tools/paelastic.py --check      # tier-1 smoke (in-process)
+    python tools/paelastic.py --drill      # full 8->6 chaos drill +
+                                           # ELASTIC_BENCH.json
+                                           # (-m slow in tests)
+    python tools/paelastic.py --drill --dry-run   # don't write files
+
+``--check`` is the fast in-process smoke wired into tier-1:
+shrink-shape arithmetic (dead-part exclusion, the
+``PA_ELASTIC_MIN_PARTS`` floor), a cross-part-count owned-bitwise
+repartition round trip with the f32 dtype pin, the typed
+`CheckpointShapeError` refusal at ``PA_ELASTIC=0``, and one small
+part-loss shrink-and-resume on a (2,2) grid.
+
+``--drill`` is the real thing: inject ``part_loss@part=6`` mid-solve
+on the 8-part (4,2) Poisson fixture, shrink to 6 survivors, complete
+within tolerance with zero progress lost beyond the interrupted
+checkpoint chunk, assert the shrunken resume is BITWISE the cold
+solve on the survivors from the same checkpointed x_k, walk the whole
+stitched event/metric/span trail, grow back on the next full-capacity
+solve — and time the shrink round trip against a cold re-solve into
+``ELASTIC_BENCH.json`` (banded; on a cpu host the canary band must
+hold, the device twin stays unmeasured).
+"""
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: The drill fixture: Poisson FDM on an (8, 8) grid over a (4, 2)
+#: part grid; part 6 dies, the survivors re-form as (3, 2).
+DRILL_GRID = (8, 8)
+DRILL_PARTS = (4, 2)
+DEAD_PART = 6
+SURVIVOR_SHAPE = (3, 2)
+
+#: Guard bands for the committed artifact; keys match
+#: ELASTIC_BENCH.json["bands"]. The canary ratio is
+#: (shrink round trip: migrate + cross-count restore + resume) /
+#: (cold re-solve from the fixture x0 on the survivors) — on a cpu
+#: host it only proves the machinery runs in the same order of
+#: magnitude as a cold solve; the device band is the acceptance
+#: number and stays unmeasured until a real TPU mesh runs the drill.
+CANARY_BANDS = {
+    "shrink_roundtrip_vs_cold_cpu_canary": (0.05, 50.0, "canary"),
+}
+DEVICE_BANDS = {
+    "shrink_roundtrip_vs_cold": (0.05, 8.0, "device"),
+}
+
+
+# ---------------------------------------------------------------------------
+# --check: the tier-1 smoke
+# ---------------------------------------------------------------------------
+
+
+def _check():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu.models.poisson_fdm import assemble_poisson
+    from partitionedarrays_jl_tpu.models.solvers import (
+        cg,
+        gather_pvector,
+        solve_with_recovery,
+    )
+    from partitionedarrays_jl_tpu.parallel.checkpoint import (
+        CheckpointShapeError,
+        SolverCheckpointer,
+        load_solver_state,
+    )
+    from partitionedarrays_jl_tpu.parallel.elastic import (
+        shrink_shape,
+        survivor_rows,
+    )
+    from partitionedarrays_jl_tpu.parallel.pvector import _owned
+    from partitionedarrays_jl_tpu.parallel.repartition import (
+        repartition_psparse,
+        repartition_pvector,
+    )
+
+    failures = []
+
+    def ok(cond, what):
+        (failures.append(what) if not cond else None)
+        print(f"  [{'ok' if cond else 'FAIL'}] {what}")
+
+    # 1. shrink-shape arithmetic: first >1 axis decrements; the dead
+    #    part id is excluded; the floor refuses
+    ok(shrink_shape((4, 2)) == (3, 2), "shrink (4,2) -> (3,2)")
+    ok(shrink_shape((4, 2), dead_part=5) == (2, 2),
+       "shrink excludes dead part 5 -> (2,2)")
+    os.environ["PA_ELASTIC_MIN_PARTS"] = "6"
+    try:
+        shrink_shape((4, 2), dead_part=3)
+        ok(False, "min-parts floor refuses")
+    except ValueError:
+        ok(True, "min-parts floor refuses")
+    finally:
+        os.environ.pop("PA_ELASTIC_MIN_PARTS", None)
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, DRILL_GRID)
+        # 2. cross-count round trip: owned entries bitwise, f32 stays f32
+        rows6 = survivor_rows(A.rows, shape=SURVIVOR_SHAPE)
+        b6 = repartition_pvector(b, rows6)
+        b_back = repartition_pvector(b6, b.rows)
+        bitwise = all(
+            (
+                _owned(iset, np.asarray(v1))
+                == _owned(iset, np.asarray(v2))
+            ).all()
+            for iset, v1, v2 in zip(
+                b.rows.partition.part_values(),
+                b.values.part_values(),
+                b_back.values.part_values(),
+            )
+        )
+        ok(bitwise, "8 -> 6 -> 8 repartition round trip owned-bitwise")
+        b32 = pa.PVector(
+            pa.map_parts(lambda v: np.asarray(v, np.float32), b.values),
+            b.rows,
+        )
+        rows1 = survivor_rows(A.rows, shape=(1, 1))
+        b32r = repartition_pvector(b32, rows1)
+        ok(
+            all(
+                np.asarray(v).dtype == np.float32
+                for v in b32r.values.part_values()
+            ),
+            "f32 survives an empty-owned-part migration",
+        )
+        # 3. typed refusal: a solver-state checkpoint written at 8
+        #    parts refuses a 6-part restore while PA_ELASTIC=0
+        A6 = repartition_psparse(A, rows6)
+        b6 = repartition_pvector(b, A6.rows)
+        d = tempfile.mkdtemp(prefix="paelastic-check-")
+        ck = SolverCheckpointer(d, every=1)
+        ck.save_state({"x": x0}, {"method": "cg", "it": 3, "tol": 1e-9})
+        ck.wait()
+        os.environ.pop("PA_ELASTIC", None)
+        try:
+            load_solver_state(d, {"x": A6.cols, "r": b6.rows, "p": A6.cols})
+            ok(False, "CheckpointShapeError at PA_ELASTIC=0")
+        except CheckpointShapeError as e:
+            ok(
+                "8 parts" in str(e) and "6 parts" in str(e)
+                and "PA_ELASTIC" in str(e),
+                "CheckpointShapeError at PA_ELASTIC=0",
+            )
+        os.environ["PA_ELASTIC"] = "1"
+        try:
+            st = load_solver_state(
+                d, {"x": A6.cols, "r": b6.rows, "p": A6.cols}
+            )
+            ok(
+                st is not None
+                and (gather_pvector(st["x"]) == gather_pvector(x0)).all(),
+                "cross-part restore under PA_ELASTIC=1 is exact",
+            )
+        finally:
+            os.environ.pop("PA_ELASTIC", None)
+        return True
+
+    assert pa.prun(driver, pa.sequential, DRILL_PARTS)
+
+    # 4. one small shrink-and-resume: (2,2) loses part 3, resumes on
+    #    (1,2) and still matches the clean solve
+    def driver_small(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, DRILL_GRID)
+        x_clean, _ = cg(A, b, x0=x0, tol=1e-9)
+        os.environ["PA_ELASTIC"] = "1"
+        try:
+            with pa.inject_faults("part_loss@part=3,after=6", seed=1):
+                x, info = solve_with_recovery(A, b, x0=x0, tol=1e-9)
+        finally:
+            os.environ.pop("PA_ELASTIC", None)
+        el = info.get("elastic") or {}
+        ok(
+            el.get("from_parts") == 4 and el.get("to_parts") == 2,
+            "small drill shrinks 4 -> 2",
+        )
+        ok(bool(info.get("converged")), "small drill converges")
+        diff = float(
+            np.max(np.abs(gather_pvector(x) - gather_pvector(x_clean)))
+        )
+        ok(diff < 1e-7, f"small drill matches clean (diff={diff:.2e})")
+        return True
+
+    assert pa.prun(driver_small, pa.sequential, (2, 2))
+
+    for f in failures:
+        print(f"paelastic --check FAILURE: {f}", file=sys.stderr)
+    print("paelastic --check:", "FAILED" if failures else "OK")
+    return 1 if failures else 0
+
+
+# ---------------------------------------------------------------------------
+# --drill: the 8 -> 6 chaos drill + ELASTIC_BENCH.json
+# ---------------------------------------------------------------------------
+
+
+def _drill(dry_run=False):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import partitionedarrays_jl_tpu as pa
+    from partitionedarrays_jl_tpu import telemetry
+    from partitionedarrays_jl_tpu.models.poisson_fdm import assemble_poisson
+    from partitionedarrays_jl_tpu.models.solvers import (
+        cg,
+        gather_pvector,
+        solve_with_recovery,
+    )
+    from partitionedarrays_jl_tpu.parallel.checkpoint import (
+        SolverCheckpointer,
+        load_solver_state,
+    )
+    from partitionedarrays_jl_tpu.parallel.elastic import survivor_rows
+    from partitionedarrays_jl_tpu.parallel.repartition import (
+        repartition_psparse,
+        repartition_pvector,
+    )
+    from partitionedarrays_jl_tpu.telemetry import artifacts
+    from partitionedarrays_jl_tpu.telemetry.tracing import (
+        clear_spans,
+        recorded_spans,
+    )
+
+    failures = []
+    results = {}
+
+    def ok(cond, what):
+        (failures.append(what) if not cond else None)
+        print(f"  [{'ok' if cond else 'FAIL'}] {what}")
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, DRILL_GRID)
+        x_clean, info_clean = cg(A, b, x0=x0, tol=1e-9)
+        g_clean = gather_pvector(x_clean)
+        d = tempfile.mkdtemp(prefix="paelastic-drill-")
+        reg = telemetry.registry()
+        shrink0 = reg.counter(
+            "elastic.shrink", labels={"reason": "part_loss"}
+        ).value
+        xpart0 = telemetry.counter("elastic.crosspart_restores")
+        clear_spans()
+        os.environ["PA_ELASTIC"] = "1"
+        try:
+            t0 = time.perf_counter()
+            with pa.inject_faults(
+                f"part_loss@part={DEAD_PART},after=12", seed=1
+            ):
+                x, info = solve_with_recovery(
+                    A, b, x0=x0, checkpoint_dir=d, every=5, tol=1e-9
+                )
+            dt_shrink = time.perf_counter() - t0
+        finally:
+            os.environ.pop("PA_ELASTIC", None)
+
+        el = info.get("elastic") or {}
+        ok(el.get("from_parts") == 8 and el.get("to_parts") == 6,
+           "drill shrinks 8 -> 6 survivors")
+        ok(el.get("dead_part") == DEAD_PART, "casualty recorded")
+        ck_it = el.get("checkpoint_iteration")
+        ok(
+            isinstance(ck_it, int) and ck_it > 0,
+            f"resumed from the last chunk checkpoint (it={ck_it})",
+        )
+        ok(bool(info.get("converged")), "degraded solve converges")
+        diff = float(np.max(np.abs(gather_pvector(x) - g_clean)))
+        ok(diff < 1e-7, f"within tolerance of the clean solve "
+                        f"(diff={diff:.2e})")
+        srcs = info["recovery"]["restart_sources"]
+        ok(
+            len(srcs) == 1
+            and srcs[0]["from"] == "elastic_shrink_checkpoint"
+            and srcs[0]["failure"] == "PartLossError",
+            "ledger: one elastic restart from the checkpoint, "
+            "no budget burned",
+        )
+
+        # the bitwise contract: replay the identical pre-fault
+        # trajectory to the checkpointed iterate (host cg is
+        # deterministic; the fault only raises, never perturbs),
+        # restore it cross-count exactly as the elastic tier did, and
+        # cold-solve on the survivors — bitwise the degraded result
+        rows6 = survivor_rows(A.rows, shape=SURVIVOR_SHAPE)
+        A6 = repartition_psparse(A, rows6)
+        b6 = repartition_pvector(b, A6.rows)
+        d2 = tempfile.mkdtemp(prefix="paelastic-cold-")
+        ck2 = SolverCheckpointer(d2, every=5)
+        cg(A, b, x0=x0, tol=1e-9, maxiter=ck_it, checkpoint=ck2)
+        ck2.wait()
+        os.environ["PA_ELASTIC"] = "1"
+        try:
+            st = load_solver_state(
+                d2, {"x": A6.cols, "r": b6.rows, "p": A6.cols}
+            )
+        finally:
+            os.environ.pop("PA_ELASTIC", None)
+        ok(
+            st is not None and int(st["meta"]["it"]) == ck_it,
+            "cold-path replay checkpoints the same iterate",
+        )
+        t0 = time.perf_counter()
+        x_cold, info_cold = cg(A6, b6, x0=st["x"], tol=1e-9)
+        dt_cold_resume = time.perf_counter() - t0
+        ok(
+            (gather_pvector(x) == gather_pvector(x_cold)).all(),
+            "shrunken resume BITWISE equals the cold solve from the "
+            "same x_k on the survivors",
+        )
+        # zero progress lost beyond the interrupted chunk: the resume
+        # spends no more iterations than a cold solve from x_k
+        ok(
+            int(info["iterations"]) <= int(info_cold["iterations"]),
+            "zero progress lost beyond the interrupted chunk",
+        )
+
+        # the stitched trail: events + metric deltas + the span
+        rec = telemetry.last_record("solve_with_recovery")
+        kinds = [(e.kind, e.label) for e in rec.events]
+        for want in [
+            ("fault_injected", "part_loss"),
+            ("health_error", "PartLossError"),
+            ("elastic_shrink", "part_loss"),
+            ("checkpoint_restore", "cg"),
+            ("restart", "PartLossError"),
+        ]:
+            ok(want in kinds, f"event trail has {want}")
+        shrink1 = reg.counter(
+            "elastic.shrink", labels={"reason": "part_loss"}
+        ).value
+        xpart1 = telemetry.counter("elastic.crosspart_restores")
+        ok(shrink1 - shrink0 == 1, "elastic.shrink{reason=part_loss} +1")
+        ok(xpart1 - xpart0 >= 1, "elastic.crosspart_restores bumped")
+        spans = [s for s in recorded_spans()
+                 if s["kind"] == "tenant.repartition"]
+        ok(
+            len(spans) == 1
+            and spans[0]["attrs"].get("from_parts") == 8
+            and spans[0]["attrs"].get("to_parts") == 6,
+            "one tenant.repartition span (8 -> 6)",
+        )
+
+        # grow back: the next full-capacity solve announces restored
+        x3, info3 = solve_with_recovery(A, b, x0=x0, tol=1e-9)
+        rec3 = telemetry.last_record("solve_with_recovery")
+        ok(
+            any(e.kind == "elastic_restore" for e in rec3.events),
+            "grow-back emits elastic_restore at full capacity",
+        )
+
+        # bench leg: the shrink round trip (fault -> migrate ->
+        # restore -> resume, wall) vs a cold re-solve of the whole
+        # system on the survivors from the fixture x0
+        x06 = repartition_pvector(x0, A6.cols)
+        t0 = time.perf_counter()
+        x_scratch, _ = cg(A6, b6, x0=x06, tol=1e-9)
+        dt_cold = time.perf_counter() - t0
+        results.update(
+            shrink_roundtrip_s=round(dt_shrink, 6),
+            cold_resolve_s=round(dt_cold, 6),
+            cold_resume_s=round(dt_cold_resume, 6),
+            ratio=round(dt_shrink / dt_cold, 4) if dt_cold > 0 else None,
+            checkpoint_iteration=ck_it,
+            degraded_iterations=int(info["iterations"]),
+            clean_iterations=int(info_clean["iterations"]),
+            max_diff_vs_clean=diff,
+        )
+        return True
+
+    assert pa.prun(driver, pa.sequential, DRILL_PARTS)
+
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "cpu"
+    bands = {}
+    for key, (lo, hi, kind) in DEVICE_BANDS.items():
+        measured = results["ratio"] if platform == "tpu" else None
+        bands[key] = {
+            "lo": lo, "hi": hi, "kind": kind, "measured": measured,
+            "in_band": (
+                None if measured is None else bool(lo <= measured <= hi)
+            ),
+        }
+    if platform != "tpu":
+        for key, (lo, hi, kind) in CANARY_BANDS.items():
+            measured = results["ratio"]
+            bands[key] = {
+                "lo": lo, "hi": hi, "kind": kind, "measured": measured,
+                "in_band": bool(lo <= measured <= hi),
+            }
+
+    rec = {
+        "methodology": (
+            "part_loss@part=6 injected at exchange call 12 of a "
+            "checkpointed (every=5) 8-part (4,2) Poisson "
+            f"{DRILL_GRID} solve under PA_ELASTIC=1; the shrink round "
+            "trip (detect -> migrate A/b gid-keyed onto (3,2) -> "
+            "cross-part-count restore of the it=checkpoint iterate -> "
+            "resumed cg to tol) is timed wall-clock against a cold "
+            "re-solve of the survivors from the fixture x0; the "
+            "resumed iterate is asserted BITWISE equal to a cold cg "
+            "from the same restored x_k"
+        ),
+        "platform": platform,
+        "fixture": {
+            "grid": list(DRILL_GRID),
+            "parts": list(DRILL_PARTS),
+            "dead_part": DEAD_PART,
+            "survivor_shape": list(SURVIVOR_SHAPE),
+        },
+        "results": results,
+        "bands": bands,
+        "note": (
+            "the device band is the acceptance number and stays "
+            "unmeasured until a TPU mesh runs the drill; the cpu "
+            "canary only proves the shrink round trip lands within "
+            "sane wall-clock ratio of a cold re-solve (host "
+            "repartition is O(n) numpy routing, so the ratio carries "
+            "no ICI signal)"
+        ),
+    }
+    if failures:
+        for f in failures:
+            print(f"paelastic --drill FAILURE: {f}", file=sys.stderr)
+        print("paelastic --drill: FAILED")
+        return 1
+    artifacts.write(
+        os.path.join(REPO, "ELASTIC_BENCH.json"), rec, tool="paelastic",
+        dry_run=dry_run,
+    )
+    print("paelastic --drill: OK")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="in-process smoke: shrink shapes, cross-count "
+                         "round trip, typed refusal, small drill")
+    ap.add_argument("--drill", action="store_true",
+                    help="full 8->6 part-loss drill + ELASTIC_BENCH.json")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="drill: skip writing ELASTIC_BENCH.json")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        return _check()
+    if args.drill:
+        return _drill(dry_run=args.dry_run)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
