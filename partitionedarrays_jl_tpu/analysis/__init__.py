@@ -24,7 +24,25 @@ usage; `tools/palint.py --check` is the command-line gate):
 * `analysis.memory_report` — static per-case memory footprints (carry
   / plan / operand / peak bytes) and the pinned ``memory-budget``
   contracts; the committed ``MEMORY_FOOTPRINT.json`` admission table.
+* `analysis.lock_model` + `analysis.concurrency_lint` — palock: the
+  whole-package lock/thread model (declarations, guarded-by
+  inference, acquisition graph, thread spawn/join audit) and the six
+  concurrency & durability-ordering checks over it, cross-checked at
+  runtime by `utils.locksan` under ``PA_LOCK_CHECK=1``
+  (`tools/palock.py --check` is the gate).
 """
+from .concurrency_lint import (  # noqa: F401
+    BLOCKING_WAIVERS,
+    CHECK_IDS,
+    DAEMON_WAIVERS,
+    DURABILITY_RULES,
+    MANUAL_WAIVERS,
+    SEEDED_FIXTURES,
+    UNGUARDED_WAIVERS,
+    DurabilityRule,
+    concurrency_report,
+    lint_concurrency,
+)
 from .contracts import (  # noqa: F401
     CONTRACTS,
     Contract,
@@ -41,6 +59,13 @@ from .env_lint import (  # noqa: F401
     key_coverage,
     lint_env_keys,
     lowering_reads,
+)
+from ..utils.locksan import find_cycle  # noqa: F401
+from .lock_model import (  # noqa: F401
+    CALLBACK_TARGETS,
+    SHARED_LOCK_ATTRS,
+    build_model,
+    static_edges,
 )
 from .matrix import build_reports, run_matrix  # noqa: F401
 from .memory_report import (  # noqa: F401
@@ -68,30 +93,44 @@ from .program_report import (  # noqa: F401
 )
 
 __all__ = [
+    "BLOCKING_WAIVERS",
+    "CALLBACK_TARGETS",
+    "CHECK_IDS",
     "COLLECTIVE_KINDS",
     "CONTRACTS",
     "Contract",
+    "DAEMON_WAIVERS",
+    "DURABILITY_RULES",
+    "DurabilityRule",
     "EnvRead",
+    "MANUAL_WAIVERS",
     "MEMORY_BUDGETS",
     "MEMORY_SCHEMA_VERSION",
     "NON_LOWERING",
     "PLAN_CHECKS",
     "PlanDefect",
     "ProgramReport",
+    "SEEDED_FIXTURES",
+    "SHARED_LOCK_ATTRS",
+    "UNGUARDED_WAIVERS",
     "Violation",
     "WhileLoop",
     "analyze",
     "analyze_text",
+    "build_model",
     "build_reports",
     "canonical_exchange_fingerprint",
     "check_contracts",
     "classify",
     "collective_counts",
+    "concurrency_report",
     "contract_by_name",
     "documented_env_names",
     "env_read_inventory",
+    "find_cycle",
     "footprint_table",
     "key_coverage",
+    "lint_concurrency",
     "lint_env_keys",
     "lower_text",
     "lowering_reads",

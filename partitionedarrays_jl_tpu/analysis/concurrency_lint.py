@@ -1,0 +1,859 @@
+"""palock — the six concurrency/durability checks over `lock_model`.
+
+The production ladder (service worker → gate → journal → fleet) is
+eleven threaded modules guarded by hand-audited lock discipline, and
+that discipline has already failed at runtime once (the PR 7
+background-worker race PR 9 closed by moving record.py onto the
+registry lock) and been patched twice more by review. This pass turns
+the review checklist into machine-checked defect classes, the way
+paplan (PR 8) did for exchange plans:
+
+``unguarded-shared-access``
+    a mutable attribute written under a lock in one method and touched
+    bare elsewhere in the class (effective held = lexical `with`
+    nesting ∪ the guarded-by inference's entry-held set);
+``lock-order-cycle``
+    a cycle in `lock_model.static_edges` — the static deadlock
+    argument over the registry/service/gate/journal/fleet locks;
+``blocking-under-lock``
+    fsync/sleep/socket/solve reachable (direct or through the call
+    closure) inside a lock region, waivable with a reason
+    (`BLOCKING_WAIVERS`, the NON_LOWERING convention);
+``manual-acquire``
+    ``lock.acquire()`` not protected by a ``try/finally`` release;
+``leaked-thread``
+    a ``threading.Thread`` spawn that no shutdown path ``join``s —
+    ``daemon=True`` alone needs a reasoned `DAEMON_WAIVERS` entry;
+``durability-ordering``
+    the PR 12 write-ahead invariant as a dominance proof: for every
+    journal-acked transition in `DURABILITY_RULES`, the fsync'd append
+    event DOMINATES every client-visible ack event (a branch-aware
+    lexical argument: the append's branch path must be a prefix of the
+    ack's, with ``if self.journal ...``-style guards transparent — no
+    journal, no durability obligation). Plus the mask-bypass guard:
+    ``_raw_state`` (the unmasked handle state) stays private to
+    frontdoor/scheduler.py.
+
+Every check has a committed seeded-defect fixture under
+tests/fixtures/palock/ (the paplan convention, `SEEDED_FIXTURES`)
+proving exactly-that-check catches exactly-that-bug; the real codebase
+is clean or waivered-with-reason. `utils.locksan` (``PA_LOCK_CHECK=1``)
+is the runtime cross-check: observed acquisition edges must stay
+inside `static_edges` and cycle-free.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from ..utils.locksan import find_cycle
+from .env_lint import PACKAGE_ROOT, _package_files
+from .lock_model import (
+    Acquire,
+    FuncModel,
+    LockModel,
+    build_model,
+    closure_acquires,
+    static_edges,
+)
+
+__all__ = [
+    "CHECK_IDS",
+    "DURABILITY_RULES",
+    "UNGUARDED_WAIVERS",
+    "BLOCKING_WAIVERS",
+    "DAEMON_WAIVERS",
+    "SEEDED_FIXTURES",
+    "DurabilityRule",
+    "lint_concurrency",
+    "concurrency_report",
+]
+
+CHECK_IDS = (
+    "unguarded-shared-access",
+    "lock-order-cycle",
+    "blocking-under-lock",
+    "manual-acquire",
+    "leaked-thread",
+    "durability-ordering",
+)
+
+# ---------------------------------------------------------------------------
+# waiver tables — every entry carries its reason (the NON_LOWERING
+# convention: a stale or reasonless entry fails the lint's own tests)
+# ---------------------------------------------------------------------------
+
+#: ``Class.attr`` → reason an apparently-unguarded access is sound.
+UNGUARDED_WAIVERS: Dict[str, str] = {
+    "OperatorRegistry._tenants": (
+        "Gate reads the tenant map with single GIL-atomic dict ops from "
+        "inside its own lock BY DESIGN — taking the registry lock there "
+        "would invert the documented registry→gate order (on_evict calls "
+        "Gate._requeue_evicted UNDER the registry lock); entries are "
+        "add-only while a gate is wired, and pump tolerates a stale miss"
+    ),
+    "RequestJournal._segment_n": (
+        "the one bare read is _segment_path called from __init__ — "
+        "pre-publication, single-threaded by construction; every "
+        "post-publication caller (_rotate, under append) holds the "
+        "journal lock, which the entry-held inference cannot credit "
+        "because the __init__ call site is lockless"
+    ),
+}
+
+#: ``(lock, primitive)`` → reason the blocking call under that lock is
+#: the intended design, not a latency bug.
+BLOCKING_WAIVERS: Dict[Tuple[str, str], str] = {
+    ("RequestJournal._lock", "fsync"): (
+        "append serialization IS the durability contract (PR 12): the "
+        "fsync must complete inside the lock so concurrent appenders "
+        "cannot reorder records around the ack"
+    ),
+    ("Gate._lock", "solve:cg"): (
+        "synchronous-mode gates (worker=None) drive the solve from "
+        "pump() under the gate lock by design — single-threaded test "
+        "harness mode, documented in Gate.pump"
+    ),
+    ("Gate._lock", "solve:pcg"): (
+        "same synchronous-mode pump() path as solve:cg — one lock, one "
+        "thread, no contention to serialize"
+    ),
+    ("Gate._lock", "solve:solve_with_recovery"): (
+        "same synchronous-mode pump() path as solve:cg (chunked "
+        "drives route through solve_with_recovery)"
+    ),
+    ("Gate._lock", "sleep"): (
+        "pump()'s synchronous quiescence drive polls the service with "
+        "a bounded backoff sleep; no second thread contends for the "
+        "gate lock in that mode"
+    ),
+    ("OperatorRegistry._lock", "solve:cg"): (
+        "paging serializes tenant quiescence under the registry lock "
+        "by design: _page_out must drain the evicted tenant before the "
+        "budget is released to the page-in"
+    ),
+    ("OperatorRegistry._lock", "solve:pcg"): (
+        "same paging-quiescence path as solve:cg under the registry "
+        "lock"
+    ),
+    ("OperatorRegistry._lock", "solve:solve_with_recovery"): (
+        "same paging-quiescence path as solve:cg under the registry "
+        "lock"
+    ),
+    ("OperatorRegistry._lock", "sleep"): (
+        "paging quiescence polls the draining service with a bounded "
+        "sleep under the registry lock (see solve:cg waiver)"
+    ),
+    ("OperatorRegistry._lock", "fsync"): (
+        "page-in of a journaling tenant wires the chunk hook whose "
+        "closure reaches journal fsync — the fsync itself runs later "
+        "on the worker thread, never during the locked wire-up"
+    ),
+    ("Gate._lock", "fsync"): (
+        "the admitted record is APPENDED inside the admission critical "
+        "section on purpose — write-ahead means the fsync must beat "
+        "the handle becoming visible, and both must beat the lock "
+        "release (PR 12; docs/durability.md)"
+    ),
+}
+
+#: ``Class.func`` (spawn site) → reason a never-joined daemon thread is
+#: acceptable. Empty today: every spawn in the package is joined.
+DAEMON_WAIVERS: Dict[str, str] = {}
+
+#: Manual ``.acquire()`` sites waived from the try/finally rule.
+#: Empty: the real package uses ``with`` exclusively (fixture-proven).
+MANUAL_WAIVERS: Dict[str, str] = {}
+
+# ---------------------------------------------------------------------------
+# blocking-call model
+# ---------------------------------------------------------------------------
+
+#: Callee attribute/function names that BLOCK (syscalls + sockets).
+BLOCKING_PRIMITIVES = {
+    "fsync", "sleep", "urlopen", "sendall", "recv", "accept",
+    "getresponse", "serve_forever",
+}
+
+#: Package solver entry points: reaching one inside a lock region means
+#: an O(iterations) solve runs under that lock.
+BLOCKING_SOLVES = {"cg", "pcg", "solve_with_recovery"}
+
+
+# ---------------------------------------------------------------------------
+# durability-ordering rules
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DurabilityRule:
+    """One journal-acked transition: the ``append`` event must dominate
+    every ``ack`` event in ``qualname``'s body."""
+
+    module: str              # repo-relative path suffix
+    qualname: str            # "Class.method"
+    transition: str          # human label ("admitted", "terminal", ...)
+    append: Dict[str, object]
+    acks: List[Dict[str, object]]
+    why: str
+
+
+#: The PR 12 write-ahead invariant, transition by transition. A rule
+#: whose function or ack events VANISH fails the lint (rot guard): the
+#: proof decays loudly, not silently.
+DURABILITY_RULES: List[DurabilityRule] = [
+    DurabilityRule(
+        module="frontdoor/scheduler.py", qualname="Gate._admit",
+        transition="admitted",
+        append={"kind": "call", "name": "append", "arg0": "admitted"},
+        acks=[
+            {"kind": "store", "attr": "_handles"},
+            {"kind": "store", "attr": "_idem"},
+        ],
+        why=(
+            "a handle visible to polls/idempotency before the admitted "
+            "record is fsync'd would vanish on crash after being "
+            "acknowledged"
+        ),
+    ),
+    DurabilityRule(
+        module="frontdoor/scheduler.py", qualname="Gate.account",
+        transition="terminal",
+        append={"kind": "call", "name": "_journal_terminal"},
+        acks=[{"kind": "attrset", "attr": "journal_pending",
+               "value": False}],
+        why=(
+            "dropping journal_pending unmasks the terminal state to "
+            "pollers — the completed/failed record must be durable "
+            "first"
+        ),
+    ),
+    DurabilityRule(
+        module="frontdoor/scheduler.py", qualname="Gate.adopt",
+        transition="adopted",
+        append={"kind": "call", "name": "_rejournal_admitted"},
+        acks=[{"kind": "call", "name": "append", "arg0": "adopted"}],
+        why=(
+            "the peer's 'adopted' marker refuses a restarted peer — "
+            "write-ahead into OUR journal must come first or a "
+            "survivor crash strands the request with no durable home"
+        ),
+    ),
+    DurabilityRule(
+        module="frontdoor/scheduler.py", qualname="Gate._recover_one",
+        transition="expired-terminal",
+        append={"kind": "call", "name": "_journal_terminal"},
+        acks=[{"kind": "attrset", "attr": "accounted", "value": True}],
+        why=(
+            "marking a recovered-expired handle accounted before its "
+            "failed record is durable would re-expire it differently "
+            "on the next recovery"
+        ),
+    ),
+    DurabilityRule(
+        module="frontdoor/journal.py", qualname="RequestJournal.append",
+        transition="record",
+        append={"kind": "call", "name": "fsync"},
+        acks=[{"kind": "return"}],
+        why=(
+            "append()'s contract is 'the caller may ack the moment "
+            "this returns' — the fsync must dominate the return"
+        ),
+    ),
+    DurabilityRule(
+        module="service/service.py", qualname="SolveService._checkpoint",
+        transition="checkpointed",
+        append={"kind": "call", "name": "wait"},
+        acks=[{"kind": "call", "name": "_set_state",
+               "arg0": "checkpointed"}],
+        why=(
+            "the 'checkpointed' state is client-visible (poll/resume); "
+            "the checkpoint write must have landed (ck.wait) first"
+        ),
+    ),
+]
+
+#: ``_raw_state`` (the unmasked handle state that ignores
+#: journal_pending) may appear only in these modules (the linter names
+#: it in its own check strings).
+_RAW_STATE_ALLOWED = (
+    "frontdoor/scheduler.py",
+    "analysis/concurrency_lint.py",
+)
+
+#: Branch guards TRANSPARENT to the dominance argument: an ``if`` whose
+#: test mentions one of these tokens gates the durability OBLIGATION
+#: itself (no journal → nothing to prove), so events under it dominate
+#: events outside it.
+_TRANSPARENT_GUARD_TOKENS = ("journal", "fsync", "_sync")
+
+
+# ---------------------------------------------------------------------------
+# seeded-defect fixtures (the paplan convention)
+# ---------------------------------------------------------------------------
+
+#: fixture dir name (under tests/fixtures/palock/) → the ONE check id
+#: its seeded defect must trip — and no other.
+SEEDED_FIXTURES: Dict[str, str] = {
+    "unguarded_shared": "unguarded-shared-access",
+    "lock_cycle": "lock-order-cycle",
+    "blocking_lock": "blocking-under-lock",
+    "manual_acquire": "manual-acquire",
+    "leaked_thread": "leaked-thread",
+    "ack_before_append": "durability-ordering",
+}
+
+#: Durability rule applied when linting the ``ack_before_append``
+#: fixture (and the ``clean`` fixture, which must pass it).
+FIXTURE_DURABILITY_RULES: List[DurabilityRule] = [
+    DurabilityRule(
+        module="mod.py", qualname="Gate.admit",
+        transition="admitted",
+        append={"kind": "call", "name": "append", "arg0": "admitted"},
+        acks=[{"kind": "store", "attr": "_handles"}],
+        why="seeded-fixture transition",
+    ),
+]
+
+
+# ---------------------------------------------------------------------------
+# check 1: unguarded shared access
+# ---------------------------------------------------------------------------
+
+
+def _effective_held(fm: FuncModel, held: FrozenSet[str]) -> FrozenSet[str]:
+    return held | fm.entry_held
+
+
+def _check_unguarded(
+    model: LockModel, waivers: Dict[str, str]
+) -> List[str]:
+    out: List[str] = []
+    for cname, ci in sorted(model.classes.items()):
+        guarded_attrs = set(ci.lock_attrs) | set(ci.cond_aliases)
+        # per attr: locked writes and bare accesses across methods
+        locked_writes: Dict[str, List[Tuple[FuncModel, int, str]]] = {}
+        bare: Dict[str, List[Tuple[FuncModel, int, str]]] = {}
+        for fm in ci.methods.values():
+            if fm.name.startswith("__") and fm.name.endswith("__"):
+                # constructors run single-threaded before publication;
+                # __repr__/__len__ are diagnostic
+                continue
+            seen_site: Set[Tuple[str, int]] = set()
+            for acc in fm.accesses:
+                if acc.attr in guarded_attrs:
+                    continue
+                site = (acc.attr, acc.lineno)
+                if site in seen_site:  # one site, one finding (an
+                    continue          # AugAssign is both r and w)
+                seen_site.add(site)
+                held = _effective_held(fm, acc.held)
+                rec = (fm, acc.lineno, acc.mode)
+                if acc.mode == "w" and held:
+                    locked_writes.setdefault(acc.attr, []).append(rec)
+                elif not held:
+                    bare.setdefault(acc.attr, []).append(rec)
+        for attr in sorted(set(locked_writes) & set(bare)):
+            key = f"{cname}.{attr}"
+            if key in waivers:
+                continue
+            guards: Dict[str, int] = {}
+            for fm, _ln, _m in locked_writes[attr]:
+                for acc in fm.accesses:
+                    if acc.attr == attr and acc.mode == "w":
+                        for g in _effective_held(fm, acc.held):
+                            guards[g] = guards.get(g, 0) + 1
+            guard = max(guards, key=guards.get) if guards else "?"
+            wfm, wln, _ = locked_writes[attr][0]
+            for fm, ln, mode in bare[attr]:
+                out.append(
+                    f"[unguarded-shared-access] {fm.module}:{ln}: "
+                    f"{cname}.{attr} {'written' if mode == 'w' else 'read'} "
+                    f"bare in {fm.qualname} but written under "
+                    f"{guard} (e.g. {wfm.qualname} at {wfm.module}:{wln}) "
+                    f"— guard it or waive {key!r} in UNGUARDED_WAIVERS "
+                    f"with a reason"
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# check 2: lock-order cycles
+# ---------------------------------------------------------------------------
+
+
+def _check_lock_order(model: LockModel) -> List[str]:
+    edges = static_edges(model)
+    cycle = find_cycle(list(edges))
+    if not cycle:
+        return []
+    out = []
+    hops = []
+    for a, b in zip(cycle, cycle[1:]):
+        mod, line, via = edges[(a, b)]
+        hops.append(f"{a} -> {b} ({mod}:{line} via {via})")
+    out.append(
+        "[lock-order-cycle] static acquisition graph has a cycle — a "
+        "deadlock is reachable:\n    " + "\n    ".join(hops)
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# check 3: blocking call under lock
+# ---------------------------------------------------------------------------
+
+
+def _closure_blocking(model: LockModel) -> Dict[Tuple[str, str], Dict[str, str]]:
+    """function key -> {primitive: via} for every blocking primitive in
+    its call closure (``via`` names the first hop toward it)."""
+    from .lock_model import _resolved_calls
+
+    resolved = _resolved_calls(model)
+    blk: Dict[Tuple[str, str], Dict[str, str]] = {}
+    for k, fm in model.functions.items():
+        mine: Dict[str, str] = {}
+        for c in fm.calls:
+            if c.name in BLOCKING_PRIMITIVES:
+                mine.setdefault(c.name, f"{fm.qualname}:{c.lineno}")
+        if fm.name in BLOCKING_SOLVES and fm.cls is None:
+            mine.setdefault(f"solve:{fm.name}", fm.qualname)
+        blk[k] = mine
+    changed = True
+    while changed:
+        changed = False
+        for k in model.functions:
+            mine = blk[k]
+            for c, ck in resolved[k]:
+                for prim, _via in blk.get(ck, {}).items():
+                    if prim not in mine:
+                        mine[prim] = f"-> {ck[1]}(...)"
+                        changed = True
+    return blk
+
+
+def _check_blocking(
+    model: LockModel, waivers: Dict[Tuple[str, str], str]
+) -> List[str]:
+    from .lock_model import _resolved_calls
+
+    blk = _closure_blocking(model)
+    resolved = _resolved_calls(model)
+    out: List[str] = []
+    seen: Set[Tuple[str, str, str]] = set()
+
+    def report(lock, prim, module, line, via, qual):
+        if (lock, prim) in waivers:
+            return
+        key = (lock, prim, qual)
+        if key in seen:
+            return
+        seen.add(key)
+        out.append(
+            f"[blocking-under-lock] {module}:{line}: {prim} reachable "
+            f"inside a {lock} region ({via}) — move it outside the "
+            f"lock or waive ({lock!r}, {prim!r}) in BLOCKING_WAIVERS "
+            f"with a reason"
+        )
+
+    for k, fm in model.functions.items():
+        base = fm.entry_held
+        for c in fm.calls:
+            held = c.held | base
+            if not held:
+                continue
+            if c.name in BLOCKING_PRIMITIVES:
+                for lock in sorted(held):
+                    report(lock, c.name, fm.module, c.lineno,
+                           f"direct call in {fm.qualname}", fm.qualname)
+        for c, ck in resolved[k]:
+            held = c.held | base
+            if not held:
+                continue
+            for prim in sorted(blk.get(ck, {})):
+                for lock in sorted(held):
+                    report(
+                        lock, prim, fm.module, c.lineno,
+                        f"{fm.qualname} -> {ck[1]}(...) reaches {prim}",
+                        fm.qualname,
+                    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# check 4: manual acquire without try/finally
+# ---------------------------------------------------------------------------
+
+
+def _check_manual(
+    model: LockModel, waivers: Dict[str, str]
+) -> List[str]:
+    out = []
+    for fm in model.functions.values():
+        for a in fm.acquires:
+            if a.manual and not a.safe:
+                if fm.qualname in waivers:
+                    continue
+                out.append(
+                    f"[manual-acquire] {fm.module}:{a.lineno}: "
+                    f"{fm.qualname} calls {a.lock}.acquire() with no "
+                    f"try/finally release — an exception leaks the "
+                    f"lock; use `with` or guard the release"
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# check 5: leaked threads
+# ---------------------------------------------------------------------------
+
+
+def _check_threads(
+    model: LockModel, waivers: Dict[str, str]
+) -> List[str]:
+    out = []
+    for sp in model.threads:
+        if sp.joined:
+            continue
+        key = sp.func
+        if key in waivers:
+            if sp.daemon:
+                continue
+            out.append(
+                f"[leaked-thread] {sp.module}:{sp.lineno}: {sp.func} "
+                f"has a DAEMON_WAIVERS entry but spawns a NON-daemon "
+                f"thread — a waiver only covers daemons"
+            )
+            continue
+        hint = f" ({sp.name_hint})" if sp.name_hint else ""
+        out.append(
+            f"[leaked-thread] {sp.module}:{sp.lineno}: thread spawned "
+            f"in {sp.func}{hint} is never joined on any shutdown path "
+            f"— join it (sink attr in a shutdown/stop/wait method) or "
+            f"add a DAEMON_WAIVERS reason"
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# check 6: durability ordering (dominance proof)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Event:
+    kind: str                 # "call" | "store" | "attrset" | "return"
+    name: str                 # callee / attr name ("" for return)
+    arg0: Optional[str]       # first positional string literal
+    value: Optional[object]   # attrset constant
+    lineno: int
+    path: Tuple[int, ...]     # branch-frame ids (prefix ⇒ dominates)
+    order: int
+
+
+def _guard_transparent(test: ast.AST) -> bool:
+    try:
+        src = ast.unparse(test)
+    except Exception:  # pragma: no cover - unparse of odd nodes
+        src = ""
+    return any(tok in src for tok in _TRANSPARENT_GUARD_TOKENS)
+
+
+def _linearize(fnode: ast.AST) -> List[_Event]:
+    events: List[_Event] = []
+    counter = [0]
+    frame_ids = iter(range(1, 1 << 20))
+
+    def emit(kind, name, arg0, value, lineno, path):
+        counter[0] += 1
+        events.append(
+            _Event(kind, name, arg0, value, lineno, tuple(path),
+                   counter[0])
+        )
+
+    def scan_expr(node: ast.AST, path: List[int]):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = None
+                if isinstance(sub.func, ast.Attribute):
+                    name = sub.func.attr
+                elif isinstance(sub.func, ast.Name):
+                    name = sub.func.id
+                if name is None:
+                    continue
+                arg0 = None
+                if sub.args and isinstance(sub.args[0], ast.Constant) \
+                        and isinstance(sub.args[0].value, str):
+                    arg0 = sub.args[0].value
+                emit("call", name, arg0, None, sub.lineno, path)
+
+    def scan_stmt(stmt: ast.stmt, path: List[int]):
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                scan_expr(stmt.value, path)
+            emit("return", "", None, None, stmt.lineno, path)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            scan_expr(stmt.value, path)
+            for tgt in targets:
+                if isinstance(tgt, ast.Subscript) and isinstance(
+                    tgt.value, ast.Attribute
+                ):
+                    emit("store", tgt.value.attr, None, None,
+                         stmt.lineno, path)
+                elif isinstance(tgt, ast.Attribute):
+                    val = None
+                    if isinstance(stmt, ast.Assign) and isinstance(
+                        stmt.value, ast.Constant
+                    ):
+                        val = stmt.value.value
+                    emit("attrset", tgt.attr, None, val,
+                         stmt.lineno, path)
+            return
+        if isinstance(stmt, ast.If):
+            scan_expr(stmt.test, path)
+            if _guard_transparent(stmt.test):
+                body_path = path          # transparent: same frame
+            else:
+                body_path = path + [next(frame_ids)]
+            for s in stmt.body:
+                scan_stmt(s, body_path)
+            else_path = path + [next(frame_ids)]
+            for s in stmt.orelse:
+                scan_stmt(s, else_path)
+            return
+        if isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.While):
+                scan_expr(stmt.test, path)
+            else:
+                scan_expr(stmt.iter, path)
+            body_path = path + [next(frame_ids)]
+            for s in stmt.body:
+                scan_stmt(s, body_path)
+            for s in stmt.orelse:
+                scan_stmt(s, path + [next(frame_ids)])
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                scan_expr(item.context_expr, path)
+            for s in stmt.body:   # runs exactly once: transparent
+                scan_stmt(s, path)
+            return
+        if isinstance(stmt, ast.Try):
+            body_path = path + [next(frame_ids)]
+            for s in stmt.body:
+                scan_stmt(s, body_path)
+            for h in stmt.handlers:
+                hpath = path + [next(frame_ids)]
+                for s in h.body:
+                    scan_stmt(s, hpath)
+            for s in stmt.orelse:
+                scan_stmt(s, body_path)
+            for s in stmt.finalbody:   # always runs: transparent
+                scan_stmt(s, path)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes run later
+        if isinstance(stmt, ast.Expr):
+            scan_expr(stmt.value, path)
+            return
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.stmt):
+                scan_stmt(sub, path)
+            else:
+                scan_expr(sub, path)
+
+    for s in fnode.body:
+        scan_stmt(s, [])
+    return events
+
+
+def _event_matches(ev: _Event, spec: Dict[str, object]) -> bool:
+    kind = spec["kind"]
+    if ev.kind != kind:
+        return False
+    if kind == "return":
+        return True
+    if kind == "call":
+        if ev.name != spec["name"]:
+            return False
+        want0 = spec.get("arg0")
+        return want0 is None or ev.arg0 == want0
+    if kind == "store":
+        return ev.name == spec["attr"]
+    if kind == "attrset":
+        if ev.name != spec["attr"]:
+            return False
+        return "value" not in spec or ev.value == spec["value"]
+    return False
+
+
+def _dominates(a: _Event, b: _Event) -> bool:
+    return (
+        a.order < b.order
+        and b.path[: len(a.path)] == a.path
+    )
+
+
+def _check_durability(
+    model: LockModel,
+    rules: Sequence[DurabilityRule],
+    check_raw_state: bool,
+) -> List[str]:
+    out: List[str] = []
+    for rule in rules:
+        fm = None
+        for (mod, qual), cand in model.functions.items():
+            if qual == rule.qualname and mod.endswith(rule.module):
+                fm = cand
+                break
+        if fm is None:
+            out.append(
+                f"[durability-ordering] rule rot: {rule.qualname} "
+                f"({rule.module}) no longer exists — the "
+                f"{rule.transition!r} transition's write-ahead proof "
+                f"decayed; update DURABILITY_RULES"
+            )
+            continue
+        events = _linearize(fm.node)
+        appends = [e for e in events if _event_matches(e, rule.append)]
+        if not appends:
+            out.append(
+                f"[durability-ordering] {fm.module}:{fm.lineno}: "
+                f"{rule.qualname} has NO {rule.append} event — the "
+                f"{rule.transition!r} transition lost its journal "
+                f"append ({rule.why})"
+            )
+            continue
+        for spec in rule.acks:
+            acks = [e for e in events if _event_matches(e, spec)]
+            if not acks:
+                out.append(
+                    f"[durability-ordering] rule rot: {rule.qualname} "
+                    f"has no {spec} ack event for transition "
+                    f"{rule.transition!r} — update DURABILITY_RULES"
+                )
+                continue
+            for ack in acks:
+                if not any(_dominates(ap, ack) for ap in appends):
+                    out.append(
+                        f"[durability-ordering] {fm.module}:{ack.lineno}"
+                        f": {rule.qualname} acks the "
+                        f"{rule.transition!r} transition ({spec}) "
+                        f"BEFORE the journal append dominates it "
+                        f"(append at line"
+                        f"{'s' if len(appends) > 1 else ''} "
+                        f"{', '.join(str(a.lineno) for a in appends)})"
+                        f" — {rule.why}"
+                    )
+    if check_raw_state:
+        out.extend(_check_raw_state_private(model.root))
+    return out
+
+
+def _check_raw_state_private(root: str) -> List[str]:
+    out = []
+    for path in _package_files(root):
+        rel = os.path.relpath(path, os.path.dirname(root))
+        if rel.endswith(_RAW_STATE_ALLOWED):
+            continue
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                if "_raw_state" in line:
+                    out.append(
+                        f"[durability-ordering] {rel}:{i}: _raw_state "
+                        f"(the journal-mask bypass) referenced outside "
+                        f"frontdoor/scheduler.py — the public `state` "
+                        f"mask is the only ack surface other modules "
+                        f"may read"
+                    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the tied-together lint
+# ---------------------------------------------------------------------------
+
+
+def lint_concurrency(
+    root: Optional[str] = None,
+    *,
+    durability_rules: Optional[Sequence[DurabilityRule]] = None,
+    use_waivers: Optional[bool] = None,
+    checks: Optional[Sequence[str]] = None,
+) -> List[str]:
+    """Run the palock checks; return violation strings (empty = clean).
+
+    ``root=None`` lints the real package with the committed waiver
+    tables and `DURABILITY_RULES`. A fixture root gets NO waivers and
+    NO durability rules unless passed explicitly — seeded defects must
+    trip their check, and rules name real-package functions.
+    """
+    real = root is None
+    if use_waivers is None:
+        use_waivers = real
+    if durability_rules is None:
+        durability_rules = DURABILITY_RULES if real else ()
+    model = build_model(root)
+    unguarded_w = UNGUARDED_WAIVERS if use_waivers else {}
+    blocking_w = BLOCKING_WAIVERS if use_waivers else {}
+    daemon_w = DAEMON_WAIVERS if use_waivers else {}
+    manual_w = MANUAL_WAIVERS if use_waivers else {}
+    run = set(checks or CHECK_IDS)
+    out: List[str] = []
+    if "unguarded-shared-access" in run:
+        out.extend(_check_unguarded(model, unguarded_w))
+    if "lock-order-cycle" in run:
+        out.extend(_check_lock_order(model))
+    if "blocking-under-lock" in run:
+        out.extend(_check_blocking(model, blocking_w))
+    if "manual-acquire" in run:
+        out.extend(_check_manual(model, manual_w))
+    if "leaked-thread" in run:
+        out.extend(_check_threads(model, daemon_w))
+    if "durability-ordering" in run:
+        out.extend(
+            _check_durability(model, durability_rules,
+                              check_raw_state=real)
+        )
+    return out
+
+
+def concurrency_report(root: Optional[str] = None) -> Dict[str, object]:
+    """The --report payload: the model inventory plus the static graph
+    (what a reviewer reads to audit the lock discipline)."""
+    model = build_model(root)
+    edges = static_edges(model)
+    return {
+        "locks": {
+            name: {"module": d.module, "line": d.lineno, "kind": d.kind}
+            for name, d in sorted(model.locks.items())
+        },
+        "threads": [
+            {
+                "spawn": sp.func, "module": sp.module,
+                "line": sp.lineno, "daemon": sp.daemon,
+                "joined": sp.joined, "sink": sp.sink,
+                "name": sp.name_hint,
+            }
+            for sp in model.threads
+        ],
+        "edges": [
+            {"held": a, "acquires": b, "module": m, "line": ln,
+             "via": via}
+            for (a, b), (m, ln, via) in sorted(edges.items())
+        ],
+        "cycle": find_cycle(list(edges)),
+        "entry_held": {
+            f"{fm.module}:{fm.qualname}": sorted(fm.entry_held)
+            for fm in model.functions.values() if fm.entry_held
+        },
+        "violations": lint_concurrency(root),
+    }

@@ -527,6 +527,43 @@ def _check_copy_budget(reports, cases):
     return out
 
 
+def _check_concurrency_soundness(reports, cases):
+    """The palock tentpole's lock half, run over the package SOURCE
+    (not the lowered reports — the threaded service stack never
+    lowers): unguarded shared access, lock-order cycles, blocking
+    calls under a lock, manual acquire without try/finally, and
+    leaked threads, with guarded-by inference seeing through
+    "callers hold self._lock" helper indirection. The lock model is
+    stat-signature cached, so re-running here is cheap."""
+    from .concurrency_lint import lint_concurrency
+
+    findings = lint_concurrency(checks=[
+        "unguarded-shared-access",
+        "lock-order-cycle",
+        "blocking-under-lock",
+        "manual-acquire",
+        "leaked-thread",
+    ])
+    return [
+        Violation("concurrency-soundness", [], msg) for msg in findings
+    ]
+
+
+def _check_durability_ordering(reports, cases):
+    """The palock tentpole's write-ahead half: every client-visible
+    ack in a journal-acked transition is DOMINATED (branch-aware, on
+    every path) by its fsync'd journal append (`DURABILITY_RULES`),
+    and the journal-mask bypass accessor stays private to
+    frontdoor/scheduler.py. A seeded ack-before-append mutant fails
+    this contract (tests/fixtures/palock/ack_before_append)."""
+    from .concurrency_lint import lint_concurrency
+
+    findings = lint_concurrency(checks=["durability-ordering"])
+    return [
+        Violation("durability-ordering", [], msg) for msg in findings
+    ]
+
+
 CONTRACTS: List[Contract] = [
     Contract("sanity",
              "baseline program shows collectives and a while loop "
@@ -591,6 +628,18 @@ CONTRACTS: List[Contract] = [
              "assignment or conservative shape-sum) within its pinned "
              "budget; every case budgeted (the paplan tentpole)",
              _check_memory_budget),
+    Contract("concurrency-soundness",
+             "source-level lock soundness: no unguarded shared access, "
+             "no lock-order cycle, no unwaivered blocking call under a "
+             "lock, no bare acquire, no leaked thread (the palock "
+             "tentpole)",
+             _check_concurrency_soundness),
+    Contract("durability-ordering",
+             "every journal-acked transition's fsync'd append dominates "
+             "its client-visible ack on every path — the PR 12 "
+             "write-ahead invariant, proven statically (the palock "
+             "tentpole)",
+             _check_durability_ordering),
 ]
 
 

@@ -325,6 +325,14 @@ NON_LOWERING: Dict[str, str] = {
         "bound on how small the survivor grid may get before the loss "
         "escalates instead; same staging story as PA_ELASTIC"
     ),
+    "PA_LOCK_CHECK": (
+        "runtime lock-order sanitizer switch (utils/locksan.py, the "
+        "palock dynamic half) — read ONCE at lock construction to "
+        "decide whether `sanitized` wraps a serving-stack lock in the "
+        "order-recording shim; acquisition paths and the solver path "
+        "never read it, and the block program is byte-identical "
+        "StableHLO on/off (tests/test_palock.py)"
+    ),
 }
 
 

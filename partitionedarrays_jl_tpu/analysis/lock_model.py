@@ -1,0 +1,841 @@
+"""The whole-package lock/thread model behind palock (PR 20).
+
+AST-driven, jax-free, and cached the way `env_lint` caches its scan:
+one parse of the package tree (stat-signature memoized) produces
+
+* every ``threading.Lock``/``RLock`` **declaration** — class attributes
+  (``self._lock = threading.RLock()``, seen through the
+  `utils.locksan.sanitized` wrapper) and module-level locks, plus
+  ``threading.Condition(self._lock)`` aliases and module-level aliases
+  of another lock (``_lock = registry().lock`` in record.py);
+* every ``threading.Thread`` **spawn** with its daemon flag, its sink
+  (the ``self`` attribute or list attribute that owns it) and whether
+  the owning class/module ever ``join``s it;
+* a per-function model: shared-attribute accesses, outgoing calls and
+  lock acquisitions, each tagged with the set of locks LEXICALLY held
+  at that point;
+* the **guarded-by inference**: a private helper whose every intra-
+  class call site holds lock L inherits L on entry (fixed point), the
+  same way env_lint's closure sees key-site helpers — so
+  ``_pop_slab``-style "callers hold self._lock" helpers resolve;
+* the **static acquisition graph**: lock-order edges (held ->
+  acquired), both lexical and through the module-qualified call
+  closure, including the three declared dynamic hooks the AST cannot
+  see (`CALLBACK_TARGETS`).
+
+`analysis.concurrency_lint` turns this model into the six palock
+checks; `utils.locksan` produces the dynamic edges the hammer tests
+compare against `static_edges`.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .env_lint import PACKAGE_ROOT, _package_files
+
+__all__ = [
+    "LockDecl",
+    "ThreadSpawn",
+    "FuncModel",
+    "LockModel",
+    "build_model",
+    "static_edges",
+    "CALLBACK_TARGETS",
+    "SHARED_LOCK_ATTRS",
+]
+
+#: Dynamic dispatch the AST cannot see: callable ATTRIBUTES assigned at
+#: wire-up time. Each entry is a declared model fact (reviewed like an
+#: env_lint exemption): calls through the attribute resolve to the
+#: listed implementations. Keyed ``Class.attr``.
+CALLBACK_TARGETS: Dict[str, List[str]] = {
+    # Gate.__init__ / recover(): self.registry.on_evict = self._requeue_evicted
+    "OperatorRegistry.on_evict": ["Gate._requeue_evicted"],
+    # Gate: self.registry.on_page_in = self._install_chunk_hook
+    "OperatorRegistry.on_page_in": ["Gate._install_chunk_hook"],
+    # Gate._install_chunk_hook: tenant.svc.on_chunk = self._journal_chunk
+    "SolveService.on_chunk": ["Gate._journal_chunk"],
+}
+
+#: Lock attributes that BORROW another lock at construction instead of
+#: creating one (``Registry._get`` hands ``self.lock`` to every metric:
+#: ``cls(self.lock)``). ``with self._lock`` inside these classes IS the
+#: borrowed lock. Declared, like CALLBACK_TARGETS.
+SHARED_LOCK_ATTRS: Dict[str, str] = {
+    "Counter._lock": "Registry.lock",
+    "Gauge._lock": "Registry.lock",
+    "Histogram._lock": "Registry.lock",
+}
+
+#: ``self.X.append(...)``-style calls that MUTATE the receiver — they
+#: count as writes for the guarded-by inference.
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "clear", "pop", "popleft",
+    "update", "add", "discard", "setdefault", "appendleft", "sort",
+}
+
+_THREADING_LOCK_CTORS = {"Lock", "RLock"}
+
+#: Attribute-call names that are container/str/builtin ops when the
+#: receiver is untyped — excluded from the name-based call fallback so
+#: ``self._inflight.append(h)`` does not resolve to
+#: ``RequestJournal.append`` (typed receivers still resolve exactly).
+_BUILTIN_NAMES = _MUTATORS | {
+    "get", "items", "keys", "values", "copy", "count", "index",
+    "join", "split", "strip", "startswith", "endswith", "format",
+    "write", "read", "readline", "flush", "close", "seek", "tell",
+}
+
+
+@dataclass
+class LockDecl:
+    name: str                 # qualified: "Class.attr" or "module.attr"
+    cls: Optional[str]
+    attr: str
+    module: str               # repo-relative file path
+    lineno: int
+    kind: str                 # "Lock" | "RLock"
+
+
+@dataclass
+class ThreadSpawn:
+    module: str
+    cls: Optional[str]
+    func: str                 # qualname of the spawning function
+    lineno: int
+    sink: Optional[Tuple[str, str]]   # ("attr"|"list", attrname) or None
+    name_hint: Optional[str]
+    daemon: Optional[bool]
+    joined: bool = False
+
+
+@dataclass
+class Access:
+    attr: str
+    mode: str                 # "r" | "w"
+    lineno: int
+    held: FrozenSet[str]      # lexically-held lock names
+
+
+@dataclass
+class CallOut:
+    kind: str                 # "self" | "attr" | "name"
+    name: str
+    recv_attr: Optional[str]  # for self.X.m(): X
+    lineno: int
+    held: FrozenSet[str]
+
+
+@dataclass
+class Acquire:
+    lock: str
+    lineno: int
+    held_before: FrozenSet[str]
+    manual: bool              # .acquire() call (not a with block)
+    safe: bool                # with block, or acquire guarded by
+                              # try/finally release
+
+
+@dataclass
+class FuncModel:
+    module: str
+    cls: Optional[str]
+    name: str
+    qualname: str             # "Class.name" or "name"
+    lineno: int
+    node: ast.AST = field(repr=False)
+    accesses: List[Access] = field(default_factory=list)
+    calls: List[CallOut] = field(default_factory=list)
+    acquires: List[Acquire] = field(default_factory=list)
+    entry_held: FrozenSet[str] = frozenset()
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: str
+    lock_attrs: Dict[str, str] = field(default_factory=dict)   # attr -> qual
+    cond_aliases: Dict[str, str] = field(default_factory=dict) # attr -> qual
+    attr_types: Dict[str, str] = field(default_factory=dict)   # attr -> ctor
+    join_attrs: Set[str] = field(default_factory=set)
+    methods: Dict[str, FuncModel] = field(default_factory=dict)
+
+
+@dataclass
+class LockModel:
+    root: str
+    locks: Dict[str, LockDecl] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    functions: Dict[Tuple[str, str], FuncModel] = field(
+        default_factory=dict
+    )  # (module, qualname) -> model
+    threads: List[ThreadSpawn] = field(default_factory=list)
+    module_lock_names: Dict[Tuple[str, str], str] = field(
+        default_factory=dict
+    )  # (module, varname) -> qualified lock name (incl. aliases)
+
+    def methods_named(self, name: str) -> List[FuncModel]:
+        return self._by_name.get(name, [])
+
+    def funcs_of_class(self, cls: str) -> List[FuncModel]:
+        ci = self.classes.get(cls)
+        return list(ci.methods.values()) if ci else []
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'threading.RLock' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _find_lock_ctor(node: ast.AST) -> Optional[str]:
+    """'Lock'/'RLock' if any threading lock constructor appears in the
+    expression (possibly under a `sanitized(...)` wrapper)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            d = _dotted(sub.func)
+            if d:
+                tail = d.split(".")[-1]
+                if tail in _THREADING_LOCK_CTORS:
+                    return tail
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _modbase(relpath: str) -> str:
+    return os.path.splitext(os.path.basename(relpath))[0]
+
+
+# ---------------------------------------------------------------------------
+# pass A: declarations (locks, aliases, attribute types)
+# ---------------------------------------------------------------------------
+
+
+def _collect_decls(model: LockModel, relpath: str, tree: ast.Module):
+    mod = _modbase(relpath)
+    for node in tree.body:
+        # module-level locks and aliases
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                kind = _find_lock_ctor(node.value)
+                if kind:
+                    qual = f"{mod}.{tgt.id}"
+                    model.locks[qual] = LockDecl(
+                        qual, None, tgt.id, relpath, node.lineno, kind
+                    )
+                    model.module_lock_names[(relpath, tgt.id)] = qual
+                elif isinstance(node.value, ast.Attribute):
+                    # `_lock = registry().lock` — alias of a class lock,
+                    # resolved after every module's decls are in
+                    model.module_lock_names[(relpath, tgt.id)] = (
+                        "?attr:" + node.value.attr
+                    )
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = model.classes.setdefault(
+            node.name, ClassInfo(node.name, relpath)
+        )
+        for item in ast.walk(node):
+            if not isinstance(item, ast.Assign) or len(item.targets) != 1:
+                continue
+            attr = _self_attr(item.targets[0])
+            if attr is None:
+                continue
+            kind = _find_lock_ctor(item.value)
+            if kind:
+                qual = f"{node.name}.{attr}"
+                ci.lock_attrs[attr] = qual
+                model.locks.setdefault(qual, LockDecl(
+                    qual, node.name, attr, relpath, item.lineno, kind
+                ))
+                continue
+            if isinstance(item.value, ast.Call):
+                d = _dotted(item.value.func)
+                if d and d.split(".")[-1] == "Condition":
+                    for sub in ast.walk(item.value):
+                        a = _self_attr(sub)
+                        if a and a != attr:
+                            ci.cond_aliases[attr] = a
+                            break
+                    continue
+                if d:
+                    ci.attr_types[attr] = d.split(".")[-1]
+
+
+def _resolve_shared_and_aliases(model: LockModel):
+    # declared borrowed-lock attributes (metric handles)
+    for key, target in SHARED_LOCK_ATTRS.items():
+        cls, attr = key.split(".", 1)
+        if target in model.locks:
+            ci = model.classes.setdefault(cls, ClassInfo(cls, "?"))
+            ci.lock_attrs[attr] = target
+    # module-level `_x = <expr>.lock` aliases
+    attr_index: Dict[str, List[str]] = {}
+    for qual, decl in model.locks.items():
+        if decl.cls is not None:
+            attr_index.setdefault(decl.attr, []).append(qual)
+    for key, val in list(model.module_lock_names.items()):
+        if val.startswith("?attr:"):
+            cands = attr_index.get(val[len("?attr:"):], [])
+            if len(cands) == 1:
+                model.module_lock_names[key] = cands[0]
+            else:
+                del model.module_lock_names[key]
+
+
+# ---------------------------------------------------------------------------
+# pass B: per-function models
+# ---------------------------------------------------------------------------
+
+
+class _FuncScan(ast.NodeVisitor):
+    def __init__(self, model: LockModel, fm: FuncModel,
+                 ci: Optional[ClassInfo], relpath: str):
+        self.model = model
+        self.fm = fm
+        self.ci = ci
+        self.relpath = relpath
+        self.held: List[str] = []
+        self.try_finally_releases: List[Set[str]] = []
+        self.finally_released: Set[str] = set()
+        self.thread_vars: Dict[str, ThreadSpawn] = {}
+        self.attr_aliases: Dict[str, str] = {}   # local var -> self attr
+        self.loop_over_attr: Dict[str, str] = {} # loop var -> self attr
+
+    # -- lock expression resolution -----------------------------------
+    def _lock_of_expr(self, node: ast.AST) -> Optional[str]:
+        attr = _self_attr(node)
+        if attr is not None and self.ci is not None:
+            if attr in self.ci.lock_attrs:
+                return self.ci.lock_attrs[attr]
+            if attr in self.ci.cond_aliases:
+                return self.ci.lock_attrs.get(
+                    self.ci.cond_aliases[attr]
+                )
+            return None
+        if isinstance(node, ast.Name):
+            return self.model.module_lock_names.get(
+                (self.relpath, node.id)
+            )
+        if isinstance(node, ast.Attribute) and attr is None:
+            # `<expr>.lock` — unique-attr resolution (registry().lock)
+            cands = [
+                q for q, d in self.model.locks.items()
+                if d.cls is not None and d.attr == node.attr
+            ]
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def _heldset(self) -> FrozenSet[str]:
+        return frozenset(self.held)
+
+    # -- structure ----------------------------------------------------
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            lock = self._lock_of_expr(item.context_expr)
+            if lock is not None:
+                self.fm.acquires.append(Acquire(
+                    lock, item.context_expr.lineno, self._heldset(),
+                    manual=False, safe=True,
+                ))
+                self.held.append(lock)
+                acquired.append(lock)
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Try(self, node: ast.Try):
+        released: Set[str] = set()
+        for stmt in node.finalbody:
+            for sub in ast.walk(stmt):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "release"
+                ):
+                    lock = self._lock_of_expr(sub.func.value)
+                    if lock:
+                        released.add(lock)
+        self.finally_released |= released
+        self.try_finally_releases.append(released)
+        try:
+            self.generic_visit(node)
+        finally:
+            self.try_finally_releases.pop()
+
+    def visit_FunctionDef(self, node):
+        # nested defs: scanned as part of the enclosing function (their
+        # bodies run later, so drop the lexical held set while inside)
+        saved, self.held = self.held, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- statements ---------------------------------------------------
+    def _record_write(self, attr: str, lineno: int):
+        self.fm.accesses.append(
+            Access(attr, "w", lineno, self._heldset())
+        )
+
+    def _scan_thread_assign(self, target, value, lineno) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        d = _dotted(value.func)
+        if not d or d.split(".")[-1] != "Thread":
+            return False
+        daemon = None
+        name_hint = None
+        for kw in value.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                name_hint = str(kw.value.value)
+        sink = None
+        tattr = _self_attr(target) if target is not None else None
+        if tattr is not None:
+            sink = ("attr", tattr)
+        sp = ThreadSpawn(
+            self.relpath, self.fm.cls, self.fm.qualname, lineno,
+            sink, name_hint, daemon,
+        )
+        self.model.threads.append(sp)
+        if target is not None and isinstance(target, ast.Name):
+            self.thread_vars[target.id] = sp
+        return True
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            if attr is not None:
+                self._record_write(attr, node.lineno)
+                # `self._thread = t` after a local `t = Thread(...)` —
+                # the attr becomes the spawn's sink (joinable handle)
+                if (
+                    isinstance(node.value, ast.Name)
+                    and node.value.id in self.thread_vars
+                ):
+                    sp = self.thread_vars[node.value.id]
+                    if sp.sink is None:
+                        sp.sink = ("attr", attr)
+            elif isinstance(tgt, ast.Subscript):
+                a = _self_attr(tgt.value)
+                if a is not None:
+                    self._record_write(a, node.lineno)
+            elif isinstance(tgt, ast.Name):
+                src = _self_attr(node.value)
+                if src is not None:
+                    self.attr_aliases[tgt.id] = src
+        if len(node.targets) == 1:
+            self._scan_thread_assign(
+                node.targets[0], node.value, node.lineno
+            )
+        self.visit(node.value)
+        for tgt in node.targets:
+            if not isinstance(tgt, (ast.Name,)):
+                self.visit(tgt)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        attr = _self_attr(node.target)
+        if attr is None and isinstance(node.target, ast.Subscript):
+            attr = _self_attr(node.target.value)
+        if attr is not None:
+            self._record_write(attr, node.lineno)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For):
+        if isinstance(node.target, ast.Name):
+            a = _self_attr(node.iter)
+            if a is not None:
+                self.loop_over_attr[node.target.id] = a
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self.fm.accesses.append(
+                Access(attr, "r", node.lineno, self._heldset())
+            )
+        elif attr is not None and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            self._record_write(attr, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        held = self._heldset()
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            # thread spawn without assignment, mutators, join sites,
+            # manual acquire/release, ordinary attr calls
+            if func.attr in ("acquire",):
+                lock = self._lock_of_expr(recv)
+                if lock is not None:
+                    safe = any(
+                        lock in rel
+                        for rel in self.try_finally_releases
+                    )
+                    self.fm.acquires.append(Acquire(
+                        lock, node.lineno, held, manual=True, safe=safe,
+                    ))
+                    self.held.append(lock)  # held for the rest lexically
+            elif func.attr == "release":
+                lock = self._lock_of_expr(recv)
+                if lock is not None and lock in self.held:
+                    self.held.remove(lock)
+            elif func.attr == "join":
+                self._note_join(recv)
+            recv_self_attr = _self_attr(recv)
+            recv_typed_cls = None
+            if recv_self_attr is not None and self.ci is not None:
+                t = self.ci.attr_types.get(recv_self_attr)
+                if t and t in self.model.classes:
+                    recv_typed_cls = t
+            if (
+                recv_self_attr is not None
+                and func.attr in _MUTATORS
+                and recv_typed_cls is None
+                # a package-typed receiver's `.append` is a METHOD call
+                # (RequestJournal.append), not a container mutation
+            ):
+                self._record_write(recv_self_attr, node.lineno)
+                # `self._threads.append(t)` — thread sink
+                if (
+                    func.attr in ("append", "add")
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in self.thread_vars
+                ):
+                    sp = self.thread_vars[node.args[0].id]
+                    if sp.sink is None:
+                        sp.sink = ("list", recv_self_attr)
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                self.fm.calls.append(CallOut(
+                    "self", func.attr, None, node.lineno, held
+                ))
+            elif recv_self_attr is not None:
+                self.fm.calls.append(CallOut(
+                    "attr", func.attr, recv_self_attr, node.lineno, held
+                ))
+            else:
+                self.fm.calls.append(CallOut(
+                    "attr", func.attr, None, node.lineno, held
+                ))
+        elif isinstance(func, ast.Name):
+            self.fm.calls.append(CallOut(
+                "name", func.id, None, node.lineno, held
+            ))
+        self.generic_visit(node)
+
+    def _note_join(self, recv: ast.AST):
+        attr = _self_attr(recv)
+        if attr is None and isinstance(recv, ast.Name):
+            attr = (
+                self.loop_over_attr.get(recv.id)
+                or self.attr_aliases.get(recv.id)
+            )
+            if attr is None and recv.id in self.thread_vars:
+                self.thread_vars[recv.id].joined = True
+                return
+        if attr is not None and self.ci is not None:
+            self.ci.join_attrs.add(attr)
+
+
+def _scan_functions(model: LockModel, relpath: str, tree: ast.Module):
+    def scan(node, cls: Optional[str]):
+        qual = f"{cls}.{node.name}" if cls else node.name
+        ci = model.classes.get(cls) if cls else None
+        fm = FuncModel(
+            relpath, cls, node.name, qual, node.lineno, node,
+        )
+        scanner = _FuncScan(model, fm, ci, relpath)
+        # daemon=True set AFTER construction (`t.daemon = True`)
+        for stmt in node.body:
+            scanner.visit(stmt)
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Assign)
+                and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Attribute)
+                and sub.targets[0].attr in ("daemon",)
+                and isinstance(sub.targets[0].value, ast.Name)
+                and sub.targets[0].value.id in scanner.thread_vars
+                and isinstance(sub.value, ast.Constant)
+            ):
+                scanner.thread_vars[
+                    sub.targets[0].value.id
+                ].daemon = bool(sub.value.value)
+        # the canonical `lock.acquire()` THEN `try/finally: release()`
+        # shape: the acquire statement is a SIBLING of the try, not
+        # inside it — a finally-release of the same lock anywhere in
+        # the function counts as the owned release path
+        for a in fm.acquires:
+            if a.manual and not a.safe and a.lock in (
+                scanner.finally_released
+            ):
+                a.safe = True
+        model.functions[(relpath, qual)] = fm
+        if ci is not None:
+            ci.methods[node.name] = fm
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan(node, None)
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    scan(item, node.name)
+
+
+# ---------------------------------------------------------------------------
+# package-level synthesis
+# ---------------------------------------------------------------------------
+
+
+def _infer_entry_held(model: LockModel):
+    """Guarded-by inheritance: a PRIVATE method whose every intra-class
+    call site holds L enters holding L (docstring convention "callers
+    hold self._lock", machine-checked). Public methods never inherit —
+    they are externally callable."""
+    privates = [
+        fm for fm in model.functions.values()
+        if fm.cls and fm.name.startswith("_")
+        and not fm.name.startswith("__")
+    ]
+    all_locks = frozenset(model.locks)
+    state = {id(fm): all_locks for fm in privates}
+    for fm in model.functions.values():
+        if id(fm) not in state:
+            state[id(fm)] = frozenset()
+    changed = True
+    while changed:
+        changed = False
+        for fm in privates:
+            sites: List[FrozenSet[str]] = []
+            for caller in model.funcs_of_class(fm.cls):
+                if caller is fm:
+                    continue
+                for c in caller.calls:
+                    if c.kind == "self" and c.name == fm.name:
+                        sites.append(c.held | state[id(caller)])
+            if not sites:
+                new = frozenset()
+            else:
+                new = frozenset.intersection(*sites)
+            if new != state[id(fm)]:
+                state[id(fm)] = new
+                changed = True
+    for fm in model.functions.values():
+        fm.entry_held = state[id(fm)]
+
+
+def _index(model: LockModel):
+    by_name: Dict[str, List[FuncModel]] = {}
+    for fm in model.functions.values():
+        by_name.setdefault(fm.name, []).append(fm)
+    model._by_name = by_name
+
+
+def resolve_call(
+    model: LockModel, fm: FuncModel, call: CallOut
+) -> List[FuncModel]:
+    """Call-target resolution: typed where the AST allows (self calls,
+    `self.X.m()` with a constructor-typed X), name-matched otherwise —
+    over-approximate, which is SAFE for reachability (the same argument
+    env_lint makes for its closure)."""
+    if call.kind == "self" and fm.cls:
+        ci = model.classes.get(fm.cls)
+        if ci and call.name in ci.methods:
+            return [ci.methods[call.name]]
+        hooked = CALLBACK_TARGETS.get(f"{fm.cls}.{call.name}")
+        if hooked:
+            out = []
+            for q in hooked:
+                c, m = q.split(".", 1)
+                tci = model.classes.get(c)
+                if tci and m in tci.methods:
+                    out.append(tci.methods[m])
+            return out
+        return model.methods_named(call.name)
+    if call.kind == "attr":
+        if call.recv_attr and fm.cls:
+            ci = model.classes.get(fm.cls)
+            t = ci.attr_types.get(call.recv_attr) if ci else None
+            if t:
+                tci = model.classes.get(t)
+                if tci is not None:
+                    m = tci.methods.get(call.name)
+                    return [m] if m else []
+                return []  # typed as an external class: no package edge
+        if call.name in _BUILTIN_NAMES or call.name.startswith("__"):
+            # `.append`/`.get`/... on an untyped receiver is a
+            # container op, not a package call, and `super().__init__`
+            # must not union every constructor in the package — typed
+            # receivers (self.journal.append) resolved above
+            return []
+        # name-based fallback: every method with this name, EXCEPT the
+        # caller's own class — a non-self receiver calling back into
+        # the same class would have been spelled `self.m()`
+        return [
+            m for m in model.methods_named(call.name)
+            if m.cls and m.cls != fm.cls
+        ]
+    # bare name: module function, package function, or constructor
+    same_mod = [
+        m for m in model.methods_named(call.name)
+        if m.cls is None and m.module == fm.module
+    ]
+    if same_mod:
+        return same_mod
+    out = [m for m in model.methods_named(call.name) if m.cls is None]
+    ctor_ci = model.classes.get(call.name)
+    if ctor_ci and "__init__" in ctor_ci.methods:
+        out.append(ctor_ci.methods["__init__"])
+    return out
+
+
+def _resolved_calls(
+    model: LockModel,
+) -> Dict[Tuple[str, str], List[Tuple[CallOut, Tuple[str, str]]]]:
+    """Every function's outgoing calls with resolved targets, computed
+    once per model (the fixed-point loops iterate over this)."""
+    cached = getattr(model, "_resolved", None)
+    if cached is not None:
+        return cached
+    res: Dict[Tuple[str, str], List[Tuple[CallOut, Tuple[str, str]]]]
+    res = {}
+    for k, fm in model.functions.items():
+        out = []
+        for c in fm.calls:
+            for callee in resolve_call(model, fm, c):
+                out.append((c, (callee.module, callee.qualname)))
+        res[k] = out
+    model._resolved = res
+    return res
+
+
+def closure_acquires(
+    model: LockModel,
+) -> Dict[Tuple[str, str], Set[str]]:
+    """For every function: the set of locks acquired anywhere in its
+    call closure (direct + transitive, fixed point)."""
+    acq: Dict[Tuple[str, str], Set[str]] = {
+        k: {a.lock for a in fm.acquires}
+        for k, fm in model.functions.items()
+    }
+    resolved = _resolved_calls(model)
+    changed = True
+    while changed:
+        changed = False
+        for k in model.functions:
+            cur = acq[k]
+            for _c, ck in resolved[k]:
+                extra = acq.get(ck, set()) - cur
+                if extra:
+                    cur |= extra
+                    changed = True
+    return acq
+
+
+def static_edges(
+    model: LockModel,
+) -> Dict[Tuple[str, str], Tuple[str, int, str]]:
+    """The static acquisition graph: (held, acquired) -> one witness
+    (module, line, via) — the inter-module lock-order graph the cycle
+    check and the runtime sanitizer cross-check run on."""
+    acq_closure = closure_acquires(model)
+    resolved = _resolved_calls(model)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def add(a: str, b: str, module: str, line: int, via: str):
+        if a != b:
+            edges.setdefault((a, b), (module, line, via))
+
+    for k, fm in model.functions.items():
+        base = fm.entry_held
+        for q in fm.acquires:
+            for h in (q.held_before | base):
+                add(h, q.lock, fm.module, q.lineno,
+                    f"{fm.qualname} acquires directly")
+        for c, ck in resolved[k]:
+            held = c.held | base
+            if not held:
+                continue
+            for lock in acq_closure.get(ck, ()):
+                for h in held:
+                    add(h, lock, fm.module, c.lineno,
+                        f"{fm.qualname} -> {ck[1]}(...)")
+    return edges
+
+
+# ---------------------------------------------------------------------------
+# the cached entry point
+# ---------------------------------------------------------------------------
+
+_MODEL_CACHE: Dict[str, tuple] = {}
+
+
+def build_model(root: Optional[str] = None) -> LockModel:
+    base = os.path.abspath(root or PACKAGE_ROOT)
+    files = _package_files(base)
+    sig = tuple(
+        (f, os.stat(f).st_mtime_ns, os.stat(f).st_size) for f in files
+    )
+    hit = _MODEL_CACHE.get(base)
+    if hit and hit[0] == sig:
+        return hit[1]
+    model = LockModel(root=base)
+    trees = []
+    for path in files:
+        rel = os.path.relpath(path, os.path.dirname(base))
+        with open(path, encoding="utf-8") as f:
+            try:
+                tree = ast.parse(f.read(), filename=path)
+            except SyntaxError:
+                continue
+        trees.append((rel, tree))
+        _collect_decls(model, rel, tree)
+    _resolve_shared_and_aliases(model)
+    for rel, tree in trees:
+        _scan_functions(model, rel, tree)
+    _index(model)
+    _infer_entry_held(model)
+    # thread joins: a spawn is joined when its sink attribute is joined
+    # anywhere in the owning class, or its local var was joined inline
+    for sp in model.threads:
+        if sp.joined:
+            continue
+        if sp.sink and sp.cls:
+            ci = model.classes.get(sp.cls)
+            if ci and sp.sink[1] in ci.join_attrs:
+                sp.joined = True
+    _MODEL_CACHE[base] = (sig, model)
+    return model

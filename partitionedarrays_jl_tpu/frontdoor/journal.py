@@ -60,6 +60,8 @@ import json
 import os
 import threading
 import zlib
+
+from ..utils.locksan import sanitized
 from typing import List, Optional, Tuple
 
 __all__ = [
@@ -268,7 +270,7 @@ class RequestJournal:
         os.makedirs(self.directory, exist_ok=True)
         self.fsync = journal_fsync() if fsync is None else bool(fsync)
         self.segment_bytes = max(4096, int(segment_bytes))
-        self._lock = threading.Lock()
+        self._lock = sanitized(threading.Lock(), "RequestJournal._lock")
         self.prior_records, _ = _scan(self.directory, truncate=True)
         self.epoch = 1 + max(
             (int(r["epoch"]) for r in self.prior_records

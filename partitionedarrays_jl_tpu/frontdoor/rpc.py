@@ -60,6 +60,7 @@ from ..parallel.health import DeadlineInfeasible
 from ..service.admission import AdmissionRejected
 from ..telemetry import tracing
 from ..telemetry.registry import registry
+from ..utils.locksan import sanitized
 from .scheduler import Gate, LoadShedded
 from .tenancy import UnknownTenantError
 
@@ -367,7 +368,7 @@ class GateServer(ThreadingHTTPServer):
         #: or None — consulted on `LoadShedded` to 307-forward instead
         #: of 429. Solo gates leave it None (behavior unchanged).
         self.peer_picker = None
-        self._hlock = threading.Lock()
+        self._hlock = sanitized(threading.Lock(), "GateServer._hlock")
         self._stop = threading.Event()
         self._pump: Optional[threading.Thread] = None
         self._http: Optional[threading.Thread] = None

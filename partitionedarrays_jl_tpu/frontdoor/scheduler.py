@@ -71,6 +71,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..telemetry import spectrum, tracing
 from ..telemetry.registry import CATALOG, monitoring_enabled, registry
 from ..utils.helpers import check
+from ..utils.locksan import sanitized
 from .journal import (
     RecoveredError,
     RequestJournal,
@@ -327,7 +328,7 @@ class Gate:
             )
         self._queue: List[GateHandle] = []
         self._inflight: List[GateHandle] = []
-        self._lock = threading.RLock()
+        self._lock = sanitized(threading.RLock(), "Gate._lock")
         self._seq = 0
         #: While True, `pump` dispatches nothing — demos and tests use
         #: it to build a deterministic backlog (shedding is a function
@@ -1162,7 +1163,9 @@ class Gate:
                 live = not (
                     {"completed", "failed", "adopted"} & st.keys()
                 )
-                if "adopted" in st or rid in self._handles:
+                with self._lock:
+                    known = rid in self._handles
+                if "adopted" in st or known:
                     summary["skipped"] += 1
                     continue
                 if live:
@@ -1207,7 +1210,10 @@ class Gate:
         adm = st["admitted"]
         key = adm.get("idempotency_key")
         if key:
-            self._idem[key] = rid
+            # under the gate lock: adopt() runs on fleet watch threads
+            # while HTTP submits race the same idempotency map
+            with self._lock:
+                self._idem[key] = rid
         if "adopted" in st:
             # a peer replica took this request while we were down —
             # refuse typed instead of double-solving it (the adopter's

@@ -52,6 +52,7 @@ import numpy as np
 from ..service.service import SolveService
 from ..telemetry.registry import monitoring_enabled, registry
 from ..utils.helpers import check
+from ..utils.locksan import sanitized
 
 __all__ = [
     "TenantBudgetError",
@@ -182,7 +183,7 @@ class OperatorRegistry:
         #: discipline as ``on_evict``.
         self.on_page_in: Optional[Callable[[str, "Tenant"], None]] = None
         self._tenants: Dict[str, Tenant] = {}
-        self._lock = threading.RLock()
+        self._lock = sanitized(threading.RLock(), "OperatorRegistry._lock")
         if monitoring_enabled():
             registry().gauge("gate.mem_budget_bytes").set(self.budget)
 

@@ -51,6 +51,7 @@ from ..telemetry.registry import monitoring_enabled, registry
 from ..telemetry.throughput import model as throughput_model
 from ..telemetry.throughput import operator_fingerprint
 from ..utils.helpers import check
+from ..utils.locksan import sanitized
 from .admission import (
     DEFAULT_TOL,
     AdmissionController,
@@ -141,7 +142,7 @@ class SolveService:
         #: unchunked path has no boundaries and never calls it.
         self.on_chunk: Optional[Callable] = None
         self._queue: list = []
-        self._lock = threading.RLock()
+        self._lock = sanitized(threading.RLock(), "SolveService._lock")
         self._cv = threading.Condition(self._lock)
         self._draining = False
         self._stop = False
@@ -310,6 +311,15 @@ class SolveService:
         with self._lock:
             return queue_compat_profile(self._queue)
 
+    def _bump(self, key: str, n: int = 1) -> None:
+        """Tick ``self.stats`` under the service lock. The worker
+        thread and a synchronous driver both land terminal stats, so a
+        bare ``+= 1`` (read-modify-write) can lose ticks — palock's
+        unguarded-shared-access check pins every stats touch to this
+        helper or an enclosing ``with self._lock:``."""
+        with self._lock:
+            self.stats[key] += n
+
     # ------------------------------------------------------------------
     # synchronous drivers
     # ------------------------------------------------------------------
@@ -352,7 +362,8 @@ class SolveService:
             self._worker is None or not self._worker.is_alive(),
             "service: worker already running",
         )
-        self._stop = False
+        with self._lock:
+            self._stop = False
         self._worker = threading.Thread(
             target=self._work, daemon=True, name="pa-solve-service"
         )
@@ -395,11 +406,13 @@ class SolveService:
                 leftover, self._queue = list(self._queue), []
             for req in leftover:
                 self._suspend(req)
+        with self._lock:
+            stats = dict(self.stats)
         telemetry.emit_event(
             "service_shutdown", label="drain" if drain else "stop",
-            **{k: v for k, v in self.stats.items()},
+            **stats,
         )
-        return dict(self.stats)
+        return stats
 
     # ------------------------------------------------------------------
     # slab execution
@@ -428,7 +441,7 @@ class SolveService:
             if key_maxiter is not None
             else 4 * self.A.rows.ngids
         )
-        self.stats["slabs"] += 1
+        self._bump("slabs")
         reg = registry()
         slabs = reg.counter("service.slabs").inc()
         ragged = reg.counter_value("service.slabs_ragged")
@@ -577,7 +590,9 @@ class SolveService:
                     self.on_chunk(r, X[r.id])
             if not active:
                 break
-            if self._stop:
+            with self._lock:
+                stopping = self._stop
+            if stopping:
                 # non-drain shutdown: checkpoint the in-flight iterates
                 # at this chunk boundary and stop
                 for r in active:
@@ -734,7 +749,7 @@ class SolveService:
                 converged=bool(info.get("converged")),
                 status=str(info.get("status")), via=via,
             )
-        self.stats["completed"] += 1
+        self._bump("completed")
         registry().counter("service.completed").inc()
         self._slo_account(req, succeeded=True)
         req._resolve(x, req.record.finish(info))
@@ -749,7 +764,7 @@ class SolveService:
                 iteration=req.iterations,
                 error=type(error).__name__,
             )
-        self.stats["failed"] += 1
+        self._bump("failed")
         registry().counter("service.failed").inc()
         self._slo_account(req, succeeded=False)
         req.record.finish_error(error)
@@ -763,7 +778,7 @@ class SolveService:
             "deadline_expired", label=req.tag, iteration=req.iterations,
             deadline=req.deadline, elapsed=now - req.submitted_at,
         )
-        self.stats["deadline_expired"] += 1
+        self._bump("deadline_expired")
         registry().counter("service.deadline_expired").inc()
         self._fail(
             req,
@@ -798,7 +813,7 @@ class SolveService:
                 "column_ejected", label=str(verdict.get("status")),
                 iteration=req.iterations, request=req.tag,
             )
-        self.stats["ejected"] += 1
+        self._bump("ejected")
         registry().counter("service.ejected").inc()
         error = verdict.get("error")
         if error is None:
@@ -858,7 +873,7 @@ class SolveService:
         except SolverHealthError as e:
             self._fail(req, e)
             return
-        self.stats["retried_solo"] += 1
+        self._bump("retried_solo")
         registry().counter("service.retried_solo").inc()
         req.iterations += int(info["iterations"])
         self._finish(req, x, info, via="solo_retry")
@@ -921,7 +936,7 @@ class SolveService:
                 "request_checkpointed", label=req.tag,
                 iteration=req.iterations, directory=d,
             )
-        self.stats["checkpointed"] += 1
+        self._bump("checkpointed")
         registry().counter("service.checkpointed").inc()
         req.finished_at = self.clock()
         req.record.finish(
@@ -938,7 +953,7 @@ class SolveService:
                 "request_suspended", label=req.tag,
                 iteration=req.iterations,
             )
-        self.stats["suspended"] += 1
+        self._bump("suspended")
         registry().counter("service.suspended").inc()
         req.finished_at = self.clock()
         req.record.finish({"status": "suspended"})

@@ -220,7 +220,12 @@ class SolveRecord:
             self.events.append(ev)
 
     def events_of(self, kind: str) -> List[TelemetryEvent]:
-        return [e for e in self.events if e.kind == kind]
+        # snapshot under the registry lock: the worker may still be
+        # appending while a reader filters (PR 9 background-worker race
+        # class — palock: unguarded-shared-access)
+        with registry().lock:
+            events = list(self.events)
+        return [e for e in events if e.kind == kind]
 
     # -- finalization ----------------------------------------------------
     def _absorb_info(self, info: Optional[dict]) -> None:
@@ -267,6 +272,10 @@ class SolveRecord:
 
     # -- serialization ---------------------------------------------------
     def as_dict(self) -> dict:
+        # events snapshot under the registry lock (same race class as
+        # events_of: serializing a live record mid-append)
+        with registry().lock:
+            events = list(self.events)
         return {
             "schema_version": self.schema_version,
             "solver": self.solver,
@@ -284,7 +293,7 @@ class SolveRecord:
             "comms": _jsonable(self.comms),
             "timings": _jsonable(self.timings),
             "error": self.error,
-            "events": [e.as_dict() for e in self.events],
+            "events": [e.as_dict() for e in events],
         }
 
     def __repr__(self):

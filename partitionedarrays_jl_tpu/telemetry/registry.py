@@ -49,6 +49,7 @@ import os
 import threading
 from typing import Dict, Iterable, Optional, Tuple
 
+from ..utils.locksan import sanitized
 from .histogram import LatencyHistogram
 
 __all__ = [
@@ -431,7 +432,7 @@ class Registry:
     def __init__(self):
         #: THE lock: every registry mutation AND the telemetry history
         #: ring (record.py) serialize on it.
-        self.lock = threading.RLock()
+        self.lock = sanitized(threading.RLock(), "Registry.lock")
         self._metrics: Dict[Tuple[str, tuple], object] = {}
 
     # -- creation / access ----------------------------------------------
@@ -465,7 +466,8 @@ class Registry:
     # -- reading ---------------------------------------------------------
     def counter_value(self, name: str,
                       labels: Optional[dict] = None) -> int:
-        m = self._metrics.get((name, _labels_key(labels)))
+        with self.lock:
+            m = self._metrics.get((name, _labels_key(labels)))
         return m.value if isinstance(m, Counter) else 0
 
     def snapshot(self, prefix: Optional[str] = None) -> dict:

@@ -1,0 +1,35 @@
+"""palock fixture: seeded ACK-BEFORE-APPEND durability defect.
+
+The handle becomes poll-visible BEFORE the journal append: a crash in
+between acknowledges a request the journal never heard of — the exact
+write-ahead inversion the PR 12 invariant forbids. Exactly the
+``durability-ordering`` check (under `FIXTURE_DURABILITY_RULES`) must
+flag this package.
+"""
+import threading
+
+
+class Journal:
+    def __init__(self):
+        self.records = []
+
+    def append(self, kind, **payload):
+        self.records.append((kind, payload))
+        return payload
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles = {}
+        self.journal = Journal()
+
+    def admit(self, rid):
+        with self._lock:
+            self._handles[rid] = rid  # seeded defect: ack first
+            rec = self.journal.append("admitted", rid=rid)
+            return rec
+
+    def poll(self, rid):
+        with self._lock:
+            return self._handles.get(rid)

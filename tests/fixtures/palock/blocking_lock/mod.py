@@ -1,0 +1,19 @@
+"""palock fixture: seeded BLOCKING-UNDER-LOCK defect.
+
+``os.fsync`` runs inside the lock region: every concurrent ``put``
+serializes behind a disk flush. Exactly the ``blocking-under-lock``
+check must flag this package (fixture roots get no waiver table).
+"""
+import os
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fh = open(os.devnull, "wb")
+
+    def put(self, data):
+        with self._lock:
+            self._fh.write(data)
+            os.fsync(self._fh.fileno())  # seeded defect: syscall under lock

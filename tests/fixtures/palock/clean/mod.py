@@ -1,0 +1,58 @@
+"""palock fixture: CLEAN — every check passes.
+
+The control for the six seeded-defect siblings: a journaling gate that
+appends before acking, a worker whose stop flag and thread are owned
+correctly, no blocking syscalls under a lock, no manual acquire, no
+lock-order inversion.
+"""
+import threading
+
+
+class Journal:
+    def __init__(self):
+        self.records = []
+
+    def append(self, kind, **payload):
+        self.records.append((kind, payload))
+        return payload
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles = {}
+        self.journal = Journal()
+
+    def admit(self, rid):
+        with self._lock:
+            rec = self.journal.append("admitted", rid=rid)
+            self._handles[rid] = rec  # ack AFTER the append
+            return rec
+
+    def poll(self, rid):
+        with self._lock:
+            return self._handles.get(rid)
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = False
+
+    def start(self):
+        t = threading.Thread(target=self._run, daemon=True)
+        self._thread = t
+        t.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+
+    def shutdown(self):
+        with self._lock:
+            self._stop = True
+        if self._thread is not None:
+            self._thread.join()

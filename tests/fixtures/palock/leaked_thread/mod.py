@@ -1,0 +1,21 @@
+"""palock fixture: seeded LEAKED-THREAD defect.
+
+A non-daemon thread spawned and never joined on any shutdown path: the
+process hangs at exit (or the thread dies mid-write under a daemon
+flag nobody reasoned about). Exactly the ``leaked-thread`` check must
+flag this package.
+"""
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        t = threading.Thread(target=self._poll)  # seeded: never joined
+        self._thread = t
+        t.start()
+
+    def _poll(self):
+        pass

@@ -1,0 +1,24 @@
+"""palock fixture: seeded LOCK-ORDER-CYCLE defect.
+
+``ab`` nests a→b while ``ba`` nests b→a: two threads running them
+concurrently deadlock. The static acquisition graph has the 2-cycle;
+exactly the ``lock-order-cycle`` check must flag this package.
+"""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                self.x += 1
+
+    def ba(self):
+        with self._b:  # seeded defect: inverted acquisition order
+            with self._a:
+                self.x += 1

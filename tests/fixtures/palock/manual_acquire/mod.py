@@ -1,0 +1,18 @@
+"""palock fixture: seeded MANUAL-ACQUIRE defect.
+
+``.acquire()`` with no ``try/finally`` release: an exception between
+the two calls leaks the lock forever. Exactly the ``manual-acquire``
+check must flag this package.
+"""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def put(self, v):
+        self._lock.acquire()  # seeded defect: no try/finally
+        self.value = v
+        self._lock.release()

@@ -1,0 +1,21 @@
+"""palock fixture: seeded UNGUARDED-SHARED-ACCESS defect.
+
+``count`` is written under the lock in one method and read bare in
+another — the torn-read/lost-update class the guarded-by inference
+exists to catch. Exactly the ``unguarded-shared-access`` check (and no
+other) must flag this package.
+"""
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def read(self):
+        return self.count  # seeded defect: bare read of a guarded attr

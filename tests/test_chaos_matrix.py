@@ -103,6 +103,13 @@ recovery ladder forks on ``PA_ELASTIC`` instead of burning budget:
 |-------------------------|---------------------|----------------------|
 | part loss, PA_ELASTIC=1 | exchange choke point (part_loss clause) | elastic shrink onto the survivor grid + resume from the last chunk checkpoint: elastic_shrink/checkpoint_restore/restart events, elastic.shrink{reason=part_loss} + elastic.crosspart_restores deltas, a tenant.repartition span, info["elastic"] ledger — and the NEXT full-capacity solve emits elastic_restore (grow back) |
 | part loss, PA_ELASTIC=0 | exchange choke point (part_loss clause) | typed PartLossError escalates IMMEDIATELY to the caller's checkpoint tier — zero restarts attempted (no silent same-partition retry loop), no restart events, restart budget untouched |
+
+Round 20 (palock): the THREAD-LIFECYCLE row — the leak class the
+static leaked-thread check forbids at the AST level, asserted live:
+
+| condition               | detector            | documented outcome   |
+|-------------------------|---------------------|----------------------|
+| drained shutdown of every thread-spawning component (SolveService worker, FleetMember beat/watch) | palock leaked-thread check + this row | zero live threads survive: shutdown(drain=True) joins the worker after finishing the queue, FleetMember.stop() joins beat+watch; the process-wide live-thread set returns to its pre-start baseline (no non-daemon thread may outlive its owner — daemon spawns need a DAEMON_WAIVERS reason) |
 """
 import numpy as np
 import pytest
@@ -1481,6 +1488,64 @@ def test_matrix_part_loss_without_elastic_escalates_typed(monkeypatch):
         assert m1["events.restart"] - m0["events.restart"] == 0
         assert m1["events.elastic_shrink"] \
             - m0["events.elastic_shrink"] == 0
+        return True
+
+    _run(driver)
+
+
+# ---------------------------------------------------------------------------
+# round 20: palock — thread lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_matrix_drained_shutdown_leaves_zero_live_threads(tmp_path):
+    """Palock row: the thread-shutdown audit, live. Every component
+    that spawns threads (the service worker, the fleet member's
+    beat/watch pair) must return the process to its pre-start
+    live-thread baseline on a drained shutdown/stop — the dynamic twin
+    of the static leaked-thread check (which proves, at the AST level,
+    that every `threading.Thread` in the package has a join on some
+    shutdown path; DAEMON_WAIVERS is empty because nothing needs
+    waiving)."""
+    import os
+    import threading
+
+    from partitionedarrays_jl_tpu.frontdoor import Gate, fleet
+    from partitionedarrays_jl_tpu.service import SolveService
+
+    def driver(parts):
+        A, b, x_exact, x0 = assemble_poisson(parts, (8, 8))
+        baseline = set(threading.enumerate())
+        # -- the service worker: start -> submit -> drained shutdown --
+        svc = SolveService(A, kmax=2).start()
+        h = svc.submit(b, x0=x0, tol=1e-9)
+        stats = svc.shutdown(drain=True)
+        assert stats["completed"] == 1 and h.result()[1]["converged"]
+        assert not svc._worker.is_alive()
+        # -- the fleet member's beat/watch pair: start -> stop --------
+        fd = str(tmp_path / "fleet")
+        os.makedirs(os.path.join(fd, "g0"), exist_ok=True)
+        gate = Gate(journal_dir=os.path.join(fd, "g0"),
+                    rid_namespace="g0")
+        member = fleet.FleetMember(fd, "g0", gate, lease_s=0.05).start()
+        assert any(
+            t.name.startswith("pafleet-") for t in threading.enumerate()
+        )
+        member.stop()
+        assert member._threads == []
+        # -- the baseline holds: nothing outlived its owner -----------
+        leaked = [
+            t for t in threading.enumerate()
+            if t not in baseline and t.is_alive()
+        ]
+        assert leaked == [], f"threads outlived shutdown: {leaked}"
+        # non-daemon leaks would also hang interpreter exit — assert
+        # the stronger process-wide property directly
+        assert [
+            t for t in threading.enumerate()
+            if not t.daemon and t is not threading.main_thread()
+            and t not in baseline
+        ] == []
         return True
 
     _run(driver)

@@ -1,0 +1,510 @@
+"""palock — static concurrency & durability-ordering analysis
+(`analysis.lock_model` + `analysis.concurrency_lint`) and its runtime
+half (`utils.locksan`, ``PA_LOCK_CHECK=1``).
+
+The contracts pinned here:
+
+* **Model soundness** — the whole-package lock/thread inventory names
+  every serving-stack lock, sees every spawn's join path, infers
+  "callers hold self._lock" helper entry conditions, and the static
+  acquisition graph is ACYCLIC (the deadlock argument) with the
+  expected cross-subsystem edges.
+* **The teeth** — each of the six committed seeded-defect fixtures
+  trips EXACTLY its check (and the clean twin none): the paplan
+  convention, so a refactor that blinds a check fails loudly.
+* **Real package clean-or-waivered** — `lint_concurrency()` is green;
+  every waiver carries a real reason AND still names a live finding
+  (no stale waivers); the `concurrency-soundness` /
+  `durability-ordering` contracts are registered and green.
+* **Write-ahead, proven** — the PR 12 durability rules pass on the
+  real package, and the seeded ack-before-append mutant fails.
+* **Dynamic cross-check** — under ``PA_LOCK_CHECK=1`` the gate/service
+  hammer's OBSERVED acquisition edges are cycle-free and a subset of
+  the static graph (static says "no cycle possible", dynamic says
+  "the model matches reality").
+* **Overhead** — ``PA_LOCK_CHECK=0`` is inert (`sanitized` returns the
+  raw lock), the solver path never reads PA_LOCK*, and the block
+  program lowers to byte-identical StableHLO either way.
+* **Regressions** — the first-run findings fixed in this round (the
+  `SolveService.stats` read-modify-write races, the bare
+  `Registry.counter_value` read) stay fixed, by name.
+
+Budget note: everything host-path runs on the sequential backend's
+tiny Poisson fixtures; only the HLO pin touches a device program.
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import partitionedarrays_jl_tpu as pa
+from partitionedarrays_jl_tpu.analysis import concurrency_lint as cl
+from partitionedarrays_jl_tpu.analysis import env_lint
+from partitionedarrays_jl_tpu.analysis.concurrency_lint import (
+    BLOCKING_WAIVERS,
+    CHECK_IDS,
+    DAEMON_WAIVERS,
+    DURABILITY_RULES,
+    FIXTURE_DURABILITY_RULES,
+    MANUAL_WAIVERS,
+    SEEDED_FIXTURES,
+    UNGUARDED_WAIVERS,
+    concurrency_report,
+    lint_concurrency,
+)
+from partitionedarrays_jl_tpu.analysis.contracts import contract_by_name
+from partitionedarrays_jl_tpu.analysis.lock_model import (
+    build_model,
+    static_edges,
+)
+from partitionedarrays_jl_tpu.models import assemble_poisson
+from partitionedarrays_jl_tpu.utils import locksan
+from partitionedarrays_jl_tpu.utils.locksan import find_cycle, sanitized
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "palock")
+
+
+def _run(driver):
+    assert pa.prun(driver, pa.sequential, (2, 2))
+
+
+# ---------------------------------------------------------------------------
+# the lock model
+# ---------------------------------------------------------------------------
+
+#: Every serving-stack lock the model must inventory, with its kind —
+#: a lock that silently drops out of the model is a lint blind spot.
+EXPECTED_LOCKS = {
+    "Gate._lock": "RLock",
+    "GateServer._hlock": "Lock",
+    "OperatorRegistry._lock": "RLock",
+    "Registry.lock": "RLock",
+    "RequestJournal._lock": "Lock",
+    "SolveService._lock": "RLock",
+    "tracing._lock": "Lock",
+}
+
+
+def test_model_inventories_serving_locks_and_threads():
+    rep = concurrency_report()
+    for name, kind in EXPECTED_LOCKS.items():
+        assert name in rep["locks"], f"lock {name} fell out of the model"
+        assert rep["locks"][name]["kind"] == kind
+    # every spawn in the package is joined on some shutdown path (the
+    # thread-shutdown audit: DAEMON_WAIVERS is empty because nothing
+    # needs waiving)
+    assert rep["threads"], "no thread spawns seen — scanner rot"
+    for sp in rep["threads"]:
+        assert sp["joined"], f"unjoined spawn: {sp}"
+    spawns = {sp["spawn"] for sp in rep["threads"]}
+    assert {"SolveService.start", "GateServer.start",
+            "FleetMember.start"} <= spawns
+
+
+def test_model_entry_held_inference_sees_helper_indirection():
+    """Private helpers whose EVERY intra-class call site holds the lock
+    inherit it as an entry condition — the env_lint-style indirection
+    the guarded-by map must see through."""
+    held = concurrency_report()["entry_held"]
+    for qual, lock in [
+        ("frontdoor/scheduler.py:Gate._idem_hit", "Gate._lock"),
+        ("service/service.py:SolveService._pop_slab",
+         "SolveService._lock"),
+        ("frontdoor/journal.py:RequestJournal._rotate",
+         "RequestJournal._lock"),
+    ]:
+        key = f"partitionedarrays_jl_tpu/{qual}"
+        assert key in held, f"entry-held inference lost {qual}"
+        assert lock in held[key]
+
+
+def test_static_graph_expected_edges_and_no_cycle():
+    """The static deadlock argument: the acquisition graph carries the
+    documented cross-subsystem edges and NO cycle. Every edge quotes
+    the module:line call chain that witnesses it."""
+    edges = static_edges(build_model())
+    for e in [
+        ("Gate._lock", "SolveService._lock"),
+        ("Gate._lock", "RequestJournal._lock"),
+        ("OperatorRegistry._lock", "Gate._lock"),
+        ("RequestJournal._lock", "Registry.lock"),
+        ("SolveService._lock", "Registry.lock"),
+    ]:
+        assert e in edges, f"static edge {e} vanished"
+    for (a, b), (module, line, via) in edges.items():
+        assert module.endswith(".py") and line > 0 and "->" in via
+    assert find_cycle(list(edges)) is None
+
+
+def test_find_cycle_detects_and_reports_a_seeded_cycle():
+    cyc = find_cycle([("a", "b"), ("b", "c"), ("c", "a"), ("c", "d")])
+    assert cyc is not None
+    assert cyc[0] == cyc[-1]
+    assert set(cyc) == {"a", "b", "c"}
+    assert find_cycle([("a", "b"), ("b", "c"), ("a", "c")]) is None
+
+
+# ---------------------------------------------------------------------------
+# the teeth: seeded-defect fixtures (the paplan convention)
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_set_covers_every_check():
+    assert set(SEEDED_FIXTURES.values()) == set(CHECK_IDS)
+
+
+def test_clean_fixture_no_findings():
+    out = lint_concurrency(
+        os.path.join(FIXTURES, "clean"),
+        durability_rules=FIXTURE_DURABILITY_RULES,
+    )
+    assert out == [], "\n".join(out)
+
+
+@pytest.mark.parametrize("fixture,expected", sorted(SEEDED_FIXTURES.items()))
+def test_seeded_fixture_trips_exactly_its_check(fixture, expected):
+    """Each committed defect trips its check and NO other (the negative
+    half: a check that starts over-firing fails here too), and every
+    finding quotes file:line."""
+    rules = (
+        FIXTURE_DURABILITY_RULES if fixture == "ack_before_append" else ()
+    )
+    out = lint_concurrency(
+        os.path.join(FIXTURES, fixture), durability_rules=rules
+    )
+    assert out, f"seeded defect in {fixture} not caught"
+    tripped = {s.split("]")[0].lstrip("[") for s in out}
+    assert tripped == {expected}, out
+    for finding in out:
+        assert "mod.py:" in finding, finding  # file:line quoted
+
+
+# ---------------------------------------------------------------------------
+# the real package: clean or waivered, waivers honest
+# ---------------------------------------------------------------------------
+
+
+def test_real_package_lint_green():
+    """The acceptance gate: `tools/palock.py --check` in-process."""
+    out = lint_concurrency()
+    assert out == [], "\n".join(out)
+
+
+def test_durability_rules_prove_write_ahead():
+    """The PR 12 invariant, statically: every journal-acked transition
+    has a rule, every rule carries its why, and all pass (the
+    ack-before-append fixture proves the same machinery FAILS on the
+    inverted order)."""
+    assert len(DURABILITY_RULES) >= 6
+    transitions = {r.transition for r in DURABILITY_RULES}
+    assert {"admitted", "terminal", "adopted", "record"} <= transitions
+    for r in DURABILITY_RULES:
+        assert len(r.why) > 20, f"rule {r.qualname} needs a real why"
+    out = lint_concurrency(checks=["durability-ordering"])
+    assert out == [], "\n".join(out)
+
+
+def test_contracts_registered_and_green():
+    for name in ("concurrency-soundness", "durability-ordering"):
+        c = contract_by_name(name)
+        assert c is not None, f"contract {name} not registered"
+        violations = c.check({}, {})
+        assert violations == [], violations
+
+
+def test_waivers_carry_reasons_and_are_not_stale():
+    """The NON_LOWERING hygiene rules, applied to palock's tables:
+    every waiver carries a >20-char reason AND suppresses a finding
+    that still EXISTS (run unwaivered, each key must reappear) — a
+    waiver for fixed code is deleted, not kept as armor."""
+    for table in (UNGUARDED_WAIVERS, BLOCKING_WAIVERS, DAEMON_WAIVERS,
+                  MANUAL_WAIVERS):
+        for key, reason in table.items():
+            assert len(reason) > 20, f"waiver {key} needs a real reason"
+    blob = "\n".join(lint_concurrency(use_waivers=False))
+    for key in UNGUARDED_WAIVERS:
+        assert repr(key) in blob, f"stale unguarded waiver: {key}"
+    for lock, prim in BLOCKING_WAIVERS:
+        assert repr(lock) in blob and repr(prim) in blob, (
+            f"stale blocking waiver: ({lock}, {prim})"
+        )
+    # the empty tables stay empty until something real needs them —
+    # the fixtures prove both checks still bite
+    assert not DAEMON_WAIVERS and not MANUAL_WAIVERS
+
+
+# ---------------------------------------------------------------------------
+# regressions: the first-run findings, fixed by name
+# ---------------------------------------------------------------------------
+
+
+def test_regression_service_stats_bump_exact_under_contention():
+    """unguarded-shared-access, fixed: `SolveService.stats` ticks were
+    bare ``+= 1`` read-modify-writes racing the worker thread against
+    synchronous drivers (first-run palock finding). `_bump` routes
+    every tick through the service lock — N threads of ticks land
+    exactly."""
+    from partitionedarrays_jl_tpu.service import SolveService
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A)
+        N_THREADS, N_TICKS = 4, 500
+
+        def work():
+            for _ in range(N_TICKS):
+                svc._bump("completed")
+
+        threads = [threading.Thread(target=work) for _ in range(N_THREADS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert svc.stats["completed"] == N_THREADS * N_TICKS
+        return True
+
+    _run(driver)
+
+
+def test_regression_counter_value_locked_read():
+    """unguarded-shared-access, fixed: `Registry.counter_value` read
+    the metrics dict bare while worker threads register counters
+    (first-run palock finding). The lint pins the fix: no
+    unguarded-shared-access finding may name the registry or the
+    service stats again."""
+    out = lint_concurrency(checks=["unguarded-shared-access"])
+    blob = "\n".join(out)
+    assert "Registry._metrics" not in blob, blob
+    assert "SolveService.stats" not in blob, blob
+    assert out == [], blob
+
+
+# ---------------------------------------------------------------------------
+# the dynamic cross-check: hammers under PA_LOCK_CHECK=1
+# ---------------------------------------------------------------------------
+
+
+def test_hammer_service_under_sanitizer(monkeypatch):
+    """The PR 10 worker-thread smoke, re-run with the lock sanitizer
+    live: two submitter threads race the background worker; the
+    observed acquisition log must be cycle-free and consistent with
+    the static graph."""
+    from partitionedarrays_jl_tpu.service import SolveService
+
+    monkeypatch.setenv("PA_LOCK_CHECK", "1")
+    locksan.reset_observations()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        svc = SolveService(A, kmax=2).start()
+        handles, errors = [], []
+
+        def submit():
+            try:
+                handles.append(svc.submit(b, x0=x0, tol=1e-9))
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        threads = [threading.Thread(target=submit) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        svc.shutdown(drain=True)
+        assert not errors
+        assert all(h.result()[1]["converged"] for h in handles)
+        return True
+
+    _run(driver)
+    events = locksan.observed_events()
+    assert events, "sanitizer recorded nothing — the shim fell off"
+    assert any(lock == "SolveService._lock" for _, _, lock, _ in events)
+    obs = locksan.observed_edges()
+    static = set(static_edges(build_model()))
+    assert obs <= static, f"observed order outside the static graph: " \
+                          f"{obs - static}"
+    assert find_cycle(sorted(obs)) is None
+
+
+def test_hammer_gate_under_sanitizer(monkeypatch, tmp_path):
+    """The PR 14/15 gate hammer under the sanitizer: two submitter
+    threads race admission (journal append under the gate lock), then
+    a drain. The observed edges must include the write-ahead nesting
+    Gate._lock -> RequestJournal._lock, stay inside the static graph,
+    and carry no cycle; nesting depth >= 2 proves the cross-lock
+    window was actually exercised."""
+    from partitionedarrays_jl_tpu.frontdoor import Gate
+
+    monkeypatch.setenv("PA_LOCK_CHECK", "1")
+    locksan.reset_observations()
+
+    def driver(parts):
+        A, b, xe, x0 = assemble_poisson(parts, (8, 8))
+        gate = Gate(journal_dir=str(tmp_path / "j"))
+        gate.register("t", A, kmax=2)
+        handles, errors = [], []
+
+        def submit(i):
+            try:
+                handles.append(
+                    gate.submit("t", b, x0=x0, tol=1e-9, tag=f"h{i}")
+                )
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=submit, args=(i,)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        gate.drain()
+        assert not errors
+        assert all(h.result()[1]["converged"] for h in handles)
+        return True
+
+    _run(driver)
+    obs = locksan.observed_edges()
+    assert ("Gate._lock", "RequestJournal._lock") in obs
+    static = set(static_edges(build_model()))
+    assert obs <= static, f"observed order outside the static graph: " \
+                          f"{obs - static}"
+    assert find_cycle(sorted(obs)) is None
+    assert locksan.observed_max_nesting() >= 2
+
+
+# ---------------------------------------------------------------------------
+# overhead: inert fast path + byte-identical programs
+# ---------------------------------------------------------------------------
+
+
+def test_sanitizer_fast_path_returns_raw_lock(monkeypatch):
+    """PA_LOCK_CHECK unset/0 must cost ONE env read at construction and
+    zero per acquisition: `sanitized` returns the raw lock object
+    itself, not a shim."""
+    monkeypatch.delenv("PA_LOCK_CHECK", raising=False)
+    raw = threading.Lock()
+    assert sanitized(raw, "T._lock") is raw
+    monkeypatch.setenv("PA_LOCK_CHECK", "0")
+    assert sanitized(raw, "T._lock") is raw
+    monkeypatch.setenv("PA_LOCK_CHECK", "1")
+    shim = sanitized(raw, "T._lock")
+    assert shim is not raw
+    locksan.reset_observations()
+    with shim:
+        pass
+    assert any(
+        lock == "T._lock" for _, _, lock, _ in locksan.observed_events()
+    )
+    locksan.reset_observations()
+
+
+def test_sanitized_lock_supports_condition_protocol(monkeypatch):
+    """The service binds ``Condition(self._lock)`` — the shim must
+    forward the private wait/notify protocol, popping every RLock
+    recursion level on wait and restoring it after."""
+    monkeypatch.setenv("PA_LOCK_CHECK", "1")
+    locksan.reset_observations()
+    lock = sanitized(threading.RLock(), "T._lock")
+    cv = threading.Condition(lock)
+    with lock:
+        with lock:  # re-entrant: two bookkeeping levels to pop
+            assert cv.wait(timeout=0.01) is False
+            cv.notify_all()
+    inner = sanitized(threading.Lock(), "T._inner")
+    with lock:
+        with inner:
+            pass
+    assert ("T._lock", "T._inner") in locksan.observed_edges()
+    assert locksan.observed_max_nesting() >= 2
+    locksan.reset_observations()
+
+
+def test_pa_lock_check_exempt_and_read_only_in_locksan():
+    """The flag is NON_LOWERING (documented reason) and its only reads
+    live in utils/locksan.py — the solver path never sees it."""
+    assert "PA_LOCK_CHECK" in env_lint.NON_LOWERING
+    assert len(env_lint.NON_LOWERING["PA_LOCK_CHECK"]) > 20
+    reads = [
+        r for r in env_lint.env_read_inventory()
+        if r.name == "PA_LOCK_CHECK"
+    ]
+    assert reads, "PA_LOCK_CHECK reads vanished — stale exemption"
+    for r in reads:
+        assert r.path.endswith("utils/locksan.py"), r
+
+
+def test_lock_check_block_program_hlo_identical(monkeypatch):
+    """The overhead pin: the compiled block body lowers to
+    byte-identical StableHLO with the sanitizer fully enabled vs off —
+    PA_LOCK_CHECK is host-side observability, invisible to lowering
+    (the PR 6/9/10 convention)."""
+    import jax
+
+    from partitionedarrays_jl_tpu.parallel.tpu import (
+        TPUBackend,
+        _matrix_operands,
+        device_matrix,
+        make_cg_fn,
+    )
+
+    backend = TPUBackend(devices=jax.devices()[:8])
+    A = pa.prun(
+        lambda parts: assemble_poisson(parts, (6, 6, 6))[0],
+        backend, (2, 2, 2),
+    )
+    dA = device_matrix(A, backend)
+    ops = _matrix_operands(dA)
+    P, W = dA.col_plan.layout.P, dA.col_plan.layout.W
+    zb = np.zeros((P, W, 2))
+
+    def text():
+        fn = make_cg_fn(dA, tol=1e-9, maxiter=50, rhs_batch=2)
+        return fn.jit_fn.lower(zb, zb, zb[..., 0], ops).as_text()
+
+    monkeypatch.setenv("PA_LOCK_CHECK", "0")
+    baseline = text()
+    monkeypatch.setenv("PA_LOCK_CHECK", "1")
+    assert text() == baseline
+
+
+# ---------------------------------------------------------------------------
+# the CLI
+# ---------------------------------------------------------------------------
+
+
+def _load_palock():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "palock", os.path.join(REPO, "tools", "palock.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_palock_check_smoke(capsys):
+    rc = _load_palock().main(["--check"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "palock: OK" in out
+
+
+def test_palock_fixtures_smoke(capsys):
+    rc = _load_palock().main(["--fixtures"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    for name in SEEDED_FIXTURES:
+        assert f"ok  {name}" in out
+
+
+def test_lint_module_reexported_from_analysis():
+    import partitionedarrays_jl_tpu.analysis as analysis
+
+    assert analysis.lint_concurrency is cl.lint_concurrency
+    assert analysis.CHECK_IDS is CHECK_IDS
+    assert analysis.find_cycle is find_cycle

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""palock — the static concurrency & durability-ordering gate.
+
+Runs `analysis.concurrency_lint` over the whole package and exits
+nonzero on any finding:
+
+* **unguarded-shared-access** — an attribute written under a lock in
+  one method and touched bare in another (guarded-by inference sees
+  through "callers hold self._lock" helper indirection);
+* **lock-order-cycle** — a cycle in the static acquisition graph
+  across the registry/service/gate/journal/fleet locks (the static
+  deadlock argument);
+* **blocking-under-lock** — fsync/sleep/socket/solve reachable inside
+  a lock region (reasoned waivers in `BLOCKING_WAIVERS`);
+* **manual-acquire** — ``.acquire()`` without try/finally;
+* **leaked-thread** — a spawn neither joined on shutdown nor covered
+  by a reasoned daemon waiver;
+* **durability-ordering** — the PR 12 write-ahead invariant proven as
+  branch-aware dominance: every journal-acked transition's fsync'd
+  append dominates its client-visible ack (`DURABILITY_RULES`), and
+  ``_raw_state`` stays private to frontdoor/scheduler.py.
+
+Every finding quotes file:line and the inferred guard. The runtime
+half (``PA_LOCK_CHECK=1``, `utils.locksan`) cross-checks the static
+graph against observed acquisition order in tests/test_palock.py.
+
+Usage:
+    python tools/palock.py --check       # the gate (CI / tier-1)
+    python tools/palock.py --report      # model inventory as JSON
+    python tools/palock.py --fixtures    # seeded-defect self-test
+
+The lint is pure AST analysis (no jax import on the --check path
+beyond the package's own import graph); it runs on the CPU mesh like
+every other tool here.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _setup_jax():
+    # same pattern as tools/palint.py: the package import graph reaches
+    # jax, so pin the virtual CPU mesh before anything imports it.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def _run_fixtures() -> int:
+    """Self-test: each committed seeded-defect fixture must trip
+    exactly its check, and the clean fixture none (the paplan
+    convention — proof the teeth still bite)."""
+    from partitionedarrays_jl_tpu.analysis.concurrency_lint import (
+        FIXTURE_DURABILITY_RULES,
+        SEEDED_FIXTURES,
+        lint_concurrency,
+    )
+
+    base = os.path.join(REPO, "tests", "fixtures", "palock")
+    failures = 0
+    clean = lint_concurrency(
+        os.path.join(base, "clean"),
+        durability_rules=FIXTURE_DURABILITY_RULES,
+    )
+    if clean:
+        failures += 1
+        print("FAIL clean fixture flagged:")
+        for s in clean:
+            print("   ", s)
+    else:
+        print("ok  clean: no findings")
+    for name, expected in sorted(SEEDED_FIXTURES.items()):
+        rules = (
+            FIXTURE_DURABILITY_RULES
+            if name == "ack_before_append" else ()
+        )
+        found = lint_concurrency(
+            os.path.join(base, name), durability_rules=rules
+        )
+        checks = {s.split("]")[0].lstrip("[") for s in found}
+        if checks == {expected}:
+            print(f"ok  {name}: exactly [{expected}]")
+        else:
+            failures += 1
+            print(f"FAIL {name}: expected [{expected}], got {sorted(checks)}")
+            for s in found:
+                print("   ", s)
+    return failures
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument("--check", action="store_true",
+                    help="run the lint; exit nonzero on any finding")
+    ap.add_argument("--report", action="store_true",
+                    help="print the lock/thread/edge inventory as JSON")
+    ap.add_argument("--fixtures", action="store_true",
+                    help="run the seeded-defect fixture self-test")
+    args = ap.parse_args(argv)
+    if not (args.check or args.report or args.fixtures):
+        ap.error("pick at least one of --check / --report / --fixtures")
+
+    _setup_jax()
+    failures = 0
+
+    if args.fixtures:
+        failures += _run_fixtures()
+
+    if args.report:
+        from partitionedarrays_jl_tpu.analysis.concurrency_lint import (
+            concurrency_report,
+        )
+
+        print(json.dumps(concurrency_report(), indent=2, default=str))
+
+    if args.check:
+        from partitionedarrays_jl_tpu.analysis.concurrency_lint import (
+            lint_concurrency,
+        )
+
+        violations = lint_concurrency()
+        for v in violations:
+            print(v)
+        failures += len(violations)
+        if not violations:
+            print("palock: OK (all six checks clean or waivered)")
+
+    if failures:
+        print(f"palock: FAILED ({failures} finding(s))")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
